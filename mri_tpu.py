@@ -1,0 +1,12 @@
+"""Short import alias: ``import mri_tpu`` == the full framework package.
+
+The canonical package name mirrors the reference repo
+(parallel_computation_of_an_inverted_index_using_map_reduce_tpu); this
+alias exists purely for ergonomics.
+"""
+
+import sys as _sys
+
+import parallel_computation_of_an_inverted_index_using_map_reduce_tpu as _pkg
+
+_sys.modules[__name__] = _pkg

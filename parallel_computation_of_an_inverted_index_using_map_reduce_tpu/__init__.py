@@ -1,0 +1,43 @@
+"""TPU-native inverted-index MapReduce framework.
+
+A ground-up re-design of the capabilities of
+rares46/Parallel-Computation-Of-An-Inverted-Index-Using-Map-Reduce
+(reference: /root/reference/main.c, a pthread fork-join MapReduce) as an
+idiomatic JAX/XLA pipeline:
+
+- host frontend: corpus manifest + vectorized tokenizer + sorted vocab
+  (reference map phase, main.c:85-124)
+- device engine: ``lax.sort`` over packed (term, doc) pairs, boundary
+  unique, segmented document-frequency reduction, emit-order sort
+  (reference reduce phase, main.c:126-242)
+- host emit: byte-identical ``<letter>.txt`` postings files
+  (format of main.c:227-234)
+- multi-chip (``parallel/``): ``shard_map`` over a 1-D mesh with a
+  hash-bucket ``all_to_all`` shuffle replacing the reference's 26 spill
+  files (main.c:332-341)
+
+Import alias: ``import mri_tpu`` re-exports this package.
+"""
+
+__version__ = "0.1.0"
+
+from .config import IndexConfig
+from .corpus.manifest import Manifest, read_manifest, write_manifest, manifest_from_dir
+from .text.tokenizer import TokenizedCorpus, tokenize_corpus, clean_token
+from .models.inverted_index import InvertedIndexModel, build_index
+from .models.oracle import oracle_index
+
+__all__ = [
+    "IndexConfig",
+    "Manifest",
+    "read_manifest",
+    "write_manifest",
+    "manifest_from_dir",
+    "TokenizedCorpus",
+    "tokenize_corpus",
+    "clean_token",
+    "InvertedIndexModel",
+    "build_index",
+    "oracle_index",
+    "__version__",
+]

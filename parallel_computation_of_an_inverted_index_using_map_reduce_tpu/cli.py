@@ -1,0 +1,66 @@
+"""Command-line driver, compatible with the reference's invocation.
+
+Reference: ``./tema1 <num_mappers> <num_reducers> <input_file>``
+(main.c:248-255, README.md).  Here the same three positionals work —
+outputs a.txt..z.txt land in the CWD by default, exactly like the
+reference — plus flags for the TPU-era knobs:
+
+    python -m parallel_computation_of_an_inverted_index_using_map_reduce_tpu \
+        4 26 test_small.txt --backend=tpu --output-dir=out --stats
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .config import IndexConfig
+from .corpus.manifest import read_manifest
+from .models.inverted_index import build_index
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mri-tpu",
+        description="TPU-native inverted-index MapReduce",
+    )
+    p.add_argument("num_mappers", type=int,
+                   help="host shard count (reference mapper threads; output-invariant)")
+    p.add_argument("num_reducers", type=int,
+                   help="reduce partition count (reference reducer threads; output-invariant)")
+    p.add_argument("file_list", help="manifest: count header then one path per line")
+    p.add_argument("--backend", choices=("tpu", "oracle"), default="tpu")
+    p.add_argument("--output-dir", default=".", help="where a.txt..z.txt are written (default: CWD)")
+    p.add_argument("--pad-multiple", type=int, default=1 << 16)
+    p.add_argument("--checkpoint", default=None,
+                   help="save/resume the tokenized map-phase pairs at this path")
+    p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
+    p.add_argument("--stats", action="store_true", help="print a JSON stats line to stdout")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = make_parser().parse_args(argv)
+    try:
+        manifest = read_manifest(args.file_list)
+        config = IndexConfig(
+            num_mappers=args.num_mappers,
+            num_reducers=args.num_reducers,
+            backend=args.backend,
+            output_dir=args.output_dir,
+            pad_multiple=args.pad_multiple,
+            checkpoint_path=args.checkpoint,
+            profile_dir=args.profile_dir,
+        )
+        stats = build_index(manifest, config)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.stats:
+        print(json.dumps(stats, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

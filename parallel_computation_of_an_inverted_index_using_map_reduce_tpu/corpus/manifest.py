@@ -1,0 +1,115 @@
+"""Corpus manifest: the input-list format and doc-id assignment.
+
+Reference behavior being reproduced (main.c:257-298):
+
+- list file format: first line = file count, then one path per line,
+  resolved relative to the current working directory (test_small.txt:1-4)
+- doc ids are the **1-based position in the list** (assigned in read order
+  at main.c:275, before any size sort; emitted as ``id + 1`` at main.c:116)
+- each file is ``stat``-ed for its size (main.c:289-296); a missing file
+  gets a warning and size 0 but stays in the manifest (it is still indexed
+  later if it turns out to be openable)
+- an unreadable file at map time is warned about and skipped
+  (main.c:97-100) — handled by the tokenizer loader, not here
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class Manifest:
+    """Ordered corpus file list.  ``doc_id`` of ``paths[i]`` is ``i + 1``."""
+
+    paths: tuple[str, ...]
+    sizes: tuple[int, ...]
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.sizes)
+
+    def doc_id(self, index: int) -> int:
+        return index + 1
+
+
+def _stat_size(path: str) -> int:
+    try:
+        return os.stat(path).st_size
+    except OSError:
+        print(f"warning: cannot stat {path!r}; keeping it with size 0", file=sys.stderr)
+        return 0
+
+
+def read_manifest(list_path: str | Path, base_dir: str | Path | None = None) -> Manifest:
+    """Read a count-header file list (format of test_small.txt:1-4).
+
+    ``base_dir`` defaults to the CWD, matching the reference, which opens
+    manifest paths relative to wherever it was launched.
+    """
+    base = Path(base_dir) if base_dir is not None else Path.cwd()
+    with open(list_path, "r", encoding="utf-8") as f:
+        tokens = f.read().split()
+    if not tokens:
+        raise ValueError(f"empty manifest {list_path!r}")
+    try:
+        count = int(tokens[0])
+    except ValueError as e:
+        raise ValueError(f"manifest {list_path!r} must start with a file count") from e
+    names = tokens[1 : 1 + count]
+    if len(names) < count:
+        raise ValueError(
+            f"manifest {list_path!r} declares {count} files but lists {len(names)}"
+        )
+    paths = tuple(str(p) if os.path.isabs(p) else str(base / p) for p in names)
+    sizes = tuple(_stat_size(p) for p in paths)
+    return Manifest(paths=paths, sizes=sizes)
+
+
+def write_manifest(manifest_path: str | Path, paths: list[str]) -> None:
+    """Write a file list in the reference's count-header format."""
+    with open(manifest_path, "w", encoding="utf-8") as f:
+        f.write(f"{len(paths)}\n")
+        for p in paths:
+            f.write(f"{p}\n")
+
+
+def manifest_from_dir(corpus_dir: str | Path, pattern: str = "**/*.txt") -> Manifest:
+    """Build a manifest by sorted recursive glob.
+
+    Sorted order reproduces the doc-id assignment used for the reference
+    baseline run (BASELINE.md: manifest generated as a sorted file list;
+    verified to give output md5 92600581e0685e69c056b65082326fc3 on
+    test_in).
+    """
+    root = Path(corpus_dir)
+    paths = sorted(str(p) for p in root.glob(pattern) if p.is_file())
+    if not paths:
+        raise ValueError(f"no files matching {pattern!r} under {corpus_dir!r}")
+    sizes = tuple(_stat_size(p) for p in paths)
+    return Manifest(paths=tuple(paths), sizes=sizes)
+
+
+def load_documents(manifest: Manifest) -> tuple[list[bytes], list[int]]:
+    """Read every manifest file, preserving doc ids for readable files.
+
+    Returns ``(contents, doc_ids)`` where unreadable files are warned about
+    and skipped (reference main.c:97-100) — their doc id simply never
+    appears in any postings list.
+    """
+    contents: list[bytes] = []
+    doc_ids: list[int] = []
+    for i, path in enumerate(manifest.paths):
+        try:
+            with open(path, "rb") as f:
+                contents.append(f.read())
+            doc_ids.append(manifest.doc_id(i))
+        except OSError:
+            print(f"warning: cannot open {path!r}; skipping", file=sys.stderr)
+    return contents, doc_ids

@@ -1,0 +1,81 @@
+"""Host-side sharding plans (the reference's scheduler, done safely).
+
+The reference sorts files by size descending (main.c:300) and greedily
+cuts contiguous ranges once a shard's byte total reaches
+``total / num_mappers`` (main.c:307-323).  With more mappers than files
+its range arrays stay uninitialized (UB; SURVEY.md §2.1 scheduler row).
+Reducers own contiguous letter ranges ``[26/R*id, 26/R*(id+1))`` with the
+remainder folded into the last reducer, so R > 26 collapses all letters
+onto the final reducer (main.c:129-130).
+
+Here both policies are explicit, total, and tested — and the *device*
+partition uses term hashing instead of letters, which removes the ~1000x
+letter skew measured in SURVEY.md §2.3.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..config import ALPHABET_SIZE
+from .manifest import Manifest
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """Per-shard index lists into a manifest (not necessarily contiguous)."""
+
+    shards: tuple[tuple[int, ...], ...]
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+
+def plan_host_shards(manifest: Manifest, num_shards: int) -> ShardPlan:
+    """LPT (longest-processing-time) balance of files across host shards.
+
+    Same goal as the reference's sort+greedy-cut (main.c:300-323) but a
+    proper LPT assignment: files sorted by size descending, each placed on
+    the currently lightest shard.  Total under any num_shards >= 1,
+    including num_shards > len(manifest) (empty shards, not UB).
+    """
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    order = sorted(range(len(manifest)), key=lambda i: (-manifest.sizes[i], i))
+    loads = [0] * num_shards
+    buckets: list[list[int]] = [[] for _ in range(num_shards)]
+    for i in order:
+        lightest = min(range(num_shards), key=lambda s: (loads[s], s))
+        buckets[lightest].append(i)
+        loads[lightest] += manifest.sizes[i]
+    return ShardPlan(shards=tuple(tuple(sorted(b)) for b in buckets))
+
+
+def plan_letter_ranges(num_reducers: int) -> tuple[tuple[int, int], ...]:
+    """Contiguous letter ranges per reduce partition.
+
+    Mirrors the reference's arithmetic (main.c:129-130) *including* its
+    degenerate R > 26 behavior (empty ranges for all but the last
+    partition) so conformance tests can cover it, since it is part of the
+    observable contract (SURVEY.md §2.3).
+    """
+    if num_reducers < 1:
+        raise ValueError("num_reducers must be >= 1")
+    per = ALPHABET_SIZE // num_reducers
+    ranges = []
+    for r in range(num_reducers):
+        start = per * r
+        end = per * (r + 1) if r < num_reducers - 1 else ALPHABET_SIZE
+        ranges.append((start, max(start, end)))
+    return tuple(ranges)
+
+
+def shard_balance_stats(manifest: Manifest, plan: ShardPlan) -> dict:
+    """Bytes per shard + imbalance ratio, for the metrics subsystem."""
+    loads = [sum(manifest.sizes[i] for i in shard) for shard in plan.shards]
+    mean = sum(loads) / len(loads) if loads else 0.0
+    return {
+        "bytes_per_shard": loads,
+        "max_over_mean": (max(loads) / mean) if mean else 0.0,
+    }

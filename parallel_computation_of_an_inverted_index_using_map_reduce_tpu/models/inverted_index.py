@@ -1,0 +1,151 @@
+"""The flagship model: end-to-end inverted-index pipeline.
+
+Orchestrates the full chain the reference runs as fork-join pthread
+phases (main.c:246-390):
+
+    manifest -> load docs -> tokenize (host) -> index (device) -> emit (host)
+
+with backends:
+    "tpu"    — sorted-vocab ids + packed-key device engine (ops/engine.py)
+    "oracle" — pure-Python dict oracle (models/oracle.py)
+
+Output is byte-identical across backends and to the pthread reference
+(conformance tests in tests/).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as np
+
+import jax
+
+from ..config import IndexConfig
+from ..utils import checkpoint
+from ..corpus.manifest import Manifest, load_documents
+from ..ops import engine
+from ..ops import keys as K
+from ..text import formatter
+from ..text.tokenizer import tokenize_documents
+from ..utils.timing import PhaseTimer
+from .oracle import oracle_index
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((max(n, 1) + multiple - 1) // multiple) * multiple
+
+
+class InvertedIndexModel:
+    """Reusable pipeline object (compiled engine state is cached by jit).
+
+    ``run`` is re-entrant: each call gets a fresh timer; ``self.timer``
+    holds the most recent run's.
+    """
+
+    def __init__(self, config: IndexConfig | None = None):
+        self.config = config or IndexConfig()
+        self.timer = PhaseTimer()
+
+    def run(self, manifest: Manifest, output_dir: str | None = None) -> dict:
+        cfg = self.config
+        self.timer = timer = PhaseTimer()
+        out_dir = output_dir if output_dir is not None else cfg.output_dir
+        if cfg.backend == "oracle":
+            with timer.phase("oracle"):
+                stats = oracle_index(manifest, out_dir)
+            return {**stats, **timer.report()}
+        return self._run_tpu(manifest, out_dir, timer)
+
+    # -- TPU backend ---------------------------------------------------
+
+    def _tokenize_or_resume(self, manifest: Manifest, timer: PhaseTimer):
+        ckpt = self.config.checkpoint_path
+        fp = checkpoint.manifest_fingerprint(manifest) if ckpt is not None else ""
+        if ckpt is not None and os.path.exists(ckpt):
+            with timer.phase("resume"):
+                corpus = checkpoint.load_pairs(ckpt, expect_fingerprint=fp)
+            timer.count("resumed_from", ckpt)
+            return corpus, 0
+        with timer.phase("load"):
+            contents, doc_ids = load_documents(manifest)
+        with timer.phase("tokenize"):
+            corpus = tokenize_documents(contents, doc_ids)
+        if ckpt is not None:
+            with timer.phase("checkpoint"):
+                checkpoint.save_pairs(ckpt, corpus, fingerprint=fp)
+        return corpus, len(contents)
+
+    def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
+        corpus, num_loaded = self._tokenize_or_resume(manifest, timer)
+
+        max_doc_id = len(manifest)  # doc ids are 1..len(manifest)
+        num_tokens, vocab_size = corpus.num_tokens, corpus.vocab_size
+        timer.count("documents", num_loaded)
+        timer.count("tokens", num_tokens)
+        timer.count("unique_terms", vocab_size)
+
+        if num_tokens == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        padded = _round_up(num_tokens, self.config.pad_multiple)
+        with timer.phase("feed"):
+            if K.can_pack(vocab_size, max_doc_id):
+                host_keys = np.full(padded, K.INT32_MAX, dtype=np.int32)
+                stride = max_doc_id + 2
+                np.multiply(corpus.term_ids, stride, out=host_keys[:num_tokens])
+                host_keys[:num_tokens] += corpus.doc_ids
+                keys_dev = jax.device_put(host_keys)
+                letters_dev = jax.device_put(corpus.letter_of_term)
+                packed = True
+            else:
+                term_dev = jax.device_put(
+                    np.concatenate([corpus.term_ids,
+                                    np.full(padded - num_tokens, K.INT32_MAX, np.int32)]))
+                doc_dev = jax.device_put(
+                    np.concatenate([corpus.doc_ids,
+                                    np.full(padded - num_tokens, K.INT32_MAX, np.int32)]))
+                letters_dev = jax.device_put(corpus.letter_of_term)
+                packed = False
+
+        profile = (
+            jax.profiler.trace(self.config.profile_dir)
+            if self.config.profile_dir
+            else contextlib.nullcontext()
+        )
+        with timer.phase("device_index"), profile:
+            if packed:
+                out = engine.index_packed(
+                    keys_dev, letters_dev, vocab_size=vocab_size, max_doc_id=max_doc_id)
+            else:
+                out = engine.index_pairs(
+                    term_dev, doc_dev, letters_dev,
+                    vocab_size=vocab_size, max_doc_id=max_doc_id)
+            out = jax.tree.map(lambda x: x.block_until_ready(), out)
+
+        with timer.phase("fetch"):
+            host = jax.device_get(out)
+
+        with timer.phase("emit"):
+            emit_stats = formatter.emit_index(
+                out_dir,
+                vocab=corpus.vocab,
+                letter_of_term=corpus.letter_of_term,
+                order=host["order"],
+                df=host["df"],
+                offsets=host["offsets"],
+                postings=host["postings"],
+                max_doc_id=max_doc_id,
+            )
+        timer.count("unique_pairs", int(host["num_unique"]))
+        timer.count("lines_written", emit_stats["lines_written"])
+        return timer.report()
+
+
+def build_index(manifest: Manifest, config: IndexConfig | None = None,
+                output_dir: str | None = None) -> dict:
+    """One-shot convenience: index a manifest and write the letter files."""
+    return InvertedIndexModel(config).run(manifest, output_dir)

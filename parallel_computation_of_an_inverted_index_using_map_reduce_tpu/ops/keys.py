@@ -1,0 +1,35 @@
+"""Packed-key helpers for the sort-based engine.
+
+The reference shuffles ``"word doc_id"`` text lines through 26 spill
+files (main.c:116) and re-parses them in the reducer (main.c:170).  On
+TPU both the pair and its ordering live in a single int32 radix-sort key
+whenever ``vocab_size * (max_doc_id + 2)`` fits in int32 — true even for
+corpora orders of magnitude beyond the reference's caps (MAX_FILES=360,
+main.c:8).  A two-key variadic ``lax.sort`` is the general fallback.
+
+Padding uses a sentinel that sorts after every real key so fixed-shape
+batches stay XLA-friendly (no dynamic shapes, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+INT32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def can_pack(vocab_size: int, max_doc_id: int) -> bool:
+    """True if (term, doc) pairs fit one int32 key with room for a sentinel."""
+    return (vocab_size + 1) * (max_doc_id + 2) < np.iinfo(np.int32).max
+
+
+def pack_pairs(term_ids, doc_ids, max_doc_id: int):
+    """key = term * (max_doc+2) + doc; key order == (term, doc) lex order."""
+    stride = max_doc_id + 2
+    return term_ids.astype(jnp.int32) * stride + doc_ids.astype(jnp.int32)
+
+
+def unpack_pairs(keys, max_doc_id: int):
+    stride = max_doc_id + 2
+    return keys // stride, keys % stride

@@ -1,0 +1,46 @@
+"""Segmented primitives over sorted key arrays.
+
+These replace the reference reducer's O(tokens x unique_words) linear
+dictionary scan and O(n^2) bubble sort (main.c:172-187, 217-226) with
+O(n) boundary diffs, cumsums and scatters over a sorted array — the
+shapes XLA fuses well on TPU (all elementwise + scan + scatter, no
+data-dependent shapes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def first_occurrence_mask(sorted_keys):
+    """mask[i] = sorted_keys[i] is the first of its run.
+
+    On a sorted pair array this is exactly the reference's per-(word, doc)
+    dedup (main.c:176-184): one True per unique pair.
+    """
+    prev = jnp.concatenate([sorted_keys[:1] - 1, sorted_keys[:-1]])
+    return sorted_keys != prev
+
+
+def segment_counts(segment_ids, weights, num_segments: int):
+    """Sum ``weights`` per segment id; ids >= num_segments are dropped.
+
+    Used for document frequency: df[t] = number of unique (t, doc) pairs
+    (the count the reference accumulates per dictionary entry at
+    main.c:176-187 and sorts by at main.c:55-64).
+    """
+    out = jnp.zeros((num_segments,), dtype=weights.dtype)
+    return out.at[segment_ids].add(weights, mode="drop")
+
+
+def compact(values, keep_mask, out_size: int, fill):
+    """Stable-compact ``values[keep_mask]`` into a fixed-size array.
+
+    Scatter to cumsum positions; dropped lanes go out of bounds.  The
+    result's first ``keep_mask.sum()`` slots are the kept values in
+    order, remaining slots are ``fill``.
+    """
+    pos = jnp.cumsum(keep_mask.astype(jnp.int32)) - 1
+    idx = jnp.where(keep_mask, pos, out_size)
+    out = jnp.full((out_size,), fill, dtype=values.dtype)
+    return out.at[idx].set(values, mode="drop")

@@ -1,0 +1,78 @@
+"""Byte-exact output emit: 26 ``<letter>.txt`` postings files.
+
+Reference format (main.c:227-234): one line per word,
+``word:[id1 id2 ... idN]\\n`` — ids space-separated, no trailing space,
+doc ids ascending (bubble sort at main.c:217-226), words ordered by
+document frequency descending then lexicographically ascending
+(comparator at main.c:55-64).  All 26 files are always created, even when
+empty (the reference always creates 26 spill files at main.c:332-341 and
+each reducer letter gets an output file at main.c:149-150).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from ..config import ALPHABET_SIZE
+
+
+def letter_filename(letter_index: int) -> str:
+    return f"{chr(ord('a') + letter_index)}.txt"
+
+
+def _doc_id_str_table(max_doc_id: int) -> np.ndarray:
+    """Doc ids repeat constantly across postings; pre-render each once."""
+    return np.array([str(i).encode("ascii") for i in range(max_doc_id + 1)], dtype=object)
+
+
+def emit_index(
+    output_dir: str | Path,
+    vocab: np.ndarray,            # (V,) numpy 'S' array, sorted
+    letter_of_term: np.ndarray,   # (V,) int
+    order: np.ndarray,            # (V,) term ids sorted by (letter, -df, term)
+    df: np.ndarray,               # (V,) document frequency per term id
+    offsets: np.ndarray,          # (V,) exclusive start of term's postings
+    postings: np.ndarray,         # (>=num pairs,) compacted ascending doc ids
+    max_doc_id: int,
+) -> dict:
+    """Write the 26 letter files from the device engine's output arrays."""
+    output_dir = Path(output_dir)
+    os.makedirs(output_dir, exist_ok=True)
+    id_strs = _doc_id_str_table(max_doc_id)
+    vocab_py = vocab.tolist()  # list[bytes]; plain indexing is faster than np scalar access
+    df = np.asarray(df)
+    offsets = np.asarray(offsets)
+    postings = np.asarray(postings)
+
+    letters_in_order = np.asarray(letter_of_term)[order]
+    bounds = np.searchsorted(letters_in_order, np.arange(ALPHABET_SIZE + 1))
+    lines_written = 0
+    for letter in range(ALPHABET_SIZE):
+        lo, hi = int(bounds[letter]), int(bounds[letter + 1])
+        out = bytearray()
+        for t in order[lo:hi].tolist():
+            n = int(df[t])
+            start = int(offsets[t])
+            out += vocab_py[t]
+            out += b":["
+            out += b" ".join(id_strs[postings[start : start + n]])
+            out += b"]\n"
+        with open(output_dir / letter_filename(letter), "wb") as f:
+            f.write(out)
+        lines_written += hi - lo
+    return {"lines_written": lines_written, "letters": ALPHABET_SIZE}
+
+
+def emit_grouped(output_dir: str | Path,
+                 per_letter: dict[int, list[tuple[bytes, list[int]]]]) -> None:
+    """Write letter files from already-ordered (word, ids) groups (oracle path)."""
+    output_dir = Path(output_dir)
+    os.makedirs(output_dir, exist_ok=True)
+    for letter in range(ALPHABET_SIZE):
+        entries = per_letter.get(letter, [])
+        with open(output_dir / letter_filename(letter), "wb") as f:
+            for word, ids in entries:
+                f.write(word + b":[" + " ".join(map(str, ids)).encode("ascii") + b"]\n")

@@ -1,0 +1,75 @@
+"""Map-phase checkpoint: durable tokenized pairs.
+
+The reference's spill files are accidentally a checkpoint — they persist
+after the run and the reduce phase could be re-run from them alone
+(SURVEY.md §5 "checkpoint/resume — absent, but latent").  Here that is a
+first-class artifact: the tokenized (term_ids, doc_ids, vocab) triple,
+saved once between the map and reduce phases, lets the device phase be
+re-run without touching the corpus.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+
+import numpy as np
+
+_FORMAT_VERSION = 2
+
+
+def manifest_fingerprint(manifest) -> str:
+    """Identity of the *file list* (count + paths), not file contents.
+
+    Resume deliberately trusts the checkpoint over the corpus bytes —
+    that is what makes re-running the reduce phase possible after the
+    corpus is gone, exactly like the reference's leftover spill files.
+    A changed file count or renamed path is a different corpus and is
+    rejected at load.
+    """
+    h = hashlib.md5()
+    h.update(str(len(manifest)).encode())
+    for p in manifest.paths:
+        h.update(b"\0" + p.encode("utf-8", "surrogateescape"))
+    return h.hexdigest()
+
+
+def save_pairs(path: str | Path, corpus, fingerprint: str = "") -> None:
+    """Atomically persist a TokenizedCorpus."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            version=np.int64(_FORMAT_VERSION),
+            fingerprint=np.bytes_(fingerprint.encode()),
+            term_ids=corpus.term_ids,
+            doc_ids=corpus.doc_ids,
+            vocab=corpus.vocab,
+            letter_of_term=corpus.letter_of_term,
+        )
+    os.replace(tmp, path)
+
+
+def load_pairs(path: str | Path, expect_fingerprint: str | None = None):
+    """Restore a TokenizedCorpus; reject version or manifest mismatch."""
+    from ..text.tokenizer import TokenizedCorpus
+
+    with np.load(path) as z:
+        version = int(z["version"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"checkpoint {path!r} has version {version}, expected {_FORMAT_VERSION}")
+        saved_fp = bytes(z["fingerprint"]).decode()
+        if expect_fingerprint is not None and saved_fp != expect_fingerprint:
+            raise ValueError(
+                f"checkpoint {path!r} was written for a different manifest "
+                f"(saved {saved_fp[:12]}…, current {expect_fingerprint[:12]}…); "
+                "delete the checkpoint or restore the original file list"
+            )
+        return TokenizedCorpus(
+            term_ids=z["term_ids"],
+            doc_ids=z["doc_ids"],
+            vocab=z["vocab"],
+            letter_of_term=z["letter_of_term"],
+        )

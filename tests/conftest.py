@@ -1,0 +1,47 @@
+"""Test environment: repo importable + 8 virtual CPU devices.
+
+Must run before the first ``import jax`` anywhere in the test session so
+the CPU backend is selected with 8 fake devices — this is how the
+multi-chip ``shard_map``/``all_to_all`` path is exercised without a TPU
+pod (SURVEY.md §4 item 4).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+FIXTURES = REPO_ROOT / "tests" / "fixtures"
+REFERENCE = Path("/root/reference")
+
+
+@pytest.fixture(scope="session")
+def smoke_fixture():
+    """Own 4-doc edge-case corpus with goldens generated from the compiled
+    reference binary (tests/fixtures/smoke/)."""
+    return FIXTURES / "smoke"
+
+
+@pytest.fixture(scope="session")
+def reference_dir():
+    if not REFERENCE.is_dir():
+        pytest.skip("/root/reference not mounted")
+    return REFERENCE
+
+
+def read_letter_files(directory) -> bytes:
+    """Concatenate a.txt..z.txt (the golden-diff unit, SURVEY.md §4)."""
+    out = bytearray()
+    for i in range(26):
+        p = Path(directory) / f"{chr(ord('a') + i)}.txt"
+        out += p.read_bytes()
+    return bytes(out)

@@ -1,0 +1,91 @@
+"""CLI surface: reference-compatible invocation + error paths."""
+
+import json
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import main
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+
+
+def _mk_corpus(tmp_path):
+    (tmp_path / "d1.txt").write_text("alpha beta Alpha!")
+    (tmp_path / "d2.txt").write_text("beta gamma")
+    write_manifest(tmp_path / "list.txt", [str(tmp_path / "d1.txt"), str(tmp_path / "d2.txt")])
+    return tmp_path / "list.txt"
+
+
+def test_cli_tpu_backend(tmp_path, capsys):
+    listfile = _mk_corpus(tmp_path)
+    out = tmp_path / "out"
+    rc = main(["4", "26", str(listfile), "--output-dir", str(out),
+               "--pad-multiple", "64", "--stats"])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["unique_terms"] == 3
+    data = read_letter_files(out)
+    assert b"alpha:[1]\n" in data and b"beta:[1 2]\n" in data and b"gamma:[2]\n" in data
+
+
+def test_cli_backends_agree(tmp_path):
+    listfile = _mk_corpus(tmp_path)
+    out_t, out_o = tmp_path / "t", tmp_path / "o"
+    assert main(["1", "1", str(listfile), "--output-dir", str(out_t), "--pad-multiple", "64"]) == 0
+    assert main(["1", "1", str(listfile), "--output-dir", str(out_o), "--backend", "oracle"]) == 0
+    assert read_letter_files(out_t) == read_letter_files(out_o)
+
+
+def test_cli_missing_manifest(tmp_path, capsys):
+    rc = main(["1", "1", str(tmp_path / "nope.txt")])
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_cli_invalid_mapper_count(tmp_path, capsys):
+    listfile = _mk_corpus(tmp_path)
+    rc = main(["0", "1", str(listfile)])
+    assert rc == 2
+    assert "num_mappers" in capsys.readouterr().err
+
+
+def test_cli_checkpoint_resume(tmp_path):
+    listfile = _mk_corpus(tmp_path)
+    ckpt = tmp_path / "pairs.npz"
+    out1, out2 = tmp_path / "o1", tmp_path / "o2"
+    assert main(["1", "1", str(listfile), "--output-dir", str(out1),
+                 "--checkpoint", str(ckpt), "--pad-multiple", "64"]) == 0
+    assert ckpt.exists()
+    # delete the corpus: resume must rebuild identical output from the
+    # checkpoint alone (the reference's spill files, as a real feature)
+    (tmp_path / "d1.txt").unlink()
+    (tmp_path / "d2.txt").unlink()
+    assert main(["1", "1", str(listfile), "--output-dir", str(out2),
+                 "--checkpoint", str(ckpt), "--pad-multiple", "64"]) == 0
+    assert read_letter_files(out1) == read_letter_files(out2)
+
+
+def test_cli_checkpoint_manifest_mismatch(tmp_path, capsys):
+    listfile = _mk_corpus(tmp_path)
+    ckpt = tmp_path / "pairs.npz"
+    assert main(["1", "1", str(listfile), "--checkpoint", str(ckpt),
+                 "--output-dir", str(tmp_path / "o1"), "--pad-multiple", "64"]) == 0
+    # different file list, same checkpoint: must refuse, not crash or
+    # silently emit the old corpus's index
+    (tmp_path / "d3.txt").write_text("delta")
+    write_manifest(tmp_path / "list2.txt", [str(tmp_path / "d3.txt")])
+    rc = main(["1", "1", str(tmp_path / "list2.txt"), "--checkpoint", str(ckpt),
+               "--output-dir", str(tmp_path / "o2"), "--pad-multiple", "64"])
+    assert rc == 2
+    assert "different manifest" in capsys.readouterr().err
+
+
+def test_cli_corrupt_checkpoint(tmp_path, capsys):
+    listfile = _mk_corpus(tmp_path)
+    ckpt = tmp_path / "bad.npz"
+    ckpt.write_bytes(b"not a checkpoint")
+    rc = main(["1", "1", str(listfile), "--checkpoint", str(ckpt),
+               "--output-dir", str(tmp_path / "o")])
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err

@@ -1,0 +1,68 @@
+"""Golden conformance: byte-identical output vs the compiled pthread
+reference (goldens generated once, committed under tests/fixtures/).
+
+This is the north-star acceptance criterion (SURVEY.md §4 item 1,
+BASELINE.json: "output byte-identical to the pthread reducer").
+"""
+
+import hashlib
+
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    manifest_from_dir,
+)
+
+# md5 of cat a.txt..z.txt produced by the reference binary (-O2 and ASan
+# builds agree; BASELINE.md) on the full test_in corpus with a sorted
+# manifest.
+FULL_CORPUS_MD5 = "92600581e0685e69c056b65082326fc3"
+
+
+def _golden(smoke_fixture) -> bytes:
+    return read_letter_files(smoke_fixture / "golden")
+
+
+def test_oracle_matches_reference_smoke(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    oracle_index(m, tmp_path)
+    assert read_letter_files(tmp_path) == _golden(smoke_fixture)
+
+
+def test_tpu_backend_matches_reference_smoke(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    stats = build_index(m, IndexConfig(backend="tpu", pad_multiple=64), output_dir=tmp_path)
+    assert read_letter_files(tmp_path) == _golden(smoke_fixture)
+    assert stats["lines_written"] > 0
+
+
+def test_backends_agree_on_reference_small(reference_dir, tmp_path):
+    m = read_manifest(reference_dir / "test_small.txt", base_dir=reference_dir)
+    out_a, out_b = tmp_path / "oracle", tmp_path / "tpu"
+    oracle_index(m, out_a)
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64), output_dir=out_b)
+    got = read_letter_files(out_a)
+    assert got == read_letter_files(out_b)
+    # and both match the committed reference-binary goldens
+    import pathlib
+
+    golden = read_letter_files(
+        pathlib.Path(__file__).parent / "fixtures" / "golden_ref_small")
+    assert got == golden
+
+
+@pytest.mark.slow
+def test_full_corpus_md5(reference_dir, tmp_path):
+    m = manifest_from_dir(reference_dir / "test_in")
+    assert len(m) == 355
+    build_index(m, IndexConfig(backend="tpu"), output_dir=tmp_path)
+    digest = hashlib.md5(read_letter_files(tmp_path)).hexdigest()
+    assert digest == FULL_CORPUS_MD5

@@ -1,0 +1,96 @@
+"""Device-engine unit tests, including the unpacked fallback path."""
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.oracle import (
+    oracle_postings,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import engine
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import keys as K
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    tokenize_documents,
+)
+
+DOCS = [
+    b"the quick brown fox the the",
+    b"quick quick zebra apple",
+    b"apple the zebra zebra box",
+]
+IDS = [1, 2, 3]
+
+
+def _expected():
+    return oracle_postings(DOCS, IDS)
+
+
+def _check_outputs(out, corpus, max_doc_id):
+    words = corpus.vocab_strings()
+    expected = _expected()
+    df = np.asarray(out["df"])
+    offsets = np.asarray(out["offsets"])
+    postings = np.asarray(out["postings"])
+    assert int(out["num_unique"]) == sum(len(v) for v in expected.values())
+    for t, w in enumerate(words):
+        got = postings[int(offsets[t]) : int(offsets[t]) + int(df[t])].tolist()
+        assert got == expected[w], w
+    # emit order: (letter asc, df desc, word asc)
+    order = np.asarray(out["order"])
+    keys = [(int(corpus.letter_of_term[t]), -int(df[t]), t) for t in order]
+    assert keys == sorted(keys)
+
+
+def test_index_packed_matches_oracle():
+    corpus = tokenize_documents(DOCS, IDS)
+    max_doc_id = 3
+    assert K.can_pack(corpus.vocab_size, max_doc_id)
+    stride = max_doc_id + 2
+    n = corpus.num_tokens
+    padded = 64
+    host_keys = np.full(padded, K.INT32_MAX, np.int32)
+    host_keys[:n] = corpus.term_ids * stride + corpus.doc_ids
+    out = engine.index_packed(
+        host_keys, corpus.letter_of_term,
+        vocab_size=corpus.vocab_size, max_doc_id=max_doc_id)
+    _check_outputs(out, corpus, max_doc_id)
+
+
+def test_index_pairs_fallback_matches_oracle():
+    # Force the unpacked two-key path that large corpora would take.
+    corpus = tokenize_documents(DOCS, IDS)
+    max_doc_id = 3
+    n = corpus.num_tokens
+    padded = 64
+    term = np.full(padded, K.INT32_MAX, np.int32)
+    doc = np.full(padded, K.INT32_MAX, np.int32)
+    term[:n] = corpus.term_ids
+    doc[:n] = corpus.doc_ids
+    out = engine.index_pairs(
+        term, doc, corpus.letter_of_term,
+        vocab_size=corpus.vocab_size, max_doc_id=max_doc_id)
+    _check_outputs(out, corpus, max_doc_id)
+
+
+def test_engine_paths_agree_random():
+    rng = np.random.default_rng(7)
+    for _ in range(5):
+        v, d, n = int(rng.integers(2, 40)), int(rng.integers(1, 20)), int(rng.integers(1, 300))
+        term = rng.integers(0, v, size=n).astype(np.int32)
+        doc = rng.integers(1, d + 1, size=n).astype(np.int32)
+        letters = rng.integers(0, 26, size=v).astype(np.int32)
+        letters.sort()  # vocab ids are sorted by string => letters non-decreasing
+        padded = ((n + 63) // 64) * 64
+        stride = d + 2
+        pk = np.full(padded, K.INT32_MAX, np.int32)
+        pk[:n] = term * stride + doc
+        tp = np.full(padded, K.INT32_MAX, np.int32)
+        dp = np.full(padded, K.INT32_MAX, np.int32)
+        tp[:n], dp[:n] = term, doc
+        a = engine.index_packed(pk, letters, vocab_size=v, max_doc_id=d)
+        b = engine.index_pairs(tp, dp, letters, vocab_size=v, max_doc_id=d)
+        np.testing.assert_array_equal(a["df"], b["df"])
+        np.testing.assert_array_equal(a["order"], b["order"])
+        np.testing.assert_array_equal(a["offsets"], b["offsets"])
+        assert int(a["num_unique"]) == int(b["num_unique"])
+        nu = int(a["num_unique"])
+        np.testing.assert_array_equal(a["postings"][:nu], b["postings"][:nu])

@@ -1,0 +1,59 @@
+"""Manifest format, doc-id assignment, warn-and-skip policies."""
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    load_documents,
+    manifest_from_dir,
+    read_manifest,
+    write_manifest,
+)
+
+
+def test_roundtrip_and_doc_ids(tmp_path):
+    for i in range(3):
+        (tmp_path / f"f{i}.txt").write_text(f"doc {i}")
+    write_manifest(tmp_path / "list.txt", [f"f{i}.txt" for i in range(3)])
+    m = read_manifest(tmp_path / "list.txt", base_dir=tmp_path)
+    assert len(m) == 3
+    assert [m.doc_id(i) for i in range(3)] == [1, 2, 3]  # 1-based (main.c:116)
+    assert m.sizes == (5, 5, 5)
+
+
+def test_missing_file_kept_with_size_zero(tmp_path, capsys):
+    write_manifest(tmp_path / "list.txt", ["nope.txt"])
+    m = read_manifest(tmp_path / "list.txt", base_dir=tmp_path)
+    assert len(m) == 1 and m.sizes == (0,)  # main.c:293-296 keeps it
+
+
+def test_count_header_truncates_extra_lines(tmp_path):
+    (tmp_path / "a.txt").write_text("x")
+    (tmp_path / "b.txt").write_text("y")
+    (tmp_path / "list.txt").write_text("1\na.txt\nb.txt\n")
+    m = read_manifest(tmp_path / "list.txt", base_dir=tmp_path)
+    assert len(m) == 1  # reference reads exactly `count` entries (main.c:281)
+
+
+def test_undercount_raises(tmp_path):
+    (tmp_path / "list.txt").write_text("5\na.txt\n")
+    with pytest.raises(ValueError):
+        read_manifest(tmp_path / "list.txt", base_dir=tmp_path)
+
+
+def test_load_documents_skips_unreadable(tmp_path):
+    (tmp_path / "ok.txt").write_text("hello")
+    write_manifest(tmp_path / "list.txt", ["ok.txt", "gone.txt"])
+    m = read_manifest(tmp_path / "list.txt", base_dir=tmp_path)
+    contents, doc_ids = load_documents(m)
+    assert contents == [b"hello"] and doc_ids == [1]  # doc id 2 never emitted
+
+
+def test_manifest_from_dir_sorted(tmp_path):
+    for name in ["b/x.txt", "a/y.txt", "a/x.txt"]:
+        p = tmp_path / name
+        p.parent.mkdir(exist_ok=True)
+        p.write_text("t")
+    m = manifest_from_dir(tmp_path)
+    rel = [p.split(str(tmp_path) + "/")[1] for p in m.paths]
+    assert rel == ["a/x.txt", "a/y.txt", "b/x.txt"]

@@ -1,0 +1,54 @@
+"""Model orchestrator behaviors beyond byte conformance."""
+
+import numpy as np
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    Manifest,
+    write_manifest,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    tokenize_documents,
+)
+
+
+def _manifest(tmp_path, texts):
+    paths = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"m{i}.txt"
+        p.write_text(t)
+        paths.append(str(p))
+    write_manifest(tmp_path / "list.txt", paths)
+    return read_manifest(tmp_path / "list.txt")
+
+
+def test_run_is_reentrant_with_fresh_stats(tmp_path):
+    m = _manifest(tmp_path, ["one two three", "two three four"])
+    model = InvertedIndexModel(IndexConfig(pad_multiple=64))
+    s1 = model.run(m, tmp_path / "a")
+    s2 = model.run(m, tmp_path / "b")
+    # second run must not accumulate the first run's wall time
+    assert s2["phases_ms"]["tokenize"] < s1["total_ms"] + 1e9  # sanity
+    assert abs(s1["tokens"] - s2["tokens"]) == 0
+    assert s2["total_ms"] < 2 * s1["total_ms"] + 1000
+
+
+def test_long_word_two_tier_vocab():
+    # words longer than the 32-byte dense pack go through the rare path;
+    # a long and short word sharing a 32-byte prefix must stay distinct
+    prefix = "abcdefghijklmnopqrstuvwxyzabcdef"  # exactly 32 letters
+    long_word = prefix + "tail"
+    docs = [f"{prefix} {long_word} zz".encode(), f"{long_word} zz".encode()]
+    corpus = tokenize_documents(docs, [1, 2])
+    words = corpus.vocab_strings()
+    assert prefix in words and long_word in words
+    assert words == sorted(words)
+    got = {}
+    for t, d in zip(corpus.term_ids, corpus.doc_ids):
+        got.setdefault(words[t], set()).add(int(d))
+    assert got == {prefix: {1}, long_word: {1, 2}, "zz": {1, 2}}
+    assert np.all(corpus.letter_of_term == 0) or words[-1] == "zz"

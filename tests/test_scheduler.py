@@ -1,0 +1,49 @@
+"""Host shard planning incl. the reference's degenerate configs."""
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import Manifest
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+    plan_host_shards,
+    plan_letter_ranges,
+    shard_balance_stats,
+)
+
+
+def _manifest(sizes):
+    return Manifest(paths=tuple(f"f{i}" for i in range(len(sizes))), sizes=tuple(sizes))
+
+
+def test_lpt_covers_all_files_once():
+    m = _manifest([100, 10, 90, 20, 80, 30])
+    plan = plan_host_shards(m, 3)
+    seen = sorted(i for shard in plan.shards for i in shard)
+    assert seen == list(range(6))
+
+
+def test_lpt_balance_reasonable():
+    m = _manifest([50] * 8)
+    plan = plan_host_shards(m, 4)
+    stats = shard_balance_stats(m, plan)
+    assert stats["max_over_mean"] == 1.0
+
+
+def test_more_shards_than_files():
+    # Reference UB case (uninitialized ranges, SURVEY.md §2.1); here: empty shards.
+    m = _manifest([5, 5])
+    plan = plan_host_shards(m, 5)
+    assert plan.num_shards == 5
+    assert sorted(i for s in plan.shards for i in s) == [0, 1]
+    assert sum(1 for s in plan.shards if not s) == 3
+
+
+def test_letter_ranges_basic():
+    assert plan_letter_ranges(1) == ((0, 26),)
+    ranges = plan_letter_ranges(4)
+    assert ranges == ((0, 6), (6, 12), (12, 18), (18, 26))
+
+
+def test_letter_ranges_degenerate_over_26():
+    # reducers > 26: 26/R == 0, all letters collapse onto the last reducer
+    # (main.c:129-130) — part of the observable contract.
+    ranges = plan_letter_ranges(27)
+    assert all(r == (0, 0) for r in ranges[:-1])
+    assert ranges[-1] == (0, 26)

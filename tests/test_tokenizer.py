@@ -1,0 +1,112 @@
+"""Tokenizer conformance: the edge cases of SURVEY.md §2.3."""
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    TokenizedCorpus,
+    clean_token,
+    tokenize_documents,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.oracle import (
+    oracle_postings,
+)
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("Don't", "dont"),
+        ("foo-bar", "foobar"),
+        ("x1y2z3", "xyz"),
+        ("café", "caf"),          # UTF-8 continuation bytes dropped
+        ("I.Loomings", "iloomings"),
+        ("42", ""),
+        ("---", ""),
+        ("HELLO", "hello"),
+        ("MiXeD", "mixed"),
+        ("", ""),
+    ],
+)
+def test_clean_token(raw, expected):
+    assert clean_token(raw) == expected
+
+
+def test_clean_token_cap_299():
+    # Reference keeps at most MAX_WORD-1 = 299 letters (main.c:105).
+    assert clean_token("a" * 500) == "a" * 299
+    assert clean_token("a" * 299) == "a" * 299
+    # Non-letters don't count toward the cap (they're deleted first-ish:
+    # the C loop appends letters until j==299 scanning all bytes).
+    assert clean_token("1" * 400 + "b" * 400) == "b" * 299
+
+
+def _pairs(corpus: TokenizedCorpus) -> set:
+    words = corpus.vocab_strings()
+    return {(words[t], int(d)) for t, d in zip(corpus.term_ids, corpus.doc_ids)}
+
+
+def test_tokenize_documents_matches_oracle_small():
+    docs = [
+        b"The quick brown Fox! don't stop x1y2z3",
+        b"quick\tquick\nfox 42 --- caf\xc3\xa9",
+        b"",
+        b"...only punct 123...",
+    ]
+    ids = [1, 2, 3, 4]
+    corpus = tokenize_documents(docs, ids)
+    expected = oracle_postings(docs, ids)
+    expected_pairs = {(w, d) for w, dl in expected.items() for d in dl}
+    assert _pairs(corpus) == expected_pairs
+
+
+def test_vocab_sorted_and_letters():
+    corpus = tokenize_documents([b"banana apple Cherry apple zzz a"], [1])
+    words = corpus.vocab_strings()
+    assert words == sorted(words)
+    assert words == ["a", "apple", "banana", "cherry", "zzz"]
+    np.testing.assert_array_equal(corpus.letter_of_term, [0, 0, 1, 2, 25])
+
+
+def test_doc_boundaries_exact():
+    # Words at document edges must get the right 1-based doc id even with
+    # no trailing newline and with leading/trailing whitespace.
+    docs = [b"alpha beta", b"beta gamma", b"  gamma\talpha "]
+    corpus = tokenize_documents(docs, [1, 2, 3])
+    got = {}
+    words = corpus.vocab_strings()
+    for t, d in zip(corpus.term_ids, corpus.doc_ids):
+        got.setdefault(words[t], set()).add(int(d))
+    assert got == {"alpha": {1, 3}, "beta": {1, 2}, "gamma": {2, 3}}
+
+
+def test_empty_corpus():
+    corpus = tokenize_documents([], [])
+    assert corpus.num_tokens == 0 and corpus.vocab_size == 0
+    corpus = tokenize_documents([b"123 ... \n\n"], [1])
+    assert corpus.num_tokens == 0 and corpus.vocab_size == 0
+
+
+def test_token_spanning_cap_in_stream():
+    # >299-letter token inside a doc stream: truncated, not crashed (the
+    # reference would overflow its fscanf buffer here — SURVEY.md §2.3).
+    long_tok = b"A" * 350
+    corpus = tokenize_documents([b"x " + long_tok + b" y"], [1])
+    words = corpus.vocab_strings()
+    assert "a" * 299 in words and "x" in words and "y" in words
+
+
+def test_random_corpora_match_oracle():
+    rng = np.random.default_rng(0)
+    alphabet = list(b"abcXYZ0-' \t\n\xc3\xa9")
+    for trial in range(10):
+        n_docs = int(rng.integers(1, 6))
+        docs = [
+            bytes(rng.choice(alphabet, size=int(rng.integers(0, 200))))
+            for _ in range(n_docs)
+        ]
+        ids = list(range(1, n_docs + 1))
+        corpus = tokenize_documents(docs, ids)
+        expected = oracle_postings(docs, ids)
+        expected_pairs = {(w, d) for w, dl in expected.items() for d in dl}
+        assert _pairs(corpus) == expected_pairs, f"trial {trial}"
