@@ -1,0 +1,79 @@
+"""Benchmark: end-to-end wall time indexing the full test_in corpus.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": R}
+
+Baseline (BASELINE.md): the reference pthread program at -O2 indexes the
+same corpus in 796 ms on this container's CPU (4 mappers / 26 reducers).
+``vs_baseline`` is the speedup ratio (baseline_ms / our_ms; > 1 means
+faster than the reference).
+
+Runs on whatever JAX platform is available (the driver runs it on a real
+TPU chip).  Falls back to a deterministic Zipfian corpus of the same
+scale if /root/reference/test_in is not mounted, scaling the baseline by
+corpus bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+BASELINE_MS = 796.0
+BASELINE_BYTES = 5_793_058
+REFERENCE_CORPUS = Path("/root/reference/test_in")
+
+
+def _manifest():
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        manifest_from_dir, read_manifest, write_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    if REFERENCE_CORPUS.is_dir():
+        return manifest_from_dir(REFERENCE_CORPUS), "test_in_e2e_wall_ms"
+    tmp = Path(tempfile.mkdtemp(prefix="bench_corpus_"))
+    docs = zipf_corpus(num_docs=355, vocab_size=33_000, tokens_per_doc=2900, seed=7)
+    paths = write_corpus(tmp / "docs", docs)
+    write_manifest(tmp / "list.txt", paths)
+    return read_manifest(tmp / "list.txt"), "synthetic_zipf_e2e_wall_ms"
+
+
+def main() -> int:
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+
+    manifest, metric = _manifest()
+    out_dir = tempfile.mkdtemp(prefix="bench_out_")
+    model = InvertedIndexModel(IndexConfig(backend="tpu", output_dir=out_dir))
+
+    model.run(manifest)  # warmup: XLA compile + numpy/jit caches
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model.run(manifest)
+        best = min(best, time.perf_counter() - t0)
+
+    value_ms = best * 1e3
+    baseline_ms = BASELINE_MS
+    if metric.startswith("synthetic"):
+        baseline_ms = BASELINE_MS * manifest.total_bytes / BASELINE_BYTES
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / value_ms, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

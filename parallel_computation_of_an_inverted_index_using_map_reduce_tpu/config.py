@@ -39,6 +39,9 @@ class IndexConfig:
     # Pad token-count up to a multiple of this so XLA re-uses compiled
     # programs across similarly-sized corpora instead of recompiling.
     pad_multiple: int = 1 << 16
+    # Device shards for the multi-chip engine (parallel/dist_engine.py):
+    # None = all visible devices; 1 = force the single-chip engine.
+    device_shards: int | None = None
     profile_dir: str | None = None  # write a jax.profiler trace of the device phase
     # Durable map-phase artifact (the analogue of the reference's spill
     # files, which double as a checkpoint — SURVEY.md §5): save the
@@ -54,3 +57,6 @@ class IndexConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.pad_multiple < 1:
             raise ValueError("pad_multiple must be >= 1")
+        if self.device_shards is not None and self.device_shards < 1:
+            raise ValueError(
+                f"device_shards must be >= 1 or None (auto), got {self.device_shards}")

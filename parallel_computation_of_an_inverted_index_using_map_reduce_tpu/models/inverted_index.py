@@ -23,6 +23,8 @@ import numpy as np
 import jax
 
 from ..config import IndexConfig
+from ..parallel import dist_engine
+from ..parallel.mesh import make_mesh, replicated_spec, shard_spec, sharding
 from ..utils import checkpoint
 from ..corpus.manifest import Manifest, load_documents
 from ..ops import engine
@@ -91,15 +93,30 @@ class InvertedIndexModel:
                 formatter.emit_grouped(out_dir, {})
             return timer.report()
 
+        num_shards = (
+            self.config.device_shards
+            if self.config.device_shards is not None
+            else len(jax.devices())
+        )
+        use_dist = num_shards > 1 and K.can_pack(vocab_size, max_doc_id)
         padded = _round_up(num_tokens, self.config.pad_multiple)
+        if use_dist:
+            padded = _round_up(padded, num_shards)
+        timer.count("device_shards", num_shards if use_dist else 1)
+        mesh = make_mesh(num_shards) if use_dist else None
         with timer.phase("feed"):
             if K.can_pack(vocab_size, max_doc_id):
                 host_keys = np.full(padded, K.INT32_MAX, dtype=np.int32)
                 stride = max_doc_id + 2
                 np.multiply(corpus.term_ids, stride, out=host_keys[:num_tokens])
                 host_keys[:num_tokens] += corpus.doc_ids
-                keys_dev = jax.device_put(host_keys)
-                letters_dev = jax.device_put(corpus.letter_of_term)
+                if use_dist:
+                    keys_dev = jax.device_put(host_keys, sharding(mesh, shard_spec()))
+                    letters_dev = jax.device_put(
+                        corpus.letter_of_term, sharding(mesh, replicated_spec()))
+                else:
+                    keys_dev = jax.device_put(host_keys)
+                    letters_dev = jax.device_put(corpus.letter_of_term)
                 packed = True
             else:
                 term_dev = jax.device_put(
@@ -117,14 +134,23 @@ class InvertedIndexModel:
             else contextlib.nullcontext()
         )
         with timer.phase("device_index"), profile:
-            if packed:
+            if use_dist:
+                out = dist_engine.dist_index(
+                    keys_dev, letters_dev, vocab_size=vocab_size, max_doc_id=max_doc_id,
+                    mesh=mesh)
+            elif packed:
                 out = engine.index_packed(
                     keys_dev, letters_dev, vocab_size=vocab_size, max_doc_id=max_doc_id)
             else:
                 out = engine.index_pairs(
                     term_dev, doc_dev, letters_dev,
                     vocab_size=vocab_size, max_doc_id=max_doc_id)
-            out = jax.tree.map(lambda x: x.block_until_ready(), out)
+            # dist path returns host-assembled numpy postings; block only
+            # device arrays.
+            out = {
+                k: v.block_until_ready() if hasattr(v, "block_until_ready") else v
+                for k, v in out.items()
+            }
 
         with timer.phase("fetch"):
             host = jax.device_get(out)

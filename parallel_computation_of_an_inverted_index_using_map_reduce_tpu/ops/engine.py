@@ -1,4 +1,4 @@
-"""Single-chip device engine: the whole reduce phase as one XLA program.
+"""Device engine: the whole reduce phase as one XLA program.
 
 The reference's reduce phase — re-parse spill text, linear-scan dict
 dedup, qsort by (df desc, word asc), bubble-sort postings, format
@@ -14,6 +14,10 @@ Everything is fixed-shape; padding keys sort to the tail and are dropped
 by bounds-checked scatters.  Control crosses host<->device exactly twice
 (feed pairs, fetch postings) vs. the reference's per-token lock/IO
 crossing (SURVEY.md §3.5).
+
+The post-sort tail (:func:`postings_from_sorted`) is shared with the
+multi-chip engine in ``parallel/dist_engine.py``, which reaches the same
+sorted state via a hash-bucket ``all_to_all`` instead of one local sort.
 """
 
 from __future__ import annotations
@@ -29,8 +33,8 @@ from . import keys as K
 from .segment import compact, first_occurrence_mask, segment_counts
 
 
-def emit_order_keys(letter_of_term, df, vocab_size: int, max_doc_id: int):
-    """Sort key giving the reference's output order (main.c:55-64).
+def emit_order_keys(letter_of_term, df, max_doc_id: int):
+    """Sort keys giving the reference's output order (main.c:55-64).
 
     Within a letter file: df descending, then word ascending — and term
     ids are assigned in sorted-vocab order, so ``term id asc == word
@@ -40,29 +44,30 @@ def emit_order_keys(letter_of_term, df, vocab_size: int, max_doc_id: int):
     return letter_of_term, neg_df
 
 
-@functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0,))
-def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
-    """Index a batch of packed (term, doc) int32 keys.
+def emit_order(letter_of_term, df, vocab_size: int, max_doc_id: int):
+    """Term ids ordered (letter asc, df desc, term asc)."""
+    letter, neg_df = emit_order_keys(letter_of_term, df, max_doc_id)
+    stride = max_doc_id + 2
+    terms = jnp.arange(vocab_size, dtype=jnp.int32)
+    if 26 * stride * (vocab_size + 1) < np.iinfo(np.int32).max:
+        emit_key = (letter * stride + neg_df) * vocab_size + terms
+        _, order = lax.sort_key_val(emit_key, terms)
+    else:
+        # stable two-key sort; stability supplies the term-asc tiebreak
+        _, _, order = lax.sort((letter, neg_df, terms), num_keys=2)
+    return order
 
-    ``keys`` may be padded with ``K.INT32_MAX`` (sorts after every valid
-    key since ``can_pack`` guarantees headroom).
-    """
+
+def postings_from_sorted(keys_s, letter_of_term, *, vocab_size: int, max_doc_id: int):
+    """Postings/df/order from an ascending packed-key array (may contain
+    ``K.INT32_MAX`` padding, which sorts last and is dropped)."""
     stride = max_doc_id + 2
     valid_limit = vocab_size * stride
-    keys_s = lax.sort(keys)
     term_s, doc_s = K.unpack_pairs(keys_s, max_doc_id)
     first = first_occurrence_mask(keys_s) & (keys_s < valid_limit)
     df = segment_counts(term_s, first.astype(jnp.int32), vocab_size)
     postings = compact(doc_s, first, keys_s.shape[0], jnp.int32(0))
-
-    letter, neg_df = emit_order_keys(letter_of_term, df, vocab_size, max_doc_id)
-    if K.can_pack(vocab_size, max_doc_id) and 26 * stride * (vocab_size + 1) < np.iinfo(np.int32).max:
-        emit_key = (letter * stride + neg_df) * vocab_size + jnp.arange(vocab_size, dtype=jnp.int32)
-        _, order = lax.sort_key_val(emit_key, jnp.arange(vocab_size, dtype=jnp.int32))
-    else:
-        _, _, order = lax.sort(
-            (letter, neg_df, jnp.arange(vocab_size, dtype=jnp.int32)), num_keys=2
-        )
+    order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df
     return {
         "postings": postings,
@@ -73,22 +78,30 @@ def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
     }
 
 
+@functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0,))
+def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
+    """Index a batch of packed (term, doc) int32 keys.
+
+    ``keys`` may be padded with ``K.INT32_MAX`` (sorts after every valid
+    key since ``can_pack`` guarantees headroom).
+    """
+    return postings_from_sorted(
+        lax.sort(keys), letter_of_term, vocab_size=vocab_size, max_doc_id=max_doc_id)
+
+
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0, 1))
 def index_pairs(term_ids, doc_ids, letter_of_term, *, vocab_size: int, max_doc_id: int):
     """General path for corpora too large to pack into one int32 key.
 
     Two-key variadic sort; otherwise identical semantics to
-    :func:`index_packed`.  Padding: term = INT32_MAX.
+    :func:`index_packed`.  Padding: term = doc = INT32_MAX.
     """
     term_s, doc_s = lax.sort((term_ids, doc_ids), num_keys=2)
     valid = term_s < vocab_size
-    first = (
-        first_occurrence_mask(term_s) | first_occurrence_mask(doc_s)
-    ) & valid
+    first = (first_occurrence_mask(term_s) | first_occurrence_mask(doc_s)) & valid
     df = segment_counts(jnp.where(valid, term_s, vocab_size), first.astype(jnp.int32), vocab_size)
     postings = compact(doc_s, first, term_s.shape[0], jnp.int32(0))
-    letter, neg_df = emit_order_keys(letter_of_term, df, vocab_size, max_doc_id)
-    _, _, order = lax.sort((letter, neg_df, jnp.arange(vocab_size, dtype=jnp.int32)), num_keys=2)
+    order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df
     return {
         "postings": postings,

@@ -1,0 +1,171 @@
+"""Multi-chip engine: hash-bucket ``all_to_all`` shuffle over the mesh.
+
+This is the TPU-native replacement for the reference's shuffle — 26
+shared spill files written by every mapper under implicit stdio locks
+and re-read by letter-owning reducers (main.c:116, 332-341, 135-137):
+
+- pairs are sharded over chips (data parallelism over documents,
+  main.c:307-328's file ranges);
+- each chip buckets its pairs by ``term % n_chips`` — a uniform hash
+  partition, unlike the reference's ~1000x-skewed first-letter
+  partition (SURVEY.md §2.3) — and exchanges them with one
+  ``lax.all_to_all`` over ICI;
+- each chip dedups its owned terms locally (sorted boundary diff — the
+  global dedup, since a term's pairs all land on its owner) and keeps
+  its survivors *sharded*;
+- only vocab-sized aggregates cross chips after the exchange: document
+  frequency via one ``psum``, from which the emit order is computed
+  replicated.  The deduped pair shards go straight to the host, which
+  merges n sorted runs during emit (emit is host-bound regardless) —
+  per-chip work and memory stay O(N/n log N/n), never O(N).
+
+The exchange uses a fixed per-bucket capacity (static shapes for XLA);
+a returned overflow flag triggers one retry at the provably-safe
+capacity.  Every step is a collective or a fused elementwise/scan —
+no host round-trips inside the program.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from ..ops import keys as K
+from ..ops.engine import emit_order
+from ..ops.segment import compact, first_occurrence_mask
+from .mesh import SHARD_AXIS, make_mesh, replicated_spec, shard_spec
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def default_capacity(local_size: int, num_shards: int, factor: float = 2.0) -> int:
+    """Per-(src, dst) bucket capacity.
+
+    Expected load is ``local_size / num_shards``; ``factor`` covers hash
+    imbalance.  Capped at ``local_size`` (the provably-safe value: one
+    source cannot send more pairs than it holds).
+    """
+    if num_shards == 1:
+        return local_size
+    return min(local_size, _round_up(int(math.ceil(local_size / num_shards * factor)), 8))
+
+
+def _shuffle_body(keys_local, letter_of_term, *, num_shards: int, capacity: int,
+                  vocab_size: int, max_doc_id: int):
+    """shard_map body: runs per-device with collectives over SHARD_AXIS."""
+    local = keys_local.shape[0]
+    stride = max_doc_id + 2
+    valid_limit = vocab_size * stride
+
+    # --- partition: bucket by term hash (uniform), padding to bucket n.
+    term = keys_local // stride
+    bucket = jnp.where(keys_local < valid_limit, term % num_shards, num_shards)
+    bucket_s, keys_s = lax.sort((bucket.astype(jnp.int32), keys_local), num_keys=2)
+    counts = jnp.zeros((num_shards,), jnp.int32).at[bucket_s].add(1, mode="drop")
+    offsets = jnp.cumsum(counts) - counts
+    overflow_local = (counts > capacity).any()
+
+    # --- build fixed-shape send buffer (num_shards, capacity).
+    slot = jnp.arange(capacity, dtype=jnp.int32)[None, :]
+    gather_idx = jnp.clip(offsets[:, None] + slot, 0, local - 1)
+    in_bucket = slot < counts[:, None]
+    send = jnp.where(in_bucket, keys_s[gather_idx], K.INT32_MAX)
+
+    # --- one ICI all_to_all: row b of `send` goes to device b.
+    recv = lax.all_to_all(send, SHARD_AXIS, 0, 0, tiled=True)
+
+    # --- owner-side global dedup of this device's terms.
+    recv_s = lax.sort(recv.reshape(-1))
+    first = first_occurrence_mask(recv_s) & (recv_s < valid_limit)
+    uniq = compact(recv_s, first, recv_s.shape[0], K.INT32_MAX)
+
+    # --- vocab-sized aggregates only: df by psum, emit order replicated.
+    owned_term = recv_s // stride
+    df_local = jnp.zeros((vocab_size,), jnp.int32).at[
+        jnp.where(first, owned_term, vocab_size)
+    ].add(1, mode="drop")
+    df = lax.psum(df_local, SHARD_AXIS)
+    order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
+    offsets = jnp.cumsum(df) - df
+    return {
+        "uniq_sharded": uniq,
+        "df": df,
+        "order": order,
+        "offsets": offsets,
+        "num_unique": lax.psum(first.astype(jnp.int32).sum(), SHARD_AXIS),
+        "overflow": lax.psum(overflow_local.astype(jnp.int32), SHARD_AXIS),
+    }
+
+
+@functools.lru_cache(maxsize=64)
+def _build(mesh: Mesh, num_shards: int, capacity: int, vocab_size: int,
+           max_doc_id: int, donate: bool):
+    def body(keys_local, letters):
+        return _shuffle_body(
+            keys_local, letters, num_shards=num_shards, capacity=capacity,
+            vocab_size=vocab_size, max_doc_id=max_doc_id)
+
+    out_specs = {
+        "uniq_sharded": shard_spec(),
+        "df": replicated_spec(),
+        "order": replicated_spec(),
+        "offsets": replicated_spec(),
+        "num_unique": replicated_spec(),
+        "overflow": replicated_spec(),
+    }
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(shard_spec(), replicated_spec()),
+            out_specs=out_specs,
+            check_vma=False,
+        ),
+        # Donation frees the input keys' HBM during the exchange, but the
+        # overflow retry re-feeds the same buffer, so only donate when no
+        # retry can follow (capacity already at the provably-safe bound).
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def assemble_postings(uniq_sharded, max_doc_id: int, valid_limit: int) -> np.ndarray:
+    """Host-side merge of the sharded deduped pair keys into the global
+    term-major postings array (runs during emit, which is host-bound)."""
+    keys = np.asarray(uniq_sharded)
+    ks = np.sort(keys[keys < valid_limit], kind="stable")
+    return (ks % (max_doc_id + 2)).astype(np.int32)
+
+
+def dist_index(keys, letter_of_term, *, vocab_size: int, max_doc_id: int,
+               mesh: Mesh | None = None, capacity_factor: float = 2.0):
+    """Distributed index of packed pair keys sharded over the mesh.
+
+    ``keys`` length must be a multiple of the mesh size (pad with
+    ``K.INT32_MAX``).  Returns the single-chip engine's dict interface;
+    ``postings`` is assembled on host from the sharded unique keys, the
+    vocab-sized outputs (df/order/offsets) are replicated device arrays.
+    If the hash partition overflows the default capacity, the exchange
+    is re-run once at the provably-safe capacity.
+    """
+    mesh = mesh if mesh is not None else make_mesh()
+    n = mesh.devices.size
+    if keys.shape[0] % n:
+        raise ValueError(f"keys length {keys.shape[0]} not divisible by mesh size {n}")
+    local = keys.shape[0] // n
+    capacity = default_capacity(local, n, capacity_factor)
+    out = _build(mesh, n, capacity, vocab_size, max_doc_id, capacity >= local)(
+        keys, letter_of_term)
+    if capacity < local and int(out["overflow"]) > 0:
+        out = _build(mesh, n, local, vocab_size, max_doc_id, True)(keys, letter_of_term)
+    out.pop("overflow", None)
+    uniq = out.pop("uniq_sharded")
+    out["postings"] = assemble_postings(
+        uniq, max_doc_id, vocab_size * (max_doc_id + 2))
+    return out

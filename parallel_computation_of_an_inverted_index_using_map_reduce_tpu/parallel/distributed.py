@@ -1,0 +1,40 @@
+"""Multi-host runtime initialization.
+
+The reference is strictly single-process (SURVEY.md §4: "no multi-node
+story at all"); its communication backend is the filesystem.  The TPU
+framework's backend is XLA collectives: ICI within a slice, DCN across
+hosts.  This module is the thin seam over ``jax.distributed`` so the
+same ``dist_index`` program runs on a multi-host pod — every host feeds
+its local shard of pairs and the collectives span the global mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join (or start) a multi-host JAX runtime.
+
+    With no arguments, relies on the environment (TPU pod metadata /
+    ``JAX_COORDINATOR_ADDRESS`` etc.), which is how TPU VMs are normally
+    launched.  Safe to call once per process before any computation.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def runtime_info() -> dict:
+    """Structured view of the distributed topology for logs/metrics."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }

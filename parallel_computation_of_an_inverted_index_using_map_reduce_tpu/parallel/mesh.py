@@ -1,0 +1,37 @@
+"""Device mesh construction.
+
+The reference's "mesh" is N pthreads in one address space
+(main.c:348-384).  Here parallelism is a 1-D JAX mesh over TPU chips;
+pairs are sharded along it and exchanged with XLA collectives over ICI
+(multi-host: DCN, via ``jax.distributed`` — see ``distributed.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first ``num_devices`` local devices."""
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    return Mesh(devices, (SHARD_AXIS,))
+
+
+def shard_spec() -> P:
+    return P(SHARD_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

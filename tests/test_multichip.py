@@ -1,0 +1,104 @@
+"""Multi-chip shuffle on 8 virtual CPU devices (SURVEY.md §4 item 4).
+
+The same shard_map/all_to_all program that runs over ICI on a pod runs
+here on fake devices — the reference has no analogue (pthread counts
+are its only scale knob).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.oracle import (
+    oracle_postings,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import engine
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import keys as K
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel import dist_engine
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel.mesh import make_mesh
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    tokenize_documents,
+)
+
+
+def _packed_input(docs, ids, pad_to_multiple):
+    corpus = tokenize_documents(docs, ids)
+    max_doc_id = max(ids)
+    stride = max_doc_id + 2
+    n = corpus.num_tokens
+    padded = ((n + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
+    keys = np.full(padded, K.INT32_MAX, np.int32)
+    keys[:n] = corpus.term_ids * stride + corpus.doc_ids
+    return corpus, keys, max_doc_id
+
+
+def test_eight_device_mesh_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("num_devices", [2, 8])
+def test_dist_matches_single_chip(num_devices):
+    docs = [
+        b"the quick brown fox jumps over the lazy dog",
+        b"pack my box with five dozen liquor jugs",
+        b"how vexingly quick daft zebras jump",
+        b"the five boxing wizards jump quickly",
+    ]
+    ids = [1, 2, 3, 4]
+    corpus, keys, max_doc_id = _packed_input(docs, ids, num_devices * 8)
+    mesh = make_mesh(num_devices)
+    out = dist_engine.dist_index(
+        keys, corpus.letter_of_term,
+        vocab_size=corpus.vocab_size, max_doc_id=max_doc_id, mesh=mesh)
+    ref = engine.index_packed(
+        keys.copy(), corpus.letter_of_term,
+        vocab_size=corpus.vocab_size, max_doc_id=max_doc_id)
+    np.testing.assert_array_equal(out["df"], ref["df"])
+    np.testing.assert_array_equal(out["order"], ref["order"])
+    np.testing.assert_array_equal(out["offsets"], ref["offsets"])
+    assert int(out["num_unique"]) == int(ref["num_unique"])
+    nu = int(ref["num_unique"])
+    np.testing.assert_array_equal(
+        np.asarray(out["postings"])[:nu], np.asarray(ref["postings"])[:nu])
+
+
+def test_dist_matches_oracle_random():
+    rng = np.random.default_rng(42)
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    vocab_pool = ["".join(rng.choice(list(letters), size=rng.integers(1, 8)))
+                  for _ in range(50)]
+    docs, ids = [], []
+    for d in range(6):
+        words = rng.choice(vocab_pool, size=int(rng.integers(5, 60)))
+        docs.append(" ".join(words).encode())
+        ids.append(d + 1)
+    corpus, keys, max_doc_id = _packed_input(docs, ids, 8 * 8)
+    out = dist_engine.dist_index(
+        keys, corpus.letter_of_term,
+        vocab_size=corpus.vocab_size, max_doc_id=max_doc_id, mesh=make_mesh(8))
+    expected = oracle_postings(docs, ids)
+    words = corpus.vocab_strings()
+    df = np.asarray(out["df"])
+    offsets = np.asarray(out["offsets"])
+    postings = np.asarray(out["postings"])
+    assert len(words) == len(expected)
+    for t, w in enumerate(words):
+        got = postings[int(offsets[t]): int(offsets[t]) + int(df[t])].tolist()
+        assert got == expected[w], w
+
+
+def test_capacity_overflow_retry():
+    # All tokens are the SAME term -> every pair lands in one bucket;
+    # the default capacity (local/n * 2) must overflow and the safe
+    # retry must still produce correct output.
+    docs = [b"word " * 40, b"word " * 40]
+    ids = [1, 2]
+    corpus, keys, max_doc_id = _packed_input(docs, ids, 8 * 8)
+    mesh = make_mesh(8)
+    out = dist_engine.dist_index(
+        keys, corpus.letter_of_term,
+        vocab_size=corpus.vocab_size, max_doc_id=max_doc_id, mesh=mesh)
+    assert int(out["num_unique"]) == 2
+    np.testing.assert_array_equal(np.asarray(out["df"]), [2])
+    np.testing.assert_array_equal(np.asarray(out["postings"])[:2], [1, 2])
