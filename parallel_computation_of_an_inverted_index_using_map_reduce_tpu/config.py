@@ -43,6 +43,9 @@ class IndexConfig:
     # None = all visible devices; 1 = force the single-chip engine.
     device_shards: int | None = None
     profile_dir: str | None = None  # write a jax.profiler trace of the device phase
+    # Host tokenizer: C++ (native/tokenizer.cc, built on first use) with
+    # automatic fallback to the vectorized numpy path.
+    use_native: bool = True
     # Durable map-phase artifact (the analogue of the reference's spill
     # files, which double as a checkpoint — SURVEY.md §5): save the
     # tokenized pair arrays here, and resume from them if present.

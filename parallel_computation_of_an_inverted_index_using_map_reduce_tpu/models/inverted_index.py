@@ -30,7 +30,7 @@ from ..corpus.manifest import Manifest, load_documents
 from ..ops import engine
 from ..ops import keys as K
 from ..text import formatter
-from ..text.tokenizer import tokenize_documents
+from ..text.tokenizer import tokenize
 from ..utils.timing import PhaseTimer
 from .oracle import oracle_index
 
@@ -73,7 +73,7 @@ class InvertedIndexModel:
         with timer.phase("load"):
             contents, doc_ids = load_documents(manifest)
         with timer.phase("tokenize"):
-            corpus = tokenize_documents(contents, doc_ids)
+            corpus = tokenize(contents, doc_ids, use_native=self.config.use_native)
         if ckpt is not None:
             with timer.phase("checkpoint"):
                 checkpoint.save_pairs(ckpt, corpus, fingerprint=fp)
@@ -99,13 +99,28 @@ class InvertedIndexModel:
             else len(jax.devices())
         )
         use_dist = num_shards > 1 and K.can_pack(vocab_size, max_doc_id)
+        # Half-bandwidth single-chip path: uint16 feed + fetch (the
+        # device->host link dominates single-chip wall time; SURVEY.md §6).
+        use_u16 = (
+            not use_dist
+            and vocab_size <= 0xFFFF
+            and max_doc_id <= 0xFFFE
+            and K.can_pack(vocab_size, max_doc_id)  # keys are packed in int32
+        )
         padded = _round_up(num_tokens, self.config.pad_multiple)
         if use_dist:
             padded = _round_up(padded, num_shards)
         timer.count("device_shards", num_shards if use_dist else 1)
         mesh = make_mesh(num_shards) if use_dist else None
         with timer.phase("feed"):
-            if K.can_pack(vocab_size, max_doc_id):
+            if use_u16:
+                # one upload op: [terms | docs] as uint16 (fixed per-transfer
+                # cost dominates the link; see ops/engine.index_u16)
+                feed_u16 = np.full(2 * padded, 0xFFFF, dtype=np.uint16)
+                feed_u16[:num_tokens] = corpus.term_ids
+                feed_u16[padded : padded + num_tokens] = corpus.doc_ids
+                feed_dev = jax.device_put(feed_u16)
+            elif K.can_pack(vocab_size, max_doc_id):
                 host_keys = np.full(padded, K.INT32_MAX, dtype=np.int32)
                 stride = max_doc_id + 2
                 np.multiply(corpus.term_ids, stride, out=host_keys[:num_tokens])
@@ -134,7 +149,10 @@ class InvertedIndexModel:
             else contextlib.nullcontext()
         )
         with timer.phase("device_index"), profile:
-            if use_dist:
+            if use_u16:
+                out = engine.index_u16(
+                    feed_dev, vocab_size=vocab_size, max_doc_id=max_doc_id)
+            elif use_dist:
                 out = dist_engine.dist_index(
                     keys_dev, letters_dev, vocab_size=vocab_size, max_doc_id=max_doc_id,
                     mesh=mesh)
@@ -153,19 +171,41 @@ class InvertedIndexModel:
             }
 
         with timer.phase("fetch"):
-            host = jax.device_get(out)
+            if use_u16:
+                # two transfer ops total: df (num_unique derives from its
+                # sum), then the valid postings prefix (rounded so slice
+                # shapes, and with them compiled slice programs, reuse)
+                df = jax.device_get(out["df"]).astype(np.int64)
+                num_unique = int(df.sum())
+                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
+                postings = jax.device_get(out["postings"][:nfetch])
+                order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
+                host = {
+                    "df": df, "order": order, "offsets": offsets,
+                    "postings": postings, "num_unique": num_unique,
+                }
+            else:
+                host = jax.device_get(out)
 
         with timer.phase("emit"):
-            emit_stats = formatter.emit_index(
-                out_dir,
-                vocab=corpus.vocab,
-                letter_of_term=corpus.letter_of_term,
-                order=host["order"],
-                df=host["df"],
-                offsets=host["offsets"],
-                postings=host["postings"],
-                max_doc_id=max_doc_id,
-            )
+            from .. import native
+
+            if self.config.use_native and native.available():
+                bytes_written = native.emit_native(
+                    out_dir, corpus.vocab, host["order"], host["df"],
+                    host["offsets"], host["postings"])
+                emit_stats = {"lines_written": vocab_size, "bytes_written": bytes_written}
+            else:
+                emit_stats = formatter.emit_index(
+                    out_dir,
+                    vocab=corpus.vocab,
+                    letter_of_term=corpus.letter_of_term,
+                    order=host["order"],
+                    df=host["df"],
+                    offsets=host["offsets"],
+                    postings=host["postings"],
+                    max_doc_id=max_doc_id,
+                )
         timer.count("unique_pairs", int(host["num_unique"]))
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()

@@ -1,0 +1,185 @@
+"""ctypes loader for the native host runtime (C++ tokenizer).
+
+The reference's performance-critical host code is C (the whole program);
+here the host hot path — tokenize + vocab build, the analogue of
+main.c:102-117 plus the reducer's dictionary — is a C++ library compiled
+on first use with the system toolchain and loaded via ctypes (no
+pybind11 in this image).  Everything degrades gracefully to the
+vectorized numpy path if no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "tokenizer.cc"
+_lib = None
+_lib_error: str | None = None
+
+
+class _TokenizeResult(ctypes.Structure):
+    _fields_ = [
+        ("num_tokens", ctypes.c_int64),
+        ("vocab_size", ctypes.c_int32),
+        ("vocab_width", ctypes.c_int32),
+        ("term_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("doc_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("vocab_packed", ctypes.POINTER(ctypes.c_uint8)),
+        ("letter_of_term", ctypes.POINTER(ctypes.c_int32)),
+    ]
+
+
+def _build_dirs():
+    yield Path(__file__).parent / "_build"
+    yield Path(tempfile.gettempdir()) / f"mri_tpu_native_{os.getuid()}"
+
+
+def _compile() -> Path:
+    src = _SRC.read_bytes()
+    tag = hashlib.md5(src).hexdigest()[:12]
+    name = f"libmri_tokenizer_{tag}.so"
+    last_err: Exception | None = None
+    for d in _build_dirs():
+        so = d / name
+        if so.exists():
+            return so
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+            tmp = so.with_suffix(f".{os.getpid()}.tmp")
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                 "-o", str(tmp), str(_SRC)],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, so)
+            return so
+        except (OSError, subprocess.SubprocessError) as e:
+            last_err = e
+    raise RuntimeError(f"native build failed: {last_err}")
+
+
+def load():
+    """The compiled library, or None (with the reason cached)."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        lib = ctypes.CDLL(str(_compile()))
+        lib.mri_tokenize.restype = ctypes.POINTER(_TokenizeResult)
+        lib.mri_tokenize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mri_free_result.restype = None
+        lib.mri_free_result.argtypes = [ctypes.POINTER(_TokenizeResult)]
+        lib.mri_emit.restype = ctypes.c_int64
+        lib.mri_emit.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p,
+        ]
+        _lib = lib
+    except (OSError, RuntimeError) as e:
+        _lib_error = str(e)
+        print(f"warning: native tokenizer unavailable ({e}); using numpy path",
+              file=sys.stderr)
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def tokenize_native(contents: list[bytes], doc_ids: list[int]):
+    """Native equivalent of text.tokenizer.tokenize_documents."""
+    from ..text.tokenizer import TokenizedCorpus
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native tokenizer unavailable: {_lib_error}")
+
+    buf = b"".join(contents)
+    data = np.frombuffer(buf, dtype=np.uint8)
+    ends = np.cumsum(np.array([len(c) for c in contents], dtype=np.int64))
+    ids = np.asarray(doc_ids, dtype=np.int32)
+    n_docs = len(contents)
+
+    res = lib.mri_tokenize(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if data.size else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(data.size),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if n_docs else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if n_docs else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n_docs),
+    )
+    if not res:
+        raise MemoryError("native tokenizer allocation failure")
+    try:
+        r = res.contents
+        n, v, w = int(r.num_tokens), int(r.vocab_size), int(r.vocab_width)
+        term = np.ctypeslib.as_array(r.term_ids, shape=(max(n, 1),))[:n].copy()
+        doc = np.ctypeslib.as_array(r.doc_ids, shape=(max(n, 1),))[:n].copy()
+        packed = np.ctypeslib.as_array(r.vocab_packed, shape=(max(v * w, 1),))[: v * w].copy()
+        letters = np.ctypeslib.as_array(r.letter_of_term, shape=(max(v, 1),))[:v].copy()
+        vocab = packed.view(f"S{w}") if v else np.empty(0, "S1")
+        return TokenizedCorpus(
+            term_ids=term, doc_ids=doc, vocab=vocab, letter_of_term=letters)
+    finally:
+        lib.mri_free_result(res)
+
+
+def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings) -> int:
+    """Native 26-file emit; byte-identical to text.formatter.emit_index.
+
+    ``vocab`` is the sorted numpy 'S' array; postings may be uint16 or
+    int32.  Returns total bytes written.
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native emit unavailable: {_lib_error}")
+    os.makedirs(out_dir, exist_ok=True)
+    vocab_size = int(vocab.shape[0])
+    width = vocab.dtype.itemsize if vocab_size else 1
+    vbuf = np.ascontiguousarray(vocab).view(np.uint8)
+    order64 = np.ascontiguousarray(order, dtype=np.int64)
+    df64 = np.ascontiguousarray(df, dtype=np.int64)
+    off64 = np.ascontiguousarray(offsets, dtype=np.int64)
+    postings = np.ascontiguousarray(postings)
+    null16 = ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint16))
+    null32 = ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int32))
+    if postings.dtype == np.uint16:
+        p16 = postings.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+        p32 = null32
+    else:
+        postings = postings.astype(np.int32, copy=False)
+        p16 = null16
+        p32 = postings.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    rc = lib.mri_emit(
+        vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if vocab_size else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int32(vocab_size), ctypes.c_int32(width),
+        order64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if vocab_size else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
+        df64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if vocab_size else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
+        off64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if vocab_size else
+        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
+        p16, p32,
+        str(out_dir).encode(),
+    )
+    if rc < 0:
+        raise OSError(f"native emit failed writing to {out_dir!r}")
+    return int(rc)

@@ -1,0 +1,298 @@
+// Native host tokenizer: the map phase's hot loop, one pass in C++.
+//
+// Re-implements (TPU-framework-style, not a translation) what the
+// reference mapper does per token — fscanf whitespace split, delete
+// non-letters, lowercase, cap at 299 letters (main.c:102-117) — plus
+// what its reducer re-derives later: the term dictionary.  Output is
+// the integer corpus the device engine consumes: per-token sorted-vocab
+// term ids + doc ids, the packed sorted vocab, and first-letter ids.
+//
+// Single allocation arena for cleaned words, open-addressing FNV-1a
+// hash table with power-of-two growth; final std::sort over unique
+// words only (vocab-scale, not token-scale).
+//
+// Build: g++ -O3 -shared -fPIC -o libmri_tokenizer.so tokenizer.cc
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxWordLetters = 299;  // reference MAX_WORD - 1 (main.c:7,105)
+
+struct Entry {
+  uint32_t offset;  // into arena
+  uint32_t len;
+  int32_t id;       // provisional (first-occurrence) id; -1 = empty slot
+};
+
+inline bool IsSpace(uint8_t b) {
+  // C-locale isspace set, what fscanf %s splits on (main.c:102).
+  return b == ' ' || b == '\t' || b == '\n' || b == '\v' || b == '\f' || b == '\r';
+}
+
+inline uint64_t Fnv1a(const uint8_t* p, uint32_t len) {
+  uint64_t h = 1469598103934665603ull;
+  for (uint32_t i = 0; i < len; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct TokenizeResult {
+  int64_t num_tokens;
+  int32_t vocab_size;
+  int32_t vocab_width;
+  int32_t* term_ids;        // [num_tokens], sorted-vocab ids
+  int32_t* doc_ids;         // [num_tokens]
+  uint8_t* vocab_packed;    // [vocab_size * vocab_width], NUL padded, sorted
+  int32_t* letter_of_term;  // [vocab_size]
+};
+
+// data: concatenated document bytes; doc_ends[i] = exclusive end offset of
+// doc i; doc_id_values[i] = its (1-based) doc id.  Returns NULL on OOM.
+TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
+                             const int64_t* doc_ends,
+                             const int32_t* doc_id_values, int32_t num_docs) {
+  std::vector<uint8_t> arena;
+  arena.reserve(1 << 20);
+  std::vector<Entry> table(1 << 16);
+  for (auto& e : table) e.id = -1;
+  uint64_t mask = table.size() - 1;
+  int32_t next_id = 0;
+
+  std::vector<int32_t> tok_terms;
+  std::vector<int32_t> tok_docs;
+  tok_terms.reserve(len / 6 + 16);
+  tok_docs.reserve(len / 6 + 16);
+
+  std::vector<uint32_t> word_offsets;  // provisional id -> arena offset
+  std::vector<uint32_t> word_lens;
+
+  uint8_t word[kMaxWordLetters];
+  int64_t pos = 0;
+  for (int32_t d = 0; d < num_docs; ++d) {
+    const int64_t end = doc_ends[d];
+    const int32_t doc_id = doc_id_values[d];
+    while (pos < end) {
+      // skip to next token start (whitespace run)
+      int wlen = 0;
+      bool in_token = false;
+      for (; pos < end; ++pos) {
+        const uint8_t b = data[pos];
+        if (IsSpace(b)) {
+          if (in_token) break;  // token finished
+          continue;
+        }
+        in_token = true;
+        // clean: keep letters only, lowercase, cap at 299
+        if (b >= 'A' && b <= 'Z') {
+          if (wlen < kMaxWordLetters) word[wlen++] = b + 32;
+        } else if (b >= 'a' && b <= 'z') {
+          if (wlen < kMaxWordLetters) word[wlen++] = b;
+        }
+      }
+      if (!in_token) break;  // trailing whitespace
+      if (wlen == 0) continue;  // token cleaned to nothing (main.c:113)
+
+      // hash-table upsert
+      const uint64_t h = Fnv1a(word, wlen);
+      uint64_t slot = h & mask;
+      int32_t id = -1;
+      for (;;) {
+        Entry& e = table[slot];
+        if (e.id < 0) {
+          // insert
+          const uint32_t off = static_cast<uint32_t>(arena.size());
+          arena.insert(arena.end(), word, word + wlen);
+          e.offset = off;
+          e.len = wlen;
+          e.id = next_id;
+          word_offsets.push_back(off);
+          word_lens.push_back(wlen);
+          id = next_id++;
+          break;
+        }
+        if (e.len == static_cast<uint32_t>(wlen) &&
+            std::memcmp(arena.data() + e.offset, word, wlen) == 0) {
+          id = e.id;
+          break;
+        }
+        slot = (slot + 1) & mask;
+      }
+      tok_terms.push_back(id);
+      tok_docs.push_back(doc_id);
+
+      // grow at 0.7 load
+      if (static_cast<uint64_t>(next_id) * 10 > table.size() * 7) {
+        std::vector<Entry> bigger(table.size() * 2);
+        for (auto& e : bigger) e.id = -1;
+        const uint64_t bmask = bigger.size() - 1;
+        for (const Entry& e : table) {
+          if (e.id < 0) continue;
+          uint64_t s = Fnv1a(arena.data() + e.offset, e.len) & bmask;
+          while (bigger[s].id >= 0) s = (s + 1) & bmask;
+          bigger[s] = e;
+        }
+        table.swap(bigger);
+        mask = bmask;
+      }
+    }
+    pos = end;
+  }
+
+  const int32_t vocab = next_id;
+  // sort unique words lexicographically (== strcmp order: letters only)
+  std::vector<int32_t> order(vocab);
+  for (int32_t i = 0; i < vocab; ++i) order[i] = i;
+  const uint8_t* base = arena.data();
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    const uint32_t la = word_lens[a], lb = word_lens[b];
+    const int c = std::memcmp(base + word_offsets[a], base + word_offsets[b],
+                              la < lb ? la : lb);
+    if (c != 0) return c < 0;
+    return la < lb;
+  });
+
+  int32_t width = 1;
+  for (int32_t i = 0; i < vocab; ++i)
+    width = std::max(width, static_cast<int32_t>(word_lens[i]));
+
+  auto* res = static_cast<TokenizeResult*>(std::malloc(sizeof(TokenizeResult)));
+  if (!res) return nullptr;
+  const int64_t n = static_cast<int64_t>(tok_terms.size());
+  res->num_tokens = n;
+  res->vocab_size = vocab;
+  res->vocab_width = width;
+  res->term_ids = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max<int64_t>(n, 1)));
+  res->doc_ids = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max<int64_t>(n, 1)));
+  res->vocab_packed = static_cast<uint8_t*>(
+      std::calloc(std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 1));
+  res->letter_of_term = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
+  if (!res->term_ids || !res->doc_ids || !res->vocab_packed || !res->letter_of_term) {
+    std::free(res->term_ids); std::free(res->doc_ids);
+    std::free(res->vocab_packed); std::free(res->letter_of_term); std::free(res);
+    return nullptr;
+  }
+
+  // provisional id -> sorted id remap; pack vocab rows
+  std::vector<int32_t> remap(vocab);
+  for (int32_t rank = 0; rank < vocab; ++rank) {
+    const int32_t prov = order[rank];
+    remap[prov] = rank;
+    std::memcpy(res->vocab_packed + static_cast<int64_t>(rank) * width,
+                base + word_offsets[prov], word_lens[prov]);
+    res->letter_of_term[rank] = res->vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    res->term_ids[i] = remap[tok_terms[i]];
+    res->doc_ids[i] = tok_docs[i];
+  }
+  return res;
+}
+
+void mri_free_result(TokenizeResult* r) {
+  if (!r) return;
+  std::free(r->term_ids);
+  std::free(r->doc_ids);
+  std::free(r->vocab_packed);
+  std::free(r->letter_of_term);
+  std::free(r);
+}
+
+// ---------------------------------------------------------------------------
+// Native emit: render the 26 <letter>.txt postings files.
+//
+// Byte-identical to the reference's fprintf loop (main.c:227-234):
+// "word:[id1 id2 ... idN]\n", ids space separated, no trailing space.
+// Terms arrive pre-ordered (order[]); letters are contiguous in that
+// order because term ids follow sorted-vocab order.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline char* PutU32(char* p, uint32_t v) {
+  char tmp[10];
+  int n = 0;
+  do {
+    tmp[n++] = '0' + (v % 10);
+    v /= 10;
+  } while (v);
+  while (n) *p++ = tmp[--n];
+  return p;
+}
+
+}  // namespace
+
+// postings16/postings32: exactly one is non-null.  order/df/offsets are
+// int64 (numpy's native index types).  Returns total bytes written, or
+// -1 on IO error.
+int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
+                 const int64_t* order, const int64_t* df, const int64_t* offsets,
+                 const uint16_t* postings16, const int32_t* postings32,
+                 const char* out_dir) {
+  std::vector<char> buf;
+  buf.reserve(1 << 22);
+  std::string dir(out_dir);
+  if (!dir.empty() && dir.back() != '/') dir += '/';
+  int64_t total = 0;
+  int32_t idx = 0;
+  for (int letter = 0; letter < 26; ++letter) {
+    buf.clear();
+    for (; idx < vocab_size; ++idx) {
+      const int64_t t = order[idx];
+      const uint8_t* w = vocab_packed + static_cast<int64_t>(t) * width;
+      if (w[0] - 'a' != letter) break;
+      // word (NUL-padded row)
+      int wl = 0;
+      while (wl < width && w[wl]) ++wl;
+      const size_t need = buf.size() + wl + 2 + 11ull * df[t] + 2;
+      if (buf.capacity() < need) buf.reserve(need * 2);
+      const size_t old = buf.size();
+      buf.resize(old + wl + 2);
+      std::memcpy(buf.data() + old, w, wl);
+      buf[old + wl] = ':';
+      buf[old + wl + 1] = '[';
+      char tail[16];
+      const int64_t start = offsets[t], n = df[t];
+      // ids
+      char* p;
+      buf.resize(buf.size() + 11ull * n + 2);
+      p = buf.data() + old + wl + 2;
+      for (int64_t k = 0; k < n; ++k) {
+        if (k) *p++ = ' ';
+        const uint32_t v = postings16 ? postings16[start + k]
+                                      : static_cast<uint32_t>(postings32[start + k]);
+        p = PutU32(p, v);
+      }
+      *p++ = ']';
+      *p++ = '\n';
+      buf.resize(p - buf.data());
+      (void)tail;
+    }
+    std::string path = dir;
+    path += static_cast<char>('a' + letter);
+    path += ".txt";
+    FILE* f = std::fopen(path.c_str(), "wb");
+    if (!f) return -1;
+    if (!buf.empty() && std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+      std::fclose(f);
+      return -1;
+    }
+    std::fclose(f);
+    total += static_cast<int64_t>(buf.size());
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -58,15 +58,36 @@ def emit_order(letter_of_term, df, vocab_size: int, max_doc_id: int):
     return order
 
 
-def postings_from_sorted(keys_s, letter_of_term, *, vocab_size: int, max_doc_id: int):
-    """Postings/df/order from an ascending packed-key array (may contain
+def host_order_offsets(letter_of_term, df):
+    """Emit order + postings offsets computed on host from fetched df.
+
+    Cheaper than fetching the device-computed versions over a slow
+    device->host link: both are vocab-sized and derive from df alone.
+    ``np.lexsort`` is stable, so full ties fall back to term id ascending
+    == word ascending, matching main.c:55-64.
+    """
+    df64 = np.asarray(df).astype(np.int64)
+    order = np.lexsort((-df64, np.asarray(letter_of_term)))
+    offsets = np.cumsum(df64) - df64
+    return order.astype(np.int64), offsets
+
+
+def dedup_df_postings(keys_s, *, vocab_size: int, max_doc_id: int):
+    """Shared post-sort block: per-(term, doc) dedup, document frequency,
+    compacted postings — from an ascending packed-key array (may contain
     ``K.INT32_MAX`` padding, which sorts last and is dropped)."""
-    stride = max_doc_id + 2
-    valid_limit = vocab_size * stride
+    valid_limit = vocab_size * (max_doc_id + 2)
     term_s, doc_s = K.unpack_pairs(keys_s, max_doc_id)
     first = first_occurrence_mask(keys_s) & (keys_s < valid_limit)
     df = segment_counts(term_s, first.astype(jnp.int32), vocab_size)
     postings = compact(doc_s, first, keys_s.shape[0], jnp.int32(0))
+    return first, df, postings
+
+
+def postings_from_sorted(keys_s, letter_of_term, *, vocab_size: int, max_doc_id: int):
+    """Postings/df/order from an ascending packed-key array."""
+    first, df, postings = dedup_df_postings(
+        keys_s, vocab_size=vocab_size, max_doc_id=max_doc_id)
     order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df
     return {
@@ -87,6 +108,35 @@ def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
     """
     return postings_from_sorted(
         lax.sort(keys), letter_of_term, vocab_size=vocab_size, max_doc_id=max_doc_id)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"),
+                   donate_argnums=(0,))
+def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
+    """Transfer-minimized path for corpora with vocab_size <= 65535 and
+    max_doc_id <= 65534 (covers the reference's whole envelope,
+    MAX_FILES=360 at main.c:8).
+
+    The device<->host link has a large per-transfer fixed cost, so input
+    is ONE uint16 buffer: term ids in the first half, doc ids in the
+    second, 0xFFFF padding; keys are packed on device.  Output postings
+    and df are uint16 — halving the bytes fetched — and
+    ``order``/``offsets``/``num_unique`` are left for the host to derive
+    from df (engine.host_order_offsets), saving further transfers.
+    """
+    pad = jnp.uint16(0xFFFF)
+    stride = max_doc_id + 2
+    half = feed_u16.shape[0] // 2
+    term_u16, doc_u16 = feed_u16[:half], feed_u16[half:]
+    term = term_u16.astype(jnp.int32)
+    keys = jnp.where(
+        term_u16 == pad, K.INT32_MAX, term * stride + doc_u16.astype(jnp.int32))
+    _, df, postings = dedup_df_postings(
+        lax.sort(keys), vocab_size=vocab_size, max_doc_id=max_doc_id)
+    return {
+        "postings": postings.astype(jnp.uint16),
+        "df": df.astype(jnp.uint16),
+    }
 
 
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0, 1))

@@ -226,9 +226,24 @@ def _doc_token_id_bounds(buf: np.ndarray, ends: np.ndarray) -> np.ndarray:
     return space_cum[ends - 1] - 1
 
 
-def tokenize_corpus(manifest) -> TokenizedCorpus:
+def tokenize(contents: list[bytes], doc_ids: list[int],
+             use_native: bool = True) -> TokenizedCorpus:
+    """Dispatch to the C++ tokenizer when built, else the numpy path.
+
+    Both implement the identical contract (tests/test_native.py asserts
+    equivalence token-for-token).
+    """
+    if use_native:
+        from .. import native
+
+        if native.available():
+            return native.tokenize_native(contents, doc_ids)
+    return tokenize_documents(contents, doc_ids)
+
+
+def tokenize_corpus(manifest, use_native: bool = True) -> TokenizedCorpus:
     """Manifest -> TokenizedCorpus (loads files, warn-and-skip unreadable)."""
     from ..corpus.manifest import load_documents
 
     contents, doc_ids = load_documents(manifest)
-    return tokenize_documents(contents, doc_ids)
+    return tokenize(contents, doc_ids, use_native=use_native)

@@ -44,6 +44,23 @@ def test_tpu_backend_matches_reference_smoke(smoke_fixture, tmp_path):
     assert stats["lines_written"] > 0
 
 
+def test_single_chip_u16_path_matches_reference_smoke(smoke_fixture, tmp_path):
+    # device_shards=1 takes the uint16 feed/fetch fast path
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=1),
+        output_dir=tmp_path)
+    assert read_letter_files(tmp_path) == _golden(smoke_fixture)
+
+
+def test_numpy_tokenizer_path_matches_reference_smoke(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, use_native=False),
+        output_dir=tmp_path)
+    assert read_letter_files(tmp_path) == _golden(smoke_fixture)
+
+
 def test_backends_agree_on_reference_small(reference_dir, tmp_path):
     m = read_manifest(reference_dir / "test_small.txt", base_dir=reference_dir)
     out_a, out_b = tmp_path / "oracle", tmp_path / "tpu"
@@ -64,5 +81,13 @@ def test_full_corpus_md5(reference_dir, tmp_path):
     m = manifest_from_dir(reference_dir / "test_in")
     assert len(m) == 355
     build_index(m, IndexConfig(backend="tpu"), output_dir=tmp_path)
+    digest = hashlib.md5(read_letter_files(tmp_path)).hexdigest()
+    assert digest == FULL_CORPUS_MD5
+
+
+@pytest.mark.slow
+def test_full_corpus_md5_single_chip_u16(reference_dir, tmp_path):
+    m = manifest_from_dir(reference_dir / "test_in")
+    build_index(m, IndexConfig(backend="tpu", device_shards=1), output_dir=tmp_path)
     digest = hashlib.md5(read_letter_files(tmp_path)).hexdigest()
     assert digest == FULL_CORPUS_MD5

@@ -71,6 +71,27 @@ def test_index_pairs_fallback_matches_oracle():
     _check_outputs(out, corpus, max_doc_id)
 
 
+def test_index_u16_matches_oracle():
+    corpus = tokenize_documents(DOCS, IDS)
+    max_doc_id = 3
+    n = corpus.num_tokens
+    padded = 64
+    feed = np.full(2 * padded, 0xFFFF, np.uint16)
+    feed[:n] = corpus.term_ids
+    feed[padded : padded + n] = corpus.doc_ids
+    out = engine.index_u16(feed, vocab_size=corpus.vocab_size, max_doc_id=max_doc_id)
+    df = np.asarray(out["df"]).astype(np.int64)
+    order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
+    full = {
+        "df": df,
+        "order": order,
+        "offsets": offsets,
+        "postings": np.asarray(out["postings"]),
+        "num_unique": int(df.sum()),
+    }
+    _check_outputs(full, corpus, max_doc_id)
+
+
 def test_engine_paths_agree_random():
     rng = np.random.default_rng(7)
     for _ in range(5):

@@ -1,0 +1,94 @@
+"""Native C++ tokenizer: exact equivalence with the numpy reference path."""
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    tokenize_documents,
+)
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+
+
+def _assert_equal(docs, ids):
+    a = tokenize_documents(docs, ids)
+    b = native.tokenize_native(docs, ids)
+    np.testing.assert_array_equal(a.term_ids, b.term_ids)
+    np.testing.assert_array_equal(a.doc_ids, b.doc_ids)
+    assert a.vocab_strings() == b.vocab_strings()
+    np.testing.assert_array_equal(a.letter_of_term, b.letter_of_term)
+
+
+def test_edge_cases():
+    _assert_equal(
+        [
+            b"The quick brown Fox! don't stop x1y2z3",
+            b"quick\tquick\nfox 42 --- caf\xc3\xa9",
+            b"",
+            b"...only punct 123...",
+            b"A" * 350 + b" tail",
+            b"no-trailing-whitespace",
+        ],
+        [1, 2, 3, 4, 5, 6],
+    )
+
+
+def test_doc_boundaries_no_whitespace():
+    # doc1 ends mid-letters, doc2 starts with letters: must NOT merge
+    _assert_equal([b"abc", b"def"], [1, 2])
+    _assert_equal([b"abc ", b" def"], [3, 7])
+
+
+def test_empty_inputs():
+    _assert_equal([], [])
+    _assert_equal([b"", b"   ", b"123"], [1, 2, 3])
+
+
+def test_random_equivalence():
+    rng = np.random.default_rng(3)
+    alphabet = list(b"abcdefXYZ0-' \t\n\xc3\xa9.")
+    for trial in range(20):
+        n_docs = int(rng.integers(1, 8))
+        docs = [bytes(rng.choice(alphabet, size=int(rng.integers(0, 400))))
+                for _ in range(n_docs)]
+        ids = list(range(1, n_docs + 1))
+        _assert_equal(docs, ids)
+
+
+def test_emit_native_matches_python(tmp_path):
+    from conftest import read_letter_files
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.engine import (
+        host_order_offsets,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text import formatter
+
+    rng = np.random.default_rng(11)
+    docs = [b" ".join(rng.choice([b"ab", b"b", b"zeta", b"yarn", b"a"], 30))
+            for _ in range(5)]
+    ids = [1, 2, 3, 4, 5]
+    corpus = tokenize_documents(docs, ids)
+    # build postings via simple host computation
+    pairs = sorted({(int(t), int(d)) for t, d in zip(corpus.term_ids, corpus.doc_ids)})
+    df = np.bincount([t for t, _ in pairs], minlength=corpus.vocab_size)
+    postings = np.array([d for _, d in pairs], dtype=np.uint16)
+    order, offsets = host_order_offsets(corpus.letter_of_term, df)
+
+    out_n, out_p = tmp_path / "native", tmp_path / "python"
+    out_n.mkdir(), out_p.mkdir()
+    native.emit_native(out_n, corpus.vocab, order, df, offsets, postings)
+    formatter.emit_index(
+        out_p, vocab=corpus.vocab, letter_of_term=corpus.letter_of_term,
+        order=order, df=df, offsets=offsets, postings=postings.astype(np.int32),
+        max_doc_id=5)
+    assert read_letter_files(out_n) == read_letter_files(out_p)
+
+
+def test_vocab_growth_rehash():
+    # enough unique words to force several hash-table growths (>64K seed
+    # table would need ~46K words at 0.7 load; use small words to get there)
+    import itertools
+
+    words = ["".join(p) for p in itertools.product("abcdefghij", repeat=4)][:30000]
+    docs = [" ".join(words[i::3]).encode() for i in range(3)]
+    _assert_equal(docs, [1, 2, 3])
