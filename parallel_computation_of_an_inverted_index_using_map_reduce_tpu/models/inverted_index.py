@@ -73,7 +73,8 @@ class InvertedIndexModel:
         with timer.phase("load"):
             contents, doc_ids = load_documents(manifest)
         with timer.phase("tokenize"):
-            corpus = tokenize(contents, doc_ids, use_native=self.config.use_native)
+            corpus = tokenize(contents, doc_ids, use_native=self.config.use_native,
+                              dedup_pairs=True)
         if ckpt is not None:
             with timer.phase("checkpoint"):
                 checkpoint.save_pairs(ckpt, corpus, fingerprint=fp)
@@ -85,7 +86,7 @@ class InvertedIndexModel:
         max_doc_id = len(manifest)  # doc ids are 1..len(manifest)
         num_tokens, vocab_size = corpus.num_tokens, corpus.vocab_size
         timer.count("documents", num_loaded)
-        timer.count("tokens", num_tokens)
+        timer.count("tokens", corpus.raw_tokens if corpus.raw_tokens is not None else num_tokens)
         timer.count("unique_terms", vocab_size)
 
         if num_tokens == 0:
@@ -171,14 +172,28 @@ class InvertedIndexModel:
             }
 
         with timer.phase("fetch"):
-            if use_u16:
-                # two transfer ops total: df (num_unique derives from its
-                # sum), then the valid postings prefix (rounded so slice
-                # shapes, and with them compiled slice programs, reuse)
-                df = jax.device_get(out["df"]).astype(np.int64)
+            if use_u16 and corpus.pairs_deduped:
+                # the combiner made num_unique == num_tokens, so the valid
+                # prefix is known up front: ONE download op of [df | postings]
+                num_unique = num_tokens
+                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
+                combined = jax.device_get(out["combined"][: vocab_size + nfetch])
+                df = combined[:vocab_size].astype(np.int64)
+                postings = combined[vocab_size:]
+                order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
+                host = {
+                    "df": df, "order": order, "offsets": offsets,
+                    "postings": postings, "num_unique": num_unique,
+                }
+            elif use_u16:
+                # two ops: df (num_unique derives from its sum), then the
+                # valid postings prefix (rounded so slice shapes, and with
+                # them compiled slice programs, reuse)
+                df = jax.device_get(out["combined"][:vocab_size]).astype(np.int64)
                 num_unique = int(df.sum())
                 nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
-                postings = jax.device_get(out["postings"][:nfetch])
+                postings = jax.device_get(
+                    out["combined"][vocab_size : vocab_size + nfetch])
                 order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
                 host = {
                     "df": df, "order": order, "offsets": offsets,

@@ -28,6 +28,7 @@ _lib_error: str | None = None
 class _TokenizeResult(ctypes.Structure):
     _fields_ = [
         ("num_tokens", ctypes.c_int64),
+        ("raw_tokens", ctypes.c_int64),
         ("vocab_size", ctypes.c_int32),
         ("vocab_width", ctypes.c_int32),
         ("term_ids", ctypes.POINTER(ctypes.c_int32)),
@@ -42,9 +43,14 @@ def _build_dirs():
     yield Path(tempfile.gettempdir()) / f"mri_tpu_native_{os.getuid()}"
 
 
+# -march=native would SIGILL if a prebuilt .so ever moved across machines;
+# plain -O3 is within noise for this workload.
+_CXX_FLAGS = ["-O3", "-shared", "-fPIC"]
+
+
 def _compile() -> Path:
     src = _SRC.read_bytes()
-    tag = hashlib.md5(src).hexdigest()[:12]
+    tag = hashlib.md5(src + " ".join(_CXX_FLAGS).encode()).hexdigest()[:12]
     name = f"libmri_tokenizer_{tag}.so"
     last_err: Exception | None = None
     for d in _build_dirs():
@@ -55,8 +61,7 @@ def _compile() -> Path:
             d.mkdir(parents=True, exist_ok=True)
             tmp = so.with_suffix(f".{os.getpid()}.tmp")
             subprocess.run(
-                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                 "-o", str(tmp), str(_SRC)],
+                ["g++", *_CXX_FLAGS, "-o", str(tmp), str(_SRC)],
                 check=True, capture_output=True, timeout=120,
             )
             os.replace(tmp, so)
@@ -77,7 +82,7 @@ def load():
         lib.mri_tokenize.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
         ]
         lib.mri_free_result.restype = None
         lib.mri_free_result.argtypes = [ctypes.POINTER(_TokenizeResult)]
@@ -101,8 +106,13 @@ def available() -> bool:
     return load() is not None
 
 
-def tokenize_native(contents: list[bytes], doc_ids: list[int]):
-    """Native equivalent of text.tokenizer.tokenize_documents."""
+def tokenize_native(contents: list[bytes], doc_ids: list[int],
+                    dedup_pairs: bool = False):
+    """Native equivalent of text.tokenizer.tokenize_documents.
+
+    ``dedup_pairs`` applies the map-side combiner: each (term, doc) pair
+    is emitted once (output-invariant; see tokenizer.cc).
+    """
     from ..text.tokenizer import TokenizedCorpus
 
     lib = load()
@@ -124,6 +134,7 @@ def tokenize_native(contents: list[bytes], doc_ids: list[int]):
         ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if n_docs else
         ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int32)),
         ctypes.c_int32(n_docs),
+        ctypes.c_int32(1 if dedup_pairs else 0),
     )
     if not res:
         raise MemoryError("native tokenizer allocation failure")
@@ -136,7 +147,8 @@ def tokenize_native(contents: list[bytes], doc_ids: list[int]):
         letters = np.ctypeslib.as_array(r.letter_of_term, shape=(max(v, 1),))[:v].copy()
         vocab = packed.view(f"S{w}") if v else np.empty(0, "S1")
         return TokenizedCorpus(
-            term_ids=term, doc_ids=doc, vocab=vocab, letter_of_term=letters)
+            term_ids=term, doc_ids=doc, vocab=vocab, letter_of_term=letters,
+            pairs_deduped=bool(dedup_pairs), raw_tokens=int(r.raw_tokens))
     finally:
         lib.mri_free_result(res)
 

@@ -50,7 +50,8 @@ inline uint64_t Fnv1a(const uint8_t* p, uint32_t len) {
 extern "C" {
 
 struct TokenizeResult {
-  int64_t num_tokens;
+  int64_t num_tokens;   // emitted pairs (== raw tokens unless dedup_pairs)
+  int64_t raw_tokens;   // tokens scanned before the combiner
   int32_t vocab_size;
   int32_t vocab_width;
   int32_t* term_ids;        // [num_tokens], sorted-vocab ids
@@ -60,10 +61,15 @@ struct TokenizeResult {
 };
 
 // data: concatenated document bytes; doc_ends[i] = exclusive end offset of
-// doc i; doc_id_values[i] = its (1-based) doc id.  Returns NULL on OOM.
+// doc i; doc_id_values[i] = its (1-based) doc id.  dedup_pairs != 0
+// enables the combiner: each (term, doc) pair is emitted once (the
+// reference reducer's dedup, main.c:176-184, pulled forward into the map
+// phase — output-invariant, shrinks the device feed ~4x on real text).
+// Returns NULL on OOM.
 TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
                              const int64_t* doc_ends,
-                             const int32_t* doc_id_values, int32_t num_docs) {
+                             const int32_t* doc_id_values, int32_t num_docs,
+                             int32_t dedup_pairs) {
   std::vector<uint8_t> arena;
   arena.reserve(1 << 20);
   std::vector<Entry> table(1 << 16);
@@ -78,7 +84,9 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
 
   std::vector<uint32_t> word_offsets;  // provisional id -> arena offset
   std::vector<uint32_t> word_lens;
+  std::vector<int32_t> last_doc;       // provisional id -> last doc ordinal seen
 
+  int64_t raw_tokens = 0;
   uint8_t word[kMaxWordLetters];
   int64_t pos = 0;
   for (int32_t d = 0; d < num_docs; ++d) {
@@ -120,6 +128,7 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
           e.id = next_id;
           word_offsets.push_back(off);
           word_lens.push_back(wlen);
+          last_doc.push_back(-1);
           id = next_id++;
           break;
         }
@@ -129,6 +138,11 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
           break;
         }
         slot = (slot + 1) & mask;
+      }
+      ++raw_tokens;
+      if (dedup_pairs) {
+        if (last_doc[id] == d) continue;  // (term, doc) already emitted
+        last_doc[id] = d;
       }
       tok_terms.push_back(id);
       tok_docs.push_back(doc_id);
@@ -172,6 +186,7 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
   if (!res) return nullptr;
   const int64_t n = static_cast<int64_t>(tok_terms.size());
   res->num_tokens = n;
+  res->raw_tokens = raw_tokens;
   res->vocab_size = vocab;
   res->vocab_width = width;
   res->term_ids = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max<int64_t>(n, 1)));
@@ -263,7 +278,6 @@ int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
       std::memcpy(buf.data() + old, w, wl);
       buf[old + wl] = ':';
       buf[old + wl + 1] = '[';
-      char tail[16];
       const int64_t start = offsets[t], n = df[t];
       // ids
       char* p;
@@ -278,7 +292,6 @@ int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
       *p++ = ']';
       *p++ = '\n';
       buf.resize(p - buf.data());
-      (void)tail;
     }
     std::string path = dir;
     path += static_cast<char>('a' + letter);

@@ -133,10 +133,11 @@ def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
         term_u16 == pad, K.INT32_MAX, term * stride + doc_u16.astype(jnp.int32))
     _, df, postings = dedup_df_postings(
         lax.sort(keys), vocab_size=vocab_size, max_doc_id=max_doc_id)
-    return {
-        "postings": postings.astype(jnp.uint16),
-        "df": df.astype(jnp.uint16),
-    }
+    # single output [df | postings]: with a pre-deduped feed (num_unique
+    # known on host up front) the whole result is ONE download op; other
+    # callers slice df/postings out of it host-side
+    return {"combined": jnp.concatenate(
+        [df.astype(jnp.uint16), postings.astype(jnp.uint16)])}
 
 
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0, 1))

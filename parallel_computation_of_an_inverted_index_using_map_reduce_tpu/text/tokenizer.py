@@ -56,6 +56,10 @@ class TokenizedCorpus:
     doc_ids: np.ndarray       # int32 (num_tokens,)
     vocab: np.ndarray         # (vocab_size,) numpy bytes (S) array, sorted
     letter_of_term: np.ndarray  # int32 (vocab_size,), first letter - 'a'
+    # combiner applied: each (term, doc) pair appears exactly once (the
+    # reducer dedup of main.c:176-184 pulled into the map phase)
+    pairs_deduped: bool = False
+    raw_tokens: int | None = None  # tokens scanned before the combiner
 
     @property
     def num_tokens(self) -> int:
@@ -227,17 +231,19 @@ def _doc_token_id_bounds(buf: np.ndarray, ends: np.ndarray) -> np.ndarray:
 
 
 def tokenize(contents: list[bytes], doc_ids: list[int],
-             use_native: bool = True) -> TokenizedCorpus:
+             use_native: bool = True, dedup_pairs: bool = False) -> TokenizedCorpus:
     """Dispatch to the C++ tokenizer when built, else the numpy path.
 
     Both implement the identical contract (tests/test_native.py asserts
-    equivalence token-for-token).
+    equivalence token-for-token).  ``dedup_pairs`` applies the map-side
+    combiner (native path only; the numpy path leaves duplicates for the
+    device engine to fold, which is output-invariant).
     """
     if use_native:
         from .. import native
 
         if native.available():
-            return native.tokenize_native(contents, doc_ids)
+            return native.tokenize_native(contents, doc_ids, dedup_pairs=dedup_pairs)
     return tokenize_documents(contents, doc_ids)
 
 

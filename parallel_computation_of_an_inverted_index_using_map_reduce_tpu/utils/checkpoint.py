@@ -48,6 +48,8 @@ def save_pairs(path: str | Path, corpus, fingerprint: str = "") -> None:
             doc_ids=corpus.doc_ids,
             vocab=corpus.vocab,
             letter_of_term=corpus.letter_of_term,
+            pairs_deduped=np.int64(1 if corpus.pairs_deduped else 0),
+            raw_tokens=np.int64(corpus.raw_tokens if corpus.raw_tokens is not None else -1),
         )
     os.replace(tmp, path)
 
@@ -67,9 +69,12 @@ def load_pairs(path: str | Path, expect_fingerprint: str | None = None):
                 f"(saved {saved_fp[:12]}…, current {expect_fingerprint[:12]}…); "
                 "delete the checkpoint or restore the original file list"
             )
+        raw = int(z["raw_tokens"]) if "raw_tokens" in z.files else -1
         return TokenizedCorpus(
             term_ids=z["term_ids"],
             doc_ids=z["doc_ids"],
             vocab=z["vocab"],
             letter_of_term=z["letter_of_term"],
+            pairs_deduped=bool(int(z["pairs_deduped"])) if "pairs_deduped" in z.files else False,
+            raw_tokens=raw if raw >= 0 else None,
         )

@@ -80,6 +80,9 @@ def test_index_u16_matches_oracle():
     feed[:n] = corpus.term_ids
     feed[padded : padded + n] = corpus.doc_ids
     out = engine.index_u16(feed, vocab_size=corpus.vocab_size, max_doc_id=max_doc_id)
+    combined = np.asarray(out["combined"])
+    out = {"df": combined[: corpus.vocab_size],
+           "postings": combined[corpus.vocab_size :]}
     df = np.asarray(out["df"]).astype(np.int64)
     order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
     full = {

@@ -56,6 +56,24 @@ def test_random_equivalence():
         _assert_equal(docs, ids)
 
 
+def test_dedup_pairs_combiner():
+    docs = [b"a b a a c b", b"a a a", b"", b"c c b"]
+    ids = [1, 2, 3, 4]
+    plain = native.tokenize_native(docs, ids)
+    dedup = native.tokenize_native(docs, ids, dedup_pairs=True)
+    assert plain.raw_tokens == dedup.raw_tokens == 12
+    assert not plain.pairs_deduped and dedup.pairs_deduped
+    # deduped stream = unique pairs of the plain stream, first-occurrence order
+    seen, expected = set(), []
+    for t, d in zip(plain.term_ids, plain.doc_ids):
+        if (int(t), int(d)) not in seen:
+            seen.add((int(t), int(d)))
+            expected.append((int(t), int(d)))
+    got = list(zip(dedup.term_ids.tolist(), dedup.doc_ids.tolist()))
+    assert got == expected
+    assert dedup.vocab_strings() == plain.vocab_strings()
+
+
 def test_emit_native_matches_python(tmp_path):
     from conftest import read_letter_files
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.engine import (
@@ -85,10 +103,11 @@ def test_emit_native_matches_python(tmp_path):
 
 
 def test_vocab_growth_rehash():
-    # enough unique words to force several hash-table growths (>64K seed
-    # table would need ~46K words at 0.7 load; use small words to get there)
+    # the 1<<16 seed table grows past 45,876 entries at 0.7 load; 60,000
+    # unique words force (at least) one rehash of the C++ table
     import itertools
 
-    words = ["".join(p) for p in itertools.product("abcdefghij", repeat=4)][:30000]
+    words = ["".join(p) for p in itertools.product("abcdefghijklmnopq", repeat=4)][:60000]
+    assert len(set(words)) == 60000
     docs = [" ".join(words[i::3]).encode() for i in range(3)]
     _assert_equal(docs, [1, 2, 3])
