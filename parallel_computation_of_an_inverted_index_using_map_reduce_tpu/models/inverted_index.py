@@ -150,7 +150,10 @@ class InvertedIndexModel:
             else contextlib.nullcontext()
         )
         with timer.phase("device_index"), profile:
-            if use_u16:
+            if use_u16 and corpus.pairs_deduped:
+                out = {"postings_sorted": engine.index_prededuped_u16(
+                    feed_dev, max_doc_id=max_doc_id)}
+            elif use_u16:
                 out = engine.index_u16(
                     feed_dev, vocab_size=vocab_size, max_doc_id=max_doc_id)
             elif use_dist:
@@ -173,13 +176,20 @@ class InvertedIndexModel:
 
         with timer.phase("fetch"):
             if use_u16 and corpus.pairs_deduped:
-                # the combiner made num_unique == num_tokens, so the valid
-                # prefix is known up front: ONE download op of [df | postings]
+                # the combiner made num_unique == num_tokens and df is just
+                # a host bincount of the deduped term ids, so the fetch is
+                # ONE download op of the valid postings prefix
                 num_unique = num_tokens
                 nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
-                combined = jax.device_get(out["combined"][: vocab_size + nfetch])
-                df = combined[:vocab_size].astype(np.int64)
-                postings = combined[vocab_size:]
+                postings = jax.device_get(out["postings_sorted"][:nfetch])
+                df = np.bincount(corpus.term_ids, minlength=vocab_size).astype(np.int64)
+                # guard the combiner invariant this path relies on: term
+                # ids within vocab, per-term counts within the doc count
+                if len(df) != vocab_size or (vocab_size and int(df.max()) > max_doc_id):
+                    raise ValueError(
+                        "pairs_deduped feed violates its invariant "
+                        f"(df len {len(df)} vs vocab {vocab_size}); "
+                        "corrupt checkpoint or tokenizer bug")
                 order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
                 host = {
                     "df": df, "order": order, "offsets": offsets,

@@ -110,6 +110,31 @@ def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
         lax.sort(keys), letter_of_term, vocab_size=vocab_size, max_doc_id=max_doc_id)
 
 
+def _u16_feed_to_keys(feed_u16, max_doc_id: int):
+    """[terms | docs] uint16 buffer (0xFFFF padding) -> packed int32 keys."""
+    pad = jnp.uint16(0xFFFF)
+    stride = max_doc_id + 2
+    half = feed_u16.shape[0] // 2
+    term_u16, doc_u16 = feed_u16[:half], feed_u16[half:]
+    return jnp.where(
+        term_u16 == pad, K.INT32_MAX,
+        term_u16.astype(jnp.int32) * stride + doc_u16.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("max_doc_id",), donate_argnums=(0,))
+def index_prededuped_u16(feed_u16, *, max_doc_id: int):
+    """Minimal device program for a combiner-deduped feed.
+
+    When the host map phase already emitted each (term, doc) pair once
+    (native tokenizer's combiner), the reduce phase is exactly one sort:
+    postings = doc component of the ascending pair keys.  df, order and
+    offsets all derive from the deduped term ids on host (np.bincount +
+    lexsort, vocab-sized).  One upload, one download.
+    """
+    keys = _u16_feed_to_keys(feed_u16, max_doc_id)
+    return (lax.sort(keys) % (max_doc_id + 2)).astype(jnp.uint16)
+
+
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"),
                    donate_argnums=(0,))
 def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
@@ -123,19 +148,14 @@ def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
     and df are uint16 — halving the bytes fetched — and
     ``order``/``offsets``/``num_unique`` are left for the host to derive
     from df (engine.host_order_offsets), saving further transfers.
+    (Feeds already deduped by the combiner skip this entirely —
+    :func:`index_prededuped_u16` is one sort and one download.)
     """
-    pad = jnp.uint16(0xFFFF)
-    stride = max_doc_id + 2
-    half = feed_u16.shape[0] // 2
-    term_u16, doc_u16 = feed_u16[:half], feed_u16[half:]
-    term = term_u16.astype(jnp.int32)
-    keys = jnp.where(
-        term_u16 == pad, K.INT32_MAX, term * stride + doc_u16.astype(jnp.int32))
+    keys = _u16_feed_to_keys(feed_u16, max_doc_id)
     _, df, postings = dedup_df_postings(
         lax.sort(keys), vocab_size=vocab_size, max_doc_id=max_doc_id)
-    # single output [df | postings]: with a pre-deduped feed (num_unique
-    # known on host up front) the whole result is ONE download op; other
-    # callers slice df/postings out of it host-side
+    # single output [df | postings]: callers slice host-side, so the fetch
+    # is at most two download ops (df prefix, then valid postings prefix)
     return {"combined": jnp.concatenate(
         [df.astype(jnp.uint16), postings.astype(jnp.uint16)])}
 
