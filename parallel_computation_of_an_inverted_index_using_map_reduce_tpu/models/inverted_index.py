@@ -149,11 +149,39 @@ class InvertedIndexModel:
             if self.config.profile_dir
             else contextlib.nullcontext()
         )
+        if use_u16 and corpus.pairs_deduped:
+            # Latency-pipelined fast path.  The device->host link has a
+            # large fixed (RTT-like) issue cost; issuing the fetch right
+            # after dispatch hides it behind the in-flight upload +
+            # sort, and the host derives df/order/offsets meanwhile.
+            with timer.phase("device_index"), profile:
+                post_dev = engine.index_prededuped_u16(feed_dev, max_doc_id=max_doc_id)
+                post_dev.copy_to_host_async()
+                num_unique = num_tokens
+                df = np.bincount(corpus.term_ids, minlength=vocab_size).astype(np.int64)
+                # guard the combiner invariant this path relies on: term
+                # ids within vocab, per-term counts within the doc count
+                if len(df) != vocab_size or (vocab_size and int(df.max()) > max_doc_id):
+                    raise ValueError(
+                        "pairs_deduped feed violates its invariant "
+                        f"(df len {len(df)} vs vocab {vocab_size}); "
+                        "corrupt checkpoint or tokenizer bug")
+                order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
+                if self.config.profile_dir:
+                    # keep the in-flight sort + D2H inside the trace window
+                    post_dev.block_until_ready()
+            with timer.phase("fetch"):
+                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
+                postings = np.asarray(post_dev)[:nfetch]
+                host = {
+                    "df": df, "order": order, "offsets": offsets,
+                    "postings": postings, "num_unique": num_unique,
+                }
+            return self._emit_and_report(
+                corpus, host, out_dir, timer, vocab_size, max_doc_id)
+
         with timer.phase("device_index"), profile:
-            if use_u16 and corpus.pairs_deduped:
-                out = {"postings_sorted": engine.index_prededuped_u16(
-                    feed_dev, max_doc_id=max_doc_id)}
-            elif use_u16:
+            if use_u16:
                 out = engine.index_u16(
                     feed_dev, vocab_size=vocab_size, max_doc_id=max_doc_id)
             elif use_dist:
@@ -175,27 +203,7 @@ class InvertedIndexModel:
             }
 
         with timer.phase("fetch"):
-            if use_u16 and corpus.pairs_deduped:
-                # the combiner made num_unique == num_tokens and df is just
-                # a host bincount of the deduped term ids, so the fetch is
-                # ONE download op of the valid postings prefix
-                num_unique = num_tokens
-                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
-                postings = jax.device_get(out["postings_sorted"][:nfetch])
-                df = np.bincount(corpus.term_ids, minlength=vocab_size).astype(np.int64)
-                # guard the combiner invariant this path relies on: term
-                # ids within vocab, per-term counts within the doc count
-                if len(df) != vocab_size or (vocab_size and int(df.max()) > max_doc_id):
-                    raise ValueError(
-                        "pairs_deduped feed violates its invariant "
-                        f"(df len {len(df)} vs vocab {vocab_size}); "
-                        "corrupt checkpoint or tokenizer bug")
-                order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
-                host = {
-                    "df": df, "order": order, "offsets": offsets,
-                    "postings": postings, "num_unique": num_unique,
-                }
-            elif use_u16:
+            if use_u16:
                 # two ops: df (num_unique derives from its sum), then the
                 # valid postings prefix (rounded so slice shapes, and with
                 # them compiled slice programs, reuse)
@@ -212,6 +220,9 @@ class InvertedIndexModel:
             else:
                 host = jax.device_get(out)
 
+        return self._emit_and_report(corpus, host, out_dir, timer, vocab_size, max_doc_id)
+
+    def _emit_and_report(self, corpus, host, out_dir, timer, vocab_size, max_doc_id) -> dict:
         with timer.phase("emit"):
             from .. import native
 
