@@ -37,6 +37,8 @@ def make_parser() -> argparse.ArgumentParser:
                    help="save/resume the tokenized map-phase pairs at this path")
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
     p.add_argument("--stats", action="store_true", help="print a JSON stats line to stdout")
+    p.add_argument("--skew", action="store_true",
+                   help="also measure letter vs hash-bucket partition skew on device")
     return p
 
 
@@ -52,6 +54,7 @@ def main(argv: list[str] | None = None) -> int:
             pad_multiple=args.pad_multiple,
             checkpoint_path=args.checkpoint,
             profile_dir=args.profile_dir,
+            collect_skew_stats=args.skew,
         )
         stats = build_index(manifest, config)
     except (OSError, ValueError) as e:

@@ -50,6 +50,10 @@ class IndexConfig:
     # files, which double as a checkpoint — SURVEY.md §5): save the
     # tokenized pair arrays here, and resume from them if present.
     checkpoint_path: str | None = None
+    # Measure shuffle-partition skew on device (utils/stats.py): letter
+    # partitioning vs hash buckets.  Off the hot path; adds a device
+    # round-trip, so opt-in.
+    collect_skew_stats: bool = False
 
     def __post_init__(self) -> None:
         if self.num_mappers < 1:

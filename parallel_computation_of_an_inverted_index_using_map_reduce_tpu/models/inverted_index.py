@@ -31,12 +31,9 @@ from ..ops import engine
 from ..ops import keys as K
 from ..text import formatter
 from ..text.tokenizer import tokenize
+from ..utils.rounding import round_up as _round_up
 from ..utils.timing import PhaseTimer
 from .oracle import oracle_index
-
-
-def _round_up(n: int, multiple: int) -> int:
-    return ((max(n, 1) + multiple - 1) // multiple) * multiple
 
 
 class InvertedIndexModel:
@@ -88,6 +85,16 @@ class InvertedIndexModel:
         timer.count("documents", num_loaded)
         timer.count("tokens", corpus.raw_tokens if corpus.raw_tokens is not None else num_tokens)
         timer.count("unique_terms", vocab_size)
+
+        if self.config.collect_skew_stats and num_tokens:
+            from ..utils.stats import partition_skew
+
+            with timer.phase("skew_stats"):
+                skew = partition_skew(
+                    corpus.term_ids, corpus.letter_of_term,
+                    num_buckets=max(len(jax.devices()), 2))
+            timer.count("letter_imbalance", round(skew["letter_imbalance"], 3))
+            timer.count("bucket_imbalance", round(skew["bucket_imbalance"], 3))
 
         if num_tokens == 0:
             with timer.phase("emit"):
@@ -154,10 +161,12 @@ class InvertedIndexModel:
             # large fixed (RTT-like) issue cost; issuing the fetch right
             # after dispatch hides it behind the in-flight upload +
             # sort, and the host derives df/order/offsets meanwhile.
+            num_unique = num_tokens
+            nfetch = min(padded, _round_up(num_unique, 1 << 16))
             with timer.phase("device_index"), profile:
-                post_dev = engine.index_prededuped_u16(feed_dev, max_doc_id=max_doc_id)
+                post_dev = engine.index_prededuped_u16(
+                    feed_dev, max_doc_id=max_doc_id, out_size=nfetch)
                 post_dev.copy_to_host_async()
-                num_unique = num_tokens
                 df = np.bincount(corpus.term_ids, minlength=vocab_size).astype(np.int64)
                 # guard the combiner invariant this path relies on: term
                 # ids within vocab, per-term counts within the doc count
@@ -171,8 +180,7 @@ class InvertedIndexModel:
                     # keep the in-flight sort + D2H inside the trace window
                     post_dev.block_until_ready()
             with timer.phase("fetch"):
-                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
-                postings = np.asarray(post_dev)[:nfetch]
+                postings = np.asarray(post_dev)
                 host = {
                     "df": df, "order": order, "offsets": offsets,
                     "postings": postings, "num_unique": num_unique,

@@ -23,6 +23,7 @@ sorted state via a hash-bucket ``all_to_all`` instead of one local sort.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,29 @@ from jax import lax
 
 from . import keys as K
 from .segment import compact, first_occurrence_mask, segment_counts
+
+# Fused Pallas kernel for the dedup mask (ops/pallas/kernels.py):
+#   "auto"  — compiled kernel on TPU, XLA elsewhere (default)
+#   "force" — always (interpret mode off-TPU; used by tests)
+#   "off"   — XLA everywhere
+_PALLAS_MODE = os.environ.get("MRI_TPU_PALLAS", "auto")
+
+
+def _dedup_mask(keys_s, valid_limit: int):
+    """(mask, count) over ascending keys: first-occurrence & validity.
+
+    Via the fused Pallas kernel when eligible (trace-time choice),
+    which also yields the unique count for free; the XLA fallback
+    returns ``count=None`` and callers reduce the mask instead.
+    """
+    if _PALLAS_MODE != "off":
+        from .pallas import kernels as pk
+
+        if pk.supports(keys_s.shape[0]) and (
+            _PALLAS_MODE == "force" or jax.default_backend() == "tpu"
+        ):
+            return pk.unique_mask_count(keys_s, valid_limit)
+    return first_occurrence_mask(keys_s) & (keys_s < valid_limit), None
 
 
 def emit_order_keys(letter_of_term, df, max_doc_id: int):
@@ -75,18 +99,22 @@ def host_order_offsets(letter_of_term, df):
 def dedup_df_postings(keys_s, *, vocab_size: int, max_doc_id: int):
     """Shared post-sort block: per-(term, doc) dedup, document frequency,
     compacted postings — from an ascending packed-key array (may contain
-    ``K.INT32_MAX`` padding, which sorts last and is dropped)."""
+    ``K.INT32_MAX`` padding, which sorts last and is dropped).
+
+    Returns ``(first, df, postings, num_unique)``; the unique count
+    comes fused from the Pallas kernel when it ran."""
     valid_limit = vocab_size * (max_doc_id + 2)
     term_s, doc_s = K.unpack_pairs(keys_s, max_doc_id)
-    first = first_occurrence_mask(keys_s) & (keys_s < valid_limit)
+    first, count = _dedup_mask(keys_s, valid_limit)
     df = segment_counts(term_s, first.astype(jnp.int32), vocab_size)
     postings = compact(doc_s, first, keys_s.shape[0], jnp.int32(0))
-    return first, df, postings
+    num_unique = count if count is not None else first.astype(jnp.int32).sum()
+    return first, df, postings, num_unique
 
 
 def postings_from_sorted(keys_s, letter_of_term, *, vocab_size: int, max_doc_id: int):
     """Postings/df/order from an ascending packed-key array."""
-    first, df, postings = dedup_df_postings(
+    _, df, postings, num_unique = dedup_df_postings(
         keys_s, vocab_size=vocab_size, max_doc_id=max_doc_id)
     order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df
@@ -95,7 +123,7 @@ def postings_from_sorted(keys_s, letter_of_term, *, vocab_size: int, max_doc_id:
         "df": df,
         "order": order,
         "offsets": offsets,
-        "num_unique": first.astype(jnp.int32).sum(),
+        "num_unique": num_unique,
     }
 
 
@@ -121,18 +149,21 @@ def _u16_feed_to_keys(feed_u16, max_doc_id: int):
         term_u16.astype(jnp.int32) * stride + doc_u16.astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("max_doc_id",), donate_argnums=(0,))
-def index_prededuped_u16(feed_u16, *, max_doc_id: int):
+@functools.partial(jax.jit, static_argnames=("max_doc_id", "out_size"), donate_argnums=(0,))
+def index_prededuped_u16(feed_u16, *, max_doc_id: int, out_size: int | None = None):
     """Minimal device program for a combiner-deduped feed.
 
     When the host map phase already emitted each (term, doc) pair once
     (native tokenizer's combiner), the reduce phase is exactly one sort:
     postings = doc component of the ascending pair keys.  df, order and
     offsets all derive from the deduped term ids on host (np.bincount +
-    lexsort, vocab-sized).  One upload, one download.
+    lexsort, vocab-sized).  One upload, one download — ``out_size``
+    (static) limits the download to the valid prefix so the D2H
+    transfer never includes padding beyond the rounding granule.
     """
     keys = _u16_feed_to_keys(feed_u16, max_doc_id)
-    return (lax.sort(keys) % (max_doc_id + 2)).astype(jnp.uint16)
+    sorted_docs = (lax.sort(keys) % (max_doc_id + 2)).astype(jnp.uint16)
+    return sorted_docs if out_size is None else sorted_docs[:out_size]
 
 
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"),
@@ -152,7 +183,7 @@ def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
     :func:`index_prededuped_u16` is one sort and one download.)
     """
     keys = _u16_feed_to_keys(feed_u16, max_doc_id)
-    _, df, postings = dedup_df_postings(
+    _, df, postings, _ = dedup_df_postings(
         lax.sort(keys), vocab_size=vocab_size, max_doc_id=max_doc_id)
     # single output [df | postings]: callers slice host-side, so the fetch
     # is at most two download ops (df prefix, then valid postings prefix)

@@ -39,11 +39,8 @@ from jax.sharding import Mesh
 from ..ops import keys as K
 from ..ops.engine import emit_order
 from ..ops.segment import compact, first_occurrence_mask
+from ..utils.rounding import round_up as _round_up
 from .mesh import SHARD_AXIS, make_mesh, replicated_spec, shard_spec
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
 
 
 def default_capacity(local_size: int, num_shards: int, factor: float = 2.0) -> int:

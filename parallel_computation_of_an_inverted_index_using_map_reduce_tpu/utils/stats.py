@@ -1,0 +1,51 @@
+"""Partition-skew statistics (device-computed).
+
+The reference partitions the shuffle by first letter, which is ~1000x
+skewed on real text (partial_t = 156,038 tokens vs partial_x = 154,
+SURVEY.md §2.3); the TPU engine partitions by term hash, which is
+near-uniform.  This module measures both on device via the Pallas
+histogram kernel so the imbalance is observable per run (the
+reference offers no such observability — printf only, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import ALPHABET_SIZE
+from ..ops.pallas import kernels as pk
+from .rounding import round_up as _round_up
+
+
+def partition_skew(term_ids, letter_of_term, num_buckets: int) -> dict:
+    """Compare letter-partition vs hash-bucket-partition balance.
+
+    ``term_ids`` are the emitted pair term ids (any length);
+    ``letter_of_term`` maps term id -> 0..25.  Returns per-partition
+    counts and the max/mean imbalance ratio for both policies.
+    """
+    terms = np.asarray(term_ids, dtype=np.int32)
+    letters = np.asarray(letter_of_term, dtype=np.int32)
+    n = _round_up(terms.shape[0], pk.BLOCK)
+    pad_letters = np.full(n, ALPHABET_SIZE, np.int32)
+    pad_buckets = np.full(n, num_buckets, np.int32)
+    if terms.size:
+        pad_letters[: terms.shape[0]] = letters[terms]
+        pad_buckets[: terms.shape[0]] = terms % num_buckets
+
+    letter_counts = np.asarray(pk.bucket_histogram(jnp.asarray(pad_letters), ALPHABET_SIZE))
+    bucket_counts = np.asarray(pk.bucket_histogram(jnp.asarray(pad_buckets), num_buckets))
+
+    def imbalance(counts: np.ndarray) -> float:
+        mean = counts.mean()
+        return float(counts.max() / mean) if mean > 0 else 0.0
+
+    return {
+        "letter_counts": letter_counts,
+        "bucket_counts": bucket_counts,
+        "letter_imbalance": imbalance(letter_counts),
+        "bucket_imbalance": imbalance(bucket_counts),
+        "num_buckets": num_buckets,
+    }

@@ -1,0 +1,135 @@
+"""Pallas kernel equivalence tests (interpret mode on the CPU backend).
+
+Each kernel is checked against its XLA/numpy reference on randomized
+inputs, including the padding tail the engine feeds them (INT32_MAX
+sorts last and must be masked out by valid_limit).
+"""
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import keys as K
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.pallas import kernels
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.segment import (
+    first_occurrence_mask,
+)
+
+BLOCK = kernels._BLOCK
+
+
+def _sorted_keys(n, n_valid, vocab, stride, seed):
+    rng = np.random.default_rng(seed)
+    term = rng.integers(0, vocab, n_valid)
+    doc = rng.integers(1, stride - 1, n_valid)
+    keys = np.full(n, K.INT32_MAX, np.int32)
+    keys[:n_valid] = term * stride + doc
+    return np.sort(keys)
+
+
+def test_supports():
+    assert kernels.supports(BLOCK)
+    assert kernels.supports(4 * BLOCK)
+    assert not kernels.supports(BLOCK + 128)
+    assert not kernels.supports(BLOCK // 2)
+
+
+@pytest.mark.parametrize("seed,blocks", [(0, 1), (1, 2), (2, 4)])
+def test_unique_mask_count_matches_xla(seed, blocks):
+    n = blocks * BLOCK
+    vocab, stride = 5000, 357
+    keys = _sorted_keys(n, n - 777, vocab, stride, seed)
+    limit = vocab * stride
+
+    mask, count = kernels.unique_mask_count(keys, limit)
+    mask, count = np.asarray(mask), int(count)
+
+    expect = np.asarray(first_occurrence_mask(keys)) & (keys < limit)
+    np.testing.assert_array_equal(mask, expect)
+    assert count == int(expect.sum())
+
+
+def test_unique_mask_count_dense_runs():
+    # long runs of equal keys exercise the cross-block carry
+    n = 2 * BLOCK
+    keys = np.sort(np.repeat(np.arange(64, dtype=np.int32) * 7, n // 64))
+    mask, count = kernels.unique_mask_count(keys, 1 << 30)
+    expect = np.asarray(first_occurrence_mask(keys))
+    np.testing.assert_array_equal(np.asarray(mask), expect)
+    assert int(count) == 64
+
+
+def test_unique_mask_count_all_padding():
+    keys = np.full(BLOCK, K.INT32_MAX, np.int32)
+    mask, count = kernels.unique_mask_count(keys, 100)
+    assert int(count) == 0
+    assert not np.asarray(mask).any()
+
+
+def test_unique_mask_count_rejects_bad_size():
+    with pytest.raises(ValueError):
+        kernels.unique_mask_count(np.zeros(100, np.int32), 10)
+
+
+@pytest.mark.parametrize("num_buckets", [1, 8, 26, 128])
+def test_bucket_histogram_matches_bincount(num_buckets):
+    rng = np.random.default_rng(num_buckets)
+    # include out-of-range padding values (== num_buckets) to be dropped
+    vals = rng.integers(0, num_buckets + 1, 2 * BLOCK).astype(np.int32)
+    counts = np.asarray(kernels.bucket_histogram(vals, num_buckets))
+    expect = np.bincount(vals[vals < num_buckets], minlength=num_buckets)
+    np.testing.assert_array_equal(counts, expect)
+
+
+def test_engine_uses_pallas_mask_when_forced(monkeypatch):
+    # index_packed through the forced Pallas dedup path must match the
+    # XLA path bit-for-bit on a full-scale padded array
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import engine
+
+    vocab, max_doc = 500, 40
+    stride = max_doc + 2
+    n = BLOCK
+    rng = np.random.default_rng(3)
+    keys = np.full(n, K.INT32_MAX, np.int32)
+    nv = n - 999
+    keys[:nv] = rng.integers(0, vocab, nv) * stride + rng.integers(1, max_doc + 1, nv)
+    letters = np.sort(rng.integers(0, 26, vocab)).astype(np.int32)
+
+    def run():
+        engine.index_packed.clear_cache()
+        return {k: np.asarray(v) for k, v in engine.index_packed(
+            keys.copy(), letters, vocab_size=vocab, max_doc_id=max_doc).items()}
+
+    monkeypatch.setattr(engine, "_PALLAS_MODE", "off")
+    xla = run()
+    monkeypatch.setattr(engine, "_PALLAS_MODE", "force")
+    pallas = run()
+    for key in xla:
+        np.testing.assert_array_equal(xla[key], pallas[key], err_msg=key)
+
+
+def test_partition_skew_stats():
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.utils.stats import (
+        partition_skew,
+    )
+
+    rng = np.random.default_rng(0)
+    vocab = 1000
+    letters = np.sort(rng.integers(0, 26, vocab)).astype(np.int32)
+    # Zipf-ish skew: most pairs hit low term ids (clustered letters)
+    terms = (rng.zipf(1.5, 20_000) % vocab).astype(np.int32)
+    s = partition_skew(terms, letters, num_buckets=8)
+    assert int(s["letter_counts"].sum()) == terms.shape[0]
+    assert int(s["bucket_counts"].sum()) == terms.shape[0]
+    np.testing.assert_array_equal(
+        s["letter_counts"], np.bincount(letters[terms], minlength=26))
+    # hash buckets must balance far better than letters on Zipf input
+    assert s["bucket_imbalance"] < s["letter_imbalance"]
+
+
+def test_bucket_histogram_validates():
+    with pytest.raises(ValueError):
+        kernels.bucket_histogram(np.zeros(BLOCK, np.int32), 0)
+    with pytest.raises(ValueError):
+        kernels.bucket_histogram(np.zeros(BLOCK, np.int32), 200)
+    with pytest.raises(ValueError):
+        kernels.bucket_histogram(np.zeros(7, np.int32), 8)
