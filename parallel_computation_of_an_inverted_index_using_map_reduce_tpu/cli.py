@@ -39,6 +39,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stats", action="store_true", help="print a JSON stats line to stdout")
     p.add_argument("--skew", action="store_true",
                    help="also measure letter vs hash-bucket partition skew on device")
+    p.add_argument("--stream-chunk-docs", type=int, default=None,
+                   help="streaming mode: window size in whole documents "
+                        "(bounded host/device memory; default: one-shot)")
     return p
 
 
@@ -55,6 +58,7 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_path=args.checkpoint,
             profile_dir=args.profile_dir,
             collect_skew_stats=args.skew,
+            stream_chunk_docs=args.stream_chunk_docs,
         )
         stats = build_index(manifest, config)
     except (OSError, ValueError) as e:

@@ -54,6 +54,11 @@ class IndexConfig:
     # partitioning vs hash buckets.  Off the hot path; adds a device
     # round-trip, so opt-in.
     collect_skew_stats: bool = False
+    # Streaming mode (SURVEY.md §5 long-context): process the corpus in
+    # windows of this many whole documents with a bounded device
+    # accumulator (ops/streaming.py) instead of one-shot arrays.  None =
+    # single-shot.  Output is byte-identical either way.
+    stream_chunk_docs: int | None = None
 
     def __post_init__(self) -> None:
         if self.num_mappers < 1:
@@ -67,3 +72,21 @@ class IndexConfig:
         if self.device_shards is not None and self.device_shards < 1:
             raise ValueError(
                 f"device_shards must be >= 1 or None (auto), got {self.device_shards}")
+        if self.stream_chunk_docs is not None:
+            if self.stream_chunk_docs < 1:
+                raise ValueError(
+                    f"stream_chunk_docs must be >= 1 or None, got {self.stream_chunk_docs}")
+            # options the windowed pipeline does not implement: fail
+            # loudly rather than silently ignore a flag the user passed
+            if self.checkpoint_path is not None:
+                raise ValueError(
+                    "stream_chunk_docs is incompatible with checkpoint_path "
+                    "(the accumulator itself is the evolving map-phase state)")
+            if self.collect_skew_stats:
+                raise ValueError(
+                    "stream_chunk_docs is incompatible with collect_skew_stats "
+                    "(per-window pair ids are discarded after each merge)")
+            if self.device_shards is not None and self.device_shards > 1:
+                raise ValueError(
+                    "stream_chunk_docs is incompatible with device_shards > 1 "
+                    "(the streaming accumulator is single-chip)")

@@ -96,6 +96,27 @@ def manifest_from_dir(corpus_dir: str | Path, pattern: str = "**/*.txt") -> Mani
     return Manifest(paths=tuple(paths), sizes=sizes)
 
 
+def iter_document_chunks(manifest: Manifest, chunk_docs: int):
+    """Yield ``(contents, doc_ids)`` windows of at most ``chunk_docs``
+    whole documents, in manifest order — the streaming loader (host
+    memory stays O(chunk), SURVEY.md §5 long-context).  Unreadable
+    files are warned about and skipped inside their window."""
+    if chunk_docs < 1:
+        raise ValueError(f"chunk_docs must be >= 1, got {chunk_docs}")
+    for start in range(0, len(manifest), chunk_docs):
+        contents: list[bytes] = []
+        doc_ids: list[int] = []
+        for i in range(start, min(start + chunk_docs, len(manifest))):
+            try:
+                with open(manifest.paths[i], "rb") as f:
+                    contents.append(f.read())
+                doc_ids.append(manifest.doc_id(i))
+            except OSError:
+                print(f"warning: cannot open {manifest.paths[i]!r}; skipping",
+                      file=sys.stderr)
+        yield contents, doc_ids
+
+
 def load_documents(manifest: Manifest) -> tuple[list[bytes], list[int]]:
     """Read every manifest file, preserving doc ids for readable files.
 
@@ -105,11 +126,8 @@ def load_documents(manifest: Manifest) -> tuple[list[bytes], list[int]]:
     """
     contents: list[bytes] = []
     doc_ids: list[int] = []
-    for i, path in enumerate(manifest.paths):
-        try:
-            with open(path, "rb") as f:
-                contents.append(f.read())
-            doc_ids.append(manifest.doc_id(i))
-        except OSError:
-            print(f"warning: cannot open {path!r}; skipping", file=sys.stderr)
+    for chunk_contents, chunk_ids in iter_document_chunks(
+            manifest, max(len(manifest), 1)):
+        contents.extend(chunk_contents)
+        doc_ids.extend(chunk_ids)
     return contents, doc_ids

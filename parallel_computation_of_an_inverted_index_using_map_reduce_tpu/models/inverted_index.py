@@ -55,6 +55,8 @@ class InvertedIndexModel:
             with timer.phase("oracle"):
                 stats = oracle_index(manifest, out_dir)
             return {**stats, **timer.report()}
+        if cfg.stream_chunk_docs is not None:
+            return self._run_tpu_streaming(manifest, out_dir, timer)
         return self._run_tpu(manifest, out_dir, timer)
 
     # -- TPU backend ---------------------------------------------------
@@ -76,6 +78,63 @@ class InvertedIndexModel:
             with timer.phase("checkpoint"):
                 checkpoint.save_pairs(ckpt, corpus, fingerprint=fp)
         return corpus, len(contents)
+
+    def _run_tpu_streaming(self, manifest: Manifest, out_dir: str,
+                           timer: PhaseTimer) -> dict:
+        """Windowed pipeline for corpora larger than host/device memory.
+
+        Host memory stays O(window + vocab); device memory O(window +
+        unique pairs).  Byte-identical output to the one-shot path
+        (tests/test_streaming.py).  ``checkpoint_path`` is ignored here
+        — the accumulator itself is the evolving map-phase state.
+        """
+        import types
+
+        from ..corpus.manifest import iter_document_chunks
+        from ..ops.streaming import StreamingIndexEngine
+        from ..text.streaming import StreamingTokenizer
+
+        cfg = self.config
+        max_doc_id = len(manifest)
+        tok = StreamingTokenizer(use_native=cfg.use_native)
+        eng = StreamingIndexEngine(
+            max_doc_id=max_doc_id, window_pad=cfg.pad_multiple)
+        docs_loaded = raw_tokens = pairs_fed = 0
+        profile = (
+            jax.profiler.trace(cfg.profile_dir)
+            if cfg.profile_dir else contextlib.nullcontext()
+        )
+        with timer.phase("stream"), profile:
+            for contents, ids in iter_document_chunks(manifest, cfg.stream_chunk_docs):
+                chunk = tok.feed(contents, ids)
+                docs_loaded += len(contents)
+                raw_tokens += chunk.raw_tokens
+                pairs_fed += int(chunk.prov_term_ids.shape[0])
+                eng.feed(chunk.prov_term_ids, chunk.doc_ids, tok.vocab_size)
+        vocab, remap, letters = tok.finalize()
+        vocab_size = int(vocab.shape[0])
+        timer.count("documents", docs_loaded)
+        timer.count("tokens", raw_tokens)
+        timer.count("unique_terms", vocab_size)
+        timer.count("stream_windows", eng.windows_fed)
+        timer.count("accumulator_capacity", eng.capacity)
+        timer.count("accumulator_mode", eng.mode)
+
+        if pairs_fed == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        with timer.phase("device_index"):
+            out = eng.finalize(remap, letters, vocab_size)
+            for v in out.values():
+                v.copy_to_host_async()
+        with timer.phase("fetch"):
+            host = {k: np.asarray(v) for k, v in out.items()}
+            host["num_unique"] = int(host["num_unique"])
+        corpus_view = types.SimpleNamespace(vocab=vocab, letter_of_term=letters)
+        return self._emit_and_report(
+            corpus_view, host, out_dir, timer, vocab_size, max_doc_id)
 
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
         corpus, num_loaded = self._tokenize_or_resume(manifest, timer)

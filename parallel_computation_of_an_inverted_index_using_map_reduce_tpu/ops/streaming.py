@@ -1,0 +1,206 @@
+"""Streaming device engine: blockwise reduction over an unbounded pair
+stream with a bounded on-device accumulator.
+
+The single-shot engine (ops/engine.py) needs the whole packed-key
+array in HBM at once.  Here the token stream arrives in fixed-size
+windows (text/streaming.py feeds them) and the device carries only the
+**sorted unique (term, doc) pairs seen so far** — bounded by the
+output's unique-pair count, not the stream length.  This is the sort
+pipeline's analogue of blockwise/sequence-parallel attention
+accumulators (SURVEY.md §5 "long-context"): per window
+
+    acc <- unique(merge_sort(acc, sort(window)))
+
+as one fused XLA program (concat -> lax.sort -> boundary dedup ->
+compact), all static shapes.  The accumulator capacity grows by
+host-side doubling *before* a window that could overflow it is merged
+(the host tracks ``unique <= fed_pairs``), so no device->host sync ever
+happens inside the stream loop; each capacity is a separate compiled
+program, hit at most O(log unique) times.
+
+Two accumulator representations, switched automatically mid-stream:
+
+- **packed**: one int32 key per pair (``term * stride + doc``) while
+  the growing vocabulary still packs (K.can_pack) — one buffer, one
+  single-key sort;
+- **pairs**: separate (term, doc) int32 arrays with a two-key sort
+  once the vocabulary outgrows the packed key space — the streaming
+  counterpart of the one-shot path's ``index_pairs`` fallback, so
+  streaming never hard-fails on the large corpora it exists for.
+
+At ``finalize`` the provisional (append-stable) term ids are remapped
+on device to sorted-vocab rank with one gather, re-sorted, and handed
+to the shared tail — output is byte-identical to the single-shot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.rounding import round_up
+from . import keys as K
+from .engine import index_pairs, postings_from_sorted
+from .segment import compact, first_occurrence_mask
+
+
+@functools.partial(jax.jit, static_argnames=("cap",), donate_argnums=(0,))
+def _merge_unique(acc, window, *, cap: int):
+    """Fold a packed-key window into the sorted-unique accumulator."""
+    s = lax.sort(jnp.concatenate([acc, window]))
+    first = first_occurrence_mask(s) & (s < K.INT32_MAX)
+    return compact(s, first, cap, K.INT32_MAX)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",), donate_argnums=(0, 1))
+def _merge_unique_pairs(acc_t, acc_d, feed, *, cap: int):
+    """Pair-mode merge: ``feed`` is one [terms | docs] int32 buffer."""
+    half = feed.shape[0] // 2
+    t = jnp.concatenate([acc_t, feed[:half]])
+    d = jnp.concatenate([acc_d, feed[half:]])
+    t_s, d_s = lax.sort((t, d), num_keys=2)
+    first = (first_occurrence_mask(t_s) | first_occurrence_mask(d_s)) & (
+        t_s < K.INT32_MAX)
+    return (compact(t_s, first, cap, K.INT32_MAX),
+            compact(d_s, first, cap, K.INT32_MAX))
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _regrow(acc, *, cap: int):
+    """Copy a buffer into a larger one (INT32_MAX padded).  No donation:
+    the output shape never matches the input, so aliasing is impossible."""
+    out = jnp.full((cap,), K.INT32_MAX, jnp.int32)
+    return lax.dynamic_update_slice(out, acc, (0,))
+
+
+@functools.partial(jax.jit, static_argnames=("stride",), donate_argnums=(0,))
+def _unpack_acc(acc, *, stride: int):
+    """Packed accumulator -> (term, doc) pair accumulator (mode switch)."""
+    valid = acc < K.INT32_MAX
+    term = jnp.where(valid, acc // stride, K.INT32_MAX)
+    doc = jnp.where(valid, acc % stride, K.INT32_MAX)
+    return term, doc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0,))
+def _final_index(acc, remap, letter_of_term, *, vocab_size: int, max_doc_id: int):
+    """Packed provisional keys -> sorted-rank keys -> shared tail."""
+    stride = max_doc_id + 2
+    valid = acc < K.INT32_MAX
+    term = jnp.where(valid, acc // stride, 0)
+    doc = acc % stride
+    final = jnp.where(valid, remap[term] * stride + doc, K.INT32_MAX)
+    return postings_from_sorted(
+        lax.sort(final), letter_of_term,
+        vocab_size=vocab_size, max_doc_id=max_doc_id)
+
+
+def _final_pairs(acc_t, acc_d, remap, letter_of_term, *, vocab_size: int,
+                 max_doc_id: int):
+    """Pair-mode finalize: remap terms, then the two-key engine path."""
+    valid = acc_t < K.INT32_MAX
+    final_t = jnp.where(valid, remap[jnp.where(valid, acc_t, 0)], K.INT32_MAX)
+    return index_pairs(final_t, acc_d, letter_of_term,
+                       vocab_size=vocab_size, max_doc_id=max_doc_id)
+
+
+class StreamingIndexEngine:
+    """Bounded-memory device reduction over a provisional-id pair stream.
+
+    ``max_doc_id`` fixes the key stride for the whole stream; the vocab
+    may keep growing while feeding (provisional ids).  Starts in packed
+    mode and switches permanently to pair mode the first time the
+    vocabulary seen so far stops packing into int32 keys.
+    """
+
+    def __init__(self, *, max_doc_id: int, window_pad: int = 1 << 16,
+                 initial_capacity: int = 1 << 18):
+        self._stride = max_doc_id + 2
+        self._max_doc_id = max_doc_id
+        self._window_pad = window_pad
+        self._cap = initial_capacity
+        self._acc = None            # packed mode: int32 (cap,)
+        self._acc_pair = None       # pair mode: (term, doc) int32 (cap,) each
+        self._unique_bound = 0      # host upper bound on unique pairs in acc
+        self.windows_fed = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def mode(self) -> str:
+        return "pairs" if self._acc_pair is not None else "packed"
+
+    def _ensure_capacity(self, extra: int) -> None:
+        self._unique_bound += extra
+        while self._unique_bound > self._cap:
+            # grow BEFORE a potentially-overflowing merge: no data loss,
+            # no device sync; at most O(log unique) recompiles total
+            self._cap *= 2
+            if self._acc is not None:
+                self._acc = _regrow(self._acc, cap=self._cap)
+            if self._acc_pair is not None:
+                t, d = self._acc_pair
+                self._acc_pair = (_regrow(t, cap=self._cap),
+                                  _regrow(d, cap=self._cap))
+
+    def _switch_to_pairs(self) -> None:
+        if self._acc is None:
+            self._acc_pair = tuple(
+                jax.device_put(np.full(self._cap, K.INT32_MAX, np.int32))
+                for _ in range(2))
+        else:
+            self._acc_pair = _unpack_acc(self._acc, stride=self._stride)
+            self._acc = None
+
+    def feed(self, prov_term_ids: np.ndarray, doc_ids: np.ndarray,
+             vocab_size_so_far: int) -> None:
+        """Merge one window of (provisional term, doc) pairs."""
+        n = int(prov_term_ids.shape[0])
+        if n == 0:
+            return
+        if self.mode == "packed" and not K.can_pack(vocab_size_so_far,
+                                                    self._max_doc_id):
+            self._switch_to_pairs()
+        if self.mode == "packed" and self._acc is None:
+            self._acc = jax.device_put(np.full(self._cap, K.INT32_MAX, np.int32))
+
+        padded = round_up(n, self._window_pad)
+        self._ensure_capacity(n)
+        if self.mode == "packed":
+            host = np.full(padded, K.INT32_MAX, np.int32)
+            np.multiply(prov_term_ids, self._stride, out=host[:n])
+            host[:n] += doc_ids
+            self._acc = _merge_unique(
+                self._acc, jax.device_put(host), cap=self._cap)
+        else:
+            host = np.full(2 * padded, K.INT32_MAX, np.int32)
+            host[:n] = prov_term_ids
+            host[padded : padded + n] = doc_ids
+            self._acc_pair = _merge_unique_pairs(
+                *self._acc_pair, jax.device_put(host), cap=self._cap)
+        self.windows_fed += 1
+
+    def finalize(self, remap: np.ndarray, letter_of_term: np.ndarray,
+                 vocab_size: int):
+        """Device dict of postings/df/order/offsets/num_unique (the
+        engine.postings_from_sorted interface) from the accumulated
+        stream.  ``remap[prov_id] == sorted rank``."""
+        remap_dev = jax.device_put(remap.astype(np.int32))
+        letters_dev = jax.device_put(letter_of_term.astype(np.int32))
+        if self._acc is not None:
+            out = _final_index(self._acc, remap_dev, letters_dev,
+                               vocab_size=vocab_size, max_doc_id=self._max_doc_id)
+        elif self._acc_pair is not None:
+            out = _final_pairs(*self._acc_pair, remap_dev, letters_dev,
+                               vocab_size=vocab_size, max_doc_id=self._max_doc_id)
+        else:
+            raise ValueError("no windows fed")
+        self._acc = self._acc_pair = None
+        return out

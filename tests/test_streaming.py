@@ -1,0 +1,164 @@
+"""Streaming (windowed) pipeline tests.
+
+The north-star property: streaming output is byte-identical to the
+one-shot pipeline and the oracle, for any window size — including
+windows of one document and windows larger than the corpus — while the
+device accumulator stays bounded and grows only by host-side doubling.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.config import IndexConfig
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    Manifest, iter_document_chunks,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus, zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.inverted_index import (
+    build_index,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.streaming import (
+    StreamingIndexEngine,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.streaming import (
+    StreamingTokenizer,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    tokenize_documents,
+)
+
+
+def _letters_dir(d: pathlib.Path) -> dict[str, bytes]:
+    return {f"{c}.txt": (d / f"{c}.txt").read_bytes()
+            for c in "abcdefghijklmnopqrstuvwxyz"}
+
+
+def _manifest_for(tmp_path, num_docs=12, seed=0):
+    docs = zipf_corpus(num_docs=num_docs, vocab_size=400, tokens_per_doc=120, seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    return Manifest(paths=tuple(str(p) for p in paths),
+                    sizes=tuple(pathlib.Path(p).stat().st_size for p in paths))
+
+
+@pytest.mark.parametrize("chunk_docs", [1, 5, 100])
+def test_streaming_matches_oneshot(tmp_path, chunk_docs):
+    m = _manifest_for(tmp_path)
+    one = tmp_path / "one"
+    stream = tmp_path / "stream"
+    build_index(m, IndexConfig(), output_dir=str(one))
+    stats = build_index(
+        m, IndexConfig(stream_chunk_docs=chunk_docs), output_dir=str(stream))
+    assert _letters_dir(one) == _letters_dir(stream)
+    assert stats["documents"] == len(m)
+    assert stats["stream_windows"] == -(-len(m) // chunk_docs)
+
+
+def test_streaming_tokenizer_ids_stable_across_windows(tmp_path):
+    docs = [b"beta alpha", b"alpha gamma", b"gamma beta delta"]
+    tok = StreamingTokenizer(use_native=False)
+    c1 = tok.feed([docs[0]], [1])
+    c2 = tok.feed([docs[1]], [2])
+    c3 = tok.feed([docs[2]], [3])
+    # provisional ids: assigned per window in that window's sorted-vocab
+    # order, stable once assigned (append-only across windows)
+    vocab, remap, letters = tok.finalize()
+    assert vocab.tolist() == [b"alpha", b"beta", b"delta", b"gamma"]
+    # window 1 sorted [alpha, beta] -> 0, 1; window 2 adds gamma -> 2;
+    # window 3 adds delta -> 3
+    np.testing.assert_array_equal(remap, [0, 1, 3, 2])
+    np.testing.assert_array_equal(c1.prov_term_ids, [1, 0])
+    np.testing.assert_array_equal(c2.prov_term_ids, [0, 2])
+    np.testing.assert_array_equal(c3.prov_term_ids, [2, 1, 3])
+    np.testing.assert_array_equal(letters, [0, 1, 3, 6])
+
+
+def test_engine_accumulator_grows_by_doubling():
+    eng = StreamingIndexEngine(max_doc_id=3, window_pad=128, initial_capacity=256)
+    rng = np.random.default_rng(0)
+    for w in range(4):
+        terms = rng.integers(0, 5000, 200).astype(np.int32)
+        docs = rng.integers(1, 4, 200).astype(np.int32)
+        eng.feed(terms, docs, vocab_size_so_far=5000)
+    assert eng.capacity == 1024  # 800 pairs fed -> two doublings from 256
+    assert eng.windows_fed == 4
+
+
+def test_engine_switches_to_pair_mode_on_unpackable_vocab():
+    # stride 100_002 stops packing once vocab exceeds ~21k terms; the
+    # engine must switch representations mid-stream without data loss
+    max_doc = 100_000
+    vocab_size = 30_000
+    rng = np.random.default_rng(1)
+    eng = StreamingIndexEngine(max_doc_id=max_doc, window_pad=128,
+                               initial_capacity=2048)
+    seen: dict[int, set] = {}
+    vocab_so_far = 10_000  # packable at first
+    for w in range(4):
+        terms = rng.integers(0, vocab_so_far, 300).astype(np.int32)
+        docs = rng.integers(1, 50, 300).astype(np.int32)
+        for t, d in zip(terms.tolist(), docs.tolist()):
+            seen.setdefault(t, set()).add(d)
+        eng.feed(terms, docs, vocab_size_so_far=vocab_so_far)
+        if w == 1:
+            vocab_so_far = vocab_size  # crosses the packing bound
+    assert eng.mode == "pairs"
+    remap = np.arange(vocab_size, dtype=np.int32)  # identity: already ranked
+    letters = np.zeros(vocab_size, np.int32)
+    out = eng.finalize(remap, letters, vocab_size)
+    df = np.asarray(out["df"])
+    postings = np.asarray(out["postings"])
+    offsets = np.asarray(out["offsets"])
+    assert int(np.asarray(out["num_unique"])) == sum(len(s) for s in seen.values())
+    for t, docs_set in seen.items():
+        got = postings[offsets[t]: offsets[t] + df[t]].tolist()
+        assert got == sorted(docs_set), t
+
+
+def test_config_rejects_streaming_incompatible_options(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        IndexConfig(stream_chunk_docs=4, checkpoint_path=str(tmp_path / "c.npz"))
+    with pytest.raises(ValueError, match="collect_skew_stats"):
+        IndexConfig(stream_chunk_docs=4, collect_skew_stats=True)
+    with pytest.raises(ValueError, match="device_shards"):
+        IndexConfig(stream_chunk_docs=4, device_shards=2)
+
+
+def test_streaming_engine_matches_oracle_postings():
+    # drive the engine directly (no files): dedup across windows
+    docs = [b"x y z x", b"y y w", b"z q x"]
+    ids = [1, 2, 3]
+    corpus = tokenize_documents(docs, ids)  # sorted-vocab one-shot view
+    tok = StreamingTokenizer(use_native=False)
+    eng = StreamingIndexEngine(max_doc_id=3, window_pad=128, initial_capacity=256)
+    for d, i in zip(docs, ids):
+        ch = tok.feed([d], [i])
+        eng.feed(ch.prov_term_ids, ch.doc_ids, tok.vocab_size)
+    vocab, remap, letters = tok.finalize()
+    out = eng.finalize(remap, letters, int(vocab.shape[0]))
+    np.testing.assert_array_equal(vocab, corpus.vocab)
+    df = np.asarray(out["df"])
+    postings = np.asarray(out["postings"])
+    offsets = np.asarray(out["offsets"])
+    # oracle: q->[3] w->[2] x->[1 3] y->[1 2] z->[1 3]
+    expect = {b"q": [3], b"w": [2], b"x": [1, 3], b"y": [1, 2], b"z": [1, 3]}
+    for t, word in enumerate(vocab.tolist()):
+        got = postings[offsets[t]: offsets[t] + df[t]].tolist()
+        assert got == expect[word], word
+
+
+def test_iter_document_chunks_windows(tmp_path):
+    m = _manifest_for(tmp_path, num_docs=7)
+    chunks = list(iter_document_chunks(m, 3))
+    assert [len(c[0]) for c in chunks] == [3, 3, 1]
+    assert [c[1] for c in chunks] == [[1, 2, 3], [4, 5, 6], [7]]
+    with pytest.raises(ValueError):
+        next(iter_document_chunks(m, 0))
+
+
+def test_config_validates_stream_chunk_docs():
+    with pytest.raises(ValueError, match="stream_chunk_docs"):
+        IndexConfig(stream_chunk_docs=0)
