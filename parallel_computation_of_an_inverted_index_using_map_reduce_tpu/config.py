@@ -59,6 +59,11 @@ class IndexConfig:
     # accumulator (ops/streaming.py) instead of one-shot arrays.  None =
     # single-shot.  Output is byte-identical either way.
     stream_chunk_docs: int | None = None
+    # Single-chip pipelined fast path (native tokenizer + provisional-key
+    # device sort): documents per upload window.  None = auto (two windows:
+    # window 1's upload overlaps window 2's tokenize); 0 disables the
+    # pipelined path entirely (forces the one-shot engine).
+    pipeline_chunk_docs: int | None = None
 
     def __post_init__(self) -> None:
         if self.num_mappers < 1:
@@ -72,6 +77,10 @@ class IndexConfig:
         if self.device_shards is not None and self.device_shards < 1:
             raise ValueError(
                 f"device_shards must be >= 1 or None (auto), got {self.device_shards}")
+        if self.pipeline_chunk_docs is not None and self.pipeline_chunk_docs < 0:
+            raise ValueError(
+                "pipeline_chunk_docs must be >= 1, 0 (disabled) or None (auto), "
+                f"got {self.pipeline_chunk_docs}")
         if self.stream_chunk_docs is not None:
             if self.stream_chunk_docs < 1:
                 raise ValueError(

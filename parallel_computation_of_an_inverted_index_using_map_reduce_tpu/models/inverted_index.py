@@ -136,7 +136,141 @@ class InvertedIndexModel:
         return self._emit_and_report(
             corpus_view, host, out_dir, timer, vocab_size, max_doc_id)
 
+    def _pipelined_eligible(self, manifest: Manifest) -> bool:
+        """Whether the provisional-key pipelined fast path applies.
+
+        It needs the native incremental tokenizer, uint16 postings
+        (doc ids < 0xFFFF), and none of the features that require the
+        token arrays on host (checkpointing, skew stats) or a different
+        engine (multi-chip, bounded-memory streaming)."""
+        from .. import native
+
+        cfg = self.config
+        num_shards = (
+            cfg.device_shards if cfg.device_shards is not None
+            else len(jax.devices())
+        )
+        return (
+            cfg.pipeline_chunk_docs != 0
+            and cfg.use_native
+            and cfg.stream_chunk_docs is None
+            and cfg.checkpoint_path is None
+            and not cfg.collect_skew_stats
+            and num_shards <= 1
+            and len(manifest) <= 0xFFFE
+            and native.available()
+        )
+
+    def _run_tpu_pipelined(self, manifest: Manifest, out_dir: str,
+                           timer: PhaseTimer) -> dict:
+        """Single-chip fast path: uploads overlap tokenization.
+
+        The reference pays its host<->"device" cost per token (stdio
+        locks on shared spill files, main.c:116); the one-shot path
+        below pays it once but serially *after* tokenizing.  Here the
+        native tokenizer emits packed ``prov_id * stride + doc_id``
+        keys per document window, and each window's keys start their
+        async host->device DMA immediately — provisional ids are stable
+        at first occurrence, so the device program
+        (ops/engine.sort_prov_chunks) never waits for the final vocab.
+        After the last window, one dispatch + one device->host fetch is
+        the entire critical path; emit order, df and offsets are
+        resolved host-side in prov space (vocab-sized work) while the
+        sort and the fetch are in flight.
+        """
+        from .. import native
+        from ..corpus.manifest import iter_document_chunks
+
+        cfg = self.config
+        max_doc_id = len(manifest)
+        stride = max_doc_id + 2
+        # Auto = two windows: window 1's upload DMA flushes while window 2
+        # tokenizes, and measured on the tunneled-link TPU this beats both
+        # one-shot (everything serialized after tokenize) and many small
+        # windows (per-transfer overhead compounds) — and is far less
+        # sensitive to link-latency weather than either.
+        chunk_docs = (
+            cfg.pipeline_chunk_docs if cfg.pipeline_chunk_docs
+            else max(1, -(-len(manifest) // 2))
+        )
+        granule = min(1 << 14, self.config.pad_multiple)
+        chunks_dev = []
+        num_pairs = docs_loaded = 0
+        stream = native.NativeKeyStream(stride)
+        try:
+            with timer.phase("tokenize_feed"):
+                for contents, ids in iter_document_chunks(manifest, chunk_docs):
+                    docs_loaded += len(contents)
+                    keys, _ = stream.feed(contents, ids)
+                    if keys.size == 0:
+                        continue
+                    padded = _round_up(keys.size, granule)
+                    buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
+                    buf[: keys.size] = keys
+                    chunks_dev.append(jax.device_put(buf))  # async DMA
+                    num_pairs += int(keys.size)
+            with timer.phase("finalize_vocab"):
+                vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
+        finally:
+            stream.close()
+
+        vocab_size = int(vocab.shape[0])
+        timer.count("documents", docs_loaded)
+        timer.count("tokens", raw_tokens)
+        timer.count("unique_terms", vocab_size)
+        timer.count("device_shards", 1)
+        timer.count("upload_windows", len(chunks_dev))
+        if num_pairs == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        profile = (
+            jax.profiler.trace(self.config.profile_dir)
+            if self.config.profile_dir
+            else contextlib.nullcontext()
+        )
+        nfetch = min(sum(int(c.shape[0]) for c in chunks_dev),
+                     _round_up(num_pairs, 1 << 16))
+        with timer.phase("device_index"), profile:
+            post_dev = engine.sort_prov_chunks(
+                tuple(chunks_dev), stride=stride, out_size=nfetch)
+            post_dev.copy_to_host_async()
+            # Emit order / offsets in *prov* space, overlapped with the
+            # in-flight sort + D2H: postings are grouped by prov id, so
+            # per-rank views just indirect through rank -> prov.
+            prov_of_rank = np.empty(vocab_size, dtype=np.int64)
+            prov_of_rank[remap] = np.arange(vocab_size)
+            df64 = df_prov.astype(np.int64)
+            offsets_prov = np.cumsum(df64) - df64
+            df_rank = df64[prov_of_rank]
+            off_rank = offsets_prov[prov_of_rank]
+            order, _ = engine.host_order_offsets(letters, df_rank)
+            if self.config.profile_dir:
+                post_dev.block_until_ready()
+        with timer.phase("fetch"):
+            postings = np.asarray(post_dev)
+        host = {
+            "df": df_rank, "order": order, "offsets": off_rank,
+            "postings": postings, "num_unique": num_pairs,
+        }
+        import types
+
+        corpus_view = types.SimpleNamespace(vocab=vocab, letter_of_term=letters)
+        return self._emit_and_report(
+            corpus_view, host, out_dir, timer, vocab_size, max_doc_id)
+
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
+        if self._pipelined_eligible(manifest):
+            from ..native import KeyOverflow
+
+            try:
+                return self._run_tpu_pipelined(manifest, out_dir, timer)
+            except KeyOverflow:
+                # vocab * stride outgrew int32 keys mid-stream: restart on
+                # the one-shot path (whose general engine sorts two-key).
+                self.timer = timer = PhaseTimer()
+                timer.count("pipelined_fallback", "key_overflow")
         corpus, num_loaded = self._tokenize_or_resume(manifest, timer)
 
         max_doc_id = len(manifest)  # doc ids are 1..len(manifest)

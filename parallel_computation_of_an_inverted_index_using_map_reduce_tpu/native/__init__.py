@@ -38,6 +38,27 @@ class _TokenizeResult(ctypes.Structure):
     ]
 
 
+class _StreamChunkResult(ctypes.Structure):
+    _fields_ = [
+        ("num_pairs", ctypes.c_int64),
+        ("raw_tokens", ctypes.c_int64),
+        ("keys", ctypes.POINTER(ctypes.c_int32)),
+    ]
+
+
+class _StreamFinalResult(ctypes.Structure):
+    _fields_ = [
+        ("vocab_size", ctypes.c_int32),
+        ("vocab_width", ctypes.c_int32),
+        ("raw_tokens", ctypes.c_int64),
+        ("num_pairs", ctypes.c_int64),
+        ("vocab_packed", ctypes.POINTER(ctypes.c_uint8)),
+        ("letter_of_term", ctypes.POINTER(ctypes.c_int32)),
+        ("remap", ctypes.POINTER(ctypes.c_int32)),
+        ("df", ctypes.POINTER(ctypes.c_int32)),
+    ]
+
+
 def _build_dirs():
     yield Path(__file__).parent / "_build"
     yield Path(tempfile.gettempdir()) / f"mri_tpu_native_{os.getuid()}"
@@ -86,6 +107,23 @@ def load():
         ]
         lib.mri_free_result.restype = None
         lib.mri_free_result.argtypes = [ctypes.POINTER(_TokenizeResult)]
+        lib.mri_stream_new.restype = ctypes.c_void_p
+        lib.mri_stream_new.argtypes = [ctypes.c_int64]
+        lib.mri_stream_free.restype = None
+        lib.mri_stream_free.argtypes = [ctypes.c_void_p]
+        lib.mri_stream_feed.restype = ctypes.POINTER(_StreamChunkResult)
+        lib.mri_stream_feed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mri_stream_chunk_free.restype = None
+        lib.mri_stream_chunk_free.argtypes = [ctypes.POINTER(_StreamChunkResult)]
+        lib.mri_stream_finalize.restype = ctypes.POINTER(_StreamFinalResult)
+        lib.mri_stream_finalize.argtypes = [ctypes.c_void_p]
+        lib.mri_stream_final_free.restype = None
+        lib.mri_stream_final_free.argtypes = [ctypes.POINTER(_StreamFinalResult)]
         lib.mri_emit.restype = ctypes.c_int64
         lib.mri_emit.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
@@ -151,6 +189,109 @@ def tokenize_native(contents: list[bytes], doc_ids: list[int],
             pairs_deduped=bool(dedup_pairs), raw_tokens=int(r.raw_tokens))
     finally:
         lib.mri_free_result(res)
+
+
+class KeyOverflow(Exception):
+    """A packed provisional key would exceed int32 — the caller must fall
+    back to the one-shot (unpacked / remapped) engine path."""
+
+
+class NativeKeyStream:
+    """Incremental native tokenizer emitting packed provisional keys.
+
+    Feeds the pipelined engine path (models/inverted_index.py): each
+    :meth:`feed` scans one window of whole documents and returns packed
+    ``prov_id * stride + doc_id`` int32 keys, combiner-deduped, ready
+    for an immediate async ``jax.device_put`` — the device program
+    (ops/engine.sort_prov_chunks) never needs the final vocab, so
+    uploads overlap the tokenizer's remaining work.  :meth:`finalize`
+    resolves the sorted vocab, the prov->rank remap, letters and the
+    per-term document frequencies the emit phase needs.
+    """
+
+    def __init__(self, stride: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native tokenizer unavailable: {_lib_error}")
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.mri_stream_new(ctypes.c_int64(stride)))
+        if not self._handle:
+            raise MemoryError("native stream allocation failure")
+
+    def feed(self, contents: list[bytes], doc_ids: list[int]):
+        """Tokenize one whole-document window.
+
+        Returns ``(keys, raw_tokens)`` — packed int32 keys (a copy,
+        safe past the next feed).  Raises :class:`KeyOverflow` when
+        ``prov_id * stride + doc_id`` no longer fits int32.
+        """
+        buf = b"".join(contents)
+        data = np.frombuffer(buf, dtype=np.uint8)
+        ends = np.cumsum(np.array([len(c) for c in contents], dtype=np.int64))
+        ids = np.asarray(doc_ids, dtype=np.int32)
+        n_docs = len(contents)
+        res = self._lib.mri_stream_feed(
+            self._handle,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if data.size else
+            ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(data.size),
+            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if n_docs else
+            ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if n_docs else
+            ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(n_docs),
+        )
+        if not res:
+            raise MemoryError("native stream feed allocation failure")
+        try:
+            r = res.contents
+            n, raw = int(r.num_pairs), int(r.raw_tokens)
+            if n < 0:
+                raise KeyOverflow()
+            keys = np.ctypeslib.as_array(r.keys, shape=(max(n, 1),))[:n].copy()
+            return keys, raw
+        finally:
+            self._lib.mri_stream_chunk_free(res)
+
+    def finalize(self):
+        """``(vocab, letter_of_term, remap, df_prov, raw_tokens, num_pairs)``.
+
+        ``vocab`` is the sorted 'S'-dtype array; ``letter_of_term`` is in
+        rank space; ``remap`` maps prov id -> rank; ``df_prov`` holds the
+        combiner's per-term document frequencies in prov space.
+        """
+        res = self._lib.mri_stream_finalize(self._handle)
+        if not res:
+            raise MemoryError("native stream finalize allocation failure")
+        try:
+            r = res.contents
+            v, w = int(r.vocab_size), int(r.vocab_width)
+            packed = np.ctypeslib.as_array(
+                r.vocab_packed, shape=(max(v * w, 1),))[: v * w].copy()
+            vocab = packed.view(f"S{w}") if v else np.empty(0, "S1")
+            letters = np.ctypeslib.as_array(r.letter_of_term, shape=(max(v, 1),))[:v].copy()
+            remap = np.ctypeslib.as_array(r.remap, shape=(max(v, 1),))[:v].copy()
+            df = np.ctypeslib.as_array(r.df, shape=(max(v, 1),))[:v].copy()
+            return vocab, letters, remap, df, int(r.raw_tokens), int(r.num_pairs)
+        finally:
+            self._lib.mri_stream_final_free(res)
+
+    def close(self):
+        if self._handle:
+            self._lib.mri_stream_free(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings) -> int:

@@ -7,9 +7,23 @@
 // the integer corpus the device engine consumes: per-token sorted-vocab
 // term ids + doc ids, the packed sorted vocab, and first-letter ids.
 //
-// Single allocation arena for cleaned words, open-addressing FNV-1a
-// hash table with power-of-two growth; final std::sort over unique
-// words only (vocab-scale, not token-scale).
+// Two frontends over one incremental core (`StreamState` + `ScanChunk`):
+//
+//   * one-shot `mri_tokenize` — whole corpus in, sorted-vocab ids out;
+//   * streaming `mri_stream_*` — per-chunk feeds return packed
+//     `prov_id * stride + doc_id` int32 keys immediately (provisional
+//     ids are first-occurrence ids, stable once assigned), so the
+//     caller can overlap host->device uploads with tokenizing the next
+//     chunk; `mri_stream_finalize` then resolves the sorted vocab, the
+//     prov->rank remap, and per-term document frequencies (the
+//     combiner's counts) — everything the emit phase needs, with the
+//     device program never depending on final vocab order.
+//
+// Hot-loop design: 256-entry byte tables (whitespace / lowercase-letter)
+// instead of range compares, FNV-1a folded into the cleaning pass (one
+// pass per byte total), open-addressing hash table with power-of-two
+// growth, single allocation arena for cleaned words; final std::sort
+// over unique words only (vocab-scale, not token-scale).
 //
 // Build: g++ -O3 -shared -fPIC -o libmri_tokenizer.so tokenizer.cc
 
@@ -24,6 +38,8 @@
 namespace {
 
 constexpr int kMaxWordLetters = 299;  // reference MAX_WORD - 1 (main.c:7,105)
+constexpr uint64_t kFnvBasis = 1469598103934665603ull;
+constexpr uint64_t kFnvPrime = 1099511628211ull;
 
 struct Entry {
   uint32_t offset;  // into arena
@@ -31,18 +47,152 @@ struct Entry {
   int32_t id;       // provisional (first-occurrence) id; -1 = empty slot
 };
 
-inline bool IsSpace(uint8_t b) {
-  // C-locale isspace set, what fscanf %s splits on (main.c:102).
-  return b == ' ' || b == '\t' || b == '\n' || b == '\v' || b == '\f' || b == '\r';
-}
+struct ByteTables {
+  bool space[256];
+  uint8_t lower[256];  // lowercase letter, or 0 = delete this byte
+  ByteTables() {
+    std::memset(space, 0, sizeof(space));
+    std::memset(lower, 0, sizeof(lower));
+    // C-locale isspace set, what fscanf %s splits on (main.c:102).
+    for (uint8_t b : {' ', '\t', '\n', '\v', '\f', '\r'}) space[b] = true;
+    for (int b = 'a'; b <= 'z'; ++b) lower[b] = static_cast<uint8_t>(b);
+    for (int b = 'A'; b <= 'Z'; ++b) lower[b] = static_cast<uint8_t>(b + 32);
+  }
+};
+const ByteTables kTab;
 
 inline uint64_t Fnv1a(const uint8_t* p, uint32_t len) {
-  uint64_t h = 1469598103934665603ull;
+  uint64_t h = kFnvBasis;
   for (uint32_t i = 0; i < len; ++i) {
     h ^= p[i];
-    h *= 1099511628211ull;
+    h *= kFnvPrime;
   }
   return h;
+}
+
+// Incremental tokenizer state shared by the one-shot and streaming
+// frontends.  Provisional ids are assigned at first occurrence and
+// never change; the combiner (per-(term, doc) dedup, the reference
+// reducer's dedup at main.c:176-184 pulled into the map phase) and the
+// per-term document-frequency counts live here so nothing token-scale
+// survives past a chunk.
+struct StreamState {
+  std::vector<uint8_t> arena;
+  std::vector<Entry> table;
+  uint64_t mask;
+  int32_t next_id = 0;
+  std::vector<uint32_t> word_offsets;  // prov id -> arena offset
+  std::vector<uint32_t> word_lens;
+  std::vector<int32_t> last_doc;  // prov id -> global doc ordinal (combiner)
+  std::vector<int32_t> df;        // prov id -> docs containing it
+  int64_t raw_tokens = 0;
+  int64_t num_pairs = 0;
+  int32_t doc_ordinal = 0;  // global across chunks
+  int64_t stride = 0;       // packed-key stride (streaming); 0 = unused
+  bool key_overflow = false;
+
+  StreamState() : table(1 << 16), mask(table.size() - 1) {
+    for (auto& e : table) e.id = -1;
+    arena.reserve(1 << 20);
+  }
+
+  void Grow() {
+    std::vector<Entry> bigger(table.size() * 2);
+    for (auto& e : bigger) e.id = -1;
+    const uint64_t bmask = bigger.size() - 1;
+    for (const Entry& e : table) {
+      if (e.id < 0) continue;
+      uint64_t s = Fnv1a(arena.data() + e.offset, e.len) & bmask;
+      while (bigger[s].id >= 0) s = (s + 1) & bmask;
+      bigger[s] = e;
+    }
+    table.swap(bigger);
+    mask = bmask;
+  }
+};
+
+// Scan one window of documents; emit combiner-deduped (prov_id, doc_id)
+// pairs through `emit`.  `data` is concatenated document bytes,
+// `doc_ends[i]` the exclusive end of doc i, `doc_id_values[i]` its
+// (1-based) id.  `dedup` off replays every raw token (one-shot
+// non-combined mode).
+template <typename Emit>
+void ScanChunk(StreamState& st, const uint8_t* data, int64_t /*len*/,
+               const int64_t* doc_ends, const int32_t* doc_id_values,
+               int32_t num_docs, bool dedup, Emit&& emit) {
+  uint8_t word[kMaxWordLetters];
+  int64_t pos = 0;
+  for (int32_t d = 0; d < num_docs; ++d, ++st.doc_ordinal) {
+    const int64_t end = doc_ends[d];
+    const int32_t doc_id = doc_id_values[d];
+    const int32_t ordinal = st.doc_ordinal;
+    while (pos < end) {
+      while (pos < end && kTab.space[data[pos]]) ++pos;  // skip whitespace
+      if (pos >= end) break;
+      int wlen = 0;
+      uint64_t h = kFnvBasis;
+      do {  // clean token: letters only, lowercase, cap at 299; hash inline
+        const uint8_t c = kTab.lower[data[pos]];
+        if (c && wlen < kMaxWordLetters) {
+          word[wlen++] = c;
+          h = (h ^ c) * kFnvPrime;
+        }
+      } while (++pos < end && !kTab.space[data[pos]]);
+      if (wlen == 0) continue;  // token cleaned to nothing (main.c:113)
+
+      // hash-table upsert
+      uint64_t slot = h & st.mask;
+      int32_t id;
+      for (;;) {
+        Entry& e = st.table[slot];
+        if (e.id < 0) {
+          const uint32_t off = static_cast<uint32_t>(st.arena.size());
+          st.arena.insert(st.arena.end(), word, word + wlen);
+          e.offset = off;
+          e.len = wlen;
+          e.id = st.next_id;
+          st.word_offsets.push_back(off);
+          st.word_lens.push_back(wlen);
+          st.last_doc.push_back(-1);
+          st.df.push_back(0);
+          id = st.next_id++;
+          if (static_cast<uint64_t>(st.next_id) * 10 > st.table.size() * 7)
+            st.Grow();
+          break;
+        }
+        if (e.len == static_cast<uint32_t>(wlen) &&
+            std::memcmp(st.arena.data() + e.offset, word, wlen) == 0) {
+          id = e.id;
+          break;
+        }
+        slot = (slot + 1) & st.mask;
+      }
+      ++st.raw_tokens;
+      if (dedup) {
+        if (st.last_doc[id] == ordinal) continue;  // (term, doc) already out
+        st.last_doc[id] = ordinal;
+      }
+      ++st.df[id];
+      ++st.num_pairs;
+      emit(id, doc_id);
+    }
+    pos = end;
+  }
+}
+
+// Sorted-vocab order of provisional ids (== strcmp order: letters only).
+std::vector<int32_t> SortedOrder(const StreamState& st) {
+  std::vector<int32_t> order(st.next_id);
+  for (int32_t i = 0; i < st.next_id; ++i) order[i] = i;
+  const uint8_t* base = st.arena.data();
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    const uint32_t la = st.word_lens[a], lb = st.word_lens[b];
+    const int c = std::memcmp(base + st.word_offsets[a],
+                              base + st.word_offsets[b], la < lb ? la : lb);
+    if (c != 0) return c < 0;
+    return la < lb;
+  });
+  return order;
 }
 
 }  // namespace
@@ -62,131 +212,34 @@ struct TokenizeResult {
 
 // data: concatenated document bytes; doc_ends[i] = exclusive end offset of
 // doc i; doc_id_values[i] = its (1-based) doc id.  dedup_pairs != 0
-// enables the combiner: each (term, doc) pair is emitted once (the
-// reference reducer's dedup, main.c:176-184, pulled forward into the map
-// phase — output-invariant, shrinks the device feed ~4x on real text).
+// enables the combiner (shrinks the device feed ~4x on real text).
 // Returns NULL on OOM.
 TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
                              const int64_t* doc_ends,
                              const int32_t* doc_id_values, int32_t num_docs,
                              int32_t dedup_pairs) {
-  std::vector<uint8_t> arena;
-  arena.reserve(1 << 20);
-  std::vector<Entry> table(1 << 16);
-  for (auto& e : table) e.id = -1;
-  uint64_t mask = table.size() - 1;
-  int32_t next_id = 0;
-
+  StreamState st;
   std::vector<int32_t> tok_terms;
   std::vector<int32_t> tok_docs;
   tok_terms.reserve(len / 6 + 16);
   tok_docs.reserve(len / 6 + 16);
+  ScanChunk(st, data, len, doc_ends, doc_id_values, num_docs,
+            dedup_pairs != 0, [&](int32_t id, int32_t doc) {
+              tok_terms.push_back(id);
+              tok_docs.push_back(doc);
+            });
 
-  std::vector<uint32_t> word_offsets;  // provisional id -> arena offset
-  std::vector<uint32_t> word_lens;
-  std::vector<int32_t> last_doc;       // provisional id -> last doc ordinal seen
-
-  int64_t raw_tokens = 0;
-  uint8_t word[kMaxWordLetters];
-  int64_t pos = 0;
-  for (int32_t d = 0; d < num_docs; ++d) {
-    const int64_t end = doc_ends[d];
-    const int32_t doc_id = doc_id_values[d];
-    while (pos < end) {
-      // skip to next token start (whitespace run)
-      int wlen = 0;
-      bool in_token = false;
-      for (; pos < end; ++pos) {
-        const uint8_t b = data[pos];
-        if (IsSpace(b)) {
-          if (in_token) break;  // token finished
-          continue;
-        }
-        in_token = true;
-        // clean: keep letters only, lowercase, cap at 299
-        if (b >= 'A' && b <= 'Z') {
-          if (wlen < kMaxWordLetters) word[wlen++] = b + 32;
-        } else if (b >= 'a' && b <= 'z') {
-          if (wlen < kMaxWordLetters) word[wlen++] = b;
-        }
-      }
-      if (!in_token) break;  // trailing whitespace
-      if (wlen == 0) continue;  // token cleaned to nothing (main.c:113)
-
-      // hash-table upsert
-      const uint64_t h = Fnv1a(word, wlen);
-      uint64_t slot = h & mask;
-      int32_t id = -1;
-      for (;;) {
-        Entry& e = table[slot];
-        if (e.id < 0) {
-          // insert
-          const uint32_t off = static_cast<uint32_t>(arena.size());
-          arena.insert(arena.end(), word, word + wlen);
-          e.offset = off;
-          e.len = wlen;
-          e.id = next_id;
-          word_offsets.push_back(off);
-          word_lens.push_back(wlen);
-          last_doc.push_back(-1);
-          id = next_id++;
-          break;
-        }
-        if (e.len == static_cast<uint32_t>(wlen) &&
-            std::memcmp(arena.data() + e.offset, word, wlen) == 0) {
-          id = e.id;
-          break;
-        }
-        slot = (slot + 1) & mask;
-      }
-      ++raw_tokens;
-      if (dedup_pairs) {
-        if (last_doc[id] == d) continue;  // (term, doc) already emitted
-        last_doc[id] = d;
-      }
-      tok_terms.push_back(id);
-      tok_docs.push_back(doc_id);
-
-      // grow at 0.7 load
-      if (static_cast<uint64_t>(next_id) * 10 > table.size() * 7) {
-        std::vector<Entry> bigger(table.size() * 2);
-        for (auto& e : bigger) e.id = -1;
-        const uint64_t bmask = bigger.size() - 1;
-        for (const Entry& e : table) {
-          if (e.id < 0) continue;
-          uint64_t s = Fnv1a(arena.data() + e.offset, e.len) & bmask;
-          while (bigger[s].id >= 0) s = (s + 1) & bmask;
-          bigger[s] = e;
-        }
-        table.swap(bigger);
-        mask = bmask;
-      }
-    }
-    pos = end;
-  }
-
-  const int32_t vocab = next_id;
-  // sort unique words lexicographically (== strcmp order: letters only)
-  std::vector<int32_t> order(vocab);
-  for (int32_t i = 0; i < vocab; ++i) order[i] = i;
-  const uint8_t* base = arena.data();
-  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
-    const uint32_t la = word_lens[a], lb = word_lens[b];
-    const int c = std::memcmp(base + word_offsets[a], base + word_offsets[b],
-                              la < lb ? la : lb);
-    if (c != 0) return c < 0;
-    return la < lb;
-  });
-
+  const int32_t vocab = st.next_id;
+  const std::vector<int32_t> order = SortedOrder(st);
   int32_t width = 1;
   for (int32_t i = 0; i < vocab; ++i)
-    width = std::max(width, static_cast<int32_t>(word_lens[i]));
+    width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
 
   auto* res = static_cast<TokenizeResult*>(std::malloc(sizeof(TokenizeResult)));
   if (!res) return nullptr;
   const int64_t n = static_cast<int64_t>(tok_terms.size());
   res->num_tokens = n;
-  res->raw_tokens = raw_tokens;
+  res->raw_tokens = st.raw_tokens;
   res->vocab_size = vocab;
   res->vocab_width = width;
   res->term_ids = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max<int64_t>(n, 1)));
@@ -206,7 +259,7 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
     const int32_t prov = order[rank];
     remap[prov] = rank;
     std::memcpy(res->vocab_packed + static_cast<int64_t>(rank) * width,
-                base + word_offsets[prov], word_lens[prov]);
+                st.arena.data() + st.word_offsets[prov], st.word_lens[prov]);
     res->letter_of_term[rank] = res->vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
   }
   for (int64_t i = 0; i < n; ++i) {
@@ -222,6 +275,137 @@ void mri_free_result(TokenizeResult* r) {
   std::free(r->doc_ids);
   std::free(r->vocab_packed);
   std::free(r->letter_of_term);
+  std::free(r);
+}
+
+// ---------------------------------------------------------------------------
+// Streaming frontend: per-chunk packed provisional keys.
+//
+// The device engine's pipelined path (ops/engine.sort_prov_chunks)
+// sorts `prov_id * stride + doc_id` keys — no final-vocab knowledge —
+// so each chunk's keys can start their host->device DMA while the next
+// chunk tokenizes.  stride = max_doc_id + 2 (doc ids < stride - 1 and
+// INT32_MAX padding stays strictly above every valid key).
+// ---------------------------------------------------------------------------
+
+struct StreamChunkResult {
+  int64_t num_pairs;   // -1 = packed key would overflow int32 (caller
+                       // falls back to the one-shot engine path)
+  int64_t raw_tokens;  // this chunk's raw token count
+  int32_t* keys;       // [num_pairs] packed prov*stride + doc, combiner-deduped
+};
+
+struct StreamFinalResult {
+  int32_t vocab_size;
+  int32_t vocab_width;
+  int64_t raw_tokens;       // whole stream
+  int64_t num_pairs;        // whole stream (post-combiner)
+  uint8_t* vocab_packed;    // [vocab_size * width], sorted, NUL padded
+  int32_t* letter_of_term;  // [vocab_size], rank space
+  int32_t* remap;           // [vocab_size], prov id -> sorted rank
+  int32_t* df;              // [vocab_size], prov space (combiner counts)
+};
+
+void* mri_stream_new(int64_t stride) {
+  auto* st = new (std::nothrow) StreamState();
+  if (st) st->stride = stride;
+  return st;
+}
+
+void mri_stream_free(void* handle) {
+  delete static_cast<StreamState*>(handle);
+}
+
+StreamChunkResult* mri_stream_feed(void* handle, const uint8_t* data,
+                                   int64_t len, const int64_t* doc_ends,
+                                   const int32_t* doc_id_values,
+                                   int32_t num_docs) {
+  auto& st = *static_cast<StreamState*>(handle);
+  auto* res =
+      static_cast<StreamChunkResult*>(std::malloc(sizeof(StreamChunkResult)));
+  if (!res) return nullptr;
+  std::vector<int32_t> keys;
+  keys.reserve(len / 24 + 16);
+  const int64_t raw_before = st.raw_tokens;
+  const int64_t stride = st.stride;
+  ScanChunk(st, data, len, doc_ends, doc_id_values, num_docs, /*dedup=*/true,
+            [&](int32_t id, int32_t doc) {
+              const int64_t key = static_cast<int64_t>(id) * stride + doc;
+              if (key >= INT32_MAX) {  // INT32_MAX itself is the pad value
+                st.key_overflow = true;
+                return;
+              }
+              keys.push_back(static_cast<int32_t>(key));
+            });
+  res->raw_tokens = st.raw_tokens - raw_before;
+  if (st.key_overflow) {
+    res->num_pairs = -1;
+    res->keys = nullptr;
+    return res;
+  }
+  res->num_pairs = static_cast<int64_t>(keys.size());
+  res->keys = static_cast<int32_t*>(
+      std::malloc(sizeof(int32_t) * std::max<size_t>(keys.size(), 1)));
+  if (!res->keys) {
+    std::free(res);
+    return nullptr;
+  }
+  std::memcpy(res->keys, keys.data(), sizeof(int32_t) * keys.size());
+  return res;
+}
+
+void mri_stream_chunk_free(StreamChunkResult* r) {
+  if (!r) return;
+  std::free(r->keys);
+  std::free(r);
+}
+
+StreamFinalResult* mri_stream_finalize(void* handle) {
+  auto& st = *static_cast<StreamState*>(handle);
+  const int32_t vocab = st.next_id;
+  const std::vector<int32_t> order = SortedOrder(st);
+  int32_t width = 1;
+  for (int32_t i = 0; i < vocab; ++i)
+    width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
+
+  auto* res =
+      static_cast<StreamFinalResult*>(std::malloc(sizeof(StreamFinalResult)));
+  if (!res) return nullptr;
+  res->vocab_size = vocab;
+  res->vocab_width = width;
+  res->raw_tokens = st.raw_tokens;
+  res->num_pairs = st.num_pairs;
+  res->vocab_packed = static_cast<uint8_t*>(
+      std::calloc(std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 1));
+  res->letter_of_term =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
+  res->remap =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
+  res->df =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
+  if (!res->vocab_packed || !res->letter_of_term || !res->remap || !res->df) {
+    std::free(res->vocab_packed); std::free(res->letter_of_term);
+    std::free(res->remap); std::free(res->df); std::free(res);
+    return nullptr;
+  }
+  for (int32_t rank = 0; rank < vocab; ++rank) {
+    const int32_t prov = order[rank];
+    res->remap[prov] = rank;
+    std::memcpy(res->vocab_packed + static_cast<int64_t>(rank) * width,
+                st.arena.data() + st.word_offsets[prov], st.word_lens[prov]);
+    res->letter_of_term[rank] =
+        res->vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
+  }
+  if (vocab) std::memcpy(res->df, st.df.data(), sizeof(int32_t) * vocab);
+  return res;
+}
+
+void mri_stream_final_free(StreamFinalResult* r) {
+  if (!r) return;
+  std::free(r->vocab_packed);
+  std::free(r->letter_of_term);
+  std::free(r->remap);
+  std::free(r->df);
   std::free(r);
 }
 

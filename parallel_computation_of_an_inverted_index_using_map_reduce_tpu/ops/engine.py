@@ -166,6 +166,31 @@ def index_prededuped_u16(feed_u16, *, max_doc_id: int, out_size: int | None = No
     return sorted_docs if out_size is None else sorted_docs[:out_size]
 
 
+@functools.partial(jax.jit, static_argnames=("stride", "out_size"))
+def sort_prov_chunks(chunks, *, stride: int, out_size: int):
+    """Pipelined path: sort packed *provisional*-id keys fed per chunk.
+
+    ``chunks`` is a tuple of int32 arrays of ``prov_id * stride + doc``
+    keys (INT32_MAX padding), each uploaded asynchronously while the
+    host tokenizer was still scanning later documents — possible
+    because provisional ids are first-occurrence ids, stable the moment
+    a chunk is scanned, so this program never depends on the final
+    sorted vocab.  Postings only need *grouping* by term and docs
+    ascending, which the prov-key sort already gives; the host resolves
+    emit order / offsets in prov space from vocab-sized arrays
+    (models/inverted_index.py), leaving exactly one device->host
+    round-trip on the critical path after tokenization ends.
+
+    Combiner-deduped feeds only (each (term, doc) at most once).
+    Returns the doc component of the ascending keys — the concatenated
+    postings lists in prov-id order — as uint16 (callers guarantee
+    ``stride <= 0x10000``); padding sorts last and is cut by
+    ``out_size``.
+    """
+    keys = chunks[0] if len(chunks) == 1 else jnp.concatenate(list(chunks))
+    return (lax.sort(keys)[:out_size] % stride).astype(jnp.uint16)
+
+
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"),
                    donate_argnums=(0,))
 def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):

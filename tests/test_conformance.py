@@ -45,10 +45,12 @@ def test_tpu_backend_matches_reference_smoke(smoke_fixture, tmp_path):
 
 
 def test_single_chip_u16_path_matches_reference_smoke(smoke_fixture, tmp_path):
-    # device_shards=1 takes the uint16 feed/fetch fast path
+    # device_shards=1 + pipeline off takes the one-shot uint16 feed/fetch
+    # path (the pipelined default is covered in tests/test_pipelined.py)
     m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
     build_index(
-        m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=1),
+        m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=1,
+                       pipeline_chunk_docs=0),
         output_dir=tmp_path)
     assert read_letter_files(tmp_path) == _golden(smoke_fixture)
 

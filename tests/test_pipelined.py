@@ -1,0 +1,141 @@
+"""Pipelined single-chip fast path: provisional-key uploads overlapped
+with tokenization (models/inverted_index._run_tpu_pipelined +
+ops/engine.sort_prov_chunks + native.NativeKeyStream).
+
+The invariant under test: for ANY window size, output is byte-identical
+to the oracle / goldens — provisional ids are first-occurrence-stable,
+so the device sort groups identically however the stream is windowed.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native tokenizer unavailable")
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("device_shards", 1)  # 8 virtual devices otherwise -> dist
+    kw.setdefault("pad_multiple", 64)
+    return IndexConfig(**kw)
+
+
+@pytest.mark.parametrize("chunk_docs", [1, 2, 100])
+def test_matches_goldens_any_window(smoke_fixture, tmp_path, chunk_docs):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    model = InvertedIndexModel(_cfg(pipeline_chunk_docs=chunk_docs))
+    report = model.run(m, output_dir=tmp_path)
+    assert "tokenize_feed" in report["phases_ms"]  # really took the fast path
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+def test_default_config_single_chip_takes_pipelined_path(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path)
+    assert "tokenize_feed" in report["phases_ms"]
+    assert report["upload_windows"] == 2  # auto = two windows
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+def test_chunk_zero_disables(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(_cfg(pipeline_chunk_docs=0)).run(
+        m, output_dir=tmp_path)
+    assert "tokenize_feed" not in report["phases_ms"]
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+def test_property_random_corpus_vs_oracle(tmp_path):
+    docs = zipf_corpus(num_docs=41, vocab_size=700, tokens_per_doc=80, seed=3)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _cfg(pipeline_chunk_docs=7), output_dir=tmp_path / "pipe")
+    assert read_letter_files(tmp_path / "pipe") == read_letter_files(tmp_path / "oracle")
+
+
+def test_empty_corpus_writes_26_empty_files(tmp_path):
+    (tmp_path / "empty.txt").write_bytes(b"   \n\t \n")
+    write_manifest(tmp_path / "list.txt", [str(tmp_path / "empty.txt")])
+    m = read_manifest(tmp_path / "list.txt")
+    report = InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path / "out")
+    assert read_letter_files(tmp_path / "out") == b""
+    assert report["unique_terms"] == 0
+
+
+def test_key_stream_matches_one_shot_tokenizer(smoke_fixture):
+    """The incremental stream and the one-shot native tokenizer must
+    describe the same (word, doc) pair set, df and vocab."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        load_documents,
+    )
+
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    contents, doc_ids = load_documents(m)
+    one = native.tokenize_native(contents, doc_ids, dedup_pairs=True)
+
+    stride = len(m) + 2
+    with native.NativeKeyStream(stride) as stream:
+        all_keys = []
+        for i in range(len(contents)):  # one-doc windows: worst case
+            keys, _ = stream.feed([contents[i]], [doc_ids[i]])
+            all_keys.append(keys)
+        vocab, letters, remap, df_prov, raw_tokens, num_pairs = stream.finalize()
+
+    assert np.array_equal(vocab, one.vocab)
+    assert raw_tokens == one.raw_tokens
+    assert num_pairs == one.num_tokens
+    keys = np.concatenate(all_keys) if all_keys else np.empty(0, np.int32)
+    # prov keys -> (rank, doc) pairs must equal the one-shot pair set
+    prov, doc = keys // stride, keys % stride
+    got = set(zip(remap[prov].tolist(), doc.tolist()))
+    want = set(zip(one.term_ids.tolist(), one.doc_ids.tolist()))
+    assert got == want
+    # df in prov space == bincount of one-shot rank ids pushed through remap
+    df_rank = np.zeros(len(vocab), np.int64)
+    df_rank[remap] = df_prov
+    assert np.array_equal(df_rank, np.bincount(one.term_ids, minlength=len(vocab)))
+
+
+def test_key_overflow_falls_back_to_one_shot(tmp_path, monkeypatch):
+    """A mid-stream int32 key overflow must transparently restart on the
+    one-shot path with identical output."""
+    docs = zipf_corpus(num_docs=9, vocab_size=300, tokens_per_doc=50, seed=11)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+
+    real_feed = native.NativeKeyStream.feed
+
+    def exploding_feed(self, contents, doc_ids):
+        if doc_ids and doc_ids[0] > 5:
+            raise native.KeyOverflow()
+        return real_feed(self, contents, doc_ids)
+
+    monkeypatch.setattr(native.NativeKeyStream, "feed", exploding_feed)
+    report = InvertedIndexModel(_cfg(pipeline_chunk_docs=2)).run(
+        m, output_dir=tmp_path / "out")
+    assert report["pipelined_fallback"] == "key_overflow"
+    assert "tokenize_feed" not in report["phases_ms"]
+    assert read_letter_files(tmp_path / "out") == read_letter_files(tmp_path / "oracle")
