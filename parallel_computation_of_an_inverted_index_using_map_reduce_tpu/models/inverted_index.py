@@ -195,7 +195,7 @@ class InvertedIndexModel:
         )
         granule = min(1 << 14, self.config.pad_multiple)
         chunks_dev = []
-        num_pairs = docs_loaded = 0
+        num_pairs = docs_loaded = keys_capacity = 0
         stream = native.NativeKeyStream(stride)
         try:
             with timer.phase("tokenize_feed"):
@@ -205,9 +205,15 @@ class InvertedIndexModel:
                     if keys.size == 0:
                         continue
                     padded = _round_up(keys.size, granule)
-                    buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
-                    buf[: keys.size] = keys
+                    terms = keys // stride
+                    if int(terms.max()) <= 0xFFFE:
+                        # fits: half-bandwidth [terms | docs] uint16 window
+                        buf = engine.pack_u16_feed(terms, keys % stride, padded)
+                    else:
+                        buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
+                        buf[: keys.size] = keys
                     chunks_dev.append(jax.device_put(buf))  # async DMA
+                    keys_capacity += padded
                     num_pairs += int(keys.size)
             with timer.phase("finalize_vocab"):
                 vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
@@ -230,8 +236,7 @@ class InvertedIndexModel:
             if self.config.profile_dir
             else contextlib.nullcontext()
         )
-        nfetch = min(sum(int(c.shape[0]) for c in chunks_dev),
-                     _round_up(num_pairs, 1 << 16))
+        nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 16))
         with timer.phase("device_index"), profile:
             post_dev = engine.sort_prov_chunks(
                 tuple(chunks_dev), stride=stride, out_size=nfetch)
@@ -317,10 +322,8 @@ class InvertedIndexModel:
             if use_u16:
                 # one upload op: [terms | docs] as uint16 (fixed per-transfer
                 # cost dominates the link; see ops/engine.index_u16)
-                feed_u16 = np.full(2 * padded, 0xFFFF, dtype=np.uint16)
-                feed_u16[:num_tokens] = corpus.term_ids
-                feed_u16[padded : padded + num_tokens] = corpus.doc_ids
-                feed_dev = jax.device_put(feed_u16)
+                feed_dev = jax.device_put(
+                    engine.pack_u16_feed(corpus.term_ids, corpus.doc_ids, padded))
             elif K.can_pack(vocab_size, max_doc_id):
                 host_keys = np.full(padded, K.INT32_MAX, dtype=np.int32)
                 stride = max_doc_id + 2

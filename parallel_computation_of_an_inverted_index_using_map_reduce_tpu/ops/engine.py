@@ -24,11 +24,20 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+# Feed buffers are donated so XLA reuses their device memory as sort
+# scratch; when the program's *outputs* have a different dtype/shape the
+# donation still frees the input after its last use, but JAX warns that
+# no output could alias it.  That warning is noise for every engine
+# entry point here (outputs are deliberately narrower than feeds).
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 from . import keys as K
 from .segment import compact, first_occurrence_mask, segment_counts
@@ -138,6 +147,17 @@ def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
         lax.sort(keys), letter_of_term, vocab_size=vocab_size, max_doc_id=max_doc_id)
 
 
+def pack_u16_feed(terms, docs, padded: int) -> np.ndarray:
+    """Host-side encode of the half-bandwidth uint16 feed buffer:
+    ``[terms | docs]``, each half ``padded`` long, 0xFFFF padding —
+    the layout :func:`_u16_feed_to_keys` decodes on device."""
+    buf = np.full(2 * padded, 0xFFFF, dtype=np.uint16)
+    n = len(terms)
+    buf[:n] = terms
+    buf[padded : padded + n] = docs
+    return buf
+
+
 def _u16_feed_to_keys(feed_u16, max_doc_id: int):
     """[terms | docs] uint16 buffer (0xFFFF padding) -> packed int32 keys."""
     pad = jnp.uint16(0xFFFF)
@@ -166,20 +186,23 @@ def index_prededuped_u16(feed_u16, *, max_doc_id: int, out_size: int | None = No
     return sorted_docs if out_size is None else sorted_docs[:out_size]
 
 
-@functools.partial(jax.jit, static_argnames=("stride", "out_size"))
+@functools.partial(jax.jit, static_argnames=("stride", "out_size"), donate_argnums=(0,))
 def sort_prov_chunks(chunks, *, stride: int, out_size: int):
     """Pipelined path: sort packed *provisional*-id keys fed per chunk.
 
-    ``chunks`` is a tuple of int32 arrays of ``prov_id * stride + doc``
-    keys (INT32_MAX padding), each uploaded asynchronously while the
-    host tokenizer was still scanning later documents — possible
-    because provisional ids are first-occurrence ids, stable the moment
-    a chunk is scanned, so this program never depends on the final
-    sorted vocab.  Postings only need *grouping* by term and docs
-    ascending, which the prov-key sort already gives; the host resolves
-    emit order / offsets in prov space from vocab-sized arrays
-    (models/inverted_index.py), leaving exactly one device->host
-    round-trip on the critical path after tokenization ends.
+    Each element of ``chunks`` is one upload window, asynchronously
+    DMA'd while the host tokenizer was still scanning later documents —
+    possible because provisional ids are first-occurrence ids, stable
+    the moment a chunk is scanned, so this program never depends on the
+    final sorted vocab.  A window is either an int32 array of
+    ``prov_id * stride + doc`` keys (INT32_MAX padding) or, when its
+    prov ids still fit, a half-bandwidth uint16 ``[terms | docs]``
+    buffer (0xFFFF padding) packed into the same keys on device.
+    Postings only need *grouping* by term and docs ascending, which the
+    prov-key sort already gives; the host resolves emit order / offsets
+    in prov space from vocab-sized arrays (models/inverted_index.py),
+    leaving exactly one device->host round-trip on the critical path
+    after tokenization ends.
 
     Combiner-deduped feeds only (each (term, doc) at most once).
     Returns the doc component of the ascending keys — the concatenated
@@ -187,7 +210,11 @@ def sort_prov_chunks(chunks, *, stride: int, out_size: int):
     ``stride <= 0x10000``); padding sorts last and is cut by
     ``out_size``.
     """
-    keys = chunks[0] if len(chunks) == 1 else jnp.concatenate(list(chunks))
+    as_keys = [
+        _u16_feed_to_keys(c, stride - 2) if c.dtype == jnp.uint16 else c
+        for c in chunks
+    ]
+    keys = as_keys[0] if len(as_keys) == 1 else jnp.concatenate(as_keys)
     return (lax.sort(keys)[:out_size] % stride).astype(jnp.uint16)
 
 

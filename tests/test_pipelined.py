@@ -74,6 +74,34 @@ def test_property_random_corpus_vs_oracle(tmp_path):
     assert read_letter_files(tmp_path / "pipe") == read_letter_files(tmp_path / "oracle")
 
 
+def test_vocab_beyond_u16_uses_int32_windows(tmp_path):
+    """A window whose provisional ids exceed 0xFFFE must switch that
+    window's upload to int32 keys and still match the oracle."""
+
+    def word(i: int) -> str:  # letters-only base-26 encoding
+        s = ""
+        while True:
+            s += chr(ord("a") + i % 26)
+            i //= 26
+            if not i:
+                return s
+
+    n = 0x10000 + 50
+    half = n // 2
+    docs = [
+        " ".join(word(i) for i in range(half)).encode(),
+        " ".join(word(i) for i in range(half, n)).encode(),
+    ]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(_cfg(pipeline_chunk_docs=1)).run(
+        m, output_dir=tmp_path / "pipe")
+    assert report["unique_terms"] == n  # second window really crossed 0xFFFE
+    assert read_letter_files(tmp_path / "pipe") == read_letter_files(tmp_path / "oracle")
+
+
 def test_empty_corpus_writes_26_empty_files(tmp_path):
     (tmp_path / "empty.txt").write_bytes(b"   \n\t \n")
     write_manifest(tmp_path / "list.txt", [str(tmp_path / "empty.txt")])
