@@ -42,6 +42,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stream-chunk-docs", type=int, default=None,
                    help="streaming mode: window size in whole documents "
                         "(bounded host/device memory; default: one-shot)")
+    p.add_argument("--pipeline-chunk-docs", type=int, default=None,
+                   help="pipelined fast path: documents per upload window "
+                        "(default: auto, two windows; 0 = one-shot engine)")
     return p
 
 
@@ -59,6 +62,7 @@ def main(argv: list[str] | None = None) -> int:
             profile_dir=args.profile_dir,
             collect_skew_stats=args.skew,
             stream_chunk_docs=args.stream_chunk_docs,
+            pipeline_chunk_docs=args.pipeline_chunk_docs,
         )
         stats = build_index(manifest, config)
     except (OSError, ValueError) as e:

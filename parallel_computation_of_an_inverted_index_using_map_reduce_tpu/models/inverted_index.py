@@ -205,10 +205,10 @@ class InvertedIndexModel:
                     if keys.size == 0:
                         continue
                     padded = _round_up(keys.size, granule)
-                    terms = keys // stride
-                    if int(terms.max()) <= 0xFFFE:
+                    if int(keys.max()) // stride <= 0xFFFE:
                         # fits: half-bandwidth [terms | docs] uint16 window
-                        buf = engine.pack_u16_feed(terms, keys % stride, padded)
+                        terms, docs = np.divmod(keys, stride)
+                        buf = engine.pack_u16_feed(terms, docs, padded)
                     else:
                         buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
                         buf[: keys.size] = keys

@@ -31,16 +31,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-# Feed buffers are donated so XLA reuses their device memory as sort
-# scratch; when the program's *outputs* have a different dtype/shape the
-# donation still frees the input after its last use, but JAX warns that
-# no output could alias it.  That warning is noise for every engine
-# entry point here (outputs are deliberately narrower than feeds).
-warnings.filterwarnings(
-    "ignore", message="Some donated buffers were not usable")
-
 from . import keys as K
 from .segment import compact, first_occurrence_mask, segment_counts
+
+
+def _quiet_donation(fn):
+    """Silence JAX's unusable-donation warning around one jitted entry.
+
+    Feed buffers are donated so XLA reuses their device memory as sort
+    scratch; the programs' *outputs* are deliberately narrower than the
+    feeds, so no output can alias them and JAX warns at lowering time.
+    Scoped per call so user code importing this library keeps the
+    diagnostic for its own donations.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return fn(*args, **kwargs)
+
+    for attr in ("clear_cache", "lower", "trace", "eval_shape"):  # jit API
+        if hasattr(fn, attr):
+            setattr(wrapper, attr, getattr(fn, attr))
+    return wrapper
 
 # Fused Pallas kernel for the dedup mask (ops/pallas/kernels.py):
 #   "auto"  — compiled kernel on TPU, XLA elsewhere (default)
@@ -136,6 +151,7 @@ def postings_from_sorted(keys_s, letter_of_term, *, vocab_size: int, max_doc_id:
     }
 
 
+@_quiet_donation
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0,))
 def index_packed(keys, letter_of_term, *, vocab_size: int, max_doc_id: int):
     """Index a batch of packed (term, doc) int32 keys.
@@ -169,6 +185,7 @@ def _u16_feed_to_keys(feed_u16, max_doc_id: int):
         term_u16.astype(jnp.int32) * stride + doc_u16.astype(jnp.int32))
 
 
+@_quiet_donation
 @functools.partial(jax.jit, static_argnames=("max_doc_id", "out_size"), donate_argnums=(0,))
 def index_prededuped_u16(feed_u16, *, max_doc_id: int, out_size: int | None = None):
     """Minimal device program for a combiner-deduped feed.
@@ -186,6 +203,7 @@ def index_prededuped_u16(feed_u16, *, max_doc_id: int, out_size: int | None = No
     return sorted_docs if out_size is None else sorted_docs[:out_size]
 
 
+@_quiet_donation
 @functools.partial(jax.jit, static_argnames=("stride", "out_size"), donate_argnums=(0,))
 def sort_prov_chunks(chunks, *, stride: int, out_size: int):
     """Pipelined path: sort packed *provisional*-id keys fed per chunk.
@@ -218,6 +236,7 @@ def sort_prov_chunks(chunks, *, stride: int, out_size: int):
     return (lax.sort(keys)[:out_size] % stride).astype(jnp.uint16)
 
 
+@_quiet_donation
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"),
                    donate_argnums=(0,))
 def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
@@ -243,6 +262,7 @@ def index_u16(feed_u16, *, vocab_size: int, max_doc_id: int):
         [df.astype(jnp.uint16), postings.astype(jnp.uint16)])}
 
 
+@_quiet_donation
 @functools.partial(jax.jit, static_argnames=("vocab_size", "max_doc_id"), donate_argnums=(0, 1))
 def index_pairs(term_ids, doc_ids, letter_of_term, *, vocab_size: int, max_doc_id: int):
     """General path for corpora too large to pack into one int32 key.

@@ -1,0 +1,69 @@
+"""Scale / stress tests: Zipfian corpora at ~full-corpus magnitude
+(BASELINE.json config 4's regime, shrunk to CI budget — SURVEY.md §4
+item 5).  All engines must agree with the dict oracle byte-for-byte on
+a skewed vocabulary ~30x the letter count, and the streaming
+accumulator must stay bounded while doing it.
+"""
+
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+
+
+@pytest.fixture(scope="module")
+def zipf_fixture(tmp_path_factory):
+    root = tmp_path_factory.mktemp("zipf_scale")
+    docs = zipf_corpus(num_docs=400, vocab_size=3000, tokens_per_doc=600,
+                       alpha=1.1, seed=42)
+    paths = write_corpus(root / "docs", docs)
+    write_manifest(root / "list.txt", paths)
+    m = read_manifest(root / "list.txt")
+    oracle_index(m, root / "oracle")
+    return m, read_letter_files(root / "oracle"), root
+
+
+@pytest.mark.slow
+def test_pipelined_matches_oracle_at_scale(zipf_fixture, tmp_path):
+    m, golden, _ = zipf_fixture
+    report = InvertedIndexModel(IndexConfig(
+        backend="tpu", device_shards=1)).run(m, output_dir=tmp_path)
+    assert "tokenize_feed" in report["phases_ms"]
+    assert report["tokens"] == 400 * 600
+    assert read_letter_files(tmp_path) == golden
+
+
+@pytest.mark.slow
+def test_multichip_matches_oracle_at_scale(zipf_fixture, tmp_path):
+    m, golden, _ = zipf_fixture
+    report = InvertedIndexModel(IndexConfig(backend="tpu")).run(
+        m, output_dir=tmp_path)  # 8 virtual devices -> dist engine
+    assert report["device_shards"] == 8
+    assert read_letter_files(tmp_path) == golden
+
+
+@pytest.mark.slow
+def test_streaming_matches_oracle_at_scale(zipf_fixture, tmp_path):
+    m, golden, _ = zipf_fixture
+    report = InvertedIndexModel(IndexConfig(
+        backend="tpu", stream_chunk_docs=64, pad_multiple=1 << 14)).run(
+        m, output_dir=tmp_path)
+    assert report["stream_windows"] >= 6
+    # bounded: unique pairs fit the accumulator's initial 2^18 capacity,
+    # so the 240k-token stream must never have forced a growth step
+    assert report["unique_pairs"] < (1 << 18)
+    assert report["accumulator_capacity"] == 1 << 18
+    assert read_letter_files(tmp_path) == golden
