@@ -236,7 +236,7 @@ class InvertedIndexModel:
             if self.config.profile_dir
             else contextlib.nullcontext()
         )
-        nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 16))
+        nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 14))
         with timer.phase("device_index"), profile:
             post_dev = engine.sort_prov_chunks(
                 tuple(chunks_dev), stride=stride, out_size=nfetch)
@@ -358,7 +358,7 @@ class InvertedIndexModel:
             # after dispatch hides it behind the in-flight upload +
             # sort, and the host derives df/order/offsets meanwhile.
             num_unique = num_tokens
-            nfetch = min(padded, _round_up(num_unique, 1 << 16))
+            nfetch = min(padded, _round_up(num_unique, 1 << 14))
             with timer.phase("device_index"), profile:
                 post_dev = engine.index_prededuped_u16(
                     feed_dev, max_doc_id=max_doc_id, out_size=nfetch)
@@ -413,7 +413,7 @@ class InvertedIndexModel:
                 # them compiled slice programs, reuse)
                 df = jax.device_get(out["combined"][:vocab_size]).astype(np.int64)
                 num_unique = int(df.sum())
-                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 16))
+                nfetch = min(padded, _round_up(max(num_unique, 1), 1 << 14))
                 postings = jax.device_get(
                     out["combined"][vocab_size : vocab_size + nfetch])
                 order, offsets = engine.host_order_offsets(corpus.letter_of_term, df)
