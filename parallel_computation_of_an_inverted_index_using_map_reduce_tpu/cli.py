@@ -30,7 +30,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("num_reducers", type=int,
                    help="reduce partition count (reference reducer threads; output-invariant)")
     p.add_argument("file_list", help="manifest: count header then one path per line")
-    p.add_argument("--backend", choices=("tpu", "oracle"), default="tpu")
+    p.add_argument("--backend", choices=("tpu", "cpu", "oracle"), default="tpu",
+                   help="tpu: device engine; cpu: one native host call; "
+                        "oracle: pure-Python conformance backend")
     p.add_argument("--output-dir", default=".", help="where a.txt..z.txt are written (default: CWD)")
     p.add_argument("--pad-multiple", type=int, default=1 << 16)
     p.add_argument("--checkpoint", default=None,

@@ -34,7 +34,12 @@ class IndexConfig:
     # validated and recorded in run stats but do not change the result.
     num_mappers: int = 1
     num_reducers: int = 1
-    backend: str = "tpu"          # "tpu" | "oracle"
+    # "tpu"    — device engine (jit sort pipeline; pipelined/one-shot plans)
+    # "cpu"    — whole pipeline in one native C++ call, no accelerator
+    #            (the reference's all-on-host regime without its
+    #            pathologies; falls back to "oracle" if g++ is absent)
+    # "oracle" — pure-Python dict oracle, the conformance seam
+    backend: str = "tpu"
     output_dir: str = "."         # where a.txt .. z.txt are written
     # Pad token-count up to a multiple of this so XLA re-uses compiled
     # programs across similarly-sized corpora instead of recompiling.
@@ -70,13 +75,24 @@ class IndexConfig:
             raise ValueError(f"num_mappers must be >= 1, got {self.num_mappers}")
         if self.num_reducers < 1:
             raise ValueError(f"num_reducers must be >= 1, got {self.num_reducers}")
-        if self.backend not in ("tpu", "oracle"):
+        if self.backend not in ("tpu", "cpu", "oracle"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.pad_multiple < 1:
             raise ValueError("pad_multiple must be >= 1")
         if self.device_shards is not None and self.device_shards < 1:
             raise ValueError(
                 f"device_shards must be >= 1 or None (auto), got {self.device_shards}")
+        if self.backend != "tpu":
+            # device-era options the host backends do not implement: fail
+            # loudly rather than silently ignore a flag the user passed
+            for flag in ("stream_chunk_docs", "checkpoint_path", "profile_dir"):
+                if getattr(self, flag) is not None:
+                    raise ValueError(
+                        f"{flag} requires backend='tpu', got backend={self.backend!r}")
+            if self.collect_skew_stats:
+                raise ValueError(
+                    "collect_skew_stats requires backend='tpu', "
+                    f"got backend={self.backend!r}")
         if self.pipeline_chunk_docs is not None and self.pipeline_chunk_docs < 0:
             raise ValueError(
                 "pipeline_chunk_docs must be >= 1, 0 (disabled) or None (auto), "

@@ -55,9 +55,36 @@ class InvertedIndexModel:
             with timer.phase("oracle"):
                 stats = oracle_index(manifest, out_dir)
             return {**stats, **timer.report()}
+        if cfg.backend == "cpu":
+            return self._run_cpu(manifest, out_dir, timer)
         if cfg.stream_chunk_docs is not None:
             return self._run_tpu_streaming(manifest, out_dir, timer)
         return self._run_tpu(manifest, out_dir, timer)
+
+    # -- CPU backend ---------------------------------------------------
+
+    def _run_cpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
+        """All-on-host engine: one native call (native.host_index_native).
+
+        The reference's regime — CPU only — re-architected: no spill
+        files, no locks, no token-scale sorts.  Falls back to the
+        Python oracle when no C++ toolchain is available, keeping the
+        backend usable everywhere.
+        """
+        from .. import native
+
+        if not self.config.use_native or not native.available():
+            with timer.phase("oracle"):
+                stats = oracle_index(manifest, out_dir)
+            timer.count("cpu_fallback", "oracle")
+            return {**stats, **timer.report()}
+        with timer.phase("load"):
+            contents, doc_ids = load_documents(manifest)
+        with timer.phase("index_emit"):
+            stats = native.host_index_native(contents, doc_ids, out_dir)
+        for key, value in stats.items():
+            timer.count(key, value)
+        return timer.report()
 
     # -- TPU backend ---------------------------------------------------
 

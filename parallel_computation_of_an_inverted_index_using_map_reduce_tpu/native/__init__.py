@@ -46,6 +46,15 @@ class _StreamChunkResult(ctypes.Structure):
     ]
 
 
+class _HostIndexStats(ctypes.Structure):
+    _fields_ = [
+        ("raw_tokens", ctypes.c_int64),
+        ("num_pairs", ctypes.c_int64),
+        ("vocab_size", ctypes.c_int32),
+        ("bytes_written", ctypes.c_int64),
+    ]
+
+
 class _StreamFinalResult(ctypes.Structure):
     _fields_ = [
         ("vocab_size", ctypes.c_int32),
@@ -124,6 +133,12 @@ def load():
         lib.mri_stream_finalize.argtypes = [ctypes.c_void_p]
         lib.mri_stream_final_free.restype = None
         lib.mri_stream_final_free.argtypes = [ctypes.POINTER(_StreamFinalResult)]
+        lib.mri_host_index.restype = ctypes.c_int32
+        lib.mri_host_index.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_char_p, ctypes.POINTER(_HostIndexStats),
+        ]
         lib.mri_emit.restype = ctypes.c_int64
         lib.mri_emit.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
@@ -144,6 +159,32 @@ def available() -> bool:
     return load() is not None
 
 
+def _marshal_docs(contents: list[bytes], doc_ids: list[int]):
+    """ctypes arguments for the document-window C entry points:
+    ``(data_ptr, data_len, ends_ptr, ids_ptr, n_docs), keepalive`` —
+    NULL pointers for empty input.  Hold ``keepalive`` across the call
+    so the backing numpy arrays outlive the native read."""
+    buf = b"".join(contents)
+    data = np.frombuffer(buf, dtype=np.uint8)
+    ends = np.cumsum(np.array([len(c) for c in contents], dtype=np.int64))
+    ids = np.asarray(doc_ids, dtype=np.int32)
+    n_docs = len(contents)
+
+    def ptr(arr, ctype, nonempty):
+        if not nonempty:
+            return ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctype))
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    args = (
+        ptr(data, ctypes.c_uint8, data.size),
+        ctypes.c_int64(data.size),
+        ptr(ends, ctypes.c_int64, n_docs),
+        ptr(ids, ctypes.c_int32, n_docs),
+        ctypes.c_int32(n_docs),
+    )
+    return args, (buf, data, ends, ids)
+
+
 def tokenize_native(contents: list[bytes], doc_ids: list[int],
                     dedup_pairs: bool = False):
     """Native equivalent of text.tokenizer.tokenize_documents.
@@ -157,23 +198,9 @@ def tokenize_native(contents: list[bytes], doc_ids: list[int],
     if lib is None:
         raise RuntimeError(f"native tokenizer unavailable: {_lib_error}")
 
-    buf = b"".join(contents)
-    data = np.frombuffer(buf, dtype=np.uint8)
-    ends = np.cumsum(np.array([len(c) for c in contents], dtype=np.int64))
-    ids = np.asarray(doc_ids, dtype=np.int32)
-    n_docs = len(contents)
-
-    res = lib.mri_tokenize(
-        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if data.size else
-        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint8)),
-        ctypes.c_int64(data.size),
-        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if n_docs else
-        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
-        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if n_docs else
-        ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int32)),
-        ctypes.c_int32(n_docs),
-        ctypes.c_int32(1 if dedup_pairs else 0),
-    )
+    args, keepalive = _marshal_docs(contents, doc_ids)
+    res = lib.mri_tokenize(*args, ctypes.c_int32(1 if dedup_pairs else 0))
+    del keepalive
     if not res:
         raise MemoryError("native tokenizer allocation failure")
     try:
@@ -225,22 +252,9 @@ class NativeKeyStream:
         safe past the next feed).  Raises :class:`KeyOverflow` when
         ``prov_id * stride + doc_id`` no longer fits int32.
         """
-        buf = b"".join(contents)
-        data = np.frombuffer(buf, dtype=np.uint8)
-        ends = np.cumsum(np.array([len(c) for c in contents], dtype=np.int64))
-        ids = np.asarray(doc_ids, dtype=np.int32)
-        n_docs = len(contents)
-        res = self._lib.mri_stream_feed(
-            self._handle,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if data.size else
-            ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint8)),
-            ctypes.c_int64(data.size),
-            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if n_docs else
-            ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if n_docs else
-            ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int32)),
-            ctypes.c_int32(n_docs),
-        )
+        args, keepalive = _marshal_docs(contents, doc_ids)
+        res = self._lib.mri_stream_feed(self._handle, *args)
+        del keepalive
         if not res:
             raise MemoryError("native stream feed allocation failure")
         try:
@@ -292,6 +306,35 @@ class NativeKeyStream:
             self.close()
         except Exception:
             pass
+
+
+def host_index_native(contents: list[bytes], doc_ids: list[int],
+                      out_dir) -> dict:
+    """Whole pipeline in one native call: tokenize + postings + emit.
+
+    The ``backend="cpu"`` engine (models/inverted_index.py): the
+    reference's all-on-host regime without its pathologies — no spill
+    files, no stdio locks, no token-scale sorts (docs arrive ascending
+    per term by construction).  Returns the stats dict.
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native host index unavailable: {_lib_error}")
+    os.makedirs(out_dir, exist_ok=True)
+    stats = _HostIndexStats()
+    args, keepalive = _marshal_docs(contents, doc_ids)
+    rc = lib.mri_host_index(*args, str(out_dir).encode(), ctypes.byref(stats))
+    del keepalive
+    if rc != 0:
+        raise OSError(f"native host index failed writing to {out_dir!r}")
+    return {
+        "documents": len(contents),
+        "tokens": int(stats.raw_tokens),
+        "unique_terms": int(stats.vocab_size),
+        "unique_pairs": int(stats.num_pairs),
+        "lines_written": int(stats.vocab_size),
+        "bytes_written": int(stats.bytes_written),
+    }
 
 
 def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings) -> int:

@@ -431,15 +431,12 @@ inline char* PutU32(char* p, uint32_t v) {
   return p;
 }
 
-}  // namespace
-
-// postings16/postings32: exactly one is non-null.  order/df/offsets are
-// int64 (numpy's native index types).  Returns total bytes written, or
-// -1 on IO error.
-int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
-                 const int64_t* order, const int64_t* df, const int64_t* offsets,
-                 const uint16_t* postings16, const int32_t* postings32,
-                 const char* out_dir) {
+// Shared emit core: one letter-file set from rank-space order/df/offsets
+// and a flat postings array (uint16 or int32 — exactly one non-null).
+int64_t EmitLetters(const uint8_t* vocab_packed, int32_t vocab_size,
+                    int32_t width, const int64_t* order, const int64_t* df,
+                    const int64_t* offsets, const uint16_t* postings16,
+                    const int32_t* postings32, const char* out_dir) {
   std::vector<char> buf;
   buf.reserve(1 << 22);
   std::string dir(out_dir);
@@ -490,6 +487,108 @@ int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
     total += static_cast<int64_t>(buf.size());
   }
   return total;
+}
+
+}  // namespace
+
+// postings16/postings32: exactly one is non-null.  order/df/offsets are
+// int64 (numpy's native index types).  Returns total bytes written, or
+// -1 on IO error.
+int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
+                 const int64_t* order, const int64_t* df, const int64_t* offsets,
+                 const uint16_t* postings16, const int32_t* postings32,
+                 const char* out_dir) {
+  return EmitLetters(vocab_packed, vocab_size, width, order, df, offsets,
+                     postings16, postings32, out_dir);
+}
+
+// ---------------------------------------------------------------------------
+// Host backend: the whole pipeline in one native call (no accelerator).
+//
+// The reference's regime — everything on the host CPU — minus its
+// pathologies (per-token stdio locks, O(T*W) reducer dict scan,
+// bubble sort).  Documents are scanned once through the incremental
+// core; the combiner appends each first (term, doc) occurrence to the
+// term's postings vector, which arrives ascending for free because
+// docs are scanned in manifest order (doc ids are 1-based manifest
+// positions, main.c:275).  No sort of token-scale data happens at all:
+// the only sorts are the vocab-scale SortedOrder and the emit-order
+// sort below.
+// ---------------------------------------------------------------------------
+
+struct HostIndexStats {
+  int64_t raw_tokens;
+  int64_t num_pairs;
+  int32_t vocab_size;
+  int64_t bytes_written;  // -1 = IO error
+};
+
+int32_t mri_host_index(const uint8_t* data, int64_t len,
+                       const int64_t* doc_ends, const int32_t* doc_id_values,
+                       int32_t num_docs, const char* out_dir,
+                       HostIndexStats* stats) {
+  StreamState st;
+  std::vector<std::vector<int32_t>> postings_by_prov;
+  ScanChunk(st, data, len, doc_ends, doc_id_values, num_docs, /*dedup=*/true,
+            [&](int32_t id, int32_t doc) {
+              if (id >= static_cast<int32_t>(postings_by_prov.size()))
+                postings_by_prov.resize(id + 1);
+              postings_by_prov[id].push_back(doc);
+            });
+
+  const int32_t vocab = st.next_id;
+  const std::vector<int32_t> order = SortedOrder(st);
+  int32_t width = 1;
+  for (int32_t i = 0; i < vocab; ++i)
+    width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
+
+  // Rank-space views over prov-space postings (same indirection the
+  // device pipeline's host side does in models/inverted_index.py).
+  std::vector<uint8_t> vocab_packed(
+      std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 0);
+  std::vector<int32_t> letter_of_rank(std::max(vocab, 1));
+  std::vector<int64_t> df_rank(std::max(vocab, 1));
+  for (int32_t rank = 0; rank < vocab; ++rank) {
+    const int32_t prov = order[rank];
+    std::memcpy(vocab_packed.data() + static_cast<int64_t>(rank) * width,
+                st.arena.data() + st.word_offsets[prov], st.word_lens[prov]);
+    letter_of_rank[rank] = vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
+    df_rank[rank] = static_cast<int64_t>(postings_by_prov[prov].size());
+  }
+
+  // Flat postings in prov order + rank-space offsets into it.
+  std::vector<int64_t> offsets_prov(std::max(vocab, 1));
+  int64_t total_pairs = 0;
+  for (int32_t p = 0; p < vocab; ++p) {
+    offsets_prov[p] = total_pairs;
+    total_pairs += static_cast<int64_t>(postings_by_prov[p].size());
+  }
+  std::vector<int32_t> flat(std::max<int64_t>(total_pairs, 1));
+  for (int32_t p = 0; p < vocab; ++p)
+    std::copy(postings_by_prov[p].begin(), postings_by_prov[p].end(),
+              flat.begin() + offsets_prov[p]);
+  std::vector<int64_t> offsets_rank(std::max(vocab, 1));
+  for (int32_t rank = 0; rank < vocab; ++rank)
+    offsets_rank[rank] = offsets_prov[order[rank]];
+
+  // Emit order: (letter asc, df desc, rank asc) — stable sort supplies
+  // the rank tiebreak == word-ascending (main.c:55-64 semantics).
+  std::vector<int64_t> emit_rank(vocab);
+  for (int32_t i = 0; i < vocab; ++i) emit_rank[i] = i;
+  std::stable_sort(emit_rank.begin(), emit_rank.end(),
+                   [&](int64_t a, int64_t b) {
+                     if (letter_of_rank[a] != letter_of_rank[b])
+                       return letter_of_rank[a] < letter_of_rank[b];
+                     return df_rank[a] > df_rank[b];
+                   });
+
+  stats->raw_tokens = st.raw_tokens;
+  stats->num_pairs = st.num_pairs;
+  stats->vocab_size = vocab;
+  stats->bytes_written = EmitLetters(
+      vocab_packed.data(), vocab, width, emit_rank.data(), df_rank.data(),
+      offsets_rank.data(), nullptr, flat.data(), out_dir);
+  return stats->bytes_written < 0 ? -1 : 0;
 }
 
 }  // extern "C"

@@ -1,0 +1,77 @@
+"""backend="cpu": the whole pipeline in one native C++ call — the
+reference's all-on-host regime re-architected (no spill files, no
+locks, no token-scale sorts).  Must be byte-identical to the oracle and
+to the reference goldens everywhere the device engines are.
+"""
+
+import hashlib
+
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    manifest_from_dir,
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from test_conformance import FULL_CORPUS_MD5
+
+
+def test_cpu_matches_goldens(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(IndexConfig(backend="cpu")).run(
+        m, output_dir=tmp_path)
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+    if native.available():
+        assert "index_emit" in report["phases_ms"]
+        assert report["unique_terms"] > 0
+
+
+def test_cpu_matches_oracle_on_random_corpus(tmp_path):
+    docs = zipf_corpus(num_docs=37, vocab_size=900, tokens_per_doc=70, seed=5)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, IndexConfig(backend="cpu"), output_dir=tmp_path / "cpu")
+    assert read_letter_files(tmp_path / "cpu") == read_letter_files(tmp_path / "oracle")
+
+
+def test_cpu_empty_corpus(tmp_path):
+    (tmp_path / "nums.txt").write_bytes(b"123 456\n")
+    write_manifest(tmp_path / "list.txt", [str(tmp_path / "nums.txt")])
+    m = read_manifest(tmp_path / "list.txt")
+    InvertedIndexModel(IndexConfig(backend="cpu")).run(m, output_dir=tmp_path / "out")
+    assert read_letter_files(tmp_path / "out") == b""
+
+
+def test_cpu_falls_back_to_oracle_without_native(smoke_fixture, tmp_path, monkeypatch):
+    monkeypatch.setattr(native, "available", lambda: False)
+    report = InvertedIndexModel(IndexConfig(backend="cpu")).run(
+        read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture),
+        output_dir=tmp_path)
+    assert report["cpu_fallback"] == "oracle"
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+@pytest.mark.slow
+def test_cpu_full_corpus_md5(reference_dir, tmp_path):
+    pytest.importorskip("numpy")
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    m = manifest_from_dir(reference_dir / "test_in")
+    build_index(m, IndexConfig(backend="cpu"), output_dir=tmp_path)
+    md5 = hashlib.md5(read_letter_files(tmp_path)).hexdigest()
+    assert md5 == FULL_CORPUS_MD5
