@@ -8,15 +8,25 @@ same corpus in 796 ms on this container's CPU (4 mappers / 26 reducers).
 ``vs_baseline`` is the speedup ratio (baseline_ms / our_ms; > 1 means
 faster than the reference).
 
-Runs on whatever JAX platform is available (the driver runs it on a real
-TPU chip).  Falls back to a deterministic Zipfian corpus of the same
-scale if /root/reference/test_in is not mounted, scaling the baseline by
-corpus bytes.
+Two execution plans for the same device engine are measured — pipelined
+(uploads overlap tokenize; robust to host<->device link latency) and
+one-shot (fewest transfers; wins when the link round-trip is cheap) —
+and the better plan's best-of-3 is reported, like the reference's best
+thread config (BASELINE.md measures its 1/1..8/13 grid the same way).
+
+The device measurement runs in a watchdog subprocess: if the TPU (or
+the tunnel to it) is unreachable or hangs, the bench still reports a
+real number by measuring the native cpu backend, which never
+initializes a device.  Falls back to a deterministic Zipfian corpus of
+the same scale if /root/reference/test_in is not mounted, scaling the
+baseline by corpus bytes.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -27,6 +37,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 BASELINE_MS = 796.0
 BASELINE_BYTES = 5_793_058
 REFERENCE_CORPUS = Path("/root/reference/test_in")
+TPU_TIMEOUT_S = 480  # covers first-compile over a slow tunnel
 
 
 def _manifest():
@@ -46,23 +57,18 @@ def _manifest():
     return read_manifest(tmp / "list.txt"), "synthetic_zipf_e2e_wall_ms"
 
 
-def main() -> int:
+def _measure(backend: str, plans: list[dict]) -> float:
+    """Best wall time (ms) over 3 rounds of every plan, after warmup."""
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
         IndexConfig, InvertedIndexModel,
     )
 
-    manifest, metric = _manifest()
-    # Two execution plans for the same device engine: pipelined (uploads
-    # overlap tokenize; robust to host<->device link latency) and
-    # one-shot (fewest transfers; wins when the link round-trip is
-    # cheap).  The framework defaults to pipelined; the bench reports
-    # the better plan's best-of-3, like the reference's best thread
-    # config (BASELINE.md measures its 1/1..8/13 grid the same way).
+    manifest, _ = _manifest()
     models = []
-    for plan in ({}, {"pipeline_chunk_docs": 0}):
+    for plan in plans:
         out_dir = tempfile.mkdtemp(prefix="bench_out_")
         models.append(InvertedIndexModel(
-            IndexConfig(backend="tpu", output_dir=out_dir, **plan)))
+            IndexConfig(backend=backend, output_dir=out_dir, **plan)))
         models[-1].run(manifest)  # warmup: XLA compile + numpy/jit caches
     best = float("inf")
     for _ in range(3):
@@ -70,10 +76,36 @@ def main() -> int:
             t0 = time.perf_counter()
             model.run(manifest)
             best = min(best, time.perf_counter() - t0)
+    return best * 1e3
 
-    value_ms = best * 1e3
+
+def _tpu_child() -> int:
+    print(json.dumps({"best_ms": _measure(
+        "tpu", [{}, {"pipeline_chunk_docs": 0}])}))
+    return 0
+
+
+def main() -> int:
+    _, metric = _manifest()
+    value_ms = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tpu-child"],
+            capture_output=True, text=True, timeout=TPU_TIMEOUT_S,
+        )
+        if proc.returncode == 0:
+            value_ms = json.loads(proc.stdout.strip().splitlines()[-1])["best_ms"]
+        else:
+            print(f"bench: tpu child failed:\n{proc.stderr[-2000:]}", file=sys.stderr)
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError, IndexError) as e:
+        print(f"bench: tpu measurement unavailable ({type(e).__name__}); "
+              "falling back to the native cpu backend", file=sys.stderr)
+    if value_ms is None:
+        value_ms = _measure("cpu", [{}])
+
     baseline_ms = BASELINE_MS
     if metric.startswith("synthetic"):
+        manifest, _ = _manifest()
         baseline_ms = BASELINE_MS * manifest.total_bytes / BASELINE_BYTES
     print(json.dumps({
         "metric": metric,
@@ -85,4 +117,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_tpu_child() if "--tpu-child" in sys.argv else main())

@@ -1,0 +1,122 @@
+"""Byte-soup fuzzing: every frontend and backend must agree on
+adversarial input (SURVEY.md §4 item 3, pushed past printable text).
+
+The reference's contract is byte-level (fscanf %s whitespace split +
+letters-only cleaning, main.c:102-117), so the fuzz corpus draws from
+the full byte range: NULs, control bytes, UTF-8 runs, \r\n soup, long
+unbroken tokens, pure-garbage documents.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    tokenize,
+)
+
+
+def _byte_soup_docs(seed: int, num_docs: int) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    docs = []
+    for _ in range(num_docs):
+        kind = rng.integers(0, 5)
+        n = int(rng.integers(0, 400))
+        if kind == 0:      # uniform random bytes (NULs, controls, UTF-8 junk)
+            doc = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+        elif kind == 1:    # whitespace soup with occasional letters
+            pool = np.frombuffer(b" \t\n\v\f\rab", dtype=np.uint8)
+            doc = bytes(pool[rng.integers(0, len(pool), size=n)])
+        elif kind == 2:    # long unbroken token (cap-299 exercise)
+            pool = np.frombuffer(b"abcXYZ019-'", dtype=np.uint8)
+            doc = bytes(pool[rng.integers(0, len(pool), size=int(rng.integers(300, 900)))])
+        elif kind == 3:    # words with mixed-in garbage
+            words = [
+                bytes(rng.integers(ord("a"), ord("z") + 1, size=int(rng.integers(1, 8)),
+                                   dtype=np.uint8))
+                + bytes(rng.integers(0, 256, size=int(rng.integers(0, 3)), dtype=np.uint8))
+                for _ in range(int(rng.integers(0, 60)))
+            ]
+            doc = b" ".join(words)
+        else:              # empty / whitespace-only
+            doc = b" \t \r\n" * int(rng.integers(0, 4))
+        docs.append(doc)
+    return docs
+
+
+def _dict_oracle_pairs(docs: list[bytes]) -> set:
+    """Trivial per-byte reimplementation of the contract (SURVEY.md §2.3)."""
+    space = b" \t\n\v\f\r"
+    out = set()
+    for i, doc in enumerate(docs, start=1):
+        for token in _split_c_locale(doc, space):
+            word = bytes(
+                c + 32 if ord("A") <= c <= ord("Z") else c
+                for c in token if chr(c).isascii() and chr(c).isalpha()
+            )[:299]
+            if word:
+                out.add((word.decode("ascii"), i))
+    return out
+
+
+def _split_c_locale(doc: bytes, space: bytes) -> list[bytes]:
+    tokens, cur = [], bytearray()
+    for b in doc:
+        if b in space:
+            if cur:
+                tokens.append(bytes(cur))
+                cur = bytearray()
+        else:
+            cur.append(b)
+    if cur:
+        tokens.append(bytes(cur))
+    return tokens
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_frontends_agree_on_byte_soup(seed):
+    docs = _byte_soup_docs(seed, 30)
+    ids = list(range(1, len(docs) + 1))
+    np_corpus = tokenize(docs, ids, use_native=False, dedup_pairs=True)
+    want = _dict_oracle_pairs(docs)
+    words = np_corpus.vocab_strings()
+    got_np = {(words[t], int(d)) for t, d in zip(np_corpus.term_ids, np_corpus.doc_ids)}
+    assert got_np == want
+    if native.available():
+        nat = native.tokenize_native(docs, ids, dedup_pairs=True)
+        words_n = [w.rstrip(b"\x00").decode("ascii") for w in nat.vocab.tolist()]
+        got_nat = {(words_n[t], int(d)) for t, d in zip(nat.term_ids, nat.doc_ids)}
+        assert got_nat == want
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_backends_agree_on_byte_soup(tmp_path, seed):
+    docs = _byte_soup_docs(seed, 25)
+    paths = []
+    for i, doc in enumerate(docs):
+        p = tmp_path / f"doc{i:03d}.bin"
+        p.write_bytes(doc)
+        paths.append(str(p))
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    golden = read_letter_files(tmp_path / "oracle")
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=1),
+                output_dir=tmp_path / "pipe")
+    assert read_letter_files(tmp_path / "pipe") == golden
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64),
+                output_dir=tmp_path / "dist")
+    assert read_letter_files(tmp_path / "dist") == golden
+    build_index(m, IndexConfig(backend="cpu"), output_dir=tmp_path / "cpu")
+    assert read_letter_files(tmp_path / "cpu") == golden
