@@ -16,6 +16,7 @@ Output is byte-identical across backends and to the pthread reference
 from __future__ import annotations
 
 import contextlib
+import math
 import os
 
 import numpy as np
@@ -163,34 +164,37 @@ class InvertedIndexModel:
         return self._emit_and_report(
             corpus_view, host, out_dir, timer, vocab_size, max_doc_id)
 
-    def _pipelined_eligible(self, manifest: Manifest) -> bool:
-        """Whether the provisional-key pipelined fast path applies.
-
-        It needs the native incremental tokenizer, uint16 postings
-        (doc ids < 0xFFFF), and none of the features that require the
-        token arrays on host (checkpointing, skew stats) or a different
-        engine (multi-chip, bounded-memory streaming)."""
-        from .. import native
-
+    def _num_shards(self) -> int:
         cfg = self.config
-        num_shards = (
+        return (
             cfg.device_shards if cfg.device_shards is not None
             else len(jax.devices())
         )
+
+    def _pipelined_eligible(self, manifest: Manifest) -> bool:
+        """Whether the provisional-key pipelined fast path applies.
+
+        It needs the native incremental tokenizer and none of the
+        features that require the token arrays on host (checkpointing,
+        skew stats) or the bounded-memory streaming engine.  Single-chip
+        additionally needs uint16 postings (doc ids < 0xFFFF); the
+        multi-chip variant fetches int32 and has no doc cap."""
+        from .. import native
+
+        cfg = self.config
         return (
             cfg.pipeline_chunk_docs != 0
             and cfg.use_native
             and cfg.stream_chunk_docs is None
             and cfg.checkpoint_path is None
             and not cfg.collect_skew_stats
-            and num_shards <= 1
-            and len(manifest) <= 0xFFFE
+            and (self._num_shards() > 1 or len(manifest) <= 0xFFFE)
             and native.available()
         )
 
     def _run_tpu_pipelined(self, manifest: Manifest, out_dir: str,
                            timer: PhaseTimer) -> dict:
-        """Single-chip fast path: uploads overlap tokenization.
+        """Pipelined fast path: uploads overlap tokenization.
 
         The reference pays its host<->"device" cost per token (stdio
         locks on shared spill files, main.c:116); the one-shot path
@@ -198,12 +202,16 @@ class InvertedIndexModel:
         native tokenizer emits packed ``prov_id * stride + doc_id``
         keys per document window, and each window's keys start their
         async host->device DMA immediately — provisional ids are stable
-        at first occurrence, so the device program
-        (ops/engine.sort_prov_chunks) never waits for the final vocab.
-        After the last window, one dispatch + one device->host fetch is
-        the entire critical path; emit order, df and offsets are
-        resolved host-side in prov space (vocab-sized work) while the
-        sort and the fetch are in flight.
+        at first occurrence, so the device programs never wait for the
+        final vocab.  After the last window, one dispatch + one
+        device->host fetch is the entire critical path; emit order, df
+        and offsets are resolved host-side in prov space (vocab-sized
+        work) from the combiner's counts.
+
+        Single chip, the finalize program is one sort
+        (ops/engine.sort_prov_chunks); on a mesh, windows upload
+        *sharded* and finalize is a hash-bucket ``all_to_all`` +
+        owner-side sort (parallel/dist_engine.dist_sort_prov_windows).
         """
         from .. import native
         from ..corpus.manifest import iter_document_chunks
@@ -211,6 +219,8 @@ class InvertedIndexModel:
         cfg = self.config
         max_doc_id = len(manifest)
         stride = max_doc_id + 2
+        num_shards = self._num_shards()
+        mesh = make_mesh(num_shards) if num_shards > 1 else None
         # Auto = two windows: window 1's upload DMA flushes while window 2
         # tokenizes, and measured on the tunneled-link TPU this beats both
         # one-shot (everything serialized after tokenize) and many small
@@ -220,7 +230,11 @@ class InvertedIndexModel:
             cfg.pipeline_chunk_docs if cfg.pipeline_chunk_docs
             else max(1, -(-len(manifest) // 2))
         )
-        granule = min(1 << 14, self.config.pad_multiple)
+        # Window padding granule; sharded windows must also split evenly
+        # over the mesh (lcm, not product: a power-of-two granule on a
+        # power-of-two mesh needs no extra padding).
+        granule = math.lcm(
+            min(1 << 14, self.config.pad_multiple), max(num_shards, 1))
         chunks_dev = []
         num_pairs = docs_loaded = keys_capacity = 0
         stream = native.NativeKeyStream(stride)
@@ -232,14 +246,18 @@ class InvertedIndexModel:
                     if keys.size == 0:
                         continue
                     padded = _round_up(keys.size, granule)
-                    if int(keys.max()) // stride <= 0xFFFE:
+                    if mesh is None and int(keys.max()) // stride <= 0xFFFE:
                         # fits: half-bandwidth [terms | docs] uint16 window
                         terms, docs = np.divmod(keys, stride)
                         buf = engine.pack_u16_feed(terms, docs, padded)
                     else:
                         buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
                         buf[: keys.size] = keys
-                    chunks_dev.append(jax.device_put(buf))  # async DMA
+                    if mesh is None:
+                        chunks_dev.append(jax.device_put(buf))  # async DMA
+                    else:
+                        chunks_dev.append(jax.device_put(
+                            buf, sharding(mesh, shard_spec())))
                     keys_capacity += padded
                     num_pairs += int(keys.size)
             with timer.phase("finalize_vocab"):
@@ -251,7 +269,7 @@ class InvertedIndexModel:
         timer.count("documents", docs_loaded)
         timer.count("tokens", raw_tokens)
         timer.count("unique_terms", vocab_size)
-        timer.count("device_shards", 1)
+        timer.count("device_shards", max(num_shards, 1))
         timer.count("upload_windows", len(chunks_dev))
         if num_pairs == 0:
             with timer.phase("emit"):
@@ -263,14 +281,10 @@ class InvertedIndexModel:
             if self.config.profile_dir
             else contextlib.nullcontext()
         )
-        nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 14))
-        with timer.phase("device_index"), profile:
-            post_dev = engine.sort_prov_chunks(
-                tuple(chunks_dev), stride=stride, out_size=nfetch)
-            post_dev.copy_to_host_async()
-            # Emit order / offsets in *prov* space, overlapped with the
-            # in-flight sort + D2H: postings are grouped by prov id, so
-            # per-rank views just indirect through rank -> prov.
+        # Emit order / offsets in *prov* space from the combiner's df
+        # counts: postings are grouped by prov id, so per-rank views
+        # just indirect through rank -> prov.
+        def host_views():
             prov_of_rank = np.empty(vocab_size, dtype=np.int64)
             prov_of_rank[remap] = np.arange(vocab_size)
             df64 = df_prov.astype(np.int64)
@@ -278,10 +292,27 @@ class InvertedIndexModel:
             df_rank = df64[prov_of_rank]
             off_rank = offsets_prov[prov_of_rank]
             order, _ = engine.host_order_offsets(letters, df_rank)
-            if self.config.profile_dir:
-                post_dev.block_until_ready()
-        with timer.phase("fetch"):
-            postings = np.asarray(post_dev)
+            return df_rank, off_rank, order
+
+        if mesh is None:
+            nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 14))
+            with timer.phase("device_index"), profile:
+                post_dev = engine.sort_prov_chunks(
+                    tuple(chunks_dev), stride=stride, out_size=nfetch)
+                post_dev.copy_to_host_async()
+                # overlapped with the in-flight sort + D2H
+                df_rank, off_rank, order = host_views()
+                if self.config.profile_dir:
+                    post_dev.block_until_ready()
+            with timer.phase("fetch"):
+                postings = np.asarray(post_dev)
+        else:
+            df_rank, off_rank, order = host_views()
+            # dispatch + exchange + fetch + host merge in one blocking
+            # call; keep it all inside the profiled device phase
+            with timer.phase("device_index"), profile:
+                postings = dist_engine.dist_sort_prov_windows(
+                    chunks_dev, stride=stride, mesh=mesh)
         host = {
             "df": df_rank, "order": order, "offsets": off_rank,
             "postings": postings, "num_unique": num_pairs,
@@ -326,11 +357,7 @@ class InvertedIndexModel:
                 formatter.emit_grouped(out_dir, {})
             return timer.report()
 
-        num_shards = (
-            self.config.device_shards
-            if self.config.device_shards is not None
-            else len(jax.devices())
-        )
+        num_shards = self._num_shards()
         use_dist = num_shards > 1 and K.can_pack(vocab_size, max_doc_id)
         # Half-bandwidth single-chip path: uint16 feed + fetch (the
         # device->host link dominates single-chip wall time; SURVEY.md §6).

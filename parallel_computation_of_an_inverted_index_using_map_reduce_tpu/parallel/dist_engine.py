@@ -55,14 +55,17 @@ def default_capacity(local_size: int, num_shards: int, factor: float = 2.0) -> i
     return min(local_size, _round_up(int(math.ceil(local_size / num_shards * factor)), 8))
 
 
-def _shuffle_body(keys_local, letter_of_term, *, num_shards: int, capacity: int,
-                  vocab_size: int, max_doc_id: int):
-    """shard_map body: runs per-device with collectives over SHARD_AXIS."""
-    local = keys_local.shape[0]
-    stride = max_doc_id + 2
-    valid_limit = vocab_size * stride
+def _bucket_exchange(keys_local, valid_limit, *, num_shards: int,
+                     capacity: int, stride: int):
+    """Shared exchange core: hash-partition packed keys and run one ICI
+    ``all_to_all``.
 
-    # --- partition: bucket by term hash (uniform), padding to bucket n.
+    Buckets by ``term % num_shards`` (uniform, unlike the reference's
+    ~1000x-skewed first-letter partition); keys ``>= valid_limit`` go to
+    the padding bucket.  Returns ``(recv, overflow_local)`` where row b
+    of the fixed-shape send buffer went to device b.
+    """
+    local = keys_local.shape[0]
     term = keys_local // stride
     bucket = jnp.where(keys_local < valid_limit, term % num_shards, num_shards)
     bucket_s, keys_s = lax.sort((bucket.astype(jnp.int32), keys_local), num_keys=2)
@@ -70,14 +73,22 @@ def _shuffle_body(keys_local, letter_of_term, *, num_shards: int, capacity: int,
     offsets = jnp.cumsum(counts) - counts
     overflow_local = (counts > capacity).any()
 
-    # --- build fixed-shape send buffer (num_shards, capacity).
+    # fixed-shape send buffer (num_shards, capacity)
     slot = jnp.arange(capacity, dtype=jnp.int32)[None, :]
     gather_idx = jnp.clip(offsets[:, None] + slot, 0, local - 1)
     in_bucket = slot < counts[:, None]
     send = jnp.where(in_bucket, keys_s[gather_idx], K.INT32_MAX)
+    return lax.all_to_all(send, SHARD_AXIS, 0, 0, tiled=True), overflow_local
 
-    # --- one ICI all_to_all: row b of `send` goes to device b.
-    recv = lax.all_to_all(send, SHARD_AXIS, 0, 0, tiled=True)
+
+def _shuffle_body(keys_local, letter_of_term, *, num_shards: int, capacity: int,
+                  vocab_size: int, max_doc_id: int):
+    """shard_map body: runs per-device with collectives over SHARD_AXIS."""
+    stride = max_doc_id + 2
+    valid_limit = vocab_size * stride
+    recv, overflow_local = _bucket_exchange(
+        keys_local, valid_limit, num_shards=num_shards, capacity=capacity,
+        stride=stride)
 
     # --- owner-side global dedup of this device's terms.
     recv_s = lax.sort(recv.reshape(-1))
@@ -138,6 +149,82 @@ def assemble_postings(uniq_sharded, max_doc_id: int, valid_limit: int) -> np.nda
     keys = np.asarray(uniq_sharded)
     ks = np.sort(keys[keys < valid_limit], kind="stable")
     return (ks % (max_doc_id + 2)).astype(np.int32)
+
+
+def _prov_shuffle_body(window_locals, *, num_shards: int, capacity: int,
+                       stride: int):
+    """shard_map body for the pipelined (provisional-key) dist path.
+
+    Unlike :func:`_shuffle_body`, the feed is already combiner-deduped
+    and emit order is resolved host-side from the combiner's df counts
+    (models/inverted_index.py), so the program is pure data movement:
+    concat this device's slice of every upload window, bucket by term
+    hash, one ``all_to_all`` over ICI, owner-side sort.  The owner sort
+    makes each device's slice ascending and term-grouped, so the host
+    assembles global postings with one valid-prefix merge instead of a
+    re-sort.
+    """
+    keys_local = jnp.concatenate(list(window_locals))
+    recv, overflow_local = _bucket_exchange(
+        keys_local, K.INT32_MAX, num_shards=num_shards, capacity=capacity,
+        stride=stride)
+    recv_s = lax.sort(recv.reshape(-1))
+    return {
+        "owned_sorted": recv_s,
+        "overflow": lax.psum(overflow_local.astype(jnp.int32), SHARD_AXIS),
+    }
+
+
+@functools.lru_cache(maxsize=64)
+def _build_prov(mesh: Mesh, num_windows: int, window_local: tuple,
+                num_shards: int, capacity: int, stride: int, donate: bool):
+    def body(*window_locals):
+        return _prov_shuffle_body(
+            window_locals, num_shards=num_shards, capacity=capacity,
+            stride=stride)
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(shard_spec() for _ in range(num_windows)),
+            out_specs={"owned_sorted": shard_spec(),
+                       "overflow": replicated_spec()},
+            check_vma=False,
+        ),
+        donate_argnums=tuple(range(num_windows)) if donate else (),
+    )
+
+
+def dist_sort_prov_windows(windows, *, stride: int, mesh: Mesh,
+                           capacity_factor: float = 2.0) -> np.ndarray:
+    """Distributed tail of the pipelined path: shuffle + sort the
+    sharded provisional-key upload windows; returns the host-assembled
+    postings array (docs grouped by prov term id, ascending).
+
+    Each element of ``windows`` is an int32 device array sharded over
+    ``mesh`` (padded with ``K.INT32_MAX`` to a multiple of the mesh
+    size).  Overflow of the per-bucket capacity triggers one retry at
+    the provably-safe bound, exactly like :func:`dist_index`.
+    """
+    n = mesh.devices.size
+    local_total = sum(w.shape[0] for w in windows) // n
+    capacity = default_capacity(local_total, n, capacity_factor)
+    shapes = tuple(w.shape[0] for w in windows)
+    out = _build_prov(mesh, len(windows), shapes, n, capacity, stride,
+                      capacity >= local_total)(*windows)
+    if capacity < local_total and int(out["overflow"]) > 0:
+        out = _build_prov(mesh, len(windows), shapes, n, local_total, stride,
+                          True)(*windows)
+    # Owner d holds ascending keys of exactly the terms ≡ d (mod n), so
+    # every term's postings are contiguous within one shard; the host
+    # merges the n sorted runs into global term order (at multi-host
+    # scale this merge disappears — each host emits its own owners'
+    # letters instead, the reference's reducer ownership re-expressed).
+    owned = np.asarray(out["owned_sorted"]).reshape(n, -1)
+    valid = [row[row < K.INT32_MAX] for row in owned]
+    keys = np.concatenate(valid) if valid else np.empty(0, np.int32)
+    keys.sort(kind="stable")
+    return (keys % stride).astype(np.int32)
 
 
 def dist_index(keys, letter_of_term, *, vocab_size: int, max_doc_id: int,

@@ -32,7 +32,8 @@ def test_run_is_reentrant_with_fresh_stats(tmp_path):
     s1 = model.run(m, tmp_path / "a")
     s2 = model.run(m, tmp_path / "b")
     # second run must not accumulate the first run's wall time
-    assert s2["phases_ms"]["tokenize"] < s1["total_ms"] + 1e9  # sanity
+    tok2 = s2["phases_ms"].get("tokenize", s2["phases_ms"].get("tokenize_feed"))
+    assert tok2 is not None and tok2 < s1["total_ms"] + 1e9  # sanity
     assert abs(s1["tokens"] - s2["tokens"]) == 0
     assert s2["total_ms"] < 2 * s1["total_ms"] + 1000
 

@@ -102,3 +102,68 @@ def test_capacity_overflow_retry():
     assert int(out["num_unique"]) == 2
     np.testing.assert_array_equal(np.asarray(out["df"]), [2])
     np.testing.assert_array_equal(np.asarray(out["postings"])[:2], [1, 2])
+
+
+# -- model-level: pipelined windowed uploads over the mesh ---------------
+
+
+def _model_corpus(tmp_path):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        read_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    docs = zipf_corpus(num_docs=23, vocab_size=400, tokens_per_doc=60, seed=9)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    return read_manifest(tmp_path / "list.txt")
+
+
+def test_pipelined_dist_matches_one_shot_dist(tmp_path):
+    from conftest import read_letter_files
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, build_index,
+    )
+
+    m = _model_corpus(tmp_path)
+    # pipelined: windows sharded over all 8 virtual devices (default)
+    r1 = build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, pipeline_chunk_docs=5),
+        output_dir=tmp_path / "pipe")
+    assert r1["device_shards"] == 8 and r1["upload_windows"] >= 4
+    # one-shot dist engine (pipeline disabled)
+    r2 = build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, pipeline_chunk_docs=0),
+        output_dir=tmp_path / "oneshot")
+    assert r2["device_shards"] == 8 and "tokenize_feed" not in r2["phases_ms"]
+    assert read_letter_files(tmp_path / "pipe") == read_letter_files(tmp_path / "oneshot")
+
+
+def test_pipelined_dist_capacity_overflow_retry(tmp_path):
+    from conftest import read_letter_files
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, build_index, oracle_index, read_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+
+    # every doc is almost one repeated word -> one hash bucket hogs the
+    # exchange; the provably-safe retry must preserve byte equality
+    paths = []
+    for i in range(6):
+        p = tmp_path / f"d{i}.txt"
+        p.write_bytes(b"word " * 30 + f"extra{i}".encode())
+        paths.append(str(p))
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, pipeline_chunk_docs=2),
+        output_dir=tmp_path / "pipe")
+    assert read_letter_files(tmp_path / "pipe") == read_letter_files(tmp_path / "oracle")
