@@ -67,3 +67,26 @@ def test_streaming_matches_oracle_at_scale(zipf_fixture, tmp_path):
     assert report["unique_pairs"] < (1 << 18)
     assert report["accumulator_capacity"] == 1 << 18
     assert read_letter_files(tmp_path) == golden
+
+
+@pytest.mark.slow
+def test_all_engines_agree_at_8k_docs(tmp_path):
+    """Cross-engine md5 agreement at 8k docs / ~36k vocab (BASELINE.json
+    config 4 shrunk to CI budget): pipelined-dist (8 virtual chips),
+    one-shot dist, streaming accumulator, and the native cpu backend."""
+    docs = zipf_corpus(num_docs=8000, vocab_size=40000, tokens_per_doc=100,
+                       alpha=1.05, seed=1)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    outs = {}
+    for name, kw in [
+        ("pipelined_dist", dict(backend="tpu")),
+        ("oneshot_dist", dict(backend="tpu", pipeline_chunk_docs=0)),
+        ("streaming", dict(backend="tpu", stream_chunk_docs=1000)),
+        ("cpu", dict(backend="cpu")),
+    ]:
+        InvertedIndexModel(IndexConfig(**kw)).run(m, output_dir=tmp_path / name)
+        outs[name] = read_letter_files(tmp_path / name)
+    assert len({v for v in outs.values()}) == 1, {
+        k: len(v) for k, v in outs.items()}
