@@ -29,9 +29,12 @@ class IndexConfig:
     """
 
     # CLI-compat knobs.  The reference's output is invariant to its thread
-    # counts (SURVEY.md §2.3 determinism) and the TPU pipeline's
-    # parallelism comes from the device mesh, so these are accepted,
-    # validated and recorded in run stats but do not change the result.
+    # counts (SURVEY.md §2.3 determinism), and so is ours: ``num_mappers``
+    # sets the host map-phase thread count when ``host_threads`` is unset
+    # (the reference's mapper threads, main.c:348-365, re-expressed —
+    # byte-identical output at any count); ``num_reducers`` is recorded in
+    # run stats (device reduce is balanced by sort/hash regardless, so the
+    # reference's 1000x letter skew, SURVEY.md §2.3, cannot recur).
     num_mappers: int = 1
     num_reducers: int = 1
     # "tpu"    — device engine (jit sort pipeline; pipelined/one-shot plans)
@@ -69,6 +72,21 @@ class IndexConfig:
     # window 1's upload overlaps window 2's tokenize); 0 disables the
     # pipelined path entirely (forces the one-shot engine).
     pipeline_chunk_docs: int | None = None
+    # Host map-phase threads for the native tokenizer (contiguous
+    # byte-balanced doc ranges, merged at vocab scale — output-identical
+    # at any count).  None = ``num_mappers`` if > 1, else auto
+    # (min(cores, 8)).
+    host_threads: int | None = None
+
+    def resolved_host_threads(self) -> int:
+        """The map-phase thread count this run will actually use."""
+        if self.host_threads is not None:
+            return self.host_threads
+        if self.num_mappers > 1:
+            return self.num_mappers
+        from .native import default_threads
+
+        return default_threads()
 
     def __post_init__(self) -> None:
         if self.num_mappers < 1:
@@ -97,6 +115,12 @@ class IndexConfig:
             raise ValueError(
                 "pipeline_chunk_docs must be >= 1, 0 (disabled) or None (auto), "
                 f"got {self.pipeline_chunk_docs}")
+        if self.backend not in ("tpu",) and self.pipeline_chunk_docs is not None:
+            raise ValueError(
+                f"pipeline_chunk_docs requires backend='tpu', got backend={self.backend!r}")
+        if self.host_threads is not None and self.host_threads < 1:
+            raise ValueError(
+                f"host_threads must be >= 1 or None (auto), got {self.host_threads}")
         if self.stream_chunk_docs is not None:
             if self.stream_chunk_docs < 1:
                 raise ValueError(

@@ -96,17 +96,16 @@ def manifest_from_dir(corpus_dir: str | Path, pattern: str = "**/*.txt") -> Mani
     return Manifest(paths=tuple(paths), sizes=sizes)
 
 
-def iter_document_chunks(manifest: Manifest, chunk_docs: int):
-    """Yield ``(contents, doc_ids)`` windows of at most ``chunk_docs``
-    whole documents, in manifest order — the streaming loader (host
-    memory stays O(chunk), SURVEY.md §5 long-context).  Unreadable
-    files are warned about and skipped inside their window."""
-    if chunk_docs < 1:
-        raise ValueError(f"chunk_docs must be >= 1, got {chunk_docs}")
-    for start in range(0, len(manifest), chunk_docs):
+def iter_document_ranges(manifest: Manifest, ranges):
+    """Yield ``(contents, doc_ids)`` for each ``[lo, hi)`` doc range —
+    the loader behind both doc-count windows and the scheduler's
+    byte-balanced plans (corpus/scheduler.plan_contiguous_windows).
+    Unreadable files are warned about and skipped inside their window
+    (reference main.c:97-100)."""
+    for lo, hi in ranges:
         contents: list[bytes] = []
         doc_ids: list[int] = []
-        for i in range(start, min(start + chunk_docs, len(manifest))):
+        for i in range(lo, hi):
             try:
                 with open(manifest.paths[i], "rb") as f:
                     contents.append(f.read())
@@ -115,6 +114,18 @@ def iter_document_chunks(manifest: Manifest, chunk_docs: int):
                 print(f"warning: cannot open {manifest.paths[i]!r}; skipping",
                       file=sys.stderr)
         yield contents, doc_ids
+
+
+def iter_document_chunks(manifest: Manifest, chunk_docs: int):
+    """Yield ``(contents, doc_ids)`` windows of at most ``chunk_docs``
+    whole documents, in manifest order — the streaming loader (host
+    memory stays O(chunk), SURVEY.md §5 long-context)."""
+    if chunk_docs < 1:
+        raise ValueError(f"chunk_docs must be >= 1, got {chunk_docs}")
+    n = len(manifest)
+    yield from iter_document_ranges(
+        manifest,
+        ((s, min(s + chunk_docs, n)) for s in range(0, n, chunk_docs)))
 
 
 def load_documents(manifest: Manifest) -> tuple[list[bytes], list[int]]:

@@ -52,6 +52,35 @@ def plan_host_shards(manifest: Manifest, num_shards: int) -> ShardPlan:
     return ShardPlan(shards=tuple(tuple(sorted(b)) for b in buckets))
 
 
+def plan_contiguous_windows(manifest: Manifest,
+                            num_windows: int) -> tuple[tuple[int, int], ...]:
+    """Contiguous byte-balanced doc ranges ``[lo, hi)`` covering the manifest.
+
+    The reference's scheduler — sort-free variant of its greedy cut at
+    ``total/N`` (main.c:307-323) — made total and safe: every doc lands in
+    exactly one range, and ``num_windows > len(manifest)`` yields empty
+    tail ranges instead of UB.  Used for the pipelined engine's upload
+    windows and mirrored by the native scan's per-thread ranges
+    (native/tokenizer.cc PlanRanges), so the same policy governs both
+    levels of host map parallelism.
+    """
+    if num_windows < 1:
+        raise ValueError("num_windows must be >= 1")
+    n = len(manifest)
+    total = sum(manifest.sizes)
+    cuts = [0]
+    d = 0
+    cum = 0
+    for t in range(1, num_windows):
+        target = total * t // num_windows
+        while d < n and cum < target:
+            cum += manifest.sizes[d]
+            d += 1
+        cuts.append(d)
+    cuts.append(n)
+    return tuple((cuts[t], cuts[t + 1]) for t in range(num_windows))
+
+
 def plan_letter_ranges(num_reducers: int) -> tuple[tuple[int, int], ...]:
     """Contiguous letter ranges per reduce partition.
 

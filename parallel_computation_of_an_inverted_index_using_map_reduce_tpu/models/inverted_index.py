@@ -51,6 +51,10 @@ class InvertedIndexModel:
     def run(self, manifest: Manifest, output_dir: str | None = None) -> dict:
         cfg = self.config
         self.timer = timer = PhaseTimer()
+        # Reference-CLI knobs, recorded as config.py promises (the
+        # reference logs its mapper ranges at main.c:327).
+        timer.count("num_mappers", cfg.num_mappers)
+        timer.count("num_reducers", cfg.num_reducers)
         out_dir = output_dir if output_dir is not None else cfg.output_dir
         if cfg.backend == "oracle":
             with timer.phase("oracle"):
@@ -79,10 +83,13 @@ class InvertedIndexModel:
                 stats = oracle_index(manifest, out_dir)
             timer.count("cpu_fallback", "oracle")
             return {**stats, **timer.report()}
+        threads = self.config.resolved_host_threads()
+        timer.count("host_threads", threads)
         with timer.phase("load"):
             contents, doc_ids = load_documents(manifest)
         with timer.phase("index_emit"):
-            stats = native.host_index_native(contents, doc_ids, out_dir)
+            stats = native.host_index_native(
+                contents, doc_ids, out_dir, num_threads=threads)
         for key, value in stats.items():
             timer.count(key, value)
         return timer.report()
@@ -97,11 +104,13 @@ class InvertedIndexModel:
                 corpus = checkpoint.load_pairs(ckpt, expect_fingerprint=fp)
             timer.count("resumed_from", ckpt)
             return corpus, 0
+        threads = self.config.resolved_host_threads()
+        timer.count("host_threads", threads)
         with timer.phase("load"):
             contents, doc_ids = load_documents(manifest)
         with timer.phase("tokenize"):
             corpus = tokenize(contents, doc_ids, use_native=self.config.use_native,
-                              dedup_pairs=True)
+                              dedup_pairs=True, num_threads=threads)
         if ckpt is not None:
             with timer.phase("checkpoint"):
                 checkpoint.save_pairs(ckpt, corpus, fingerprint=fp)
@@ -214,22 +223,30 @@ class InvertedIndexModel:
         owner-side sort (parallel/dist_engine.dist_sort_prov_windows).
         """
         from .. import native
-        from ..corpus.manifest import iter_document_chunks
+        from ..corpus.manifest import iter_document_ranges
+        from ..corpus.scheduler import plan_contiguous_windows
 
         cfg = self.config
         max_doc_id = len(manifest)
         stride = max_doc_id + 2
         num_shards = self._num_shards()
         mesh = make_mesh(num_shards) if num_shards > 1 else None
-        # Auto = two windows: window 1's upload DMA flushes while window 2
-        # tokenizes, and measured on the tunneled-link TPU this beats both
-        # one-shot (everything serialized after tokenize) and many small
-        # windows (per-transfer overhead compounds) — and is far less
-        # sensitive to link-latency weather than either.
-        chunk_docs = (
-            cfg.pipeline_chunk_docs if cfg.pipeline_chunk_docs
-            else max(1, -(-len(manifest) // 2))
-        )
+        # Auto = two windows, byte-balanced by the scheduler (the
+        # reference's greedy size cut, main.c:307-323): window 1's upload
+        # DMA flushes while window 2 tokenizes, and measured on the
+        # tunneled-link TPU this beats both one-shot (everything
+        # serialized after tokenize) and many small windows (per-transfer
+        # overhead compounds) — and is far less sensitive to link-latency
+        # weather than either.
+        if cfg.pipeline_chunk_docs:
+            n = len(manifest)
+            windows = tuple(
+                (s, min(s + cfg.pipeline_chunk_docs, n))
+                for s in range(0, n, cfg.pipeline_chunk_docs))
+        else:
+            windows = plan_contiguous_windows(manifest, min(2, max(len(manifest), 1)))
+        threads = cfg.resolved_host_threads()
+        timer.count("host_threads", threads)
         # Window padding granule; sharded windows must also split evenly
         # over the mesh (lcm, not product: a power-of-two granule on a
         # power-of-two mesh needs no extra padding).
@@ -237,10 +254,10 @@ class InvertedIndexModel:
             min(1 << 14, self.config.pad_multiple), max(num_shards, 1))
         chunks_dev = []
         num_pairs = docs_loaded = keys_capacity = 0
-        stream = native.NativeKeyStream(stride)
+        stream = native.NativeKeyStream(stride, num_threads=threads)
         try:
             with timer.phase("tokenize_feed"):
-                for contents, ids in iter_document_chunks(manifest, chunk_docs):
+                for contents, ids in iter_document_ranges(manifest, windows):
                     docs_loaded += len(contents)
                     keys, _ = stream.feed(contents, ids)
                     if keys.size == 0:
@@ -332,8 +349,14 @@ class InvertedIndexModel:
             except KeyOverflow:
                 # vocab * stride outgrew int32 keys mid-stream: restart on
                 # the one-shot path (whose general engine sorts two-key).
+                aborted_ms = timer.total_seconds * 1e3
                 self.timer = timer = PhaseTimer()
+                timer.count("num_mappers", self.config.num_mappers)
+                timer.count("num_reducers", self.config.num_reducers)
                 timer.count("pipelined_fallback", "key_overflow")
+                # keep total_ms honest: the aborted attempt's wall time
+                # stays in the report as its own phase
+                timer.phases["aborted_pipelined"] = aborted_ms / 1e3
         corpus, num_loaded = self._tokenize_or_resume(manifest, timer)
 
         max_doc_id = len(manifest)  # doc ids are 1..len(manifest)

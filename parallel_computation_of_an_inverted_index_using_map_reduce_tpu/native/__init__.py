@@ -112,12 +112,12 @@ def load():
         lib.mri_tokenize.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ]
         lib.mri_free_result.restype = None
         lib.mri_free_result.argtypes = [ctypes.POINTER(_TokenizeResult)]
-        lib.mri_stream_new.restype = ctypes.c_void_p
-        lib.mri_stream_new.argtypes = [ctypes.c_int64]
+        lib.mri_stream_new_mt.restype = ctypes.c_void_p
+        lib.mri_stream_new_mt.argtypes = [ctypes.c_int64, ctypes.c_int32]
         lib.mri_stream_free.restype = None
         lib.mri_stream_free.argtypes = [ctypes.c_void_p]
         lib.mri_stream_feed.restype = ctypes.POINTER(_StreamChunkResult)
@@ -138,6 +138,7 @@ def load():
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int32, ctypes.c_char_p, ctypes.POINTER(_HostIndexStats),
+            ctypes.c_int32,
         ]
         lib.mri_emit.restype = ctypes.c_int64
         lib.mri_emit.argtypes = [
@@ -185,12 +186,21 @@ def _marshal_docs(contents: list[bytes], doc_ids: list[int]):
     return args, (buf, data, ends, ids)
 
 
+def default_threads() -> int:
+    """Auto map-phase thread count: the cores we have, capped — the scan
+    saturates memory bandwidth long before high core counts pay off."""
+    return max(1, min(os.cpu_count() or 1, 8))
+
+
 def tokenize_native(contents: list[bytes], doc_ids: list[int],
-                    dedup_pairs: bool = False):
+                    dedup_pairs: bool = False, num_threads: int = 1):
     """Native equivalent of text.tokenizer.tokenize_documents.
 
     ``dedup_pairs`` applies the map-side combiner: each (term, doc) pair
     is emitted once (output-invariant; see tokenizer.cc).
+    ``num_threads`` scans contiguous byte-balanced doc ranges in
+    parallel (the reference's mapper threads, main.c:348-365); output
+    arrays are identical for every thread count.
     """
     from ..text.tokenizer import TokenizedCorpus
 
@@ -199,7 +209,8 @@ def tokenize_native(contents: list[bytes], doc_ids: list[int],
         raise RuntimeError(f"native tokenizer unavailable: {_lib_error}")
 
     args, keepalive = _marshal_docs(contents, doc_ids)
-    res = lib.mri_tokenize(*args, ctypes.c_int32(1 if dedup_pairs else 0))
+    res = lib.mri_tokenize(*args, ctypes.c_int32(1 if dedup_pairs else 0),
+                           ctypes.c_int32(max(1, num_threads)))
     del keepalive
     if not res:
         raise MemoryError("native tokenizer allocation failure")
@@ -236,12 +247,13 @@ class NativeKeyStream:
     per-term document frequencies the emit phase needs.
     """
 
-    def __init__(self, stride: int):
+    def __init__(self, stride: int, num_threads: int = 1):
         lib = load()
         if lib is None:
             raise RuntimeError(f"native tokenizer unavailable: {_lib_error}")
         self._lib = lib
-        self._handle = ctypes.c_void_p(lib.mri_stream_new(ctypes.c_int64(stride)))
+        self._handle = ctypes.c_void_p(lib.mri_stream_new_mt(
+            ctypes.c_int64(stride), ctypes.c_int32(max(1, num_threads))))
         if not self._handle:
             raise MemoryError("native stream allocation failure")
 
@@ -309,13 +321,14 @@ class NativeKeyStream:
 
 
 def host_index_native(contents: list[bytes], doc_ids: list[int],
-                      out_dir) -> dict:
+                      out_dir, num_threads: int = 1) -> dict:
     """Whole pipeline in one native call: tokenize + postings + emit.
 
     The ``backend="cpu"`` engine (models/inverted_index.py): the
     reference's all-on-host regime without its pathologies — no spill
     files, no stdio locks, no token-scale sorts (docs arrive ascending
-    per term by construction).  Returns the stats dict.
+    per term by construction).  ``num_threads`` forks the map scan over
+    contiguous byte-balanced doc ranges.  Returns the stats dict.
     """
     lib = load()
     if lib is None:
@@ -323,8 +336,11 @@ def host_index_native(contents: list[bytes], doc_ids: list[int],
     os.makedirs(out_dir, exist_ok=True)
     stats = _HostIndexStats()
     args, keepalive = _marshal_docs(contents, doc_ids)
-    rc = lib.mri_host_index(*args, str(out_dir).encode(), ctypes.byref(stats))
+    rc = lib.mri_host_index(*args, str(out_dir).encode(), ctypes.byref(stats),
+                            ctypes.c_int32(max(1, num_threads)))
     del keepalive
+    if rc == -2:
+        raise MemoryError("native host index allocation failure")
     if rc != 0:
         raise OSError(f"native host index failed writing to {out_dir!r}")
     return {

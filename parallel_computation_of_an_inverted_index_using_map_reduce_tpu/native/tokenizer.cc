@@ -19,6 +19,22 @@
 //     combiner's counts) — everything the emit phase needs, with the
 //     device program never depending on final vocab order.
 //
+// Map-phase host parallelism (the reference's N mapper threads over
+// size-balanced contiguous file ranges, main.c:307-328, 348-365,
+// re-expressed): every entry point takes a `num_threads`; documents are
+// partitioned into contiguous byte-balanced ranges (the reference's
+// greedy cut at total/N, made total and safe), each scanned by a worker
+// with a *thread-local* vocab table and combiner, then merged
+// sequentially at vocab scale — per-worker local ids upsert into the
+// global table once per unique word, never per token.  Because the doc
+// ranges are contiguous and workers are merged in range order, the
+// emitted (term, doc) pair sequence is byte-for-byte the same as the
+// single-threaded scan for rank-space outputs, and postings stay
+// doc-ascending per term for free.  No locks anywhere: workers share
+// nothing until the join, the same fork-join shape as the reference's
+// map phase but without its serializing spill-file stdio locks
+// (main.c:116).
+//
 // Hot-loop design: 256-entry byte tables (whitespace / lowercase-letter)
 // instead of range compares, FNV-1a folded into the cleaning pass (one
 // pass per byte total), open-addressing hash table with power-of-two
@@ -32,7 +48,11 @@
 #include <cstdio>
 #include <cstring>
 #include <cstdlib>
+#include <exception>
+#include <new>
+#include <system_error>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -70,12 +90,12 @@ inline uint64_t Fnv1a(const uint8_t* p, uint32_t len) {
   return h;
 }
 
-// Incremental tokenizer state shared by the one-shot and streaming
-// frontends.  Provisional ids are assigned at first occurrence and
-// never change; the combiner (per-(term, doc) dedup, the reference
-// reducer's dedup at main.c:176-184 pulled into the map phase) and the
-// per-term document-frequency counts live here so nothing token-scale
-// survives past a chunk.
+// Incremental tokenizer state: one per scanning thread (or the single
+// global one when num_threads == 1).  Provisional ids are assigned at
+// first occurrence and never change; the combiner (per-(term, doc)
+// dedup, the reference reducer's dedup at main.c:176-184 pulled into
+// the map phase) and the per-term document-frequency counts live here
+// so nothing token-scale survives past a chunk.
 struct StreamState {
   std::vector<uint8_t> arena;
   std::vector<Entry> table;
@@ -84,12 +104,11 @@ struct StreamState {
   std::vector<uint32_t> word_offsets;  // prov id -> arena offset
   std::vector<uint32_t> word_lens;
   std::vector<int32_t> last_doc;  // prov id -> global doc ordinal (combiner)
-  std::vector<int32_t> df;        // prov id -> docs containing it
+  std::vector<int32_t> df;        // prov id -> docs containing it; only
+                                  // meaningful when scanned with dedup=true
   int64_t raw_tokens = 0;
   int64_t num_pairs = 0;
   int32_t doc_ordinal = 0;  // global across chunks
-  int64_t stride = 0;       // packed-key stride (streaming); 0 = unused
-  bool key_overflow = false;
 
   StreamState() : table(1 << 16), mask(table.size() - 1) {
     for (auto& e : table) e.id = -1;
@@ -109,20 +128,45 @@ struct StreamState {
     table.swap(bigger);
     mask = bmask;
   }
+
+  // Upsert a cleaned word (hash h precomputed); returns its prov id.
+  int32_t Upsert(const uint8_t* word, int32_t wlen, uint64_t h) {
+    uint64_t slot = h & mask;
+    for (;;) {
+      Entry& e = table[slot];
+      if (e.id < 0) {
+        const uint32_t off = static_cast<uint32_t>(arena.size());
+        arena.insert(arena.end(), word, word + wlen);
+        e.offset = off;
+        e.len = wlen;
+        e.id = next_id;
+        word_offsets.push_back(off);
+        word_lens.push_back(wlen);
+        last_doc.push_back(-1);
+        df.push_back(0);
+        const int32_t id = next_id++;
+        if (static_cast<uint64_t>(next_id) * 10 > table.size() * 7) Grow();
+        return id;
+      }
+      if (e.len == static_cast<uint32_t>(wlen) &&
+          std::memcmp(arena.data() + e.offset, word, wlen) == 0)
+        return e.id;
+      slot = (slot + 1) & mask;
+    }
+  }
 };
 
-// Scan one window of documents; emit combiner-deduped (prov_id, doc_id)
-// pairs through `emit`.  `data` is concatenated document bytes,
-// `doc_ends[i]` the exclusive end of doc i, `doc_id_values[i]` its
-// (1-based) id.  `dedup` off replays every raw token (one-shot
-// non-combined mode).
+// Scan a contiguous run of documents; emit (prov_id, doc_id) pairs
+// through `emit` — combiner-deduped when `dedup`.  `data` is the whole
+// window's concatenated bytes; this call scans docs
+// `[doc_lo, doc_hi)` whose bytes span `[start_pos, doc_ends[doc_hi-1])`.
 template <typename Emit>
-void ScanChunk(StreamState& st, const uint8_t* data, int64_t /*len*/,
+void ScanChunk(StreamState& st, const uint8_t* data, int64_t start_pos,
                const int64_t* doc_ends, const int32_t* doc_id_values,
-               int32_t num_docs, bool dedup, Emit&& emit) {
+               int32_t doc_lo, int32_t doc_hi, bool dedup, Emit&& emit) {
   uint8_t word[kMaxWordLetters];
-  int64_t pos = 0;
-  for (int32_t d = 0; d < num_docs; ++d, ++st.doc_ordinal) {
+  int64_t pos = start_pos;
+  for (int32_t d = doc_lo; d < doc_hi; ++d, ++st.doc_ordinal) {
     const int64_t end = doc_ends[d];
     const int32_t doc_id = doc_id_values[d];
     const int32_t ordinal = st.doc_ordinal;
@@ -140,39 +184,13 @@ void ScanChunk(StreamState& st, const uint8_t* data, int64_t /*len*/,
       } while (++pos < end && !kTab.space[data[pos]]);
       if (wlen == 0) continue;  // token cleaned to nothing (main.c:113)
 
-      // hash-table upsert
-      uint64_t slot = h & st.mask;
-      int32_t id;
-      for (;;) {
-        Entry& e = st.table[slot];
-        if (e.id < 0) {
-          const uint32_t off = static_cast<uint32_t>(st.arena.size());
-          st.arena.insert(st.arena.end(), word, word + wlen);
-          e.offset = off;
-          e.len = wlen;
-          e.id = st.next_id;
-          st.word_offsets.push_back(off);
-          st.word_lens.push_back(wlen);
-          st.last_doc.push_back(-1);
-          st.df.push_back(0);
-          id = st.next_id++;
-          if (static_cast<uint64_t>(st.next_id) * 10 > st.table.size() * 7)
-            st.Grow();
-          break;
-        }
-        if (e.len == static_cast<uint32_t>(wlen) &&
-            std::memcmp(st.arena.data() + e.offset, word, wlen) == 0) {
-          id = e.id;
-          break;
-        }
-        slot = (slot + 1) & st.mask;
-      }
+      const int32_t id = st.Upsert(word, wlen, h);
       ++st.raw_tokens;
       if (dedup) {
         if (st.last_doc[id] == ordinal) continue;  // (term, doc) already out
         st.last_doc[id] = ordinal;
+        ++st.df[id];
       }
-      ++st.df[id];
       ++st.num_pairs;
       emit(id, doc_id);
     }
@@ -195,6 +213,140 @@ std::vector<int32_t> SortedOrder(const StreamState& st) {
   return order;
 }
 
+// ---------------------------------------------------------------------------
+// Fork-join map phase: contiguous byte-balanced doc ranges, one worker
+// per range, merged in range order (the reference's scheduler,
+// main.c:307-323, made total: every doc lands in exactly one range and
+// num_threads > num_docs yields empty tail ranges, not UB).
+// ---------------------------------------------------------------------------
+
+struct Worker {
+  StreamState local;              // thread-local vocab + combiner + df
+  std::vector<int32_t> l2g;       // local prov id -> global prov id
+  std::vector<int32_t> pair_lids; // current window's emissions
+  std::vector<int32_t> pair_docs;
+  int64_t raw_in_window = 0;
+};
+
+// Cut points: ranges[t] = first doc of worker t (ranges[T] = num_docs).
+std::vector<int32_t> PlanRanges(const int64_t* doc_ends, int32_t num_docs,
+                                int32_t num_threads) {
+  std::vector<int32_t> cuts(num_threads + 1, num_docs);
+  cuts[0] = 0;
+  const int64_t total = num_docs ? doc_ends[num_docs - 1] : 0;
+  int32_t d = 0;
+  for (int32_t t = 1; t < num_threads; ++t) {
+    const int64_t target = total * t / num_threads;
+    while (d < num_docs && (d ? doc_ends[d - 1] : 0) < target) ++d;
+    cuts[t] = d;
+  }
+  return cuts;
+}
+
+// Run `fn(t)` for t in [0, T) on T-1 spawned threads + the caller.
+// Exceptions inside a worker (bad_alloc on arena/vector growth) are
+// captured and rethrown after the join instead of std::terminate-ing
+// the process; a failed thread spawn degrades to running that worker
+// inline.  Keeps the extern "C" NULL/-2-on-OOM contract intact for
+// every thread count.
+template <typename Fn>
+void ForkJoin(int32_t T, Fn&& fn) {
+  if (T == 1) {
+    fn(0);
+    return;
+  }
+  std::vector<std::thread> threads;
+  std::vector<std::exception_ptr> errs(T);
+  threads.reserve(T - 1);
+  auto guarded = [&](int32_t t) {
+    try {
+      fn(t);
+    } catch (...) {
+      errs[t] = std::current_exception();
+    }
+  };
+  for (int32_t t = 1; t < T; ++t) {
+    try {
+      threads.emplace_back(guarded, t);
+    } catch (const std::system_error&) {
+      guarded(t);  // cannot spawn: run this worker's range inline
+    }
+  }
+  guarded(0);
+  for (auto& th : threads) th.join();
+  for (auto& e : errs)
+    if (e) std::rethrow_exception(e);
+}
+
+// Scan one window with `workers.size()` threads; each worker appends
+// this window's (local_id, doc) pairs to its pair vectors and tracks
+// its raw-token delta.  Single-threaded (workers.size() == 1) runs
+// inline — no thread spawn.
+void ParallelScan(std::vector<Worker>& workers, const uint8_t* data,
+                  const int64_t* doc_ends, const int32_t* doc_id_values,
+                  int32_t num_docs, bool dedup) {
+  const int32_t T = static_cast<int32_t>(workers.size());
+  const std::vector<int32_t> cuts = PlanRanges(doc_ends, num_docs, T);
+  ForkJoin(T, [&](int32_t t) {
+    Worker& w = workers[t];
+    const int64_t raw0 = w.local.raw_tokens;
+    const int32_t lo = cuts[t], hi = cuts[t + 1];
+    const int64_t start_pos = lo ? doc_ends[lo - 1] : 0;
+    w.pair_lids.clear();
+    w.pair_docs.clear();
+    ScanChunk(w.local, data, start_pos, doc_ends, doc_id_values, lo, hi, dedup,
+              [&](int32_t id, int32_t doc) {
+                w.pair_lids.push_back(id);
+                w.pair_docs.push_back(doc);
+              });
+    w.raw_in_window = w.local.raw_tokens - raw0;
+  });
+}
+
+// Extend each worker's local->global map with the words it saw for the
+// first time this window.  Vocab-scale, sequential, in range order —
+// this is the only cross-thread step, the analogue of the reference's
+// join barrier (main.c:367-369).
+void MergeVocabs(StreamState& global, std::vector<Worker>& workers) {
+  for (Worker& w : workers) {
+    const uint8_t* base = w.local.arena.data();
+    for (int32_t lid = static_cast<int32_t>(w.l2g.size());
+         lid < w.local.next_id; ++lid) {
+      const uint8_t* word = base + w.local.word_offsets[lid];
+      const uint32_t len = w.local.word_lens[lid];
+      w.l2g.push_back(global.Upsert(word, len, Fnv1a(word, len)));
+    }
+  }
+}
+
+// Single-threaded fast path: the lone worker's local state IS the
+// global vocab — extend l2g with the identity instead of re-hashing
+// every word into a second table.  Returns the vocab-authoritative
+// state for any thread count.
+StreamState& ResolveVocab(StreamState& global, std::vector<Worker>& workers) {
+  if (workers.size() == 1) {
+    Worker& w = workers[0];
+    for (int32_t lid = static_cast<int32_t>(w.l2g.size());
+         lid < w.local.next_id; ++lid)
+      w.l2g.push_back(lid);
+    return w.local;
+  }
+  MergeVocabs(global, workers);
+  return global;
+}
+
+// Fold the workers' combiner df counts (local prov space) into global
+// prov space.  Correct because each document is scanned by exactly one
+// worker, so per-(term, doc) dedup is complete thread-locally.
+std::vector<int32_t> GlobalDf(const StreamState& global,
+                              const std::vector<Worker>& workers) {
+  std::vector<int32_t> df(std::max(global.next_id, 1), 0);
+  for (const Worker& w : workers)
+    for (int32_t lid = 0; lid < w.local.next_id; ++lid)
+      df[w.l2g[lid]] += w.local.df[lid];
+  return df;
+}
+
 }  // namespace
 
 extern "C" {
@@ -213,33 +365,36 @@ struct TokenizeResult {
 // data: concatenated document bytes; doc_ends[i] = exclusive end offset of
 // doc i; doc_id_values[i] = its (1-based) doc id.  dedup_pairs != 0
 // enables the combiner (shrinks the device feed ~4x on real text).
+// num_threads >= 1 scans byte-balanced contiguous doc ranges in
+// parallel; output arrays are identical for every thread count (pairs
+// stay in document order, term ids are sorted-vocab ranks).
 // Returns NULL on OOM.
 TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
                              const int64_t* doc_ends,
                              const int32_t* doc_id_values, int32_t num_docs,
-                             int32_t dedup_pairs) {
-  StreamState st;
-  std::vector<int32_t> tok_terms;
-  std::vector<int32_t> tok_docs;
-  tok_terms.reserve(len / 6 + 16);
-  tok_docs.reserve(len / 6 + 16);
-  ScanChunk(st, data, len, doc_ends, doc_id_values, num_docs,
-            dedup_pairs != 0, [&](int32_t id, int32_t doc) {
-              tok_terms.push_back(id);
-              tok_docs.push_back(doc);
-            });
+                             int32_t dedup_pairs, int32_t num_threads) try {
+  (void)len;
+  StreamState global;
+  std::vector<Worker> workers(std::max(num_threads, 1));
+  ParallelScan(workers, data, doc_ends, doc_id_values, num_docs,
+               dedup_pairs != 0);
+  StreamState& vst = ResolveVocab(global, workers);
 
-  const int32_t vocab = st.next_id;
-  const std::vector<int32_t> order = SortedOrder(st);
+  const int32_t vocab = vst.next_id;
+  const std::vector<int32_t> order = SortedOrder(vst);
   int32_t width = 1;
   for (int32_t i = 0; i < vocab; ++i)
-    width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
+    width = std::max(width, static_cast<int32_t>(vst.word_lens[i]));
 
   auto* res = static_cast<TokenizeResult*>(std::malloc(sizeof(TokenizeResult)));
   if (!res) return nullptr;
-  const int64_t n = static_cast<int64_t>(tok_terms.size());
+  int64_t n = 0, raw = 0;
+  for (const Worker& w : workers) {
+    n += static_cast<int64_t>(w.pair_lids.size());
+    raw += w.local.raw_tokens;
+  }
   res->num_tokens = n;
-  res->raw_tokens = st.raw_tokens;
+  res->raw_tokens = raw;
   res->vocab_size = vocab;
   res->vocab_width = width;
   res->term_ids = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max<int64_t>(n, 1)));
@@ -259,14 +414,19 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
     const int32_t prov = order[rank];
     remap[prov] = rank;
     std::memcpy(res->vocab_packed + static_cast<int64_t>(rank) * width,
-                st.arena.data() + st.word_offsets[prov], st.word_lens[prov]);
+                vst.arena.data() + vst.word_offsets[prov],
+                vst.word_lens[prov]);
     res->letter_of_term[rank] = res->vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
   }
-  for (int64_t i = 0; i < n; ++i) {
-    res->term_ids[i] = remap[tok_terms[i]];
-    res->doc_ids[i] = tok_docs[i];
-  }
+  int64_t i = 0;
+  for (const Worker& w : workers)
+    for (size_t k = 0; k < w.pair_lids.size(); ++k, ++i) {
+      res->term_ids[i] = remap[w.l2g[w.pair_lids[k]]];
+      res->doc_ids[i] = w.pair_docs[k];
+    }
   return res;
+} catch (const std::bad_alloc&) {
+  return nullptr;
 }
 
 void mri_free_result(TokenizeResult* r) {
@@ -286,6 +446,12 @@ void mri_free_result(TokenizeResult* r) {
 // so each chunk's keys can start their host->device DMA while the next
 // chunk tokenizes.  stride = max_doc_id + 2 (doc ids < stride - 1 and
 // INT32_MAX padding stays strictly above every valid key).
+//
+// With num_threads > 1 the prov ids are assigned at the per-window
+// merge (vocab-scale) instead of per token, so the numbering can
+// differ from the single-threaded scan — everything downstream is
+// invariant to prov numbering (the device sorts keys; emit indirects
+// through the prov->rank remap).
 // ---------------------------------------------------------------------------
 
 struct StreamChunkResult {
@@ -306,39 +472,83 @@ struct StreamFinalResult {
   int32_t* df;              // [vocab_size], prov space (combiner counts)
 };
 
-void* mri_stream_new(int64_t stride) {
-  auto* st = new (std::nothrow) StreamState();
-  if (st) st->stride = stride;
-  return st;
+struct StreamHandle {
+  StreamState global;
+  std::vector<Worker> workers;  // empty when single-threaded
+  int64_t stride = 0;
+  bool key_overflow = false;
+};
+
+// num_threads > 1: byte-balanced contiguous doc ranges per feed window.
+void* mri_stream_new_mt(int64_t stride, int32_t num_threads) {
+  auto* h = new (std::nothrow) StreamHandle();
+  if (!h) return nullptr;
+  h->stride = stride;
+  if (num_threads > 1) {
+    try {
+      h->workers.resize(num_threads);
+    } catch (const std::bad_alloc&) {
+      delete h;
+      return nullptr;
+    }
+  }
+  return h;
 }
 
 void mri_stream_free(void* handle) {
-  delete static_cast<StreamState*>(handle);
+  delete static_cast<StreamHandle*>(handle);
 }
 
 StreamChunkResult* mri_stream_feed(void* handle, const uint8_t* data,
                                    int64_t len, const int64_t* doc_ends,
                                    const int32_t* doc_id_values,
-                                   int32_t num_docs) {
-  auto& st = *static_cast<StreamState*>(handle);
+                                   int32_t num_docs) try {
+  (void)len;
+  auto& h = *static_cast<StreamHandle*>(handle);
   auto* res =
       static_cast<StreamChunkResult*>(std::malloc(sizeof(StreamChunkResult)));
   if (!res) return nullptr;
   std::vector<int32_t> keys;
-  keys.reserve(len / 24 + 16);
-  const int64_t raw_before = st.raw_tokens;
-  const int64_t stride = st.stride;
-  ScanChunk(st, data, len, doc_ends, doc_id_values, num_docs, /*dedup=*/true,
-            [&](int32_t id, int32_t doc) {
-              const int64_t key = static_cast<int64_t>(id) * stride + doc;
-              if (key >= INT32_MAX) {  // INT32_MAX itself is the pad value
-                st.key_overflow = true;
-                return;
-              }
-              keys.push_back(static_cast<int32_t>(key));
-            });
-  res->raw_tokens = st.raw_tokens - raw_before;
-  if (st.key_overflow) {
+  const int64_t stride = h.stride;
+
+  if (h.workers.empty()) {  // single-threaded: scan straight into global
+    keys.reserve(len / 24 + 16);
+    const int64_t raw_before = h.global.raw_tokens;
+    ScanChunk(h.global, data, 0, doc_ends, doc_id_values, 0, num_docs,
+              /*dedup=*/true, [&](int32_t id, int32_t doc) {
+                const int64_t key = static_cast<int64_t>(id) * stride + doc;
+                if (key >= INT32_MAX) {  // INT32_MAX itself is the pad value
+                  h.key_overflow = true;
+                  return;
+                }
+                keys.push_back(static_cast<int32_t>(key));
+              });
+    res->raw_tokens = h.global.raw_tokens - raw_before;
+  } else {  // fork-join scan + vocab-scale merge, then vectorized remap
+    ParallelScan(h.workers, data, doc_ends, doc_id_values, num_docs,
+                 /*dedup=*/true);
+    MergeVocabs(h.global, h.workers);
+    int64_t n = 0, raw = 0;
+    for (const Worker& w : h.workers) {
+      n += static_cast<int64_t>(w.pair_lids.size());
+      raw += w.raw_in_window;
+    }
+    res->raw_tokens = raw;
+    keys.reserve(n);
+    for (const Worker& w : h.workers)
+      for (size_t k = 0; k < w.pair_lids.size(); ++k) {
+        const int64_t key =
+            static_cast<int64_t>(w.l2g[w.pair_lids[k]]) * stride +
+            w.pair_docs[k];
+        if (key >= INT32_MAX) {
+          h.key_overflow = true;
+          break;
+        }
+        keys.push_back(static_cast<int32_t>(key));
+      }
+  }
+
+  if (h.key_overflow) {
     res->num_pairs = -1;
     res->keys = nullptr;
     return res;
@@ -352,6 +562,8 @@ StreamChunkResult* mri_stream_feed(void* handle, const uint8_t* data,
   }
   std::memcpy(res->keys, keys.data(), sizeof(int32_t) * keys.size());
   return res;
+} catch (const std::bad_alloc&) {
+  return nullptr;
 }
 
 void mri_stream_chunk_free(StreamChunkResult* r) {
@@ -360,21 +572,41 @@ void mri_stream_chunk_free(StreamChunkResult* r) {
   std::free(r);
 }
 
-StreamFinalResult* mri_stream_finalize(void* handle) {
-  auto& st = *static_cast<StreamState*>(handle);
+StreamFinalResult* mri_stream_finalize(void* handle) try {
+  auto& h = *static_cast<StreamHandle*>(handle);
+  StreamState& st = h.global;
   const int32_t vocab = st.next_id;
   const std::vector<int32_t> order = SortedOrder(st);
   int32_t width = 1;
   for (int32_t i = 0; i < vocab; ++i)
     width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
 
+  // Stream totals + prov-space df: from the global state when
+  // single-threaded, folded from the workers otherwise.
+  int64_t raw_tokens, num_pairs;
+  std::vector<int32_t> df_mt;
+  const int32_t* df_src;
+  if (h.workers.empty()) {
+    raw_tokens = st.raw_tokens;
+    num_pairs = st.num_pairs;
+    df_src = st.df.data();
+  } else {
+    raw_tokens = num_pairs = 0;
+    for (const Worker& w : h.workers) {
+      raw_tokens += w.local.raw_tokens;
+      num_pairs += w.local.num_pairs;
+    }
+    df_mt = GlobalDf(st, h.workers);
+    df_src = df_mt.data();
+  }
+
   auto* res =
       static_cast<StreamFinalResult*>(std::malloc(sizeof(StreamFinalResult)));
   if (!res) return nullptr;
   res->vocab_size = vocab;
   res->vocab_width = width;
-  res->raw_tokens = st.raw_tokens;
-  res->num_pairs = st.num_pairs;
+  res->raw_tokens = raw_tokens;
+  res->num_pairs = num_pairs;
   res->vocab_packed = static_cast<uint8_t*>(
       std::calloc(std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 1));
   res->letter_of_term =
@@ -396,8 +628,10 @@ StreamFinalResult* mri_stream_finalize(void* handle) {
     res->letter_of_term[rank] =
         res->vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
   }
-  if (vocab) std::memcpy(res->df, st.df.data(), sizeof(int32_t) * vocab);
+  if (vocab) std::memcpy(res->df, df_src, sizeof(int32_t) * vocab);
   return res;
+} catch (const std::bad_alloc&) {
+  return nullptr;
 }
 
 void mri_stream_final_free(StreamFinalResult* r) {
@@ -497,9 +731,11 @@ int64_t EmitLetters(const uint8_t* vocab_packed, int32_t vocab_size,
 int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
                  const int64_t* order, const int64_t* df, const int64_t* offsets,
                  const uint16_t* postings16, const int32_t* postings32,
-                 const char* out_dir) {
+                 const char* out_dir) try {
   return EmitLetters(vocab_packed, vocab_size, width, order, df, offsets,
                      postings16, postings32, out_dir);
+} catch (const std::bad_alloc&) {
+  return -1;
 }
 
 // ---------------------------------------------------------------------------
@@ -508,12 +744,13 @@ int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
 // The reference's regime — everything on the host CPU — minus its
 // pathologies (per-token stdio locks, O(T*W) reducer dict scan,
 // bubble sort).  Documents are scanned once through the incremental
-// core; the combiner appends each first (term, doc) occurrence to the
-// term's postings vector, which arrives ascending for free because
-// docs are scanned in manifest order (doc ids are 1-based manifest
-// positions, main.c:275).  No sort of token-scale data happens at all:
-// the only sorts are the vocab-scale SortedOrder and the emit-order
-// sort below.
+// core — in parallel over contiguous byte-balanced doc ranges when
+// num_threads > 1 (the reference's mapper scheduling, main.c:307-328)
+// — and each worker's combiner appends first (term, doc) occurrences
+// to its local postings vectors, which arrive ascending for free
+// because docs are scanned in manifest order (doc ids are 1-based
+// manifest positions, main.c:275); ranges are contiguous and merged in
+// order, so global postings stay ascending with no token-scale sort.
 // ---------------------------------------------------------------------------
 
 struct HostIndexStats {
@@ -526,17 +763,75 @@ struct HostIndexStats {
 int32_t mri_host_index(const uint8_t* data, int64_t len,
                        const int64_t* doc_ends, const int32_t* doc_id_values,
                        int32_t num_docs, const char* out_dir,
-                       HostIndexStats* stats) {
-  StreamState st;
-  std::vector<std::vector<int32_t>> postings_by_prov;
-  ScanChunk(st, data, len, doc_ends, doc_id_values, num_docs, /*dedup=*/true,
-            [&](int32_t id, int32_t doc) {
-              if (id >= static_cast<int32_t>(postings_by_prov.size()))
-                postings_by_prov.resize(id + 1);
-              postings_by_prov[id].push_back(doc);
-            });
+                       HostIndexStats* stats, int32_t num_threads) try {
+  (void)len;
+  const int32_t T = std::max(num_threads, 1);
+  const std::vector<int32_t> cuts = PlanRanges(doc_ends, num_docs, T);
+
+  // Per-worker scan: local vocab + local postings (doc-ascending).
+  struct HostWorker {
+    StreamState local;
+    std::vector<std::vector<int32_t>> postings;  // local prov id -> docs
+    std::vector<int32_t> l2g;
+  };
+  std::vector<HostWorker> workers(T);
+  ForkJoin(T, [&](int32_t t) {
+    HostWorker& w = workers[t];
+    const int32_t lo = cuts[t], hi = cuts[t + 1];
+    const int64_t start_pos = lo ? doc_ends[lo - 1] : 0;
+    ScanChunk(w.local, data, start_pos, doc_ends, doc_id_values, lo, hi,
+              /*dedup=*/true, [&](int32_t id, int32_t doc) {
+                if (id >= static_cast<int32_t>(w.postings.size()))
+                  w.postings.resize(id + 1);
+                w.postings[id].push_back(doc);
+              });
+  });
+
+  // Vocab-scale merge in range order (the join barrier); with one
+  // worker its local state is the global vocab (identity l2g).
+  StreamState merged;
+  int64_t raw_tokens = 0, num_pairs = 0;
+  for (HostWorker& w : workers) {
+    const uint8_t* base = w.local.arena.data();
+    for (int32_t lid = 0; lid < w.local.next_id; ++lid) {
+      if (T == 1) {
+        w.l2g.push_back(lid);
+        continue;
+      }
+      const uint8_t* word = base + w.local.word_offsets[lid];
+      const uint32_t wl = w.local.word_lens[lid];
+      w.l2g.push_back(merged.Upsert(word, wl, Fnv1a(word, wl)));
+    }
+    raw_tokens += w.local.raw_tokens;
+    num_pairs += w.local.num_pairs;
+  }
+  StreamState& st = (T == 1) ? workers[0].local : merged;
 
   const int32_t vocab = st.next_id;
+  // Global postings by prov id: concatenate the workers' runs in range
+  // order — contiguous ranges keep every term's docs ascending.
+  std::vector<int64_t> df_prov(std::max(vocab, 1), 0);
+  for (const HostWorker& w : workers)
+    for (size_t lid = 0; lid < w.postings.size(); ++lid)
+      df_prov[w.l2g[lid]] += static_cast<int64_t>(w.postings[lid].size());
+  std::vector<int64_t> offsets_prov(std::max(vocab, 1));
+  int64_t total_pairs = 0;
+  for (int32_t p = 0; p < vocab; ++p) {
+    offsets_prov[p] = total_pairs;
+    total_pairs += df_prov[p];
+  }
+  std::vector<int32_t> flat(std::max<int64_t>(total_pairs, 1));
+  {
+    std::vector<int64_t> cursor(offsets_prov.begin(), offsets_prov.end());
+    for (const HostWorker& w : workers)
+      for (size_t lid = 0; lid < w.postings.size(); ++lid) {
+        const int32_t gid = w.l2g[lid];
+        std::copy(w.postings[lid].begin(), w.postings[lid].end(),
+                  flat.begin() + cursor[gid]);
+        cursor[gid] += static_cast<int64_t>(w.postings[lid].size());
+      }
+  }
+
   const std::vector<int32_t> order = SortedOrder(st);
   int32_t width = 1;
   for (int32_t i = 0; i < vocab; ++i)
@@ -548,28 +843,15 @@ int32_t mri_host_index(const uint8_t* data, int64_t len,
       std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 0);
   std::vector<int32_t> letter_of_rank(std::max(vocab, 1));
   std::vector<int64_t> df_rank(std::max(vocab, 1));
+  std::vector<int64_t> offsets_rank(std::max(vocab, 1));
   for (int32_t rank = 0; rank < vocab; ++rank) {
     const int32_t prov = order[rank];
     std::memcpy(vocab_packed.data() + static_cast<int64_t>(rank) * width,
                 st.arena.data() + st.word_offsets[prov], st.word_lens[prov]);
     letter_of_rank[rank] = vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
-    df_rank[rank] = static_cast<int64_t>(postings_by_prov[prov].size());
+    df_rank[rank] = df_prov[prov];
+    offsets_rank[rank] = offsets_prov[prov];
   }
-
-  // Flat postings in prov order + rank-space offsets into it.
-  std::vector<int64_t> offsets_prov(std::max(vocab, 1));
-  int64_t total_pairs = 0;
-  for (int32_t p = 0; p < vocab; ++p) {
-    offsets_prov[p] = total_pairs;
-    total_pairs += static_cast<int64_t>(postings_by_prov[p].size());
-  }
-  std::vector<int32_t> flat(std::max<int64_t>(total_pairs, 1));
-  for (int32_t p = 0; p < vocab; ++p)
-    std::copy(postings_by_prov[p].begin(), postings_by_prov[p].end(),
-              flat.begin() + offsets_prov[p]);
-  std::vector<int64_t> offsets_rank(std::max(vocab, 1));
-  for (int32_t rank = 0; rank < vocab; ++rank)
-    offsets_rank[rank] = offsets_prov[order[rank]];
 
   // Emit order: (letter asc, df desc, rank asc) — stable sort supplies
   // the rank tiebreak == word-ascending (main.c:55-64 semantics).
@@ -582,13 +864,15 @@ int32_t mri_host_index(const uint8_t* data, int64_t len,
                      return df_rank[a] > df_rank[b];
                    });
 
-  stats->raw_tokens = st.raw_tokens;
-  stats->num_pairs = st.num_pairs;
+  stats->raw_tokens = raw_tokens;
+  stats->num_pairs = num_pairs;
   stats->vocab_size = vocab;
   stats->bytes_written = EmitLetters(
       vocab_packed.data(), vocab, width, emit_rank.data(), df_rank.data(),
       offsets_rank.data(), nullptr, flat.data(), out_dir);
   return stats->bytes_written < 0 ? -1 : 0;
+} catch (const std::bad_alloc&) {
+  return -2;
 }
 
 }  // extern "C"

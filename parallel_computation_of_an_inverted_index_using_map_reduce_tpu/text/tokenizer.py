@@ -231,19 +231,25 @@ def _doc_token_id_bounds(buf: np.ndarray, ends: np.ndarray) -> np.ndarray:
 
 
 def tokenize(contents: list[bytes], doc_ids: list[int],
-             use_native: bool = True, dedup_pairs: bool = False) -> TokenizedCorpus:
+             use_native: bool = True, dedup_pairs: bool = False,
+             num_threads: int = 1) -> TokenizedCorpus:
     """Dispatch to the C++ tokenizer when built, else the numpy path.
 
     Both implement the identical contract (tests/test_native.py asserts
     equivalence token-for-token).  ``dedup_pairs`` applies the map-side
     combiner (native path only; the numpy path leaves duplicates for the
-    device engine to fold, which is output-invariant).
+    device engine to fold, which is output-invariant).  ``num_threads``
+    parallelizes the native scan over contiguous doc ranges (the
+    reference's mapper threads, main.c:348-365); output is identical
+    for every thread count.
     """
     if use_native:
         from .. import native
 
         if native.available():
-            return native.tokenize_native(contents, doc_ids, dedup_pairs=dedup_pairs)
+            return native.tokenize_native(
+                contents, doc_ids, dedup_pairs=dedup_pairs,
+                num_threads=num_threads)
     return tokenize_documents(contents, doc_ids)
 
 

@@ -75,3 +75,23 @@ def test_cpu_full_corpus_md5(reference_dir, tmp_path):
     build_index(m, IndexConfig(backend="cpu"), output_dir=tmp_path)
     md5 = hashlib.md5(read_letter_files(tmp_path)).hexdigest()
     assert md5 == FULL_CORPUS_MD5
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_cpu_mapper_threads_output_invariant(smoke_fixture, tmp_path):
+    """num_mappers drives the host map threads (reference main.c:348-365);
+    output must be byte-identical at any count, like the reference's."""
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    outs = []
+    for i, mappers in enumerate((1, 4)):
+        out = tmp_path / f"m{mappers}"
+        report = InvertedIndexModel(
+            IndexConfig(backend="cpu", num_mappers=mappers, num_reducers=2)
+        ).run(m, output_dir=out)
+        assert report["num_mappers"] == mappers
+        assert report["num_reducers"] == 2
+        assert report["host_threads"] == (mappers if mappers > 1
+                                          else native.default_threads())
+        outs.append(read_letter_files(out))
+    assert outs[0] == outs[1]
+    assert outs[0] == read_letter_files(smoke_fixture / "golden")

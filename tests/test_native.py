@@ -111,3 +111,78 @@ def test_vocab_growth_rehash():
     assert len(set(words)) == 60000
     docs = [" ".join(words[i::3]).encode() for i in range(3)]
     _assert_equal(docs, [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# Multithreaded map phase (the reference's mapper threads, main.c:348-365):
+# output must be identical for every thread count.
+# ---------------------------------------------------------------------------
+
+
+def _random_docs(seed, n_docs=40, max_len=600):
+    rng = np.random.default_rng(seed)
+    alphabet = list(b"abcdefgh XYZ01-'\t\n.")
+    docs = [bytes(rng.choice(alphabet, size=int(rng.integers(0, max_len))))
+            for _ in range(n_docs)]
+    return docs, list(range(1, n_docs + 1))
+
+
+@pytest.mark.parametrize("threads", [2, 3, 8, 61])
+def test_tokenize_mt_identical(threads):
+    docs, ids = _random_docs(17)
+    st = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=1)
+    mt = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=threads)
+    np.testing.assert_array_equal(st.term_ids, mt.term_ids)
+    np.testing.assert_array_equal(st.doc_ids, mt.doc_ids)
+    assert st.vocab_strings() == mt.vocab_strings()
+    assert st.raw_tokens == mt.raw_tokens
+
+
+def test_tokenize_mt_more_threads_than_docs():
+    docs, ids = [b"alpha beta", b"beta gamma"], [1, 2]
+    st = native.tokenize_native(docs, ids, num_threads=1)
+    mt = native.tokenize_native(docs, ids, num_threads=16)
+    np.testing.assert_array_equal(st.term_ids, mt.term_ids)
+    np.testing.assert_array_equal(st.doc_ids, mt.doc_ids)
+
+
+@pytest.mark.parametrize("threads", [2, 5])
+def test_host_index_mt_identical(tmp_path, threads):
+    from conftest import read_letter_files
+
+    docs, ids = _random_docs(23, n_docs=60)
+    out1, out2 = tmp_path / "st", tmp_path / "mt"
+    s1 = native.host_index_native(docs, ids, out1, num_threads=1)
+    s2 = native.host_index_native(docs, ids, out2, num_threads=threads)
+    assert read_letter_files(out1) == read_letter_files(out2)
+    assert s1 == s2
+
+
+@pytest.mark.parametrize("threads", [2, 4])
+def test_stream_mt_rank_space_identical(threads):
+    """MT prov numbering may differ, but everything in rank space —
+    postings multiset, df, vocab — must match the single-threaded scan."""
+    docs, ids = _random_docs(29, n_docs=50)
+    stride = len(docs) + 2
+
+    def run(t):
+        keys = []
+        with native.NativeKeyStream(stride, num_threads=t) as s:
+            for lo in range(0, len(docs), 17):
+                k, _ = s.feed(docs[lo:lo + 17], ids[lo:lo + 17])
+                keys.append(k)
+            fin = s.finalize()
+        return np.concatenate(keys), fin
+
+    k1, (vocab1, let1, remap1, df1, raw1, np1) = run(1)
+    k2, (vocab2, let2, remap2, df2, raw2, np2) = run(threads)
+    np.testing.assert_array_equal(vocab1, vocab2)
+    np.testing.assert_array_equal(let1, let2)
+    assert raw1 == raw2 and np1 == np2
+
+    def rank_keys(k, remap):
+        term, doc = np.divmod(k.astype(np.int64), stride)
+        return np.sort(remap[term].astype(np.int64) * stride + doc)
+
+    np.testing.assert_array_equal(rank_keys(k1, remap1), rank_keys(k2, remap2))
+    np.testing.assert_array_equal(df1[np.argsort(remap1)], df2[np.argsort(remap2)])

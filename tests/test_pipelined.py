@@ -167,3 +167,24 @@ def test_key_overflow_falls_back_to_one_shot(tmp_path, monkeypatch):
     assert report["pipelined_fallback"] == "key_overflow"
     assert "tokenize_feed" not in report["phases_ms"]
     assert read_letter_files(tmp_path / "out") == read_letter_files(tmp_path / "oracle")
+
+
+def test_pipelined_host_threads_output_invariant(tmp_path):
+    """The pipelined TPU path with a multithreaded native scan is
+    byte-identical to the single-threaded run (prov numbering differs;
+    rank space cannot)."""
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    docs = zipf_corpus(num_docs=37, vocab_size=500, tokens_per_doc=120, seed=5)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    outs = []
+    for threads in (1, 3):
+        out = tmp_path / f"t{threads}"
+        report = InvertedIndexModel(IndexConfig(
+            backend="tpu", device_shards=1, host_threads=threads,
+        )).run(m, output_dir=out)
+        assert report["host_threads"] == threads
+        outs.append(read_letter_files(out))
+    assert outs[0] == outs[1]
