@@ -1,7 +1,7 @@
 """Benchmark: end-to-end wall time indexing the full test_in corpus.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": R}
+    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": R, ...}
 
 Baseline (BASELINE.md): the reference pthread program at -O2 indexes the
 same corpus in 796 ms on this container's CPU (4 mappers / 26 reducers).
@@ -14,16 +14,27 @@ one-shot (fewest transfers; wins when the link round-trip is cheap) —
 and the better plan's best-of-3 is reported, like the reference's best
 thread config (BASELINE.md measures its 1/1..8/13 grid the same way).
 
-The device measurement runs in a watchdog subprocess: if the TPU (or
-the tunnel to it) is unreachable or hangs, the bench still reports a
-real number by measuring the native cpu backend, which never
-initializes a device.  Falls back to a deterministic Zipfian corpus of
-the same scale if /root/reference/test_in is not mounted, scaling the
-baseline by corpus bytes.
+Tunnel-weather hardening (VERDICT r1 #1): the TPU measurement runs in a
+watchdog subprocess with up to ``TPU_ATTEMPTS`` tries and a persistent
+XLA compilation cache (first attempt pays the compile; retries and
+later rounds reuse it), so one hung tunnel RPC no longer erases the TPU
+story.  The native cpu backend is ALWAYS measured too (it never touches
+a device), and both numbers are reported; ``value`` is the TPU number
+when any attempt lands, else the cpu number with
+``measured_backend: "cpu-fallback"``.
+
+Falls back to a deterministic Zipfian corpus of the same scale if
+/root/reference/test_in is not mounted, scaling the baseline by corpus
+bytes.
+
+``--scale`` runs the large-corpus streaming benchmark instead
+(BASELINE.json config 4 magnitude): Zipfian docs through the bounded
+streaming engine, reporting docs/s and the accumulator high-water mark.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -37,10 +48,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 BASELINE_MS = 796.0
 BASELINE_BYTES = 5_793_058
 REFERENCE_CORPUS = Path("/root/reference/test_in")
-TPU_TIMEOUT_S = 480  # covers first-compile over a slow tunnel
-
-
-import functools
+TPU_ATTEMPTS = int(os.environ.get("MRI_TPU_BENCH_ATTEMPTS", 3))
+# First attempt pays XLA compile over the tunnel (round-1 evidence:
+# can exceed 8 min when the link is bad) — keep its 480 s leash;
+# retries reuse the persistent compilation cache and get less.
+TPU_TIMEOUTS_S = tuple(
+    int(s) for s in os.environ.get("MRI_TPU_BENCH_TIMEOUTS", "480,240,180").split(","))
+CACHE_DIR = Path(tempfile.gettempdir()) / "mri_tpu_xla_cache"
 
 
 @functools.lru_cache(maxsize=1)
@@ -61,8 +75,12 @@ def _manifest():
     return read_manifest(tmp / "list.txt"), "synthetic_zipf_e2e_wall_ms"
 
 
-def _measure(backend: str, plans: list[dict]) -> float:
-    """Best wall time (ms) over 3 rounds of every plan, after warmup."""
+def _measure(backend: str, plans: list[dict]) -> dict:
+    """Best wall time (ms) over 3 rounds of every plan, after warmup.
+
+    Returns ``{"best_ms": .., "phases_ms": {..}}`` — phases from the
+    best-timed run, so device vs host time is reported, not asserted.
+    """
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
         IndexConfig, InvertedIndexModel,
     )
@@ -74,54 +92,147 @@ def _measure(backend: str, plans: list[dict]) -> float:
         models.append(InvertedIndexModel(
             IndexConfig(backend=backend, output_dir=out_dir, **plan)))
         models[-1].run(manifest)  # warmup: XLA compile + numpy/jit caches
-    best = float("inf")
+    best, best_report = float("inf"), {}
     for _ in range(3):
         for model in models:
             t0 = time.perf_counter()
-            model.run(manifest)
-            best = min(best, time.perf_counter() - t0)
-    return best * 1e3
+            report = model.run(manifest)
+            dt = time.perf_counter() - t0
+            if dt < best:
+                best, best_report = dt, report
+    return {
+        "best_ms": best * 1e3,
+        "phases_ms": best_report.get("phases_ms", {}),
+        "host_threads": best_report.get("host_threads"),
+    }
 
 
 def _tpu_child() -> int:
-    print(json.dumps({"best_ms": _measure(
-        "tpu", [{}, {"pipeline_chunk_docs": 0}])}))
+    print(json.dumps(_measure("tpu", [{}, {"pipeline_chunk_docs": 0}])))
+    return 0
+
+
+def _run_tpu_attempts() -> tuple[dict | None, list[str]]:
+    """Run the TPU child up to TPU_ATTEMPTS times; returns (result, log)."""
+    CACHE_DIR.mkdir(parents=True, exist_ok=True)
+    env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=str(CACHE_DIR))
+    log: list[str] = []
+    for attempt in range(TPU_ATTEMPTS):
+        timeout = TPU_TIMEOUTS_S[min(attempt, len(TPU_TIMEOUTS_S) - 1)]
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--tpu-child"],
+                capture_output=True, text=True, timeout=timeout, env=env,
+            )
+            if proc.returncode == 0:
+                return (json.loads(proc.stdout.strip().splitlines()[-1]),
+                        log)
+            log.append(f"attempt {attempt + 1}: rc={proc.returncode} "
+                       f"stderr={proc.stderr[-500:]}")
+        except subprocess.TimeoutExpired:
+            log.append(f"attempt {attempt + 1}: timeout after {timeout}s")
+        except (json.JSONDecodeError, KeyError, IndexError) as e:
+            log.append(f"attempt {attempt + 1}: bad child output "
+                       f"({type(e).__name__})")
+    return None, log
+
+
+def _bench_scale() -> int:
+    """Large-corpus streaming benchmark (BASELINE.json config 4 scale)."""
+    plat = os.environ.get("MRI_TPU_SCALE_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus import (
+        synthetic,
+    )
+
+    num_docs = int(os.environ.get("MRI_TPU_SCALE_DOCS", 1_000_000))
+    vocab = int(os.environ.get("MRI_TPU_SCALE_VOCAB", 100_000))
+    manifest = synthetic.synthetic_manifest(
+        num_docs=num_docs, vocab_size=vocab, tokens_per_doc=40, seed=11)
+    out_dir = tempfile.mkdtemp(prefix="bench_scale_")
+    model = InvertedIndexModel(IndexConfig(
+        backend="tpu", output_dir=out_dir,
+        stream_chunk_docs=int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))))
+    t0 = time.perf_counter()
+    stats = model.run(manifest)
+    wall = time.perf_counter() - t0
+    line = {
+        "metric": "scale_stream_docs_per_s",
+        "value": round(num_docs / wall, 1),
+        "unit": "docs/s",
+        "vs_baseline": round((num_docs / wall) / 446.0, 3),  # ref: 446 docs/s
+        "num_docs": num_docs,
+        "configured_vocab": vocab,
+        "unique_terms": stats.get("unique_terms"),
+        "unique_pairs": stats.get("unique_pairs"),
+        "wall_s": round(wall, 2),
+        "accumulator_capacity": stats.get("accumulator_capacity"),
+        "stream_windows": stats.get("stream_windows"),
+    }
+    if os.environ.get("MRI_TPU_SCALE_CROSSCHECK"):
+        import hashlib
+
+        def letters_md5(d):
+            h = hashlib.md5()
+            for i in range(26):
+                h.update((Path(d) / f"{chr(97 + i)}.txt").read_bytes())
+            return h.hexdigest()
+
+        cpu_dir = tempfile.mkdtemp(prefix="bench_scale_cpu_")
+        InvertedIndexModel(IndexConfig(backend="cpu", output_dir=cpu_dir)).run(
+            manifest)
+        line["md5"] = letters_md5(out_dir)
+        line["md5_matches_cpu_backend"] = line["md5"] == letters_md5(cpu_dir)
+    print(json.dumps(line))
     return 0
 
 
 def main() -> int:
     _, metric = _manifest()
-    value_ms = None
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--tpu-child"],
-            capture_output=True, text=True, timeout=TPU_TIMEOUT_S,
-        )
-        if proc.returncode == 0:
-            value_ms = json.loads(proc.stdout.strip().splitlines()[-1])["best_ms"]
-        else:
-            print(f"bench: tpu child failed:\n{proc.stderr[-2000:]}", file=sys.stderr)
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError, IndexError) as e:
-        print(f"bench: tpu measurement unavailable ({type(e).__name__}); "
-              "falling back to the native cpu backend", file=sys.stderr)
-    measured_backend = "tpu"
-    if value_ms is None:
-        value_ms = _measure("cpu", [{}])
-        measured_backend = "cpu-fallback"
+    tpu, tpu_log = _run_tpu_attempts()
+    cpu = _measure("cpu", [{}])
+
+    if tpu is not None:
+        value_ms, measured_backend = tpu["best_ms"], "tpu"
+    else:
+        value_ms, measured_backend = cpu["best_ms"], "cpu-fallback"
+        print("bench: tpu measurement unavailable "
+              f"({'; '.join(tpu_log)}); reporting the native cpu backend",
+              file=sys.stderr)
 
     baseline_ms = BASELINE_MS
     if metric.startswith("synthetic"):
         manifest, _ = _manifest()
         baseline_ms = BASELINE_MS * manifest.total_bytes / BASELINE_BYTES
-    print(json.dumps({
+    line = {
         "metric": metric,
         "value": round(value_ms, 2),
         "unit": "ms",
         "vs_baseline": round(baseline_ms / value_ms, 3),
         "measured_backend": measured_backend,
-    }))
+        "cpu_ms": round(cpu["best_ms"], 2),
+        "cpu_host_threads": cpu.get("host_threads"),
+    }
+    if tpu is not None:
+        line["tpu_ms"] = round(tpu["best_ms"], 2)
+        line["tpu_phases_ms"] = {
+            k: round(v, 2) for k, v in tpu.get("phases_ms", {}).items()}
+        line["tpu_host_threads"] = tpu.get("host_threads")
+    if tpu_log:
+        line["tpu_attempt_log"] = tpu_log
+    print(json.dumps(line))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(_tpu_child() if "--tpu-child" in sys.argv else main())
+    if "--tpu-child" in sys.argv:
+        sys.exit(_tpu_child())
+    if "--scale" in sys.argv:
+        sys.exit(_bench_scale())
+    sys.exit(main())

@@ -77,6 +77,14 @@ class IndexConfig:
     # at any count).  None = ``num_mappers`` if > 1, else auto
     # (min(cores, 8)).
     host_threads: int | None = None
+    # Emit-side ownership for the multi-chip pipelined path:
+    #   "merged" — one host assembles and writes all 26 files (default)
+    #   "letter" — pairs are exchanged by *letter owner*
+    #              (corpus/scheduler.plan_letter_ranges — the reference's
+    #              reducer ownership, main.c:129-150) and each owner
+    #              emits only its own letter files; no global merge
+    #              anywhere.  The multi-host emit strategy.
+    emit_ownership: str = "merged"
 
     def resolved_host_threads(self) -> int:
         """The map-phase thread count this run will actually use."""
@@ -121,6 +129,22 @@ class IndexConfig:
         if self.host_threads is not None and self.host_threads < 1:
             raise ValueError(
                 f"host_threads must be >= 1 or None (auto), got {self.host_threads}")
+        if self.emit_ownership not in ("merged", "letter"):
+            raise ValueError(
+                f"emit_ownership must be 'merged' or 'letter', got {self.emit_ownership!r}")
+        if self.emit_ownership == "letter":
+            if self.backend != "tpu":
+                raise ValueError(
+                    f"emit_ownership='letter' requires backend='tpu', "
+                    f"got backend={self.backend!r}")
+            if self.stream_chunk_docs is not None:
+                raise ValueError(
+                    "emit_ownership='letter' requires the pipelined multi-chip "
+                    "path (incompatible with stream_chunk_docs)")
+            if self.pipeline_chunk_docs == 0:
+                raise ValueError(
+                    "emit_ownership='letter' requires the pipelined multi-chip "
+                    "path (pipeline_chunk_docs=0 disables it)")
         if self.stream_chunk_docs is not None:
             if self.stream_chunk_docs < 1:
                 raise ValueError(

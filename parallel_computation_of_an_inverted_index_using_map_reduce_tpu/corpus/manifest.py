@@ -38,6 +38,14 @@ class Manifest:
     def doc_id(self, index: int) -> int:
         return index + 1
 
+    def read_doc(self, index: int) -> bytes:
+        """Document bytes (raises OSError for unreadable files — the
+        loader turns that into warn-and-skip, main.c:97-100).  Virtual
+        manifests (corpus/synthetic.SyntheticManifest) override this to
+        generate content without a filesystem."""
+        with open(self.paths[index], "rb") as f:
+            return f.read()
+
 
 def _stat_size(path: str) -> int:
     try:
@@ -107,8 +115,7 @@ def iter_document_ranges(manifest: Manifest, ranges):
         doc_ids: list[int] = []
         for i in range(lo, hi):
             try:
-                with open(manifest.paths[i], "rb") as f:
-                    contents.append(f.read())
+                contents.append(manifest.read_doc(i))
                 doc_ids.append(manifest.doc_id(i))
             except OSError:
                 print(f"warning: cannot open {manifest.paths[i]!r}; skipping",

@@ -49,6 +49,108 @@ def zipf_corpus(num_docs: int, vocab_size: int, tokens_per_doc: int,
     return docs
 
 
+class SyntheticManifest:
+    """Manifest-shaped Zipfian corpus generated on the fly — no files.
+
+    Duck-types the ``Manifest`` surface the loaders use (``__len__``,
+    ``doc_id``, ``read_doc``, ``paths`` for error messages, ``sizes`` /
+    ``total_bytes`` for the scheduler) while generating documents
+    lazily in fixed-size chunks, deterministically per chunk — random
+    access costs one chunk generation, sequential streaming costs one
+    per chunk total.  This is what makes BASELINE.json config 4
+    (1M docs / 100K vocab) runnable without materializing a million
+    files (SURVEY.md §5 long-context: corpora larger than any one
+    memory are fed as windows).
+    """
+
+    def __init__(self, num_docs: int, vocab_size: int, tokens_per_doc: int,
+                 alpha: float = 1.05, seed: int = 0, gen_chunk: int = 65536):
+        self.num_docs = num_docs
+        self.tokens_per_doc = tokens_per_doc
+        self.seed = seed
+        self.gen_chunk = gen_chunk
+        self._vocab = np.array(make_vocab(vocab_size, seed=seed), dtype=object)
+        ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+        probs = ranks ** (-alpha)
+        self._cdf = np.cumsum(probs / probs.sum())
+        self._cache: tuple[int, list[bytes]] | None = None
+        # mean word length + separators, for byte-balance planning
+        mean_len = float(np.mean([len(w) for w in self._vocab[:1024]])) + 1.0
+        self._avg_doc_bytes = int(mean_len * tokens_per_doc)
+
+    def __len__(self) -> int:
+        return self.num_docs
+
+    def doc_id(self, index: int) -> int:
+        return index + 1
+
+    @property
+    def paths(self):
+        return _VirtualPaths(self.num_docs)
+
+    @property
+    def sizes(self):
+        return _ConstSeq(self._avg_doc_bytes, self.num_docs)
+
+    @property
+    def total_bytes(self) -> int:
+        return self._avg_doc_bytes * self.num_docs
+
+    def _generate(self, chunk_idx: int) -> list[bytes]:
+        rng = np.random.default_rng((self.seed, chunk_idx))
+        lo = chunk_idx * self.gen_chunk
+        count = min(self.gen_chunk, self.num_docs - lo)
+        u = rng.random((count, self.tokens_per_doc))
+        ids = np.searchsorted(self._cdf, u, side="right").clip(
+            0, len(self._vocab) - 1)
+        return [b" ".join(row) for row in self._vocab[ids]]
+
+    def read_doc(self, index: int) -> bytes:
+        chunk_idx = index // self.gen_chunk
+        if self._cache is None or self._cache[0] != chunk_idx:
+            self._cache = (chunk_idx, self._generate(chunk_idx))
+        return self._cache[1][index - chunk_idx * self.gen_chunk]
+
+
+class _VirtualPaths:
+    """Lazy path labels for SyntheticManifest error messages."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> str:
+        return f"<synthetic doc {i}>"
+
+
+class _ConstSeq:
+    """Constant-valued virtual size list (no 1M-element tuple)."""
+
+    def __init__(self, value: int, n: int):
+        self._value, self._n = value, n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i) -> int:
+        if isinstance(i, slice):
+            return [self._value] * len(range(*i.indices(self._n)))
+        return self._value
+
+    def __iter__(self):
+        return (self._value for _ in range(self._n))
+
+
+def synthetic_manifest(num_docs: int, vocab_size: int, tokens_per_doc: int,
+                       alpha: float = 1.05, seed: int = 0,
+                       gen_chunk: int = 65536) -> SyntheticManifest:
+    """BASELINE.json config 4 generator as a streamable manifest."""
+    return SyntheticManifest(num_docs, vocab_size, tokens_per_doc,
+                             alpha=alpha, seed=seed, gen_chunk=gen_chunk)
+
+
 def write_corpus(directory, docs: list[bytes]) -> list[str]:
     """Materialize docs as files; returns paths (for a manifest)."""
     from pathlib import Path

@@ -309,7 +309,7 @@ class InvertedIndexModel:
             df_rank = df64[prov_of_rank]
             off_rank = offsets_prov[prov_of_rank]
             order, _ = engine.host_order_offsets(letters, df_rank)
-            return df_rank, off_rank, order
+            return df_rank, off_rank, order, offsets_prov, prov_of_rank
 
         if mesh is None:
             nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 14))
@@ -318,18 +318,31 @@ class InvertedIndexModel:
                     tuple(chunks_dev), stride=stride, out_size=nfetch)
                 post_dev.copy_to_host_async()
                 # overlapped with the in-flight sort + D2H
-                df_rank, off_rank, order = host_views()
+                df_rank, off_rank, order, _, _ = host_views()
                 if self.config.profile_dir:
                     post_dev.block_until_ready()
             with timer.phase("fetch"):
                 postings = np.asarray(post_dev)
+        elif cfg.emit_ownership == "letter":
+            df_rank, off_rank, order, offsets_prov, prov_of_rank = host_views()
+            return self._emit_per_owner(
+                chunks_dev, stride=stride, mesh=mesh, vocab=vocab,
+                letters=letters, remap=remap, df_prov=df_prov, order=order,
+                df_rank=df_rank, prov_of_rank=prov_of_rank, out_dir=out_dir,
+                timer=timer, vocab_size=vocab_size, max_doc_id=max_doc_id,
+                num_pairs=num_pairs, profile=profile)
         else:
-            df_rank, off_rank, order = host_views()
+            df_rank, off_rank, order, offsets_prov, _ = host_views()
             # dispatch + exchange + fetch + host merge in one blocking
             # call; keep it all inside the profiled device phase
+            dist_stats: dict = {}
             with timer.phase("device_index"), profile:
                 postings = dist_engine.dist_sort_prov_windows(
-                    chunks_dev, stride=stride, mesh=mesh)
+                    chunks_dev, stride=stride, mesh=mesh,
+                    offsets_prov=offsets_prov, num_pairs=num_pairs,
+                    stats=dist_stats)
+            for k, v in dist_stats.items():
+                timer.count(k, v)
         host = {
             "df": df_rank, "order": order, "offsets": off_rank,
             "postings": postings, "num_unique": num_pairs,
@@ -340,13 +353,81 @@ class InvertedIndexModel:
         return self._emit_and_report(
             corpus_view, host, out_dir, timer, vocab_size, max_doc_id)
 
+    def _emit_per_owner(self, chunks_dev, *, stride, mesh, vocab, letters,
+                        remap, df_prov, order, df_rank, prov_of_rank,
+                        out_dir, timer, vocab_size, max_doc_id, num_pairs,
+                        profile) -> dict:
+        """Per-owner letter emission (the multi-host emit strategy).
+
+        One ``all_to_all`` keyed by *letter owner* — the reference's
+        reducer ownership (contiguous letter ranges incl. the R > 26
+        degenerate collapse, main.c:129-150) via
+        corpus/scheduler.plan_letter_ranges — then every owner emits
+        only its own letter files from its own pairs.  No host ever
+        holds or merges the global postings array.  On a real pod each
+        host runs only its owner's iteration (``jax.process_index``);
+        this single-controller loop simulates every host.
+        """
+        from ..corpus.scheduler import plan_letter_ranges
+
+        n = mesh.devices.size
+        ranges = plan_letter_ranges(n)
+        owner_of_letter = np.zeros(26, dtype=np.int32)
+        for o, (lo, hi) in enumerate(ranges):
+            owner_of_letter[lo:hi] = o
+        letters = np.asarray(letters)
+        letters_prov = letters[np.asarray(remap)]
+        owner_of_prov = owner_of_letter[letters_prov]
+
+        dist_stats: dict = {}
+        with timer.phase("device_index"), profile:
+            rows = dist_engine.dist_letter_windows(
+                chunks_dev, owner_of_prov, stride=stride, mesh=mesh,
+                stats=dist_stats)
+        for k, v in dist_stats.items():
+            timer.count(k, v)
+
+        df64 = df_prov.astype(np.int64)
+        lines = 0
+        with timer.phase("emit"):
+            for o, row in enumerate(rows):
+                df_o = np.where(owner_of_prov == o, df64, 0)
+                offsets_local = np.cumsum(df_o) - df_o
+                postings_o = dist_engine.merge_owner_runs(
+                    [row], stride, offsets_local, int(df_o.sum()))
+                stats_o = formatter.emit_index(
+                    out_dir, vocab=vocab, letter_of_term=letters,
+                    order=order, df=df_rank,
+                    offsets=offsets_local[prov_of_rank],
+                    postings=postings_o, max_doc_id=max_doc_id,
+                    letter_range=ranges[o])
+                lines += stats_o["lines_written"]
+        timer.count("emit_ownership", "letter")
+        timer.count("letter_owners", n)
+        timer.count("unique_pairs", num_pairs)
+        timer.count("lines_written", lines)
+        return timer.report()
+
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
+        if self.config.emit_ownership == "letter":
+            if self._num_shards() < 2:
+                raise ValueError(
+                    "emit_ownership='letter' requires a multi-chip mesh "
+                    "(device_shards > 1)")
+            if not self._pipelined_eligible(manifest):
+                raise ValueError(
+                    "emit_ownership='letter' requires the pipelined path "
+                    "(native tokenizer available, no checkpoint/skew flags)")
         if self._pipelined_eligible(manifest):
             from ..native import KeyOverflow
 
             try:
                 return self._run_tpu_pipelined(manifest, out_dir, timer)
             except KeyOverflow:
+                if self.config.emit_ownership == "letter":
+                    raise ValueError(
+                        "emit_ownership='letter' cannot fall back to the "
+                        "one-shot engine after packed-key overflow") from None
                 # vocab * stride outgrew int32 keys mid-stream: restart on
                 # the one-shot path (whose general engine sorts two-key).
                 aborted_ms = timer.total_seconds * 1e3

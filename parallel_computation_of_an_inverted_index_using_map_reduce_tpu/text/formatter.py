@@ -37,8 +37,15 @@ def emit_index(
     offsets: np.ndarray,          # (V,) exclusive start of term's postings
     postings: np.ndarray,         # (>=num pairs,) compacted ascending doc ids
     max_doc_id: int,
+    letter_range: tuple[int, int] = (0, ALPHABET_SIZE),
 ) -> dict:
-    """Write the 26 letter files from the device engine's output arrays."""
+    """Write letter files from the device engine's output arrays.
+
+    ``letter_range`` restricts emission to ``[lo, hi)`` — the per-owner
+    emit of the multi-host regime (the reference's reducer letter
+    ownership, main.c:129-150): each owner writes only its own files,
+    so no host ever assembles the global index.
+    """
     output_dir = Path(output_dir)
     os.makedirs(output_dir, exist_ok=True)
     id_strs = _doc_id_str_table(max_doc_id)
@@ -50,7 +57,7 @@ def emit_index(
     letters_in_order = np.asarray(letter_of_term)[order]
     bounds = np.searchsorted(letters_in_order, np.arange(ALPHABET_SIZE + 1))
     lines_written = 0
-    for letter in range(ALPHABET_SIZE):
+    for letter in range(*letter_range):
         lo, hi = int(bounds[letter]), int(bounds[letter + 1])
         out = bytearray()
         for t in order[lo:hi].tolist():
@@ -63,7 +70,8 @@ def emit_index(
         with open(output_dir / letter_filename(letter), "wb") as f:
             f.write(out)
         lines_written += hi - lo
-    return {"lines_written": lines_written, "letters": ALPHABET_SIZE}
+    return {"lines_written": lines_written,
+            "letters": letter_range[1] - letter_range[0]}
 
 
 def emit_grouped(output_dir: str | Path,

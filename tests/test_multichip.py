@@ -167,3 +167,87 @@ def test_pipelined_dist_capacity_overflow_retry(tmp_path):
         m, IndexConfig(backend="tpu", pad_multiple=64, pipeline_chunk_docs=2),
         output_dir=tmp_path / "pipe")
     assert read_letter_files(tmp_path / "pipe") == read_letter_files(tmp_path / "oracle")
+
+
+def test_letter_ownership_emit_matches_merged(tmp_path):
+    """emit_ownership='letter' (per-owner letter emission over a second
+    all_to_all — the reference's reducer ownership, main.c:129-150, at
+    mesh scale) must be byte-identical to the merged emit and track its
+    fetch in stats."""
+    from conftest import read_letter_files
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, build_index, read_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    docs = zipf_corpus(num_docs=64, vocab_size=900, tokens_per_doc=80, seed=13)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64),
+                output_dir=tmp_path / "merged")
+    stats = build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, emit_ownership="letter"),
+        output_dir=tmp_path / "letter")
+    assert stats["emit_ownership"] == "letter"
+    assert stats["letter_owners"] == 8
+    assert stats["dist_valid_pairs"] == stats["unique_pairs"]
+    assert read_letter_files(tmp_path / "letter") == read_letter_files(tmp_path / "merged")
+
+
+def test_letter_ownership_two_owners(tmp_path):
+    """Sub-mesh letter ownership (2 owners over 13 letters each)."""
+    from conftest import read_letter_files
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, build_index, oracle_index, read_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    docs = zipf_corpus(num_docs=30, vocab_size=400, tokens_per_doc=50, seed=21)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    stats = build_index(
+        m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=2,
+                       emit_ownership="letter"),
+        output_dir=tmp_path / "letter2")
+    assert stats["letter_owners"] == 2
+    assert read_letter_files(tmp_path / "letter2") == read_letter_files(tmp_path / "oracle")
+
+
+def test_letter_ownership_requires_mesh():
+    import pytest
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        Manifest,
+    )
+
+    model = InvertedIndexModel(IndexConfig(
+        backend="tpu", device_shards=1, emit_ownership="letter"))
+    with pytest.raises(ValueError, match="multi-chip"):
+        model.run(Manifest(paths=("x",), sizes=(1,)), output_dir="/tmp/nope")
+
+
+def test_letter_ownership_config_validation():
+    import pytest
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import IndexConfig
+
+    with pytest.raises(ValueError, match="emit_ownership"):
+        IndexConfig(emit_ownership="bogus")
+    with pytest.raises(ValueError, match="backend"):
+        IndexConfig(backend="cpu", emit_ownership="letter")
+    with pytest.raises(ValueError, match="pipelined"):
+        IndexConfig(backend="tpu", emit_ownership="letter", pipeline_chunk_docs=0)
