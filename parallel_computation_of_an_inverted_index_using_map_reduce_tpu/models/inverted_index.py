@@ -390,7 +390,7 @@ class InvertedIndexModel:
         df64 = df_prov.astype(np.int64)
         lines = 0
         with timer.phase("emit"):
-            for o, row in enumerate(rows):
+            for o, row in sorted(rows.items()):
                 df_o = np.where(owner_of_prov == o, df64, 0)
                 offsets_local = np.cumsum(df_o) - df_o
                 postings_o = dist_engine.merge_owner_runs(

@@ -178,9 +178,13 @@ def _prov_shuffle_body(window_locals, *, num_shards: int, capacity: int,
         keys_local, K.INT32_MAX, num_shards=num_shards, capacity=capacity,
         stride=stride, owner_of_term=owner_of_term)
     recv_s = lax.sort(recv.reshape(-1))
+    valid = (recv_s < K.INT32_MAX).sum(dtype=jnp.int32)
     return {
         "owned_sorted": recv_s,
-        "valid": (recv_s < K.INT32_MAX).sum(dtype=jnp.int32)[None],
+        "valid": valid[None],
+        # replicated global max -> every process computes the same
+        # fetch-slice shape without seeing the other hosts' counts
+        "max_valid": lax.pmax(valid, SHARD_AXIS),
         "overflow": lax.psum(overflow_local.astype(jnp.int32), SHARD_AXIS),
     }
 
@@ -215,6 +219,7 @@ def _build_prov(mesh: Mesh, num_windows: int, window_local: tuple,
             in_specs=in_specs,
             out_specs={"owned_sorted": shard_spec(),
                        "valid": shard_spec(),
+                       "max_valid": replicated_spec(),
                        "overflow": replicated_spec()},
             check_vma=False,
         ),
@@ -225,12 +230,21 @@ def _build_prov(mesh: Mesh, num_windows: int, window_local: tuple,
 def _exchange_and_fetch_rows(windows, *, stride: int, mesh: Mesh,
                              capacity_factor: float,
                              owner_of_prov: np.ndarray | None,
-                             stats: dict | None) -> list[np.ndarray]:
+                             stats: dict | None) -> dict[int, np.ndarray]:
     """Shared tail of both dist paths: run the (possibly letter-keyed)
     exchange with the capacity-overflow retry, then fetch each owner's
-    valid prefix — counts first (n ints), then one device-side slice at
-    the max count rounded to a reuse granule, so fetched bytes track
-    unique pairs, not the overprovisioned capacity (VERDICT r1 #7)."""
+    valid prefix — per-owner counts, then one device-side slice at the
+    replicated global max count rounded to a reuse granule, so fetched
+    bytes track unique pairs, not the overprovisioned capacity
+    (VERDICT r1 #7).
+
+    Returns ``{owner_id: keys}`` for every *addressable* owner: in a
+    multi-host (multi-controller) run each process sees only its local
+    devices' shards — exactly what the per-owner emit needs, and why
+    the slice shape comes from the device-computed ``max_valid``
+    (replicated) rather than a host-side max over counts this process
+    cannot see.
+    """
     n = mesh.devices.size
     local_total = sum(w.shape[0] for w in windows) // n
     capacity = default_capacity(local_total, n, capacity_factor)
@@ -249,32 +263,42 @@ def _exchange_and_fetch_rows(windows, *, stride: int, mesh: Mesh,
     if capacity < local_total and int(out["overflow"]) > 0:
         out = _build_prov(mesh, len(windows), shapes, n, local_total, stride,
                           True, with_owner)(*args)
-    counts = np.asarray(out["valid"]).reshape(-1)
+    # shard.index[0].start is None for a full-span shard (1-device mesh)
+    counts = {
+        (s.index[0].start or 0): int(np.asarray(s.data)[0])
+        for s in out["valid"].addressable_shards
+    }
     local_len = int(out["owned_sorted"].shape[0]) // n
     nfetch = min(local_len,
-                 _round_up(max(int(counts.max(initial=0)), 1), 1 << 13))
+                 _round_up(max(int(out["max_valid"]), 1), 1 << 13))
     sliced = _build_prefix_slice(mesh, local_len, nfetch)(out["owned_sorted"])
-    owned = np.asarray(sliced).reshape(n, nfetch)
+    rows = {}
+    fetched = 0
+    for s in sliced.addressable_shards:
+        owner = (s.index[0].start or 0) // nfetch
+        row = np.asarray(s.data)
+        rows[owner] = row[: counts[owner]]
+        fetched += row.nbytes
     if stats is not None:
-        stats["dist_fetched_bytes"] = int(owned.nbytes + counts.nbytes)
-        stats["dist_valid_pairs"] = int(counts.sum())
-    return [owned[d, : counts[d]] for d in range(n)]
+        stats["dist_fetched_bytes"] = fetched + 4 * len(counts)
+        stats["dist_valid_pairs"] = int(sum(counts.values()))
+    return rows
 
 
 def dist_letter_windows(windows, owner_of_prov: np.ndarray, *, stride: int,
                         mesh: Mesh, capacity_factor: float = 2.0,
-                        stats: dict | None = None) -> list[np.ndarray]:
+                        stats: dict | None = None) -> dict[int, np.ndarray]:
     """Per-owner-emit tail of the pipelined path: exchange the sharded
     upload windows by letter owner (the reference's reducer letter
     ranges, main.c:129-130, via corpus/scheduler.plan_letter_ranges);
-    returns each owner's valid sorted keys (prov-grouped ascending,
-    docs ascending inside each term).  The letter partition is skewed
-    by construction (SURVEY.md §2.3); the capacity-overflow retry at
-    the provably-safe bound absorbs it.
+    returns ``{owner: keys}`` (prov-grouped ascending, docs ascending
+    inside each term) for every addressable owner.  The letter
+    partition is skewed by construction (SURVEY.md §2.3); the
+    capacity-overflow retry at the provably-safe bound absorbs it.
 
-    In the multi-host regime each host only fetches and emits its own
-    owner's rows (``jax.process_index``); this single-controller
-    version returns all rows so the caller can simulate every host.
+    In the multi-host regime each process receives only its own local
+    owners' rows and emits just those letter files; a single-controller
+    run receives all of them.
     """
     return _exchange_and_fetch_rows(
         windows, stride=stride, mesh=mesh, capacity_factor=capacity_factor,
@@ -340,7 +364,12 @@ def dist_sort_prov_windows(windows, *, stride: int, mesh: Mesh,
     rows = _exchange_and_fetch_rows(
         windows, stride=stride, mesh=mesh, capacity_factor=capacity_factor,
         owner_of_prov=None, stats=stats)
-    return merge_owner_runs(rows, stride, offsets_prov, num_pairs)
+    if len(rows) < mesh.devices.size:
+        raise RuntimeError(
+            "merged postings assembly needs every shard addressable; in a "
+            "multi-host run use emit_ownership='letter' so each host emits "
+            "only its own owners' letters")
+    return merge_owner_runs(rows.values(), stride, offsets_prov, num_pairs)
 
 
 def dist_index(keys, letter_of_term, *, vocab_size: int, max_doc_id: int,

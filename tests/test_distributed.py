@@ -1,0 +1,166 @@
+"""Real multi-host seam: 2 OS processes, a localhost coordinator, and a
+4-device global mesh (2 virtual CPU devices per process) running the
+letter-ownership dist pipeline end-to-end vs the oracle.
+
+This is the reference's "no multi-node story at all" (SURVEY.md §4)
+replaced with the TPU framework's: ``parallel/distributed.initialize``
+(the ``jax.distributed`` seam), cross-process ``all_to_all`` (the DCN
+analogue on CPU), and per-owner letter emission where each process
+writes only its own owners' files (VERDICT r1 #4 + #6).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from conftest import REPO_ROOT, read_letter_files
+
+WORKER = textwrap.dedent("""
+    import sys
+    repo, pid, coord, corpus_dir, out_dir = sys.argv[1:6]
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        load_documents, manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+        plan_letter_ranges,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import engine
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel import (
+        dist_engine, distributed,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel.mesh import (
+        make_mesh, shard_spec, sharding,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text import formatter
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+        tokenize_documents,
+    )
+
+    distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=int(pid))
+    info = distributed.runtime_info()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 4, info
+
+    # Every process tokenizes the same corpus deterministically (in a
+    # real pod each host reads its own shard; the exchange is the same).
+    m = manifest_from_dir(corpus_dir)
+    contents, ids = load_documents(m)
+    corpus = tokenize_documents(contents, ids)
+    stride = len(m) + 2
+    keys = np.unique(
+        corpus.term_ids.astype(np.int64) * stride + corpus.doc_ids)
+    vocab_size = corpus.vocab_size
+    df = np.bincount((keys // stride).astype(np.int64),
+                     minlength=vocab_size).astype(np.int64)
+    order, _ = engine.host_order_offsets(corpus.letter_of_term, df)
+
+    n = 4
+    padded = -(-keys.size // n) * n
+    buf = np.full(padded, dist_engine.K.INT32_MAX, dtype=np.int32)
+    buf[: keys.size] = keys
+
+    mesh = make_mesh(n)
+    sh = sharding(mesh, shard_spec())
+    # multi-controller feed: every process donates its local slice.
+    # Owners are MESH POSITIONS (multi-process device ids are sparse,
+    # e.g. 2048+ on host 1 — never index by device.id).
+    pos_of_device = {d: i for i, d in enumerate(mesh.devices.flat)}
+    local = buf.reshape(n, -1)
+    arrays = [
+        jax.device_put(local[pos_of_device[d]], d)
+        for d in jax.local_devices()
+    ]
+    keys_global = jax.make_array_from_single_device_arrays(
+        (padded,), sh, arrays)
+
+    ranges = plan_letter_ranges(n)
+    owner_of_letter = np.zeros(26, dtype=np.int32)
+    for o, (lo, hi) in enumerate(ranges):
+        owner_of_letter[lo:hi] = o
+    owner_of_term = owner_of_letter[np.asarray(corpus.letter_of_term)]
+
+    rows = dist_engine.dist_letter_windows(
+        [keys_global], owner_of_term, stride=stride, mesh=mesh)
+    local_owner_ids = sorted(rows)
+    expected = sorted(pos_of_device[d] for d in jax.local_devices())
+    assert local_owner_ids == expected, (local_owner_ids, expected)
+
+    df64 = df
+    for o, row in sorted(rows.items()):
+        df_o = np.where(owner_of_term == o, df64, 0)
+        offsets_local = np.cumsum(df_o) - df_o
+        postings_o = dist_engine.merge_owner_runs(
+            [row], stride, offsets_local, int(df_o.sum()))
+        formatter.emit_index(
+            out_dir, vocab=corpus.vocab,
+            letter_of_term=corpus.letter_of_term, order=order, df=df64,
+            offsets=offsets_local, postings=postings_o,
+            max_doc_id=len(m), letter_range=ranges[o])
+    print(f"proc {pid} emitted owners {local_owner_ids}", flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_letter_emit_matches_oracle(tmp_path):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        oracle_index,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    docs = zipf_corpus(num_docs=24, vocab_size=300, tokens_per_doc=60, seed=77)
+    write_corpus(tmp_path / "docs", docs)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(REPO_ROOT), str(pid), coord,
+             str(tmp_path / "docs"), str(out_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:  # no orphans holding the coordinator port
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+
+    m = manifest_from_dir(tmp_path / "docs")
+    oracle_index(m, tmp_path / "oracle")
+    assert read_letter_files(out_dir) == read_letter_files(tmp_path / "oracle")
+    # each process emitted a disjoint half of the owners
+    assert "owners [0, 1]" in outs[0][0]
+    assert "owners [2, 3]" in outs[1][0]
