@@ -47,6 +47,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-chunk-docs", type=int, default=None,
                    help="pipelined fast path: documents per upload window "
                         "(default: auto, two windows; 0 = one-shot engine)")
+    p.add_argument("--host-threads", type=int, default=None,
+                   help="host map-phase threads (default: num_mappers if > 1, "
+                        "else min(cores, 8)); output-invariant")
+    p.add_argument("--emit-ownership", choices=("merged", "letter"),
+                   default="merged",
+                   help="merged: one host writes all 26 files; letter: "
+                        "multi-chip owners emit their own letter ranges "
+                        "(the reference's reducer ownership, multi-host mode)")
     return p
 
 
@@ -65,6 +73,8 @@ def main(argv: list[str] | None = None) -> int:
             collect_skew_stats=args.skew,
             stream_chunk_docs=args.stream_chunk_docs,
             pipeline_chunk_docs=args.pipeline_chunk_docs,
+            host_threads=args.host_threads,
+            emit_ownership=args.emit_ownership,
         )
         stats = build_index(manifest, config)
     except (OSError, ValueError) as e:

@@ -133,7 +133,9 @@ class InvertedIndexModel:
 
         cfg = self.config
         max_doc_id = len(manifest)
-        tok = StreamingTokenizer(use_native=cfg.use_native)
+        threads = cfg.resolved_host_threads()
+        timer.count("host_threads", threads)
+        tok = StreamingTokenizer(use_native=cfg.use_native, num_threads=threads)
         eng = StreamingIndexEngine(
             max_doc_id=max_doc_id, window_pad=cfg.pad_multiple)
         docs_loaded = raw_tokens = pairs_fed = 0

@@ -43,8 +43,9 @@ class StreamingTokenizer:
     token-scale arrays are remapped with one gather.
     """
 
-    def __init__(self, use_native: bool = True):
+    def __init__(self, use_native: bool = True, num_threads: int = 1):
         self._use_native = use_native
+        self._num_threads = num_threads
         self._vocab_ids: dict[bytes, int] = {}
         self._finalized = False
 
@@ -62,7 +63,7 @@ class StreamingTokenizer:
         if self._finalized:
             raise RuntimeError("finalize() already called")
         chunk = tokenize(contents, doc_ids, use_native=self._use_native,
-                         dedup_pairs=True)
+                         dedup_pairs=True, num_threads=self._num_threads)
         vocab_ids = self._vocab_ids
         local2prov = np.empty(chunk.vocab_size, dtype=np.int32)
         next_id = len(vocab_ids)

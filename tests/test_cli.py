@@ -89,3 +89,25 @@ def test_cli_corrupt_checkpoint(tmp_path, capsys):
                "--output-dir", str(tmp_path / "o")])
     assert rc == 2
     assert "error:" in capsys.readouterr().err
+
+
+def test_cli_host_threads_and_emit_ownership(tmp_path, capsys):
+    """New TPU-era flags parse and flow into run stats."""
+    listfile = _mk_corpus(tmp_path)
+    out = tmp_path / "out"
+    rc = main(["2", "3", str(listfile), "--backend", "cpu",
+               "--output-dir", str(out), "--host-threads", "3", "--stats"])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["host_threads"] == 3
+    assert stats["num_mappers"] == 2 and stats["num_reducers"] == 3
+
+
+def test_cli_emit_ownership_letter(tmp_path):
+    listfile = _mk_corpus(tmp_path)
+    out_l, out_o = tmp_path / "l", tmp_path / "o"
+    assert main(["1", "1", str(listfile), "--output-dir", str(out_l),
+                 "--pad-multiple", "64", "--emit-ownership", "letter"]) == 0
+    assert main(["1", "1", str(listfile), "--output-dir", str(out_o),
+                 "--backend", "oracle"]) == 0
+    assert read_letter_files(out_l) == read_letter_files(out_o)
