@@ -100,11 +100,21 @@ def plan_letter_ranges(num_reducers: int) -> tuple[tuple[int, int], ...]:
     return tuple(ranges)
 
 
-def shard_balance_stats(manifest: Manifest, plan: ShardPlan) -> dict:
-    """Bytes per shard + imbalance ratio, for the metrics subsystem."""
-    loads = [sum(manifest.sizes[i] for i in shard) for shard in plan.shards]
+def _balance(loads: list[int]) -> dict:
     mean = sum(loads) / len(loads) if loads else 0.0
     return {
         "bytes_per_shard": loads,
-        "max_over_mean": (max(loads) / mean) if mean else 0.0,
+        "max_over_mean": round(max(loads) / mean, 3) if mean else 0.0,
     }
+
+
+def shard_balance_stats(manifest: Manifest, plan: ShardPlan) -> dict:
+    """Bytes per shard + imbalance ratio, for the metrics subsystem."""
+    return _balance(
+        [sum(manifest.sizes[i] for i in shard) for shard in plan.shards])
+
+
+def window_balance_stats(manifest: Manifest, windows) -> dict:
+    """Balance stats for contiguous ``[lo, hi)`` ranges (the pipelined
+    upload windows) — same metric as :func:`shard_balance_stats`."""
+    return _balance([int(sum(manifest.sizes[lo:hi])) for lo, hi in windows])

@@ -249,6 +249,13 @@ class InvertedIndexModel:
             windows = plan_contiguous_windows(manifest, min(2, max(len(manifest), 1)))
         threads = cfg.resolved_host_threads()
         timer.count("host_threads", threads)
+        # scheduling observability (the reference logs its mapper ranges,
+        # main.c:327): per-window byte loads + imbalance ratio
+        from ..corpus.scheduler import window_balance_stats
+
+        wstats = window_balance_stats(manifest, windows)
+        timer.count("window_plan_bytes", wstats["bytes_per_shard"])
+        timer.count("window_imbalance", wstats["max_over_mean"])
         # Window padding granule; sharded windows must also split evenly
         # over the mesh (lcm, not product: a power-of-two granule on a
         # power-of-two mesh needs no extra padding).

@@ -13,17 +13,23 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# MRI_TPU_TESTS_ON_TPU=1 runs the suite against the real chip instead
+# (used to prove Pallas kernels/XLA programs compile on hardware —
+# VERDICT r1 #3); default is 8 virtual CPU devices.
+ON_TPU = os.environ.get("MRI_TPU_TESTS_ON_TPU", "").lower() in ("1", "true", "yes")
+if not ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The axon sitecustomize force-selects the TPU platform via jax.config,
 # which overrides JAX_PLATFORMS — override it back before any backend
 # initializes so tests really run on 8 virtual CPU devices.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

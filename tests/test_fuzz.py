@@ -120,3 +120,31 @@ def test_backends_agree_on_byte_soup(tmp_path, seed):
     assert read_letter_files(tmp_path / "dist") == golden
     build_index(m, IndexConfig(backend="cpu"), output_dir=tmp_path / "cpu")
     assert read_letter_files(tmp_path / "cpu") == golden
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_mt_and_letter_emit_agree_on_byte_soup(tmp_path, seed):
+    """Multithreaded scan and letter-ownership emit under byte soup."""
+    if not native.available():
+        pytest.skip("letter emit requires the pipelined (native) path")
+    docs = _byte_soup_docs(seed, 25)
+    ids = list(range(1, len(docs) + 1))
+    if native.available():
+        st = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=1)
+        mt = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=5)
+        np.testing.assert_array_equal(st.term_ids, mt.term_ids)
+        np.testing.assert_array_equal(st.doc_ids, mt.doc_ids)
+        np.testing.assert_array_equal(st.vocab, mt.vocab)
+    paths = []
+    for i, doc in enumerate(docs):
+        p = tmp_path / f"doc{i:03d}.bin"
+        p.write_bytes(doc)
+        paths.append(str(p))
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    golden = read_letter_files(tmp_path / "oracle")
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64,
+                               emit_ownership="letter", host_threads=3),
+                output_dir=tmp_path / "letter")
+    assert read_letter_files(tmp_path / "letter") == golden
