@@ -153,11 +153,13 @@ def _bench_scale() -> int:
 
     num_docs = int(os.environ.get("MRI_TPU_SCALE_DOCS", 1_000_000))
     vocab = int(os.environ.get("MRI_TPU_SCALE_VOCAB", 100_000))
+    shards = int(os.environ.get("MRI_TPU_SCALE_SHARDS", 0))  # 0 = all devices
     manifest = synthetic.synthetic_manifest(
         num_docs=num_docs, vocab_size=vocab, tokens_per_doc=40, seed=11)
     out_dir = tempfile.mkdtemp(prefix="bench_scale_")
     model = InvertedIndexModel(IndexConfig(
         backend="tpu", output_dir=out_dir,
+        device_shards=shards if shards else None,
         stream_chunk_docs=int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))))
     t0 = time.perf_counter()
     stats = model.run(manifest)
@@ -172,7 +174,9 @@ def _bench_scale() -> int:
         "unique_terms": stats.get("unique_terms"),
         "unique_pairs": stats.get("unique_pairs"),
         "wall_s": round(wall, 2),
-        "accumulator_capacity": stats.get("accumulator_capacity"),
+        "accumulator_capacity": stats.get(
+            "accumulator_capacity", stats.get("accumulator_capacity_per_owner")),
+        "device_shards": stats.get("device_shards", 1),
         "stream_windows": stats.get("stream_windows"),
     }
     if os.environ.get("MRI_TPU_SCALE_CROSSCHECK"):

@@ -159,7 +159,5 @@ class IndexConfig:
                 raise ValueError(
                     "stream_chunk_docs is incompatible with collect_skew_stats "
                     "(per-window pair ids are discarded after each merge)")
-            if self.device_shards is not None and self.device_shards > 1:
-                raise ValueError(
-                    "stream_chunk_docs is incompatible with device_shards > 1 "
-                    "(the streaming accumulator is single-chip)")
+            # device_shards > 1 routes to the distributed streaming
+            # accumulator (parallel/dist_streaming.py)

@@ -124,6 +124,9 @@ class InvertedIndexModel:
         unique pairs).  Byte-identical output to the one-shot path
         (tests/test_streaming.py).  ``checkpoint_path`` is ignored here
         — the accumulator itself is the evolving map-phase state.
+        On a mesh (device_shards > 1) the accumulator is hash-sharded
+        per owner (parallel/dist_streaming.py) — BASELINE config 5's
+        streaming-on-a-mesh regime.
         """
         import types
 
@@ -132,6 +135,8 @@ class InvertedIndexModel:
         from ..text.streaming import StreamingTokenizer
 
         cfg = self.config
+        if self._num_shards() > 1:
+            return self._run_tpu_streaming_dist(manifest, out_dir, timer)
         max_doc_id = len(manifest)
         threads = cfg.resolved_host_threads()
         timer.count("host_threads", threads)
@@ -171,6 +176,92 @@ class InvertedIndexModel:
         with timer.phase("fetch"):
             host = {k: np.asarray(v) for k, v in out.items()}
             host["num_unique"] = int(host["num_unique"])
+        corpus_view = types.SimpleNamespace(vocab=vocab, letter_of_term=letters)
+        return self._emit_and_report(
+            corpus_view, host, out_dir, timer, vocab_size, max_doc_id)
+
+    def _run_tpu_streaming_dist(self, manifest: Manifest, out_dir: str,
+                                timer: PhaseTimer) -> dict:
+        """Streaming + mesh: per-window ICI shuffle into hash-sharded
+        bounded accumulators (parallel/dist_streaming.py).  Per-chip
+        memory is O(unique pairs / n); output byte-identical to every
+        other path (tests/test_dist_streaming.py)."""
+        import types
+
+        from ..corpus.manifest import iter_document_chunks
+        from ..parallel.dist_streaming import DistStreamingIndexEngine
+        from ..text.streaming import StreamingTokenizer
+
+        cfg = self.config
+        num_shards = self._num_shards()
+        mesh = make_mesh(num_shards)
+        max_doc_id = len(manifest)
+        stride = max_doc_id + 2
+        threads = cfg.resolved_host_threads()
+        timer.count("host_threads", threads)
+        timer.count("device_shards", num_shards)
+        tok = StreamingTokenizer(use_native=cfg.use_native, num_threads=threads)
+        eng = DistStreamingIndexEngine(
+            max_doc_id=max_doc_id, mesh=mesh, window_pad=cfg.pad_multiple)
+        docs_loaded = raw_tokens = 0
+        profile = (
+            jax.profiler.trace(cfg.profile_dir)
+            if cfg.profile_dir else contextlib.nullcontext()
+        )
+        with timer.phase("stream"), profile:
+            for contents, ids in iter_document_chunks(manifest, cfg.stream_chunk_docs):
+                chunk = tok.feed(contents, ids)
+                docs_loaded += len(contents)
+                raw_tokens += chunk.raw_tokens
+                eng.feed(chunk.prov_term_ids, chunk.doc_ids, tok.vocab_size)
+        with timer.phase("finalize_vocab"):
+            vocab, remap, letters = tok.finalize()
+        vocab_size = int(vocab.shape[0])
+        timer.count("documents", docs_loaded)
+        timer.count("tokens", raw_tokens)
+        timer.count("unique_terms", vocab_size)
+        timer.count("stream_windows", eng.windows_fed)
+        timer.count("accumulator_capacity_per_owner", eng.capacity)
+        timer.count("accumulator_mode", eng.mode)
+        timer.count("merge_retries", eng.merge_retries)
+
+        dist_stats: dict = {}
+        with timer.phase("fetch"):
+            mode, rows = eng.finalize(stats=dist_stats)
+        for k, v in dist_stats.items():
+            timer.count(k, v)
+        sizes = [(r[0].size if mode == "pairs" else r.size)
+                 for r in rows.values()]
+        num_pairs = int(sum(sizes))
+        if num_pairs == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        # vocab-scale host views in prov space, then the O(N) owner-run
+        # merge (same math as the pipelined dist tail)
+        if mode == "pairs":
+            terms = np.concatenate(
+                [r[0].astype(np.int64) for r in rows.values()])
+        else:
+            terms = np.concatenate([r // stride for r in rows.values()])
+        df_prov = np.bincount(terms, minlength=vocab_size).astype(np.int64)
+        offsets_prov = np.cumsum(df_prov) - df_prov
+        if mode == "pairs":
+            postings = dist_engine.merge_owner_pair_runs(
+                rows.values(), offsets_prov, num_pairs)
+        else:
+            postings = dist_engine.merge_owner_runs(
+                rows.values(), stride, offsets_prov, num_pairs)
+        prov_of_rank = np.empty(vocab_size, dtype=np.int64)
+        prov_of_rank[remap] = np.arange(vocab_size)
+        df_rank = df_prov[prov_of_rank]
+        order, _ = engine.host_order_offsets(letters, df_rank)
+        host = {
+            "df": df_rank, "order": order,
+            "offsets": offsets_prov[prov_of_rank],
+            "postings": postings, "num_unique": num_pairs,
+        }
         corpus_view = types.SimpleNamespace(vocab=vocab, letter_of_term=letters)
         return self._emit_and_report(
             corpus_view, host, out_dir, timer, vocab_size, max_doc_id)

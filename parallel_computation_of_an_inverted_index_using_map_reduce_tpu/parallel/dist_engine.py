@@ -315,10 +315,24 @@ def _build_prefix_slice(mesh: Mesh, local_len: int, nfetch: int):
         in_specs=shard_spec(), out_specs=shard_spec(), check_vma=False))
 
 
+def _scatter_run(term: np.ndarray, doc: np.ndarray,
+                 offsets_prov: np.ndarray, postings: np.ndarray) -> None:
+    """Scatter one owner's (term-grouped ascending) run into the global
+    prov-grouped postings array — vectorized, collision-free because
+    every term lives on exactly one owner."""
+    change = np.empty(term.shape[0], dtype=bool)
+    change[0] = True
+    np.not_equal(term[1:], term[:-1], out=change[1:])
+    starts = np.flatnonzero(change)
+    run_of_elem = np.cumsum(change) - 1
+    within = np.arange(term.shape[0], dtype=np.int64) - starts[run_of_elem]
+    postings[offsets_prov[term] + within] = doc
+
+
 def merge_owner_runs(rows, stride: int, offsets_prov: np.ndarray,
                      num_pairs: int) -> np.ndarray:
-    """O(N) host merge of per-owner sorted key runs into the global
-    prov-grouped postings array.
+    """O(N) host merge of per-owner sorted *packed-key* runs into the
+    global prov-grouped postings array.
 
     Each ``rows[d]`` is owner d's valid keys, ascending — grouped by
     prov term with docs ascending inside each group — and every term's
@@ -328,17 +342,19 @@ def merge_owner_runs(rows, stride: int, offsets_prov: np.ndarray,
     """
     postings = np.empty(max(num_pairs, 1), dtype=np.int32)
     for row in rows:
-        if row.size == 0:
-            continue
-        term = row // stride
-        change = np.empty(term.shape[0], dtype=bool)
-        change[0] = True
-        np.not_equal(term[1:], term[:-1], out=change[1:])
-        starts = np.flatnonzero(change)
-        run_of_elem = np.cumsum(change) - 1
-        within = np.arange(term.shape[0], dtype=np.int64) - starts[run_of_elem]
-        dest = offsets_prov[term] + within
-        postings[dest] = row % stride
+        if row.size:
+            _scatter_run(row // stride, row % stride, offsets_prov, postings)
+    return postings[:num_pairs]
+
+
+def merge_owner_pair_runs(rows, offsets_prov: np.ndarray,
+                          num_pairs: int) -> np.ndarray:
+    """Pair-mode variant of :func:`merge_owner_runs`: each ``rows[d]``
+    is ``(terms, docs)`` sorted by (term, doc)."""
+    postings = np.empty(max(num_pairs, 1), dtype=np.int32)
+    for term, doc in rows:
+        if term.size:
+            _scatter_run(term.astype(np.int64), doc, offsets_prov, postings)
     return postings[:num_pairs]
 
 

@@ -1,0 +1,137 @@
+"""Distributed streaming accumulator (parallel/dist_streaming.py):
+streaming + mesh in one path — BASELINE config 5's regime.  Must be
+byte-identical to the oracle and bounded per owner."""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel.dist_streaming import (
+    DistStreamingIndexEngine,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel.mesh import (
+    make_mesh,
+)
+
+
+@pytest.fixture(scope="module")
+def corpus_fixture(tmp_path_factory):
+    root = tmp_path_factory.mktemp("dist_stream")
+    docs = zipf_corpus(num_docs=120, vocab_size=1200, tokens_per_doc=90,
+                       alpha=1.1, seed=31)
+    paths = write_corpus(root / "docs", docs)
+    write_manifest(root / "list.txt", paths)
+    m = read_manifest(root / "list.txt")
+    oracle_index(m, root / "oracle")
+    return m, read_letter_files(root / "oracle")
+
+
+@pytest.mark.parametrize("chunk", [7, 40, 1000])
+def test_dist_streaming_matches_oracle(corpus_fixture, tmp_path, chunk):
+    m, golden = corpus_fixture
+    report = InvertedIndexModel(IndexConfig(
+        backend="tpu", stream_chunk_docs=chunk, pad_multiple=256)).run(
+        m, output_dir=tmp_path)
+    assert report["device_shards"] == 8
+    assert report["stream_windows"] == -(-len(m) // chunk)
+    assert read_letter_files(tmp_path) == golden
+
+
+def test_dist_streaming_matches_single_chip(corpus_fixture, tmp_path):
+    m, golden = corpus_fixture
+    InvertedIndexModel(IndexConfig(
+        backend="tpu", stream_chunk_docs=25, device_shards=1,
+        pad_multiple=256)).run(m, output_dir=tmp_path / "single")
+    InvertedIndexModel(IndexConfig(
+        backend="tpu", stream_chunk_docs=25, device_shards=4,
+        pad_multiple=256)).run(m, output_dir=tmp_path / "mesh4")
+    assert read_letter_files(tmp_path / "single") == read_letter_files(
+        tmp_path / "mesh4") == golden
+
+
+def test_engine_capacity_growth_and_retry():
+    """A tiny initial capacity must grow (retry path) without losing
+    pairs — skewed keys land on one owner to force per-owner overflow."""
+    mesh = make_mesh(4)
+    stride = 10
+    eng = DistStreamingIndexEngine(
+        max_doc_id=8, mesh=mesh, window_pad=64, initial_capacity=64)
+    rng = np.random.default_rng(5)
+    want = set()
+    for _ in range(6):
+        # terms all ≡ 0 (mod 4): every pair lands on owner 0
+        terms = (rng.integers(0, 400, size=300) * 4).astype(np.int32)
+        docs = rng.integers(1, 9, size=300).astype(np.int32)
+        eng.feed(terms, docs, vocab_size_so_far=1600)
+        want.update(int(t) * stride + int(d) for t, d in zip(terms, docs))
+    mode, rows = eng.finalize()
+    assert mode == "packed"
+    got = sorted(int(k) for r in rows.values() for k in r)
+    assert got == sorted(want)
+    assert eng.capacity >= len(want)
+    assert eng.merge_retries >= 1 or eng.capacity > 64
+
+
+def test_engine_empty_feed_and_finalize():
+    mesh = make_mesh(2)
+    eng = DistStreamingIndexEngine(max_doc_id=3, mesh=mesh)
+    eng.feed(np.empty(0, np.int32), np.empty(0, np.int32), vocab_size_so_far=0)
+    assert eng.finalize() == ("packed", {})
+
+
+def test_pair_mode_switch_mid_stream():
+    """A vocabulary that outgrows int32 packing mid-stream switches the
+    accumulator to pair mode without losing any pairs."""
+    mesh = make_mesh(4)
+    max_doc_id = 1 << 20  # stride 2^20+2: only ~2047 terms can pack
+    eng = DistStreamingIndexEngine(
+        max_doc_id=max_doc_id, mesh=mesh, window_pad=64,
+        initial_capacity=1 << 12)
+    rng = np.random.default_rng(9)
+    want = set()
+    vocab = 100
+    for step in range(4):
+        terms = rng.integers(0, vocab, size=200).astype(np.int32)
+        docs = rng.integers(1, max_doc_id + 1, size=200).astype(np.int32)
+        eng.feed(terms, docs, vocab_size_so_far=vocab)
+        want.update(zip(terms.tolist(), docs.tolist()))
+        if step == 1:
+            vocab = 5000  # no longer packs with this stride
+    assert eng.mode == "pairs"
+    mode, rows = eng.finalize()
+    assert mode == "pairs"
+    got = sorted((int(t), int(d)) for tt, dd in rows.values()
+                 for t, d in zip(tt, dd))
+    assert got == sorted(want)
+
+
+def test_pair_mode_from_first_window(tmp_path):
+    """Model-level: corpus whose doc count forces pair mode from window
+    one must still match the oracle byte-for-byte (synthetic manifest
+    inflates max_doc_id, not actual docs)."""
+    docs = zipf_corpus(num_docs=40, vocab_size=600, tokens_per_doc=50, seed=3)
+    paths = write_corpus(tmp_path / "docs", docs)
+    # pad the manifest with unreadable ghost entries to blow up
+    # max_doc_id (they are warned about and skipped, main.c:97-100)
+    ghost = [str(tmp_path / "missing" / f"g{i}.txt") for i in range(3)]
+    write_manifest(tmp_path / "list.txt", paths + ghost * 1)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(IndexConfig(
+        backend="tpu", stream_chunk_docs=8, pad_multiple=256)).run(
+        m, output_dir=tmp_path / "out")
+    assert read_letter_files(tmp_path / "out") == read_letter_files(tmp_path / "oracle")

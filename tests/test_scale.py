@@ -59,8 +59,8 @@ def test_multichip_matches_oracle_at_scale(zipf_fixture, tmp_path):
 def test_streaming_matches_oracle_at_scale(zipf_fixture, tmp_path):
     m, golden, _ = zipf_fixture
     report = InvertedIndexModel(IndexConfig(
-        backend="tpu", stream_chunk_docs=64, pad_multiple=1 << 14)).run(
-        m, output_dir=tmp_path)
+        backend="tpu", stream_chunk_docs=64, pad_multiple=1 << 14,
+        device_shards=1)).run(m, output_dir=tmp_path)
     assert report["stream_windows"] >= 6
     # bounded: unique pairs fit the accumulator's initial 2^18 capacity,
     # so the 240k-token stream must never have forced a growth step

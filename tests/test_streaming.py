@@ -123,8 +123,11 @@ def test_config_rejects_streaming_incompatible_options(tmp_path):
         IndexConfig(stream_chunk_docs=4, checkpoint_path=str(tmp_path / "c.npz"))
     with pytest.raises(ValueError, match="collect_skew_stats"):
         IndexConfig(stream_chunk_docs=4, collect_skew_stats=True)
-    with pytest.raises(ValueError, match="device_shards"):
-        IndexConfig(stream_chunk_docs=4, device_shards=2)
+    # streaming + mesh is a supported combination now (the distributed
+    # streaming accumulator, parallel/dist_streaming.py)
+    IndexConfig(stream_chunk_docs=4, device_shards=2)
+    with pytest.raises(ValueError, match="emit_ownership"):
+        IndexConfig(stream_chunk_docs=4, emit_ownership="letter")
 
 
 def test_streaming_engine_matches_oracle_postings():
