@@ -47,6 +47,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-chunk-docs", type=int, default=None,
                    help="pipelined fast path: documents per upload window "
                         "(default: auto, two windows; 0 = one-shot engine)")
+    p.add_argument("--overlap-tail-fraction", type=float, default=None,
+                   help="windowed overlap plan: this fraction of corpus "
+                        "bytes (the last doc range) is indexed on host "
+                        "while earlier windows' device sorts + fetches fly "
+                        "in the background (single chip; hides link RTT)")
     p.add_argument("--host-threads", type=int, default=None,
                    help="host map-phase threads (default: num_mappers if > 1, "
                         "else min(cores, 8)); output-invariant")
@@ -73,6 +78,7 @@ def main(argv: list[str] | None = None) -> int:
             collect_skew_stats=args.skew,
             stream_chunk_docs=args.stream_chunk_docs,
             pipeline_chunk_docs=args.pipeline_chunk_docs,
+            overlap_tail_fraction=args.overlap_tail_fraction,
             host_threads=args.host_threads,
             emit_ownership=args.emit_ownership,
         )

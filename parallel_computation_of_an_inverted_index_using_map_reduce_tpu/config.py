@@ -72,6 +72,15 @@ class IndexConfig:
     # window 1's upload overlaps window 2's tokenize); 0 disables the
     # pipelined path entirely (forces the one-shot engine).
     pipeline_chunk_docs: int | None = None
+    # Windowed overlap plan (single-chip pipelined variant for
+    # high-latency host<->device links): this fraction of corpus bytes —
+    # the LAST contiguous doc range — is indexed on the host (numpy sort
+    # of its packed keys) while the earlier windows' device sorts and
+    # async fetches are still in flight, so the device round-trip
+    # latency hides under host work instead of serializing after it.
+    # Emit concatenates the per-window runs in doc order (no merge
+    # pass).  None = disabled (plain pipelined plan); must be in (0, 1).
+    overlap_tail_fraction: float | None = None
     # Host map-phase threads for the native tokenizer (contiguous
     # byte-balanced doc ranges, merged at vocab scale — output-identical
     # at any count).  None = ``num_mappers`` if > 1, else auto
@@ -126,6 +135,28 @@ class IndexConfig:
         if self.backend not in ("tpu",) and self.pipeline_chunk_docs is not None:
             raise ValueError(
                 f"pipeline_chunk_docs requires backend='tpu', got backend={self.backend!r}")
+        if self.overlap_tail_fraction is not None:
+            if not 0.0 < self.overlap_tail_fraction < 1.0:
+                raise ValueError(
+                    "overlap_tail_fraction must be in (0, 1) or None, "
+                    f"got {self.overlap_tail_fraction}")
+            if self.backend != "tpu":
+                raise ValueError(
+                    "overlap_tail_fraction requires backend='tpu', "
+                    f"got backend={self.backend!r}")
+            if self.pipeline_chunk_docs == 0:
+                raise ValueError(
+                    "overlap_tail_fraction requires the pipelined path "
+                    "(pipeline_chunk_docs=0 disables it)")
+            if self.stream_chunk_docs is not None:
+                raise ValueError(
+                    "overlap_tail_fraction is incompatible with "
+                    "stream_chunk_docs (the streaming engine has its own "
+                    "window pipeline)")
+            if self.emit_ownership == "letter":
+                raise ValueError(
+                    "overlap_tail_fraction is single-chip; "
+                    "emit_ownership='letter' is the multi-chip emit path")
         if self.host_threads is not None and self.host_threads < 1:
             raise ValueError(
                 f"host_threads must be >= 1 or None (auto), got {self.host_threads}")

@@ -81,6 +81,39 @@ def plan_contiguous_windows(manifest: Manifest,
     return tuple((cuts[t], cuts[t + 1]) for t in range(num_windows))
 
 
+def plan_fraction_windows(manifest: Manifest,
+                          fractions) -> tuple[tuple[int, int], ...]:
+    """Contiguous doc ranges ``[lo, hi)`` with byte shares ~ ``fractions``.
+
+    Generalizes :func:`plan_contiguous_windows` to uneven shares (the
+    windowed overlap plan's device windows vs host tail): cut points are
+    placed at the cumulative-byte targets ``total * sum(fractions[:k])``.
+    ``fractions`` must be positive and sum to ~1; every doc lands in
+    exactly one range (degenerate manifests yield empty ranges, not
+    errors).
+    """
+    fr = [float(f) for f in fractions]
+    if not fr or any(f <= 0 for f in fr):
+        raise ValueError(f"fractions must be positive, got {fractions!r}")
+    if abs(sum(fr) - 1.0) > 1e-6:
+        raise ValueError(f"fractions must sum to 1, got sum={sum(fr)}")
+    n = len(manifest)
+    total = sum(manifest.sizes)
+    cuts = [0]
+    d = 0
+    cum = 0
+    acc = 0.0
+    for f in fr[:-1]:
+        acc += f
+        target = total * acc
+        while d < n and cum < target:
+            cum += manifest.sizes[d]
+            d += 1
+        cuts.append(d)
+    cuts.append(n)
+    return tuple((cuts[t], cuts[t + 1]) for t in range(len(fr)))
+
+
 def plan_letter_ranges(num_reducers: int) -> tuple[tuple[int, int], ...]:
     """Contiguous letter ranges per reduce partition.
 

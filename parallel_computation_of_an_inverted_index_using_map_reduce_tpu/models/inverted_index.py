@@ -508,6 +508,145 @@ class InvertedIndexModel:
         timer.count("lines_written", lines)
         return timer.report()
 
+    def _run_tpu_overlap(self, manifest: Manifest, out_dir: str,
+                         timer: PhaseTimer) -> dict:
+        """Windowed overlap plan: device round trips hide under the scan.
+
+        The pipelined plan still serializes its one device->host fetch
+        *after* tokenization ends; on a high-RTT host<->device link
+        (tunneled TPU: ~60 ms each way, measured) that round trip
+        dominates the run.  Here the corpus is cut into contiguous
+        byte-weighted doc windows (corpus/scheduler.plan_fraction_windows):
+        each *device* window's packed provisional keys are uploaded,
+        sorted and fetched asynchronously the moment the window is
+        scanned — those chains progress in the background while the host
+        scans later windows — and the last ``overlap_tail_fraction`` of
+        bytes never goes to the device at all: its keys are sorted with
+        numpy while the fetches are still in flight.  Windows are
+        contiguous ascending doc ranges and a window's sorted keys give
+        docs ascending per term, so each term's global postings list is
+        the concatenation of its per-window segments in window order —
+        the native multi-run emit renders them with no merge pass
+        (native/tokenizer.cc mri_emit_runs).
+
+        The reference's strict map->reduce join barrier (main.c:367-369)
+        forbids exactly this overlap; dissolving it — while keeping the
+        output byte-identical — is the point of the redesign.
+        """
+        from .. import native
+        from ..corpus.manifest import iter_document_ranges
+        from ..corpus.scheduler import plan_fraction_windows, window_balance_stats
+
+        cfg = self.config
+        max_doc_id = len(manifest)
+        stride = max_doc_id + 2
+        tail_f = cfg.overlap_tail_fraction
+        # Two device windows when there is enough corpus to cut: the
+        # first window's fetch is issued as early as possible, the
+        # second balances upload sizes.
+        dev_f = 1.0 - tail_f
+        if len(manifest) >= 8:
+            fractions = (0.55 * dev_f, 0.45 * dev_f, tail_f)
+        else:
+            fractions = (dev_f, tail_f)
+        windows = plan_fraction_windows(manifest, fractions)
+        threads = cfg.resolved_host_threads()
+        timer.count("host_threads", threads)
+        wstats = window_balance_stats(manifest, windows)
+        timer.count("window_plan_bytes", wstats["bytes_per_shard"])
+        granule = min(1 << 14, cfg.pad_multiple)
+
+        dev_handles: list[tuple] = []  # (in-flight fetch, nvalid, term ids)
+        tail_keys = None
+        num_pairs = docs_loaded = 0
+        profile = (
+            jax.profiler.trace(cfg.profile_dir)
+            if cfg.profile_dir else contextlib.nullcontext()
+        )
+        stream = native.NativeKeyStream(stride, num_threads=threads)
+        try:
+            with profile, timer.phase("tokenize_feed"):
+                for wi, (contents, ids) in enumerate(
+                        iter_document_ranges(manifest, windows)):
+                    docs_loaded += len(contents)
+                    keys, _ = stream.feed(contents, ids)
+                    num_pairs += int(keys.size)
+                    if keys.size == 0:
+                        continue
+                    if wi == len(windows) - 1:
+                        tail_keys = keys
+                        continue
+                    padded = _round_up(keys.size, granule)
+                    terms = keys // stride
+                    if int(keys.max()) // stride <= 0xFFFE:
+                        # half-bandwidth uint16 window
+                        buf = engine.pack_u16_feed(terms, keys % stride, padded)
+                    else:
+                        buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
+                        buf[: keys.size] = keys
+                    post = engine.sort_prov_chunks(
+                        (jax.device_put(buf),), stride=stride, out_size=padded)
+                    post.copy_to_host_async()
+                    dev_handles.append((post, int(keys.size), terms))
+            with timer.phase("finalize_vocab"):
+                vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
+        finally:
+            stream.close()
+
+        vocab_size = int(vocab.shape[0])
+        timer.count("documents", docs_loaded)
+        timer.count("tokens", raw_tokens)
+        timer.count("unique_terms", vocab_size)
+        timer.count("upload_windows", len(dev_handles))
+        timer.count("overlap_tail_fraction", tail_f)
+        dev_pairs = sum(n for _, n, _ in dev_handles)
+        timer.count("device_pairs", dev_pairs)
+        timer.count("unique_pairs", num_pairs)
+        timer.count("device_shards", 1)
+        if num_pairs == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        with timer.phase("host_tail"):
+            if tail_keys is not None and tail_keys.size:
+                tail_sorted = np.sort(tail_keys)
+                tail_docs = (tail_sorted % stride).astype(np.uint16)
+                tail_terms = tail_sorted // stride
+            else:
+                tail_docs = np.empty(0, np.uint16)
+                tail_terms = np.empty(0, np.int64)
+
+        with timer.phase("host_views"):
+            # All vocab-scale, all while the device fetches are in
+            # flight: emit order, plus per-run rank-space segment tables.
+            prov_of_rank = np.empty(vocab_size, dtype=np.int64)
+            prov_of_rank[remap] = np.arange(vocab_size)
+            df_rank = df_prov.astype(np.int64)[prov_of_rank]
+            order, _ = engine.host_order_offsets(letters, df_rank)
+            runs_meta = []
+            for _, nvalid, terms in dev_handles:
+                c = np.bincount(terms, minlength=vocab_size).astype(np.int64)
+                off = np.cumsum(c) - c
+                runs_meta.append((off[prov_of_rank], c[prov_of_rank]))
+            c = np.bincount(tail_terms, minlength=vocab_size).astype(np.int64)
+            off = np.cumsum(c) - c
+            tail_meta = (off[prov_of_rank], c[prov_of_rank])
+
+        with timer.phase("fetch"):
+            fetched = [np.asarray(post) for post, _, _ in dev_handles]
+
+        with timer.phase("emit"):
+            runs = [
+                (arr, off_rank, c_rank)
+                for arr, (off_rank, c_rank) in zip(fetched, runs_meta)
+            ]
+            runs.append((tail_docs, *tail_meta))
+            bytes_written = native.emit_native_runs(out_dir, vocab, order, runs)
+        timer.count("lines_written", vocab_size)
+        timer.count("bytes_written", bytes_written)
+        return timer.report()
+
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
         if self.config.emit_ownership == "letter":
             if self._num_shards() < 2:
@@ -518,10 +657,16 @@ class InvertedIndexModel:
                 raise ValueError(
                     "emit_ownership='letter' requires the pipelined path "
                     "(native tokenizer available, no checkpoint/skew flags)")
+        if self.config.overlap_tail_fraction is not None and self._num_shards() > 1:
+            raise ValueError(
+                "overlap_tail_fraction is a single-chip plan "
+                "(device_shards > 1 selects the multi-chip engine)")
         if self._pipelined_eligible(manifest):
             from ..native import KeyOverflow
 
             try:
+                if self.config.overlap_tail_fraction is not None:
+                    return self._run_tpu_overlap(manifest, out_dir, timer)
                 return self._run_tpu_pipelined(manifest, out_dir, timer)
             except KeyOverflow:
                 if self.config.emit_ownership == "letter":

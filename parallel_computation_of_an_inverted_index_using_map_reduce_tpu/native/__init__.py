@@ -148,6 +148,15 @@ def load():
             ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_char_p,
         ]
+        lib.mri_emit_runs.restype = ctypes.c_int64
+        lib.mri_emit_runs.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint16)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_char_p,
+        ]
         _lib = lib
     except (OSError, RuntimeError) as e:
         _lib_error = str(e)
@@ -351,6 +360,53 @@ def host_index_native(contents: list[bytes], doc_ids: list[int],
         "lines_written": int(stats.vocab_size),
         "bytes_written": int(stats.bytes_written),
     }
+
+
+def emit_native_runs(out_dir, vocab: np.ndarray, order, runs) -> int:
+    """Multi-run native emit: each term's postings list is the
+    concatenation of its per-run segments in run order.
+
+    ``runs`` is a sequence of ``(postings_u16, offsets, counts)`` —
+    postings a uint16 array, offsets/counts rank-space int64 arrays.
+    Used by the windowed overlap plan, whose device-window fetches and
+    host tail are contiguous ascending doc ranges (so concatenation in
+    run order IS the merge).  Byte-identical to a single merged
+    :func:`emit_native` call.  Returns total bytes written.
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native emit unavailable: {_lib_error}")
+    os.makedirs(out_dir, exist_ok=True)
+    vocab_size = int(vocab.shape[0])
+    width = vocab.dtype.itemsize if vocab_size else 1
+    vbuf = np.ascontiguousarray(vocab).view(np.uint8)
+    order64 = np.ascontiguousarray(order, dtype=np.int64)
+    n = len(runs)
+    keep = []  # contiguous arrays outliving the call
+    bases = (ctypes.POINTER(ctypes.c_uint16) * max(n, 1))()
+    offs = (ctypes.POINTER(ctypes.c_int64) * max(n, 1))()
+    cnts = (ctypes.POINTER(ctypes.c_int64) * max(n, 1))()
+    for i, (postings, offsets, counts) in enumerate(runs):
+        p = np.ascontiguousarray(postings, dtype=np.uint16)
+        o = np.ascontiguousarray(offsets, dtype=np.int64)
+        c = np.ascontiguousarray(counts, dtype=np.int64)
+        keep.extend((p, o, c))
+        bases[i] = p.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+        offs[i] = o.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        cnts[i] = c.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    null8 = ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_uint8))
+    null64 = ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64))
+    rc = lib.mri_emit_runs(
+        vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if vocab_size else null8,
+        ctypes.c_int32(vocab_size), ctypes.c_int32(width),
+        order64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if vocab_size else null64,
+        ctypes.c_int32(n), bases, offs, cnts,
+        str(out_dir).encode(),
+    )
+    del keep
+    if rc < 0:
+        raise OSError(f"native emit failed writing to {out_dir!r}")
+    return int(rc)
 
 
 def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings) -> int:

@@ -1,0 +1,217 @@
+"""Windowed overlap plan (models/inverted_index._run_tpu_overlap):
+device windows are sorted + fetched asynchronously while the host scans
+later windows; the last ``overlap_tail_fraction`` of bytes is indexed on
+host; emit concatenates the per-window runs (native mri_emit_runs).
+
+The invariant under test: for ANY tail fraction, output is byte-identical
+to the oracle / goldens — windows are contiguous ascending doc ranges,
+so per-term run concatenation in window order IS the merged postings
+list (the reference re-derives the same grouping by re-reading spill
+text, main.c:170-212).
+"""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    Manifest,
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+    plan_fraction_windows,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native tokenizer unavailable")
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("device_shards", 1)
+    kw.setdefault("pad_multiple", 64)
+    kw.setdefault("overlap_tail_fraction", 0.4)
+    return IndexConfig(**kw)
+
+
+@pytest.mark.parametrize("tail", [0.1, 0.4, 0.9])
+def test_matches_goldens_any_fraction(smoke_fixture, tmp_path, tail):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(
+        _cfg(overlap_tail_fraction=tail)).run(m, output_dir=tmp_path)
+    assert "host_tail" in report["phases_ms"]  # really took the overlap plan
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+@pytest.mark.parametrize("tail", [0.15, 0.5, 0.85])
+def test_property_random_corpus_vs_oracle(tmp_path, tail):
+    docs = zipf_corpus(num_docs=53, vocab_size=900, tokens_per_doc=70, seed=11)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(
+        _cfg(overlap_tail_fraction=tail)).run(m, output_dir=tmp_path / "ovl")
+    assert read_letter_files(tmp_path / "ovl") == read_letter_files(tmp_path / "oracle")
+    # every pair lands in exactly one run
+    assert report["device_pairs"] <= report["unique_pairs"]
+
+
+def test_device_actually_covers_pairs(tmp_path):
+    """A small tail fraction must leave most pairs on the device side."""
+    docs = zipf_corpus(num_docs=64, vocab_size=500, tokens_per_doc=60, seed=5)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    report = InvertedIndexModel(
+        _cfg(overlap_tail_fraction=0.2)).run(m, output_dir=tmp_path / "out")
+    assert report["upload_windows"] >= 1
+    assert report["device_pairs"] > report["unique_pairs"] // 2
+
+
+def test_tiny_corpus_single_device_window(tmp_path):
+    """< 8 docs degenerates to one device window + tail, still correct."""
+    docs = [b"alpha beta gamma", b"beta beta delta", b"zeta alpha"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path / "ovl")
+    assert read_letter_files(tmp_path / "ovl") == read_letter_files(tmp_path / "oracle")
+
+
+def test_empty_corpus(tmp_path):
+    (tmp_path / "e.txt").write_text("   \n\t  ")
+    write_manifest(tmp_path / "list.txt", [tmp_path / "e.txt"])
+    m = read_manifest(tmp_path / "list.txt")
+    InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path / "out")
+    assert read_letter_files(tmp_path / "out") == b""
+
+
+def test_numbers_only_tail(tmp_path):
+    """Tail window that cleans to zero pairs."""
+    docs = [b"alpha beta", b"gamma delta epsilon", b"123 456 --- !!"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    InvertedIndexModel(
+        _cfg(overlap_tail_fraction=0.2)).run(m, output_dir=tmp_path / "ovl")
+    assert read_letter_files(tmp_path / "ovl") == read_letter_files(tmp_path / "oracle")
+
+
+def test_multi_chip_rejected(tmp_path):
+    (tmp_path / "d.txt").write_text("hello world")
+    write_manifest(tmp_path / "list.txt", [tmp_path / "d.txt"])
+    m = read_manifest(tmp_path / "list.txt")
+    model = InvertedIndexModel(
+        _cfg(device_shards=4, overlap_tail_fraction=0.4))
+    with pytest.raises(ValueError, match="single-chip"):
+        model.run(m, output_dir=tmp_path / "out")
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="overlap_tail_fraction"):
+        IndexConfig(overlap_tail_fraction=0.0)
+    with pytest.raises(ValueError, match="overlap_tail_fraction"):
+        IndexConfig(overlap_tail_fraction=1.0)
+    with pytest.raises(ValueError, match="backend"):
+        IndexConfig(backend="cpu", overlap_tail_fraction=0.5)
+    with pytest.raises(ValueError, match="pipelined"):
+        IndexConfig(overlap_tail_fraction=0.5, pipeline_chunk_docs=0)
+    with pytest.raises(ValueError, match="stream_chunk_docs"):
+        IndexConfig(overlap_tail_fraction=0.5, stream_chunk_docs=100)
+    with pytest.raises(ValueError, match="letter"):
+        IndexConfig(overlap_tail_fraction=0.5, emit_ownership="letter")
+
+
+# -- plan_fraction_windows ------------------------------------------------
+
+
+def _manifest(sizes):
+    return Manifest(paths=tuple(f"f{i}" for i in range(len(sizes))),
+                    sizes=tuple(sizes))
+
+
+def test_fraction_windows_cover_everything():
+    m = _manifest([10, 30, 5, 5, 50, 10, 20, 70])
+    for fr in [(0.5, 0.5), (0.3, 0.3, 0.4), (0.05, 0.95)]:
+        w = plan_fraction_windows(m, fr)
+        assert w[0][0] == 0 and w[-1][1] == len(m)
+        for (a, b), (c, d) in zip(w, w[1:]):
+            assert b == c  # contiguous, no gaps
+
+def test_fraction_windows_byte_shares():
+    m = _manifest([10] * 100)
+    w = plan_fraction_windows(m, (0.25, 0.25, 0.5))
+    assert w == ((0, 25), (25, 50), (50, 100))
+
+
+def test_fraction_windows_rejects_bad_fractions():
+    m = _manifest([10])
+    with pytest.raises(ValueError):
+        plan_fraction_windows(m, ())
+    with pytest.raises(ValueError):
+        plan_fraction_windows(m, (0.5, -0.5, 1.0))
+    with pytest.raises(ValueError):
+        plan_fraction_windows(m, (0.5, 0.2))
+
+
+# -- native multi-run emit -----------------------------------------------
+
+
+def test_emit_runs_matches_single_run(tmp_path):
+    """Splitting postings into runs must render byte-identically."""
+    rng = np.random.default_rng(7)
+    vocab = np.sort(np.array(
+        [b"ant", b"bee", b"cat", b"dog", b"emu", b"fox"], dtype="S3"))
+    v = len(vocab)
+    df = rng.integers(1, 9, size=v).astype(np.int64)
+    offsets = np.cumsum(df) - df
+    postings = np.concatenate(
+        [np.sort(rng.choice(50, size=n, replace=False)) + 1 for n in df]
+    ).astype(np.uint16)
+    letters = np.array([w[0] - ord("a") for w in vocab.tolist()])
+    order = np.lexsort((-df, letters))
+
+    native.emit_native(tmp_path / "one", vocab, order, df, offsets, postings)
+
+    # split each term's postings at a random point into run A and run B
+    split = np.array([rng.integers(0, n + 1) for n in df], dtype=np.int64)
+    ca, cb = split, df - split
+    oa = np.cumsum(ca) - ca
+    ob = np.cumsum(cb) - cb
+    run_a = np.concatenate(
+        [postings[offsets[t]: offsets[t] + ca[t]] for t in range(v)]
+    ).astype(np.uint16) if ca.sum() else np.empty(0, np.uint16)
+    run_b = np.concatenate(
+        [postings[offsets[t] + ca[t]: offsets[t] + df[t]] for t in range(v)]
+    ).astype(np.uint16) if cb.sum() else np.empty(0, np.uint16)
+    native.emit_native_runs(
+        tmp_path / "two", vocab, order,
+        [(run_a, oa, ca), (run_b, ob, cb)])
+    assert read_letter_files(tmp_path / "two") == read_letter_files(tmp_path / "one")
+
+
+def test_emit_runs_empty_runs(tmp_path):
+    vocab = np.array([b"abc"], dtype="S3")
+    order = np.array([0], dtype=np.int64)
+    zero = np.zeros(1, np.int64)
+    one = np.ones(1, np.int64)
+    native.emit_native_runs(
+        tmp_path / "out", vocab, order,
+        [(np.empty(0, np.uint16), zero, zero),
+         (np.array([3], np.uint16), zero, one)])
+    assert (tmp_path / "out" / "a.txt").read_bytes() == b"abc:[3]\n"
