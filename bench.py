@@ -117,7 +117,7 @@ def _tpu_child() -> int:
         {},
         {"pipeline_chunk_docs": 0},
         {"overlap_tail_fraction": 0.4, "device_shards": 1},
-        {"overlap_tail_fraction": 0.3, "device_shards": 1},
+        {"overlap_tail_fraction": 0.5, "device_shards": 1},
     ])))
     return 0
 

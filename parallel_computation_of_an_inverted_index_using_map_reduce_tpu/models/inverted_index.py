@@ -359,24 +359,35 @@ class InvertedIndexModel:
             with timer.phase("tokenize_feed"):
                 for contents, ids in iter_document_ranges(manifest, windows):
                     docs_loaded += len(contents)
-                    keys, _ = stream.feed(contents, ids)
-                    if keys.size == 0:
-                        continue
-                    padded = _round_up(keys.size, granule)
-                    if mesh is None and int(keys.max()) // stride <= 0xFFFE:
-                        # fits: half-bandwidth [terms | docs] uint16 window
-                        terms, docs = np.divmod(keys, stride)
-                        buf = engine.pack_u16_feed(terms, docs, padded)
-                    else:
-                        buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
-                        buf[: keys.size] = keys
                     if mesh is None:
+                        # the native scan assembles the half-bandwidth
+                        # [terms | docs] uint16 upload buffer directly
+                        # (int32 keys when prov ids outgrow uint16 —
+                        # one gate, owned by mri_stream_feed_u16)
+                        mode, buf, nvalid, _ = stream.feed_u16(
+                            contents, ids, granule=granule)
+                        if nvalid == 0:
+                            continue
+                        if mode == "u16":
+                            padded = buf.shape[0] // 2
+                        else:
+                            padded = _round_up(nvalid, granule)
+                            keys = buf
+                            buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
+                            buf[:nvalid] = keys
                         chunks_dev.append(jax.device_put(buf))  # async DMA
                     else:
+                        keys, _ = stream.feed(contents, ids)
+                        nvalid = int(keys.size)
+                        if nvalid == 0:
+                            continue
+                        padded = _round_up(nvalid, granule)
+                        buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
+                        buf[:nvalid] = keys
                         chunks_dev.append(jax.device_put(
                             buf, sharding(mesh, shard_spec())))
                     keys_capacity += padded
-                    num_pairs += int(keys.size)
+                    num_pairs += nvalid
             with timer.phase("finalize_vocab"):
                 vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
         finally:
@@ -559,37 +570,49 @@ class InvertedIndexModel:
         dev_handles: list[tuple] = []  # (in-flight fetch, nvalid, term ids)
         tail_keys = None
         num_pairs = docs_loaded = 0
-        profile = (
-            jax.profiler.trace(cfg.profile_dir)
-            if cfg.profile_dir else contextlib.nullcontext()
-        )
+        # the trace must span dispatch THROUGH fetch — the device sorts
+        # and D2H transfers this plan overlaps complete long after the
+        # feed loop ends (closed in the finally below)
+        trace = contextlib.ExitStack()
+        if cfg.profile_dir:
+            trace.enter_context(jax.profiler.trace(cfg.profile_dir))
         stream = native.NativeKeyStream(stride, num_threads=threads)
         try:
-            with profile, timer.phase("tokenize_feed"):
+            with timer.phase("tokenize_feed"):
                 for wi, (contents, ids) in enumerate(
                         iter_document_ranges(manifest, windows)):
                     docs_loaded += len(contents)
-                    keys, _ = stream.feed(contents, ids)
-                    num_pairs += int(keys.size)
-                    if keys.size == 0:
-                        continue
                     if wi == len(windows) - 1:
-                        tail_keys = keys
+                        keys, _ = stream.feed(contents, ids)
+                        num_pairs += int(keys.size)
+                        if keys.size:
+                            tail_keys = keys
                         continue
-                    padded = _round_up(keys.size, granule)
-                    terms = keys // stride
-                    if int(keys.max()) // stride <= 0xFFFE:
-                        # half-bandwidth uint16 window
-                        buf = engine.pack_u16_feed(terms, keys % stride, padded)
-                    else:
+                    # device window: the native scan assembles the
+                    # [terms | docs] uint16 upload buffer directly
+                    mode, buf, nvalid, _ = stream.feed_u16(
+                        contents, ids, granule=granule)
+                    num_pairs += nvalid
+                    if nvalid == 0:
+                        continue
+                    if mode == "u16":
+                        terms = buf[: nvalid]  # terms half, valid prefix
+                    else:  # prov ids outgrew uint16: packed int32 keys
+                        keys = buf
+                        terms = keys // stride
+                        padded = _round_up(nvalid, granule)
                         buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
-                        buf[: keys.size] = keys
+                        buf[:nvalid] = keys
                     post = engine.sort_prov_chunks(
-                        (jax.device_put(buf),), stride=stride, out_size=padded)
+                        (jax.device_put(buf),), stride=stride,
+                        out_size=_round_up(nvalid, granule))
                     post.copy_to_host_async()
-                    dev_handles.append((post, int(keys.size), terms))
+                    dev_handles.append((post, nvalid, terms))
             with timer.phase("finalize_vocab"):
                 vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
+        except BaseException:
+            trace.close()
+            raise
         finally:
             stream.close()
 
@@ -604,6 +627,7 @@ class InvertedIndexModel:
         timer.count("unique_pairs", num_pairs)
         timer.count("device_shards", 1)
         if num_pairs == 0:
+            trace.close()
             with timer.phase("emit"):
                 formatter.emit_grouped(out_dir, {})
             return timer.report()
@@ -635,6 +659,7 @@ class InvertedIndexModel:
 
         with timer.phase("fetch"):
             fetched = [np.asarray(post) for post, _, _ in dev_handles]
+        trace.close()
 
         with timer.phase("emit"):
             runs = [
@@ -657,10 +682,19 @@ class InvertedIndexModel:
                 raise ValueError(
                     "emit_ownership='letter' requires the pipelined path "
                     "(native tokenizer available, no checkpoint/skew flags)")
-        if self.config.overlap_tail_fraction is not None and self._num_shards() > 1:
-            raise ValueError(
-                "overlap_tail_fraction is a single-chip plan "
-                "(device_shards > 1 selects the multi-chip engine)")
+        if self.config.overlap_tail_fraction is not None:
+            if self._num_shards() > 1:
+                raise ValueError(
+                    "overlap_tail_fraction is a single-chip plan "
+                    "(device_shards > 1 selects the multi-chip engine)")
+            if not self._pipelined_eligible(manifest):
+                # fail loudly rather than silently run a different plan
+                # than the one the config names (same policy as
+                # emit_ownership='letter' above)
+                raise ValueError(
+                    "overlap_tail_fraction requires the pipelined path: "
+                    "native tokenizer available, no checkpoint/skew flags, "
+                    "no streaming, and <= 65534 documents")
         if self._pipelined_eligible(manifest):
             from ..native import KeyOverflow
 

@@ -46,6 +46,16 @@ class _StreamChunkResult(ctypes.Structure):
     ]
 
 
+class _StreamChunkU16Result(ctypes.Structure):
+    _fields_ = [
+        ("num_pairs", ctypes.c_int64),
+        ("raw_tokens", ctypes.c_int64),
+        ("padded", ctypes.c_int64),
+        ("feed_u16", ctypes.POINTER(ctypes.c_uint16)),
+        ("keys", ctypes.POINTER(ctypes.c_int32)),
+    ]
+
+
 class _HostIndexStats(ctypes.Structure):
     _fields_ = [
         ("raw_tokens", ctypes.c_int64),
@@ -129,6 +139,16 @@ def load():
         ]
         lib.mri_stream_chunk_free.restype = None
         lib.mri_stream_chunk_free.argtypes = [ctypes.POINTER(_StreamChunkResult)]
+        lib.mri_stream_feed_u16.restype = ctypes.POINTER(_StreamChunkU16Result)
+        lib.mri_stream_feed_u16.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int64,
+        ]
+        lib.mri_stream_chunk_u16_free.restype = None
+        lib.mri_stream_chunk_u16_free.argtypes = [
+            ctypes.POINTER(_StreamChunkU16Result)]
         lib.mri_stream_finalize.restype = ctypes.POINTER(_StreamFinalResult)
         lib.mri_stream_finalize.argtypes = [ctypes.c_void_p]
         lib.mri_stream_final_free.restype = None
@@ -287,6 +307,39 @@ class NativeKeyStream:
             return keys, raw
         finally:
             self._lib.mri_stream_chunk_free(res)
+
+    def feed_u16(self, contents: list[bytes], doc_ids: list[int],
+                 granule: int = 1 << 14):
+        """Tokenize one window, returning the device-ready uint16 feed.
+
+        Returns ``("u16", buf, num_pairs, raw_tokens)`` where ``buf`` is
+        the ``[terms | docs]`` uint16 upload buffer (each half padded to
+        ``granule``, 0xFFFF padding) — or ``("keys", keys, num_pairs,
+        raw_tokens)`` when provisional ids outgrow uint16.  Raises
+        :class:`KeyOverflow` when even packed int32 keys overflow.
+        """
+        args, keepalive = _marshal_docs(contents, doc_ids)
+        res = self._lib.mri_stream_feed_u16(
+            self._handle, *args, ctypes.c_int64(granule))
+        del keepalive
+        if not res:
+            raise MemoryError("native stream feed allocation failure")
+        try:
+            r = res.contents
+            n, raw = int(r.num_pairs), int(r.raw_tokens)
+            if n < 0:
+                raise KeyOverflow()
+            if r.feed_u16:
+                padded = int(r.padded)
+                buf = np.ctypeslib.as_array(
+                    r.feed_u16, shape=(2 * padded,)).copy()
+                return "u16", buf, n, raw
+            if n == 0:
+                return "u16", np.empty(0, np.uint16), 0, raw
+            keys = np.ctypeslib.as_array(r.keys, shape=(max(n, 1),))[:n].copy()
+            return "keys", keys, n, raw
+        finally:
+            self._lib.mri_stream_chunk_u16_free(res)
 
     def finalize(self):
         """``(vocab, letter_of_term, remap, df_prov, raw_tokens, num_pairs)``.

@@ -60,6 +60,10 @@
 #include <thread>
 #include <vector>
 
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr int kMaxWordLetters = 299;  // reference MAX_WORD - 1 (main.c:7,105)
@@ -113,6 +117,142 @@ inline bool WordsEqual(const uint8_t* a, const uint8_t* b, uint32_t len) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// SIMD scan support (x86-64 AVX2+BMI2; scalar fallback elsewhere).
+//
+// The scalar clean loop pays ~10 cycles per corpus byte in branchy
+// per-byte work.  Instead: one vector pass builds per-64-byte-group
+// space/letter bitmasks, then tokens are walked by bit scanning and
+// cleaned 8 raw bytes at a time with a pext byte-compaction (the
+// letter-mask bytes select which lowered bytes survive).  Short tokens
+// (<= 8 raw bytes — most of real text) first probe a direct-mapped
+// raw-bytes -> prov-id cache: raw-equal implies cleaned-equal (cleaning
+// deletes NUL bytes, so masked-load equality is sufficient), which
+// skips clean+hash+table entirely for hot words.
+// ---------------------------------------------------------------------------
+
+#if defined(__x86_64__)
+
+struct MaskSpan {
+  std::vector<uint64_t> S;  // space bits (beyond data: 1)
+  std::vector<uint64_t> L;  // letter bits
+  std::vector<uint64_t> T;  // non-space bits (beyond data: 0)
+  size_t base = 0;          // absolute group index of word 0
+};
+
+struct LenMasks {
+  uint64_t bytes[9];  // low 8*n bits set
+  LenMasks() {
+    bytes[8] = ~0ull;
+    for (int i = 0; i < 8; ++i) bytes[i] = (1ull << (8 * i)) - 1;
+  }
+};
+const LenMasks kLen;
+
+// bit j set -> byte j = 0xFF (the pext byte-selection mask)
+struct ByteMaskLut {
+  uint64_t m[256];
+  ByteMaskLut() {
+    for (int mask = 0; mask < 256; ++mask) {
+      uint64_t v = 0;
+      for (int j = 0; j < 8; ++j)
+        if (mask & (1 << j)) v |= 0xFFull << (8 * j);
+      m[mask] = v;
+    }
+  }
+};
+const ByteMaskLut kByteMask;
+
+__attribute__((target("avx2")))
+void BuildMasks(const uint8_t* data, int64_t data_len, int64_t lo, int64_t hi,
+                MaskSpan& m) {
+  const size_t g0 = static_cast<size_t>(lo) >> 6;
+  const size_t g1 = (static_cast<size_t>(hi) + 63) >> 6;  // exclusive
+  m.base = g0;
+  m.S.assign(g1 - g0 + 2, ~0ull);
+  m.L.assign(g1 - g0 + 2, 0);
+  m.T.assign(g1 - g0 + 2, 0);
+  const __m256i v9 = _mm256_set1_epi8(9), v4 = _mm256_set1_epi8(4),
+      vsp = _mm256_set1_epi8(' '), v20 = _mm256_set1_epi8(0x20),
+      va = _mm256_set1_epi8('a'), v25 = _mm256_set1_epi8(25);
+  for (size_t g = g0; g < g1; ++g) {
+    const int64_t p = static_cast<int64_t>(g) << 6;
+    uint64_t sm, lm;
+    if (p + 64 <= data_len) {
+      sm = lm = 0;
+      for (int half = 0; half < 2; ++half) {
+        __m256i v = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(data + p + 32 * half));
+        __m256i u = _mm256_sub_epi8(v, v9);
+        __m256i ctl = _mm256_cmpeq_epi8(_mm256_min_epu8(u, v4), u);  // \t..\r
+        __m256i spc = _mm256_or_si256(ctl, _mm256_cmpeq_epi8(v, vsp));
+        __m256i lo8 = _mm256_or_si256(v, v20);
+        __m256i d = _mm256_sub_epi8(lo8, va);
+        __m256i let = _mm256_cmpeq_epi8(_mm256_min_epu8(d, v25), d);
+        sm |= static_cast<uint64_t>(
+                  static_cast<uint32_t>(_mm256_movemask_epi8(spc)))
+              << (32 * half);
+        lm |= static_cast<uint64_t>(
+                  static_cast<uint32_t>(_mm256_movemask_epi8(let)))
+              << (32 * half);
+      }
+    } else {  // buffer-tail group, scalar (bytes beyond data read as space)
+      sm = ~0ull;
+      lm = 0;
+      for (int64_t j = p; j < data_len; ++j) {
+        const uint64_t b = 1ull << (j - p);
+        if (!kTab.space[data[j]]) sm &= ~b;
+        if (kTab.lower[data[j]]) lm |= b;
+      }
+    }
+    m.S[g - g0] = sm;
+    m.L[g - g0] = lm;
+    m.T[g - g0] = ~sm;
+  }
+  // +2 guard words: S stays all-ones (space), T/L all-zero — walks and
+  // ExtractBits never read uninitialized memory.
+  m.T[g1 - g0] = m.T[g1 - g0 + 1] = 0;
+  m.L[g1 - g0] = m.L[g1 - g0 + 1] = 0;
+}
+
+// >= 8 mask bits starting at absolute byte position a (low bits).
+inline uint64_t ExtractBits(const std::vector<uint64_t>& M, size_t base,
+                            int64_t a) {
+  const size_t w = (static_cast<size_t>(a) >> 6) - base;
+  const unsigned o = static_cast<unsigned>(a) & 63;
+  uint64_t x = M[w] >> o;
+  if (o) x |= M[w + 1] << (64 - o);
+  return x;
+}
+
+// First set bit >= pos, capped at end.
+inline int64_t NextSet(const std::vector<uint64_t>& M, size_t base,
+                       int64_t pos, int64_t end) {
+  size_t w = (static_cast<size_t>(pos) >> 6) - base;
+  uint64_t x = M[w] >> (pos & 63);
+  if (x) {
+    const int64_t r = pos + __builtin_ctzll(x);
+    return r < end ? r : end;
+  }
+  const size_t wend = ((static_cast<size_t>(end) + 63) >> 6) - base;
+  for (++w; w <= wend; ++w) {
+    if (M[w]) {
+      const int64_t r =
+          (static_cast<int64_t>(w + base) << 6) + __builtin_ctzll(M[w]);
+      return r < end ? r : end;
+    }
+  }
+  return end;
+}
+
+#endif  // __x86_64__
+
+struct CacheEntry {
+  uint64_t tag;
+  int32_t id;  // -1 = empty
+};
+constexpr int kRawCacheBits = 13;
+
 // Incremental tokenizer state: one per scanning thread (or the single
 // global one when num_threads == 1).  Provisional ids are assigned at
 // first occurrence and never change; the combiner (per-(term, doc)
@@ -126,12 +266,17 @@ struct StreamState {
   int32_t next_id = 0;
   std::vector<uint32_t> word_offsets;  // prov id -> arena offset
   std::vector<uint32_t> word_lens;
-  std::vector<int32_t> last_doc;  // prov id -> global doc ordinal (combiner)
-  std::vector<int32_t> df;        // prov id -> docs containing it; only
-                                  // meaningful when scanned with dedup=true
+  // Combiner state, interleaved so the per-token dedup touches ONE
+  // cache line: last_doc = global doc ordinal last seen; df = docs
+  // containing the term (meaningful only when scanned with dedup=true).
+  struct TermState { int32_t last_doc; int32_t df; };
+  std::vector<TermState> combiner;
   int64_t raw_tokens = 0;
   int64_t num_pairs = 0;
   int32_t doc_ordinal = 0;  // global across chunks
+  // Direct-mapped raw-bytes -> prov-id cache for the SIMD scan's short
+  // tokens (lazily sized; ids are stream-stable so it never invalidates).
+  std::vector<CacheEntry> raw_cache;
 
   StreamState() : table(1 << 16), mask(table.size() - 1) {
     for (auto& e : table) e.id = -1;
@@ -169,8 +314,7 @@ struct StreamState {
         e.id = next_id;
         word_offsets.push_back(off);
         word_lens.push_back(wlen);
-        last_doc.push_back(-1);
-        df.push_back(0);
+        combiner.push_back(TermState{-1, 0});
         const int32_t id = next_id++;
         if (static_cast<uint64_t>(next_id) * 10 > table.size() * 7) Grow();
         return id;
@@ -186,12 +330,13 @@ struct StreamState {
 
 // Scan a contiguous run of documents; emit (prov_id, doc_id) pairs
 // through `emit` — combiner-deduped when `dedup`.  `data` is the whole
-// window's concatenated bytes; this call scans docs
-// `[doc_lo, doc_hi)` whose bytes span `[start_pos, doc_ends[doc_hi-1])`.
+// window's concatenated bytes (`data_len` total — loads never read past
+// it); this call scans docs `[doc_lo, doc_hi)` whose bytes span
+// `[start_pos, doc_ends[doc_hi-1])`.
 template <typename Emit>
-void ScanChunk(StreamState& st, const uint8_t* data, int64_t start_pos,
-               const int64_t* doc_ends, const int32_t* doc_id_values,
-               int32_t doc_lo, int32_t doc_hi, bool dedup, Emit&& emit) {
+void ScanChunkScalar(StreamState& st, const uint8_t* data, int64_t start_pos,
+                     const int64_t* doc_ends, const int32_t* doc_id_values,
+                     int32_t doc_lo, int32_t doc_hi, bool dedup, Emit&& emit) {
   uint8_t word[kMaxWordLetters + 8];  // +8: zero pad for block loads
   int64_t pos = start_pos;
   for (int32_t d = doc_lo; d < doc_hi; ++d, ++st.doc_ordinal) {
@@ -212,9 +357,10 @@ void ScanChunk(StreamState& st, const uint8_t* data, int64_t start_pos,
       const int32_t id = st.Upsert(word, wlen, HashWord(word, wlen));
       ++st.raw_tokens;
       if (dedup) {
-        if (st.last_doc[id] == ordinal) continue;  // (term, doc) already out
-        st.last_doc[id] = ordinal;
-        ++st.df[id];
+        StreamState::TermState& ts = st.combiner[id];
+        if (ts.last_doc == ordinal) continue;  // (term, doc) already out
+        ts.last_doc = ordinal;
+        ++ts.df;
       }
       ++st.num_pairs;
       emit(id, doc_id);
@@ -223,18 +369,150 @@ void ScanChunk(StreamState& st, const uint8_t* data, int64_t start_pos,
   }
 }
 
+#if defined(__x86_64__)
+
+// Mask-driven scan: identical observable behavior to ScanChunkScalar
+// (fuzz-tested against it via the oracle conformance suite), ~2x faster
+// on real text.
+template <typename Emit>
+__attribute__((target("avx2,bmi2")))
+void ScanChunkSimd(StreamState& st, const uint8_t* data, int64_t data_len,
+                   int64_t start_pos, const int64_t* doc_ends,
+                   const int32_t* doc_id_values, int32_t doc_lo,
+                   int32_t doc_hi, bool dedup, Emit&& emit) {
+  const int64_t span_end = doc_ends[doc_hi - 1];
+  MaskSpan m;
+  BuildMasks(data, data_len, start_pos, span_end, m);
+  if (st.raw_cache.empty()) {
+    st.raw_cache.assign(size_t{1} << kRawCacheBits, CacheEntry{0, -1});
+  }
+  CacheEntry* cache = st.raw_cache.data();
+  constexpr uint64_t kLow8 = 0x2020202020202020ull;
+  uint8_t word[kMaxWordLetters + 8];
+  int64_t pos = start_pos;
+  for (int32_t d = doc_lo; d < doc_hi; ++d, ++st.doc_ordinal) {
+    const int64_t end = doc_ends[d];
+    const int32_t doc_id = doc_id_values[d];
+    const int32_t ordinal = st.doc_ordinal;
+    while (pos < end) {
+      const int64_t a = NextSet(m.T, m.base, pos, end);
+      if (a >= end) break;
+      const int64_t b = NextSet(m.S, m.base, a, end);
+      pos = b;
+      const int64_t len_raw = b - a;
+      int32_t id;
+      if (len_raw <= 8 && a + 8 <= data_len) {
+        const uint64_t raw = Load64(data + a) & kLen.bytes[len_raw];
+        CacheEntry& ce =
+            cache[(raw * 0x9E3779B97F4A7C15ull) >> (64 - kRawCacheBits)];
+        if (ce.id >= 0 && ce.tag == raw) {
+          id = ce.id;
+        } else {
+          const uint64_t bits =
+              ExtractBits(m.L, m.base, a) & ((1ull << len_raw) - 1) & 0xFF;
+          if (bits == 0) continue;  // cleaned to nothing (main.c:113)
+          const uint64_t cleaned = _pext_u64(raw | kLow8, kByteMask.m[bits]);
+          const int32_t wlen = __builtin_popcountll(bits);
+          uint64_t wbuf[2] = {cleaned, 0};
+          id = st.Upsert(reinterpret_cast<const uint8_t*>(wbuf), wlen,
+                         HashWord(reinterpret_cast<const uint8_t*>(wbuf),
+                                  static_cast<uint32_t>(wlen)));
+          ce.tag = raw;
+          ce.id = id;
+        }
+      } else {  // long or buffer-tail token: chunked pext into the buffer
+        int wlen = 0;
+        for (int64_t i = a; i < b; i += 8) {
+          const int64_t take = (b - i < 8) ? b - i : 8;
+          uint64_t raw;
+          if (i + 8 <= data_len) {
+            raw = Load64(data + i);
+          } else {
+            raw = 0;
+            std::memcpy(&raw, data + i, static_cast<size_t>(data_len - i));
+          }
+          raw &= kLen.bytes[take];
+          const uint64_t bits = ExtractBits(m.L, m.base, i) &
+                                ((take == 8) ? 0xFFull
+                                             : ((1ull << take) - 1)) & 0xFF;
+          const uint64_t chunk = _pext_u64(raw | kLow8, kByteMask.m[bits]);
+          std::memcpy(word + wlen, &chunk, 8);  // buffer is 299 + 8
+          const int add = __builtin_popcountll(bits);
+          wlen = (wlen + add > kMaxWordLetters) ? kMaxWordLetters
+                                                : wlen + add;
+        }
+        if (wlen == 0) continue;
+        std::memset(word + wlen, 0, 8);
+        id = st.Upsert(word, wlen, HashWord(word, wlen));
+      }
+      ++st.raw_tokens;
+      if (dedup) {
+        StreamState::TermState& ts = st.combiner[id];
+        if (ts.last_doc == ordinal) continue;
+        ts.last_doc = ordinal;
+        ++ts.df;
+      }
+      ++st.num_pairs;
+      emit(id, doc_id);
+    }
+    pos = end;
+  }
+}
+
+const bool kHaveSimdScan =
+    __builtin_cpu_supports("avx2") && __builtin_cpu_supports("bmi2");
+
+#endif  // __x86_64__
+
+template <typename Emit>
+void ScanChunk(StreamState& st, const uint8_t* data, int64_t data_len,
+               int64_t start_pos, const int64_t* doc_ends,
+               const int32_t* doc_id_values, int32_t doc_lo, int32_t doc_hi,
+               bool dedup, Emit&& emit) {
+  if (doc_lo >= doc_hi) return;
+#if defined(__x86_64__)
+  if (kHaveSimdScan) {
+    ScanChunkSimd(st, data, data_len, start_pos, doc_ends, doc_id_values,
+                  doc_lo, doc_hi, dedup, emit);
+    return;
+  }
+#endif
+  (void)data_len;
+  ScanChunkScalar(st, data, start_pos, doc_ends, doc_id_values, doc_lo,
+                  doc_hi, dedup, emit);
+}
+
 // Sorted-vocab order of provisional ids (== strcmp order: letters only).
+// Big-endian u64 prefix keys resolve almost every comparison with one
+// integer compare (arena words are zero-padded, and 0x00 < any letter,
+// so shorter-prefix words sort first automatically); only words sharing
+// a full 8-byte prefix fall through to the block loop.
 std::vector<int32_t> SortedOrder(const StreamState& st) {
-  std::vector<int32_t> order(st.next_id);
-  for (int32_t i = 0; i < st.next_id; ++i) order[i] = i;
   const uint8_t* base = st.arena.data();
-  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
-    const uint32_t la = st.word_lens[a], lb = st.word_lens[b];
-    const int c = std::memcmp(base + st.word_offsets[a],
-                              base + st.word_offsets[b], la < lb ? la : lb);
-    if (c != 0) return c < 0;
-    return la < lb;
-  });
+  std::vector<std::pair<uint64_t, int32_t>> keyed(st.next_id);
+  for (int32_t i = 0; i < st.next_id; ++i)
+    keyed[i] = {__builtin_bswap64(Load64(base + st.word_offsets[i])), i};
+  std::sort(keyed.begin(), keyed.end(),
+            [&](const std::pair<uint64_t, int32_t>& a,
+                const std::pair<uint64_t, int32_t>& b) {
+              if (a.first != b.first) return a.first < b.first;
+              const int32_t ia = a.second, ib = b.second;
+              const uint8_t* pa = base + st.word_offsets[ia];
+              const uint8_t* pb = base + st.word_offsets[ib];
+              const uint32_t pla = (st.word_lens[ia] + 7) & ~7u;
+              const uint32_t plb = (st.word_lens[ib] + 7) & ~7u;
+              const uint32_t lim = pla > plb ? pla : plb;
+              for (uint32_t i = 8; i < lim; i += 8) {
+                const uint64_t ka =
+                    i < pla ? __builtin_bswap64(Load64(pa + i)) : 0;
+                const uint64_t kb =
+                    i < plb ? __builtin_bswap64(Load64(pb + i)) : 0;
+                if (ka != kb) return ka < kb;
+              }
+              return false;  // identical words cannot occur (unique vocab)
+            });
+  std::vector<int32_t> order(st.next_id);
+  for (int32_t i = 0; i < st.next_id; ++i) order[i] = keyed[i].second;
   return order;
 }
 
@@ -308,8 +586,8 @@ void ForkJoin(int32_t T, Fn&& fn) {
 // its raw-token delta.  Single-threaded (workers.size() == 1) runs
 // inline — no thread spawn.
 void ParallelScan(std::vector<Worker>& workers, const uint8_t* data,
-                  const int64_t* doc_ends, const int32_t* doc_id_values,
-                  int32_t num_docs, bool dedup) {
+                  int64_t data_len, const int64_t* doc_ends,
+                  const int32_t* doc_id_values, int32_t num_docs, bool dedup) {
   const int32_t T = static_cast<int32_t>(workers.size());
   const std::vector<int32_t> cuts = PlanRanges(doc_ends, num_docs, T);
   ForkJoin(T, [&](int32_t t) {
@@ -319,8 +597,8 @@ void ParallelScan(std::vector<Worker>& workers, const uint8_t* data,
     const int64_t start_pos = lo ? doc_ends[lo - 1] : 0;
     w.pair_lids.clear();
     w.pair_docs.clear();
-    ScanChunk(w.local, data, start_pos, doc_ends, doc_id_values, lo, hi, dedup,
-              [&](int32_t id, int32_t doc) {
+    ScanChunk(w.local, data, data_len, start_pos, doc_ends, doc_id_values,
+              lo, hi, dedup, [&](int32_t id, int32_t doc) {
                 w.pair_lids.push_back(id);
                 w.pair_docs.push_back(doc);
               });
@@ -369,7 +647,7 @@ std::vector<int32_t> GlobalDf(const StreamState& global,
   std::vector<int32_t> df(std::max(global.next_id, 1), 0);
   for (const Worker& w : workers)
     for (int32_t lid = 0; lid < w.local.next_id; ++lid)
-      df[w.l2g[lid]] += w.local.df[lid];
+      df[w.l2g[lid]] += w.local.combiner[lid].df;
   return df;
 }
 
@@ -399,10 +677,9 @@ TokenizeResult* mri_tokenize(const uint8_t* data, int64_t len,
                              const int64_t* doc_ends,
                              const int32_t* doc_id_values, int32_t num_docs,
                              int32_t dedup_pairs, int32_t num_threads) try {
-  (void)len;
   StreamState global;
   std::vector<Worker> workers(std::max(num_threads, 1));
-  ParallelScan(workers, data, doc_ends, doc_id_values, num_docs,
+  ParallelScan(workers, data, len, doc_ends, doc_id_values, num_docs,
                dedup_pairs != 0);
   StreamState& vst = ResolveVocab(global, workers);
 
@@ -529,7 +806,6 @@ StreamChunkResult* mri_stream_feed(void* handle, const uint8_t* data,
                                    int64_t len, const int64_t* doc_ends,
                                    const int32_t* doc_id_values,
                                    int32_t num_docs) try {
-  (void)len;
   auto& h = *static_cast<StreamHandle*>(handle);
   auto* res =
       static_cast<StreamChunkResult*>(std::malloc(sizeof(StreamChunkResult)));
@@ -540,7 +816,7 @@ StreamChunkResult* mri_stream_feed(void* handle, const uint8_t* data,
   if (h.workers.empty()) {  // single-threaded: scan straight into global
     keys.reserve(len / 24 + 16);
     const int64_t raw_before = h.global.raw_tokens;
-    ScanChunk(h.global, data, 0, doc_ends, doc_id_values, 0, num_docs,
+    ScanChunk(h.global, data, len, 0, doc_ends, doc_id_values, 0, num_docs,
               /*dedup=*/true, [&](int32_t id, int32_t doc) {
                 const int64_t key = static_cast<int64_t>(id) * stride + doc;
                 if (key >= INT32_MAX) {  // INT32_MAX itself is the pad value
@@ -551,7 +827,7 @@ StreamChunkResult* mri_stream_feed(void* handle, const uint8_t* data,
               });
     res->raw_tokens = h.global.raw_tokens - raw_before;
   } else {  // fork-join scan + vocab-scale merge, then vectorized remap
-    ParallelScan(h.workers, data, doc_ends, doc_id_values, num_docs,
+    ParallelScan(h.workers, data, len, doc_ends, doc_id_values, num_docs,
                  /*dedup=*/true);
     MergeVocabs(h.global, h.workers);
     int64_t n = 0, raw = 0;
@@ -598,6 +874,126 @@ void mri_stream_chunk_free(StreamChunkResult* r) {
   std::free(r);
 }
 
+// Device-feed variant for the windowed overlap plan: returns the
+// half-bandwidth ``[terms | docs]`` uint16 upload buffer directly
+// (0xFFFF padding, each half ``padded`` long with ``padded`` the pair
+// count rounded up to ``granule``) — no host-side divmod/pack pass.
+// Falls back to packed int32 keys (``keys`` non-null, ``feed_u16``
+// null) when a provisional id outgrows uint16; ``num_pairs`` = -1
+// signals int32 key overflow (same contract as mri_stream_feed).
+struct StreamChunkU16Result {
+  int64_t num_pairs;
+  int64_t raw_tokens;
+  int64_t padded;       // half-length of feed_u16 (0 in keys mode)
+  uint16_t* feed_u16;   // [2 * padded] or NULL
+  int32_t* keys;        // [num_pairs] or NULL
+};
+
+StreamChunkU16Result* mri_stream_feed_u16(void* handle, const uint8_t* data,
+                                          int64_t len,
+                                          const int64_t* doc_ends,
+                                          const int32_t* doc_id_values,
+                                          int32_t num_docs,
+                                          int64_t granule) try {
+  auto& h = *static_cast<StreamHandle*>(handle);
+  auto* res = static_cast<StreamChunkU16Result*>(
+      std::malloc(sizeof(StreamChunkU16Result)));
+  if (!res) return nullptr;
+  res->feed_u16 = nullptr;
+  res->keys = nullptr;
+  res->padded = 0;
+  const int64_t stride = h.stride;
+  std::vector<int32_t> ids;
+  std::vector<int32_t> docs;
+
+  if (h.workers.empty()) {  // single-threaded: scan straight into global
+    ids.reserve(len / 24 + 16);
+    docs.reserve(len / 24 + 16);
+    const int64_t raw_before = h.global.raw_tokens;
+    ScanChunk(h.global, data, len, 0, doc_ends, doc_id_values, 0, num_docs,
+              /*dedup=*/true, [&](int32_t id, int32_t doc) {
+                ids.push_back(id);
+                docs.push_back(doc);
+              });
+    res->raw_tokens = h.global.raw_tokens - raw_before;
+  } else {  // fork-join scan + vocab-scale merge, then remap
+    ParallelScan(h.workers, data, len, doc_ends, doc_id_values, num_docs,
+                 /*dedup=*/true);
+    MergeVocabs(h.global, h.workers);
+    int64_t n = 0, raw = 0;
+    for (const Worker& w : h.workers) {
+      n += static_cast<int64_t>(w.pair_lids.size());
+      raw += w.raw_in_window;
+    }
+    res->raw_tokens = raw;
+    ids.reserve(n);
+    docs.reserve(n);
+    for (const Worker& w : h.workers)
+      for (size_t k = 0; k < w.pair_lids.size(); ++k) {
+        ids.push_back(w.l2g[w.pair_lids[k]]);
+        docs.push_back(w.pair_docs[k]);
+      }
+  }
+
+  const int64_t n = static_cast<int64_t>(ids.size());
+  res->num_pairs = n;
+  // prov ids are first-occurrence ranks, so the global high-water mark
+  // bounds every id in this window.  u16 mode also requires the packed
+  // key the DEVICE reconstructs (id * stride + doc, int32) to fit —
+  // otherwise fall through to the int32 branch, whose per-key check
+  // raises the KeyOverflow contract instead of wrapping on device.
+  const bool fits_u16 =
+      h.global.next_id <= 0xFFFF &&
+      static_cast<int64_t>(h.global.next_id - 1) * stride + (stride - 1) <
+          INT32_MAX;
+  if (fits_u16) {
+    const int64_t g = granule > 0 ? granule : 1;
+    const int64_t padded = n ? ((n + g - 1) / g) * g : 0;
+    res->padded = padded;
+    if (padded) {
+      res->feed_u16 = static_cast<uint16_t*>(
+          std::malloc(sizeof(uint16_t) * 2 * padded));
+      if (!res->feed_u16) {
+        std::free(res);
+        return nullptr;
+      }
+      for (int64_t k = 0; k < n; ++k) {
+        res->feed_u16[k] = static_cast<uint16_t>(ids[k]);
+        res->feed_u16[padded + k] = static_cast<uint16_t>(docs[k]);
+      }
+      for (int64_t k = n; k < padded; ++k)
+        res->feed_u16[k] = res->feed_u16[padded + k] = 0xFFFF;
+    }
+    return res;
+  }
+  // prov ids beyond uint16: fall back to packed int32 keys
+  res->keys = static_cast<int32_t*>(
+      std::malloc(sizeof(int32_t) * std::max<int64_t>(n, 1)));
+  if (!res->keys) {
+    std::free(res);
+    return nullptr;
+  }
+  for (int64_t k = 0; k < n; ++k) {
+    const int64_t key = static_cast<int64_t>(ids[k]) * stride + docs[k];
+    if (key >= INT32_MAX) {
+      h.key_overflow = true;
+      res->num_pairs = -1;
+      return res;
+    }
+    res->keys[k] = static_cast<int32_t>(key);
+  }
+  return res;
+} catch (const std::bad_alloc&) {
+  return nullptr;
+}
+
+void mri_stream_chunk_u16_free(StreamChunkU16Result* r) {
+  if (!r) return;
+  std::free(r->feed_u16);
+  std::free(r->keys);
+  std::free(r);
+}
+
 StreamFinalResult* mri_stream_finalize(void* handle) try {
   auto& h = *static_cast<StreamHandle*>(handle);
   StreamState& st = h.global;
@@ -615,7 +1011,9 @@ StreamFinalResult* mri_stream_finalize(void* handle) try {
   if (h.workers.empty()) {
     raw_tokens = st.raw_tokens;
     num_pairs = st.num_pairs;
-    df_src = st.df.data();
+    df_mt.resize(std::max(vocab, 1));
+    for (int32_t i = 0; i < vocab; ++i) df_mt[i] = st.combiner[i].df;
+    df_src = df_mt.data();
   } else {
     raw_tokens = num_pairs = 0;
     for (const Worker& w : h.workers) {
@@ -837,7 +1235,6 @@ int32_t mri_host_index(const uint8_t* data, int64_t len,
                        const int64_t* doc_ends, const int32_t* doc_id_values,
                        int32_t num_docs, const char* out_dir,
                        HostIndexStats* stats, int32_t num_threads) try {
-  (void)len;
   const int32_t T = std::max(num_threads, 1);
   const std::vector<int32_t> cuts = PlanRanges(doc_ends, num_docs, T);
 
@@ -852,7 +1249,7 @@ int32_t mri_host_index(const uint8_t* data, int64_t len,
     HostWorker& w = workers[t];
     const int32_t lo = cuts[t], hi = cuts[t + 1];
     const int64_t start_pos = lo ? doc_ends[lo - 1] : 0;
-    ScanChunk(w.local, data, start_pos, doc_ends, doc_id_values, lo, hi,
+    ScanChunk(w.local, data, len, start_pos, doc_ends, doc_id_values, lo, hi,
               /*dedup=*/true, [&](int32_t id, int32_t doc) {
                 if (id >= static_cast<int32_t>(w.postings.size()))
                   w.postings.resize(id + 1);

@@ -169,6 +169,47 @@ def test_fraction_windows_rejects_bad_fractions():
         plan_fraction_windows(m, (0.5, 0.2))
 
 
+# -- native u16 feed -----------------------------------------------------
+
+
+def _feed_docs(words):
+    contents = [(" ".join(words)).encode()]
+    return contents, [1]
+
+
+def test_feed_u16_overflow_guard():
+    """u16 mode must refuse keys the device would wrap past int32.
+
+    With a huge stride, 40 distinct prov ids already exceed
+    INT32_MAX when packed as ``id * stride + doc`` — the feed must take
+    the int32 branch and raise KeyOverflow, never hand the device a
+    uint16 buffer it would decode into wrapped (corrupt) keys.
+    """
+    words = [f"w{chr(97 + i)}{chr(97 + j)}" for i in range(8) for j in range(6)]
+    stream = native.NativeKeyStream(1 << 26, num_threads=1)
+    try:
+        with pytest.raises(native.KeyOverflow):
+            stream.feed_u16(*_feed_docs(words))
+    finally:
+        stream.close()
+
+
+def test_feed_u16_near_boundary_still_u16():
+    """Just under the int32 key bound, u16 mode stays on and decodes right."""
+    words = [f"x{chr(97 + i)}" for i in range(20)]
+    stride = 1 << 26  # 19 * 2^26 + doc < INT32_MAX
+    stream = native.NativeKeyStream(stride, num_threads=1)
+    try:
+        mode, buf, n, _ = stream.feed_u16(*_feed_docs(words), granule=8)
+        assert mode == "u16" and n == 20
+        padded = buf.shape[0] // 2
+        terms, docs = buf[:n], buf[padded: padded + n]
+        assert sorted(terms.tolist()) == list(range(20))
+        assert (docs == 1).all()
+    finally:
+        stream.close()
+
+
 # -- native multi-run emit -----------------------------------------------
 
 

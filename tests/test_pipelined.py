@@ -155,13 +155,20 @@ def test_key_overflow_falls_back_to_one_shot(tmp_path, monkeypatch):
     oracle_index(m, tmp_path / "oracle")
 
     real_feed = native.NativeKeyStream.feed
+    real_feed_u16 = native.NativeKeyStream.feed_u16
 
     def exploding_feed(self, contents, doc_ids):
         if doc_ids and doc_ids[0] > 5:
             raise native.KeyOverflow()
         return real_feed(self, contents, doc_ids)
 
+    def exploding_feed_u16(self, contents, doc_ids, granule=1 << 14):
+        if doc_ids and doc_ids[0] > 5:
+            raise native.KeyOverflow()
+        return real_feed_u16(self, contents, doc_ids, granule)
+
     monkeypatch.setattr(native.NativeKeyStream, "feed", exploding_feed)
+    monkeypatch.setattr(native.NativeKeyStream, "feed_u16", exploding_feed_u16)
     report = InvertedIndexModel(_cfg(pipeline_chunk_docs=2)).run(
         m, output_dir=tmp_path / "out")
     assert report["pipelined_fallback"] == "key_overflow"
