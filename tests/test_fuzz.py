@@ -100,9 +100,9 @@ def test_frontends_agree_on_byte_soup(seed):
         assert got_nat == want
 
 
-@pytest.mark.parametrize("seed", [3, 4])
-def test_backends_agree_on_byte_soup(tmp_path, seed):
-    docs = _byte_soup_docs(seed, 25)
+def _soup_corpus(tmp_path, seed: int, num_docs: int = 25):
+    """Byte-soup corpus on disk + oracle golden: (manifest, golden)."""
+    docs = _byte_soup_docs(seed, num_docs)
     paths = []
     for i, doc in enumerate(docs):
         p = tmp_path / f"doc{i:03d}.bin"
@@ -111,7 +111,12 @@ def test_backends_agree_on_byte_soup(tmp_path, seed):
     write_manifest(tmp_path / "list.txt", paths)
     m = read_manifest(tmp_path / "list.txt")
     oracle_index(m, tmp_path / "oracle")
-    golden = read_letter_files(tmp_path / "oracle")
+    return m, read_letter_files(tmp_path / "oracle")
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_backends_agree_on_byte_soup(tmp_path, seed):
+    m, golden = _soup_corpus(tmp_path, seed)
     build_index(m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=1),
                 output_dir=tmp_path / "pipe")
     assert read_letter_files(tmp_path / "pipe") == golden
@@ -122,6 +127,52 @@ def test_backends_agree_on_byte_soup(tmp_path, seed):
     assert read_letter_files(tmp_path / "cpu") == golden
 
 
+def test_simd_scan_boundary_cases():
+    """Deterministic adversarial cases for the mask-driven SIMD scan
+    (native/tokenizer.cc ScanChunkSimd): tokens at the exact buffer
+    end, tokens spanning 64-byte mask-word boundaries, raw-cache
+    aliasing via trailing NULs, and the 299-letter cap across pext
+    chunks.  The numpy frontend is the reference implementation."""
+    docs = [
+        b"endtoken",                          # 8-byte token, no trailing space, buffer end
+        b" " * 60 + b"crossingboundary",      # token spans the 64-byte mask word
+        b"ab ab\x00 ab\x00\x00 ab",           # trailing NULs clean to the same word
+        b"x" * 298 + b"-" + b"y" * 20,        # cap at 299 across pext chunks
+        b"123 --- \x00\x00\x00",              # tokens that clean to nothing
+        b"the the the the the the the",       # hot cache-hit path + combiner dedup
+        b"a" * 63 + b" " + b"b" * 64,         # runs aligned to mask-word edges
+        b"tail7zz",                           # 7-byte token at buffer end
+    ]
+    ids = list(range(1, len(docs) + 1))
+    ref = tokenize(docs, ids, use_native=False, dedup_pairs=True)
+    words = ref.vocab_strings()
+    want = {(words[t], int(d)) for t, d in zip(ref.term_ids, ref.doc_ids)}
+    if not native.available():
+        pytest.skip("native tokenizer unavailable")
+    for threads in (1, 3):
+        nat = native.tokenize_native(docs, ids, dedup_pairs=True,
+                                     num_threads=threads)
+        words_n = [w.rstrip(b"\x00").decode("ascii") for w in nat.vocab.tolist()]
+        got = {(words_n[t], int(d)) for t, d in zip(nat.term_ids, nat.doc_ids)}
+        assert got == want, f"threads={threads}"
+    # the capped long token must keep exactly the first 299 letters
+    capped = [w for w in want if len(w[0]) == 299]
+    assert capped and capped[0][0] == "x" * 298 + "y"
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_overlap_plan_agrees_on_byte_soup(tmp_path, seed):
+    """The windowed overlap plan under byte soup (device windows + host
+    tail + multi-run emit must agree with the oracle byte-for-byte)."""
+    if not native.available():
+        pytest.skip("overlap requires the pipelined (native) path")
+    m, golden = _soup_corpus(tmp_path, seed)
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64, device_shards=1,
+                               overlap_tail_fraction=0.4),
+                output_dir=tmp_path / "ovl")
+    assert read_letter_files(tmp_path / "ovl") == golden
+
+
 @pytest.mark.parametrize("seed", [5, 6])
 def test_mt_and_letter_emit_agree_on_byte_soup(tmp_path, seed):
     """Multithreaded scan and letter-ownership emit under byte soup."""
@@ -129,21 +180,12 @@ def test_mt_and_letter_emit_agree_on_byte_soup(tmp_path, seed):
         pytest.skip("letter emit requires the pipelined (native) path")
     docs = _byte_soup_docs(seed, 25)
     ids = list(range(1, len(docs) + 1))
-    if native.available():
-        st = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=1)
-        mt = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=5)
-        np.testing.assert_array_equal(st.term_ids, mt.term_ids)
-        np.testing.assert_array_equal(st.doc_ids, mt.doc_ids)
-        np.testing.assert_array_equal(st.vocab, mt.vocab)
-    paths = []
-    for i, doc in enumerate(docs):
-        p = tmp_path / f"doc{i:03d}.bin"
-        p.write_bytes(doc)
-        paths.append(str(p))
-    write_manifest(tmp_path / "list.txt", paths)
-    m = read_manifest(tmp_path / "list.txt")
-    oracle_index(m, tmp_path / "oracle")
-    golden = read_letter_files(tmp_path / "oracle")
+    st = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=1)
+    mt = native.tokenize_native(docs, ids, dedup_pairs=True, num_threads=5)
+    np.testing.assert_array_equal(st.term_ids, mt.term_ids)
+    np.testing.assert_array_equal(st.doc_ids, mt.doc_ids)
+    np.testing.assert_array_equal(st.vocab, mt.vocab)
+    m, golden = _soup_corpus(tmp_path, seed)
     build_index(m, IndexConfig(backend="tpu", pad_multiple=64,
                                emit_ownership="letter", host_threads=3),
                 output_dir=tmp_path / "letter")
