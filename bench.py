@@ -193,6 +193,22 @@ def _tpu_child() -> int:
     finally:
         signal.alarm(0)
     print(json.dumps(result), flush=True)
+    # All-device engine, recorded as its own datapoint (it cannot win on
+    # a ~60 ms-RTT link — its two serial syncs are the wall — but the
+    # number belongs in the artifact: on local-PCIe hardware this is
+    # the headline plan).  Same alarm discipline as the kernel probe.
+    signal.alarm(int(os.environ.get("MRI_TPU_DEVTOK_PROBE_S", 240)))
+    try:
+        devtok = _measure("tpu", [{"device_tokenize": True}])
+        result["device_tokenize_ms"] = round(devtok["best_ms"], 2)
+        result["device_tokenize_phases_ms"] = {
+            k: round(v, 2) for k, v in devtok.get("phases_ms", {}).items()}
+    except BaseException as e:
+        result["device_tokenize_ms"] = None
+        result["device_tokenize_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        signal.alarm(0)
+    print(json.dumps(result), flush=True)
     return 0
 
 
@@ -213,7 +229,20 @@ def _run_tpu_attempts() -> tuple[dict | None, list[str]]:
                         log)
             log.append(f"attempt {attempt + 1}: rc={proc.returncode} "
                        f"stderr={proc.stderr[-500:]}")
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            # the child prints the grid line BEFORE the probes — salvage
+            # it so probe overruns cannot erase a finished measurement
+            partial = (e.stdout or b"")
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            for line in reversed(partial.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                log.append(f"attempt {attempt + 1}: timeout after "
+                           f"{timeout}s (grid line salvaged)")
+                return parsed, log
             log.append(f"attempt {attempt + 1}: timeout after {timeout}s")
         except (json.JSONDecodeError, KeyError, IndexError) as e:
             log.append(f"attempt {attempt + 1}: bad child output "

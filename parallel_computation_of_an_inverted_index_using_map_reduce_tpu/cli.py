@@ -47,6 +47,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-chunk-docs", type=int, default=None,
                    help="pipelined fast path: documents per upload window "
                         "(default: auto, two windows; 0 = one-shot engine)")
+    p.add_argument("--device-tokenize", action="store_true",
+                   help="all-device engine: raw corpus bytes up, finished "
+                        "index down (the whole map phase as one XLA program; "
+                        "single chip; exact, with host fallback for tokens "
+                        "longer than --device-tokenize-width)")
+    p.add_argument("--device-tokenize-width", type=int, default=48,
+                   help="device word-row bytes (multiple of 4)")
     p.add_argument("--overlap-tail-fraction", type=float, default=None,
                    help="windowed overlap plan: this fraction of corpus "
                         "bytes (the last doc range) is indexed on host "
@@ -79,6 +86,8 @@ def main(argv: list[str] | None = None) -> int:
             stream_chunk_docs=args.stream_chunk_docs,
             pipeline_chunk_docs=args.pipeline_chunk_docs,
             overlap_tail_fraction=args.overlap_tail_fraction,
+            device_tokenize=args.device_tokenize,
+            device_tokenize_width=args.device_tokenize_width,
             host_threads=args.host_threads,
             emit_ownership=args.emit_ownership,
         )

@@ -81,6 +81,20 @@ class IndexConfig:
     # Emit concatenates the per-window runs in doc order (no merge
     # pass).  None = disabled (plain pipelined plan); must be in (0, 1).
     overlap_tail_fraction: float | None = None
+    # Device-side tokenizer (ops/device_tokenizer.py): raw corpus bytes
+    # go up, the finished index comes down — the ENTIRE map phase (byte
+    # classify, token segmentation, cleaning, dedup, df, postings) as
+    # one XLA program; no host scan at all.  Exact (no hashing): words
+    # live as fixed-width byte rows sorted lexicographically; a cleaned
+    # token longer than ``device_tokenize_width`` aborts to the host
+    # path (WidthOverflow), keeping output byte-identical always.
+    # Single chip; wins where the host<->device link is cheap (local
+    # PCIe) — on a high-RTT link the host-scan plans win end-to-end.
+    device_tokenize: bool = False
+    # Word-row width in bytes (multiple of 4; >= the longest cleaned
+    # token or the run falls back).  48 covers real text with margin
+    # (reference corpus max: 38 letters).
+    device_tokenize_width: int = 48
     # Host map-phase threads for the native tokenizer (contiguous
     # byte-balanced doc ranges, merged at vocab scale — output-identical
     # at any count).  None = ``num_mappers`` if > 1, else auto
@@ -157,6 +171,33 @@ class IndexConfig:
                 raise ValueError(
                     "overlap_tail_fraction is single-chip; "
                     "emit_ownership='letter' is the multi-chip emit path")
+        # upper bound 296 (< MAX_WORD_LETTERS): a width that could hold
+        # a 299+-letter token would silently skip the reference's 299
+        # cap (main.c:105) instead of falling back to the host path
+        if not (4 <= self.device_tokenize_width <= 296
+                and self.device_tokenize_width % 4 == 0):
+            raise ValueError(
+                "device_tokenize_width must be a multiple of 4 in [4, 296], "
+                f"got {self.device_tokenize_width}")
+        if self.device_tokenize:
+            if self.backend != "tpu":
+                raise ValueError(
+                    "device_tokenize requires backend='tpu', "
+                    f"got backend={self.backend!r}")
+            for flag in ("stream_chunk_docs", "checkpoint_path",
+                         "pipeline_chunk_docs", "overlap_tail_fraction"):
+                if getattr(self, flag) is not None:
+                    raise ValueError(
+                        f"device_tokenize is a complete engine; {flag} "
+                        "belongs to the host-scan plans")
+            if self.collect_skew_stats:
+                raise ValueError(
+                    "device_tokenize is incompatible with collect_skew_stats "
+                    "(no host-side pair ids exist)")
+            if self.emit_ownership == "letter":
+                raise ValueError(
+                    "device_tokenize is single-chip; emit_ownership='letter' "
+                    "is the multi-chip emit path")
         if self.host_threads is not None and self.host_threads < 1:
             raise ValueError(
                 f"host_threads must be >= 1 or None (auto), got {self.host_threads}")

@@ -672,7 +672,143 @@ class InvertedIndexModel:
         timer.count("bytes_written", bytes_written)
         return timer.report()
 
+    def _run_tpu_device_tokenize(self, manifest: Manifest, out_dir: str,
+                                 timer: PhaseTimer) -> dict:
+        """All-device engine: raw bytes up, finished index down.
+
+        The whole map phase — the reference's mapper tokenize/clean/emit
+        (main.c:85-124) AND its reducer dedup/df/sort (main.c:126-242) —
+        runs as one XLA program over the corpus byte tensor
+        (ops/device_tokenizer.py).  The host only loads files, decodes
+        the fetched unique word rows, and renders the letter files.
+        Exact by construction (words are sorted byte rows, not hashes);
+        a cleaned token longer than ``device_tokenize_width`` raises
+        WidthOverflow and the caller restarts on the host-scan path.
+        """
+        from ..ops import device_tokenizer as DT
+
+        cfg = self.config
+        width = cfg.device_tokenize_width
+        max_doc_id = len(manifest)
+        with timer.phase("load"):
+            contents, doc_ids = load_documents(manifest)
+        num_docs = len(contents)
+        total = sum(len(c) for c in contents)
+        timer.count("documents", num_docs)
+        timer.count("device_tokenize_width", width)
+        if num_docs == 0 or total == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        profile = (
+            jax.profiler.trace(cfg.profile_dir)
+            if cfg.profile_dir else contextlib.nullcontext()
+        )
+        with profile:
+            with timer.phase("feed"):
+                padded = _round_up(total, cfg.pad_multiple)
+                buf = np.full(padded, 0x20, np.uint8)  # space padding
+                buf[:total] = np.frombuffer(b"".join(contents), np.uint8)
+                ends = np.cumsum(
+                    [len(c) for c in contents]).astype(np.int32)
+                # Exact token count via vectorized masks (NOT a scan —
+                # a handful of whole-array byte compares): a snug
+                # tok_cap shrinks every device array ~2.5x vs the
+                # worst-case N/2 + 1 bound on real text.
+                sp = ((buf == 0x20) | (buf == 0x09) | (buf == 0x0A)
+                      | (buf == 0x0B) | (buf == 0x0C) | (buf == 0x0D))
+                prev_sp = np.empty_like(sp)
+                prev_sp[0] = True
+                prev_sp[1:] = sp[:-1]
+                start = ~sp & prev_sp
+                start[0] = not sp[0]
+                start[ends[:-1][ends[:-1] < padded]] |= ~sp[
+                    ends[:-1][ends[:-1] < padded]]
+                # the mask count is exact; note N//2+1 is NOT a valid
+                # fallback bound (doc boundaries split tokens, so up to
+                # one token per byte)
+                tok_cap = _round_up(int(np.count_nonzero(start)) + 1, 1 << 15)
+                out = DT.index_bytes_device(
+                    jax.device_put(buf), jax.device_put(ends),
+                    jax.device_put(np.asarray(doc_ids, np.int32)),
+                    width=width, tok_cap=tok_cap, num_docs=num_docs)
+            with timer.phase("device_index"):
+                num_words, num_pairs, max_len, num_tokens = (
+                    int(v) for v in np.asarray(out["counts"]))
+                if num_tokens + 1 > tok_cap:
+                    raise AssertionError(
+                        f"device token count {num_tokens} exceeded "
+                        f"tok_cap {tok_cap}: host mask count diverged "
+                        "from the device classifier (bug)")
+                if max_len > width:
+                    raise DT.WidthOverflow(
+                        f"cleaned token of {max_len} letters exceeds "
+                        f"device_tokenize_width={width}")
+            with timer.phase("fetch"):
+                # dispatch every prefix slice, then fetch them all
+                # concurrently — sequential fetches would each pay the
+                # link's fixed RTT
+                nu = min(tok_cap, _round_up(max(num_words, 1), 1 << 13))
+                npairs = min(tok_cap, _round_up(max(num_pairs, 1), 1 << 13))
+                df_d = out["df"][:nu]
+                cols_d = [c[:nu] for c in out["unique_cols"]]
+                post_d = out["postings"][:npairs]
+                for a in (df_d, post_d, *cols_d):
+                    a.copy_to_host_async()
+                df = np.asarray(df_d)[:num_words]
+                cols = [np.asarray(c)[:num_words] for c in cols_d]
+                postings = np.asarray(post_d)[:num_pairs]
+        timer.count("unique_terms", num_words)
+        timer.count("unique_pairs", num_pairs)
+        timer.count("device_shards", 1)
+        # raw token count is not materialized on host in this engine;
+        # record the deduped pair count the device measured instead
+        timer.count("tokens", num_pairs)
+        if num_pairs == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+        with timer.phase("host_views"):
+            vocab = DT.decode_word_rows(cols, width)
+            letters = vocab.view(np.uint8).reshape(num_words, width)[:, 0] - ord("a")
+            df64 = df.astype(np.int64)
+            order, offsets = engine.host_order_offsets(letters, df64)
+        with timer.phase("emit"):
+            from .. import native
+
+            if cfg.use_native and native.available():
+                bytes_written = native.emit_native(
+                    out_dir, vocab, order, df64, offsets,
+                    postings.astype(np.int32))
+                emit_stats = {"lines_written": num_words,
+                              "bytes_written": bytes_written}
+            else:
+                emit_stats = formatter.emit_index(
+                    out_dir, vocab=vocab, letter_of_term=letters,
+                    order=order, df=df64, offsets=offsets,
+                    postings=postings, max_doc_id=max_doc_id)
+        timer.count("lines_written", emit_stats["lines_written"])
+        return timer.report()
+
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
+        if self.config.device_tokenize:
+            from ..ops.device_tokenizer import WidthOverflow
+
+            if self._num_shards() > 1 and self.config.device_shards is not None:
+                raise ValueError(
+                    "device_tokenize is a single-chip engine "
+                    "(set device_shards=1 or leave it unset)")
+            try:
+                return self._run_tpu_device_tokenize(manifest, out_dir, timer)
+            except WidthOverflow as e:
+                # exactness guard tripped: restart on the host-scan path
+                aborted_ms = timer.total_seconds * 1e3
+                self.timer = timer = PhaseTimer()
+                timer.count("num_mappers", self.config.num_mappers)
+                timer.count("num_reducers", self.config.num_reducers)
+                timer.count("device_tokenize_fallback", str(e))
+                timer.phases["aborted_device_tokenize"] = aborted_ms / 1e3
         if self.config.emit_ownership == "letter":
             if self._num_shards() < 2:
                 raise ValueError(

@@ -1,0 +1,211 @@
+"""Device-side tokenizer: the ENTIRE map phase as one XLA program.
+
+Every other engine in this package keeps the reference's split: host
+scans text (main.c:102-117 re-expressed in C++/numpy), device sorts
+integers.  This module removes the host from the compute path entirely:
+raw corpus bytes go up, the finished index comes down.
+
+    bytes (uint8, N) ──► classify: space/letter via 256-entry tables
+        ──► token segmentation: start mask, token ids, within-token
+            letter ranks — all cumsum/cummax scans, no loops
+        ──► scatter cleaned letters into fixed-width word rows
+        ──► pack rows into big-endian int32 columns
+            (cleaned bytes are a-z < 0x80, so signed int32 ascending
+             == byte-lexicographic ascending)
+        ──► ONE variadic ``lax.sort`` over (word columns…, doc)
+        ──► boundary-diff word/pair dedup ► df ► postings ► unique rows
+
+Exactness without strings-on-host: rows are the *actual cleaned bytes*
+(no hashing, no collisions); sorted-row order IS strcmp order because
+rows are zero-padded (0x00 < any letter, so shorter words sort first —
+the same argument as the C side's prefix keys, native/tokenizer.cc
+SortedOrder).  Words longer than ``width`` cleaned letters cannot be
+represented exactly; the program returns the global max cleaned length
+and the caller MUST fall back to a host path when it exceeds ``width``
+(``WidthOverflow``).  The reference's own cap is 299 (main.c:105), and
+its corpus maxes at 38, so ``width=48`` covers real text with margin.
+
+This is the TPU-first endpoint of the design space: on hardware where
+the host<->device link is ~free (local PCIe), the whole pipeline runs
+at device sort throughput; on a high-RTT link the host-scan engines
+win end-to-end (bench.py records both, labeled).  Reference seams
+re-expressed: mapper tokenize+emit (main.c:85-124) and reducer
+dedup/sort (main.c:126-242) become one fused program with no
+intermediate materialization at all — not even the (term, doc) pair
+array the other engines feed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .segment import compact
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+class WidthOverflow(Exception):
+    """A cleaned token exceeded the row width — the device rows would be
+    truncated (inexact); the caller must fall back to a host tokenizer."""
+
+
+@functools.lru_cache(maxsize=1)
+def _byte_tables():
+    """(space, lower) 256-entry tables — the exact C-locale contract of
+    the native scan (native/tokenizer.cc ByteTables).  Cached as numpy
+    (NOT device arrays: an lru-cached jnp value created inside a trace
+    would leak that trace's tracers into later calls); jit closes over
+    them as constants."""
+    space = np.zeros(256, np.bool_)
+    for b in b" \t\n\v\f\r":
+        space[b] = True
+    lower = np.zeros(256, np.uint8)
+    for b in range(ord("a"), ord("z") + 1):
+        lower[b] = b
+    for b in range(ord("A"), ord("Z") + 1):
+        lower[b] = b + 32
+    return space, lower
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("width", "tok_cap", "num_docs"),
+)
+def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
+                       tok_cap: int, num_docs: int):
+    """bytes -> sorted/deduped index, entirely on device.
+
+    ``data``: uint8 (N,) — concatenated documents, padded with spaces
+    (0x20) to a static length.  ``doc_ends``: int32 (num_docs,)
+    exclusive end offsets.  ``doc_id_values``: int32 (num_docs,)
+    1-based ids.  ``width``: word-row bytes, multiple of 4.
+    ``tok_cap``: static token capacity — must be > the true token count
+    (callers compute it exactly with vectorized masks; note doc
+    boundaries split tokens, so up to one token per byte can exist).
+
+    Returns a dict of fixed-shape arrays; valid prefixes are bounded by
+    ``num_words`` / ``num_pairs`` (see caller).  ``max_word_len`` must
+    be checked against ``width`` host-side (WidthOverflow contract).
+    """
+    n = data.shape[0]
+    space_np, lower_np = _byte_tables()
+    is_space = jnp.asarray(space_np)[data]
+    lowered = jnp.asarray(lower_np)[data]
+    is_letter = lowered > 0
+
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # first byte of each document forces a token break (tokens never
+    # span documents — the per-doc scan loop of every host frontend)
+    doc_starts = jnp.zeros(n, jnp.bool_).at[doc_ends[:-1]].set(
+        True, mode="drop").at[0].set(True)
+    nonspace = ~is_space
+    prev_space = jnp.concatenate([jnp.ones(1, jnp.bool_), is_space[:-1]])
+    token_start = nonspace & (prev_space | doc_starts)
+
+    tok_id = jnp.cumsum(token_start.astype(jnp.int32)) - 1  # per byte
+    # within-token letter rank: letters in [token_start, i)
+    cs = jnp.cumsum(is_letter.astype(jnp.int32))
+    start_pos = lax.cummax(jnp.where(token_start, pos, -1))
+    cs_at_start = cs[jnp.maximum(start_pos, 0)]
+    letter_at_start = is_letter[jnp.maximum(start_pos, 0)].astype(jnp.int32)
+    k = cs - cs_at_start + letter_at_start - 1  # 0-based, valid where is_letter
+
+    # scatter cleaned letters straight into big-endian-packed int32 word
+    # columns, laid out column-major as ONE flat (width/4 * tok_cap)
+    # buffer — a (tok_cap, width) byte matrix (or any array with a tiny
+    # minor dimension) would be padded to the TPU's (8, 128) tile and
+    # blow HBM by ~32x.  Each (token, letter-rank) cell is written at
+    # most once, so scatter-add over zeros composes the shifted bytes.
+    ncols = width // 4
+    emit = is_letter & (k < width) & (tok_id >= 0)
+    shifted = lowered.astype(jnp.int32) << (8 * (3 - (k % 4)))
+    flat_idx = jnp.where(emit, (k // 4) * tok_cap + tok_id, ncols * tok_cap)
+    packed = jnp.zeros(ncols * tok_cap, jnp.int32).at[flat_idx].add(
+        shifted, mode="drop")
+
+    # cleaned length per token (for the exactness guard): letters with
+    # NO width clip — a token's true cleaned length, capped only by the
+    # reference's own 299 semantics at the caller
+    tok_len = jnp.zeros(tok_cap, jnp.int32).at[
+        jnp.where(is_letter & (tok_id >= 0), tok_id, tok_cap)
+    ].add(1, mode="drop")
+    max_word_len = tok_len.max() if tok_cap else jnp.int32(0)
+
+    # doc id per token: token start byte -> manifest slot -> 1-based id
+    tok_start_byte = jnp.zeros(tok_cap, jnp.int32).at[
+        jnp.where(token_start, tok_id, tok_cap)
+    ].add(jnp.where(token_start, pos, 0), mode="drop")
+    slot = jnp.searchsorted(doc_ends, tok_start_byte, side="right")
+    doc_of_tok = doc_id_values[jnp.clip(slot, 0, num_docs - 1)]
+
+    # valid rows (>= 1 letter) have column 0's top byte in [a-z] =>
+    # positive int32; empty/padding rows get INT32_MAX in column 0 so
+    # they sort after every real word
+    num_tokens = jnp.int32(0) + jnp.sum(token_start.astype(jnp.int32))
+    valid_tok = (tok_len > 0) & (jnp.arange(tok_cap) < num_tokens)
+    cols = [packed[c * tok_cap:(c + 1) * tok_cap] for c in range(ncols)]
+    col0 = jnp.where(valid_tok, cols[0], INT32_MAX)
+    doc_col = jnp.where(valid_tok, doc_of_tok, INT32_MAX)
+
+    # Lexicographic (word columns…, doc) order via LSD radix: stable
+    # single-key passes from least significant (doc) to most (col 0).
+    # Identical result to one variadic comparator sort, but the TPU AOT
+    # compiler takes ~80x longer on the wide comparator (measured:
+    # 1403 s for a 13-key sort vs 17.8 s for 13 stable passes at 2^21).
+    perm = jnp.arange(tok_cap, dtype=jnp.int32)
+    for key in (doc_col, *cols[ncols - 1:0:-1], col0):
+        _, perm = lax.sort((key[perm], perm), num_keys=1, is_stable=True)
+    s_cols = tuple(c[perm] for c in (col0, *cols[1:]))
+    s_docs = doc_col[perm]
+
+    def neq_prev(a):
+        return jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), a[1:] != a[:-1]])
+
+    word_valid = s_cols[0] != INT32_MAX
+    first_word = word_valid & functools.reduce(
+        jnp.logical_or, (neq_prev(c) for c in s_cols))
+    first_pair = word_valid & (first_word | neq_prev(s_docs))
+
+    word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
+    num_words = first_word.sum(dtype=jnp.int32)
+    num_pairs = first_pair.sum(dtype=jnp.int32)
+    df = jnp.zeros(tok_cap, jnp.int32).at[
+        jnp.where(first_pair, word_rank, tok_cap)
+    ].add(1, mode="drop")
+    postings = compact(s_docs, first_pair, tok_cap, jnp.int32(0))
+    unique_cols = tuple(
+        compact(c, first_word, tok_cap, jnp.int32(0)) for c in s_cols)
+
+    return {
+        # one 4-scalar array: ONE host sync fetches all counts (each
+        # scalar fetched separately would pay the link RTT per scalar);
+        # num_tokens lets the caller verify its tok_cap bound held
+        "counts": jnp.stack([num_words, num_pairs, max_word_len,
+                             num_tokens]),
+        "df": df,                    # (tok_cap,) valid prefix num_words
+        "postings": postings,        # (tok_cap,) valid prefix num_pairs
+        "unique_cols": unique_cols,  # width//4 x (tok_cap,) prefix num_words
+    }
+
+
+def decode_word_rows(cols: list[np.ndarray], width: int) -> np.ndarray:
+    """Fetched big-endian int32 columns -> numpy 'S(width)' word array.
+
+    Column 0 of row 0..U-1 had INT32_MAX replaced only for padding rows,
+    which the caller already sliced off, so a plain byte-reassembly is
+    exact."""
+    u = cols[0].shape[0]
+    out = np.zeros((u, width), np.uint8)
+    for c, col in enumerate(cols):
+        col = col.astype(np.uint32)
+        out[:, 4 * c + 0] = (col >> 24) & 0xFF
+        out[:, 4 * c + 1] = (col >> 16) & 0xFF
+        out[:, 4 * c + 2] = (col >> 8) & 0xFF
+        out[:, 4 * c + 3] = col & 0xFF
+    return np.ascontiguousarray(out).view(f"S{width}").reshape(u)

@@ -108,10 +108,15 @@ def test_cli_host_threads_and_emit_ownership(tmp_path, capsys):
 
 
 def test_cli_emit_ownership_letter(tmp_path):
+    import pytest
+
+    import jax
+
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
     if not native.available():
-        import pytest
         pytest.skip("letter emit requires the pipelined (native) path")
+    if len(jax.devices()) < 2:
+        pytest.skip("letter emit needs a multi-device mesh")
     listfile = _mk_corpus(tmp_path)
     out_l, out_o = tmp_path / "l", tmp_path / "o"
     assert main(["1", "1", str(listfile), "--output-dir", str(out_l),

@@ -1,0 +1,166 @@
+"""All-device engine (ops/device_tokenizer.py + device_tokenize=True):
+the entire map phase as one XLA program over raw corpus bytes.
+
+Exactness contract: byte-identical to the oracle for every corpus whose
+cleaned tokens fit ``device_tokenize_width``; anything longer trips
+WidthOverflow and falls back to the host-scan path — so output is
+byte-identical ALWAYS, and the engine never silently truncates."""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+    device_tokenizer as DT,
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("device_tokenize", True)
+    kw.setdefault("pad_multiple", 256)
+    return IndexConfig(**kw)
+
+
+def test_matches_goldens_smoke(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path)
+    assert "host_views" in report["phases_ms"]  # really took the device engine
+    assert "load" in report["phases_ms"]
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+@pytest.mark.parametrize("seed", [2, 9])
+def test_property_random_corpus_vs_oracle(tmp_path, seed):
+    docs = zipf_corpus(num_docs=37, vocab_size=800, tokens_per_doc=60, seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _cfg(), output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_tokenizer_edge_cases(tmp_path):
+    """The §2.3 contract cases through the device byte classifier."""
+    docs = [b"don't foo-bar x1y2z3 I.Loomings cafe\xcc\x81 42 --- UPPER",
+            b"a  b\tc\nd\ve\ff\rg", b"", b"ab ab\x00 ab"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _cfg(), output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_width_overflow_falls_back_exactly(tmp_path):
+    """A cleaned token longer than the row width must abort to the host
+    path and still produce byte-identical output."""
+    docs = [b"short words here", b"a" * 30 + b" tail", b"end doc"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(
+        _cfg(device_tokenize_width=16)).run(m, output_dir=tmp_path / "dev")
+    assert "device_tokenize_fallback" in report
+    assert "aborted_device_tokenize" in report["phases_ms"]
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_over_299_letter_token_falls_back(tmp_path):
+    """Tokens past the reference's own 299-letter cap (main.c:105) can
+    never be represented in a device row; the guard must fire."""
+    docs = [b"x" * 400 + b" normal words"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path / "dev")
+    assert "device_tokenize_fallback" in report
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_empty_and_allspace_corpus(tmp_path):
+    docs = [b"", b"  \t \r\n "]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == b""
+
+
+def test_numbers_only_corpus(tmp_path):
+    docs = [b"123 456", b"--- !!!"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    InvertedIndexModel(_cfg()).run(m, output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == b""
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="backend"):
+        IndexConfig(backend="cpu", device_tokenize=True)
+    with pytest.raises(ValueError, match="host-scan"):
+        IndexConfig(device_tokenize=True, overlap_tail_fraction=0.4)
+    with pytest.raises(ValueError, match="host-scan"):
+        IndexConfig(device_tokenize=True, stream_chunk_docs=10)
+    with pytest.raises(ValueError, match="skew"):
+        IndexConfig(device_tokenize=True, collect_skew_stats=True)
+    with pytest.raises(ValueError, match="device_tokenize_width"):
+        IndexConfig(device_tokenize_width=30)  # not a multiple of 4
+    with pytest.raises(ValueError, match="device_tokenize_width"):
+        IndexConfig(device_tokenize_width=300)  # could hide the 299 cap
+
+
+def test_tiny_docs_tok_cap_bound(tmp_path):
+    # One-byte docs: up to one token per byte (doc boundaries split
+    # tokens) -- the review-found tok_cap crash regression test.
+    docs = [b"a"] * 64
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _cfg(pad_multiple=64), output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_explicit_multichip_rejected(tmp_path):
+    (tmp_path / "d.txt").write_text("hello world")
+    write_manifest(tmp_path / "list.txt", [tmp_path / "d.txt"])
+    m = read_manifest(tmp_path / "list.txt")
+    with pytest.raises(ValueError, match="single-chip"):
+        InvertedIndexModel(_cfg(device_shards=4)).run(
+            m, output_dir=tmp_path / "out")
+
+
+def test_decode_word_rows_roundtrip():
+    words = [b"cat", b"aardvark", b"z" * 12]
+    width = 16
+    rows = np.zeros((len(words), width), np.uint8)
+    for i, w in enumerate(words):
+        rows[i, : len(w)] = np.frombuffer(w, np.uint8)
+    r32 = rows.reshape(len(words), width // 4, 4).astype(np.int64)
+    cols = [
+        ((r32[:, c, 0] << 24) | (r32[:, c, 1] << 16)
+         | (r32[:, c, 2] << 8) | r32[:, c, 3]).astype(np.int32)
+        for c in range(width // 4)
+    ]
+    decoded = DT.decode_word_rows(cols, width)
+    assert [w.rstrip(b"\x00") for w in decoded.tolist()] == words

@@ -5,6 +5,13 @@ byte-identical to the oracle and bounded per owner."""
 import numpy as np
 import pytest
 
+import jax
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="multi-chip paths need >= 2 devices (8 virtual on CPU; a "
+           "single real TPU chip cannot form a mesh)")
+
 from conftest import read_letter_files
 
 from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (

@@ -10,6 +10,11 @@ import pytest
 
 import jax
 
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="multi-chip paths need >= 2 devices (8 virtual on CPU; a "
+           "single real TPU chip cannot form a mesh)")
+
 from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.oracle import (
     oracle_postings,
 )
