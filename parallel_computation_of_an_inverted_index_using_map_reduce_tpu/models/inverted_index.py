@@ -712,23 +712,13 @@ class InvertedIndexModel:
                 buf[:total] = np.frombuffer(b"".join(contents), np.uint8)
                 ends = np.cumsum(
                     [len(c) for c in contents]).astype(np.int32)
-                # Exact token count via vectorized masks (NOT a scan —
-                # a handful of whole-array byte compares): a snug
-                # tok_cap shrinks every device array ~2.5x vs the
-                # worst-case N/2 + 1 bound on real text.
-                sp = ((buf == 0x20) | (buf == 0x09) | (buf == 0x0A)
-                      | (buf == 0x0B) | (buf == 0x0C) | (buf == 0x0D))
-                prev_sp = np.empty_like(sp)
-                prev_sp[0] = True
-                prev_sp[1:] = sp[:-1]
-                start = ~sp & prev_sp
-                start[0] = not sp[0]
-                start[ends[:-1][ends[:-1] < padded]] |= ~sp[
-                    ends[:-1][ends[:-1] < padded]]
-                # the mask count is exact; note N//2+1 is NOT a valid
-                # fallback bound (doc boundaries split tokens, so up to
-                # one token per byte)
-                tok_cap = _round_up(int(np.count_nonzero(start)) + 1, 1 << 15)
+                # Exact token count (DT.count_token_starts mirrors the
+                # device classifier): a snug tok_cap shrinks every
+                # device array ~2.5x vs the worst-case bound; note
+                # N//2+1 is NOT a valid bound (doc boundaries split
+                # tokens, so up to one token per byte).
+                tok_cap = _round_up(
+                    DT.count_token_starts(buf, ends) + 1, 1 << 15)
                 out = DT.index_bytes_device(
                     jax.device_put(buf), jax.device_put(ends),
                     jax.device_put(np.asarray(doc_ids, np.int32)),
@@ -791,15 +781,133 @@ class InvertedIndexModel:
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()
 
+    def _run_tpu_device_tokenize_dist(self, manifest: Manifest, out_dir: str,
+                                      timer: PhaseTimer) -> dict:
+        """Mesh all-device engine: sharded raw bytes in, index out.
+
+        Each chip tokenizes a contiguous doc range's bytes locally; one
+        ``all_to_all`` exchanges whole word rows by content hash; owners
+        dedup/count their terms (parallel/dist_device_tokenizer.py).
+        The host decodes per-owner vocab blocks and merges at vocab
+        scale — token-scale data never re-sorts on host.
+        """
+        from ..corpus.manifest import iter_document_ranges
+        from ..corpus.scheduler import plan_contiguous_windows
+        from ..ops import device_tokenizer as DT
+        from ..parallel import dist_device_tokenizer as DDT
+
+        cfg = self.config
+        width = cfg.device_tokenize_width
+        n = self._num_shards()
+        mesh = make_mesh(n)
+        max_doc_id = len(manifest)
+        with timer.phase("load"):
+            windows = plan_contiguous_windows(manifest, n)
+            shards = list(iter_document_ranges(manifest, windows))
+        num_docs = sum(len(c) for c, _ in shards)
+        total = sum(len(b) for c, _ in shards for b in c)
+        timer.count("documents", num_docs)
+        timer.count("device_shards", n)
+        timer.count("device_tokenize_width", width)
+        if num_docs == 0 or total == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+
+        with timer.phase("feed"):
+            shard_len = _round_up(
+                max(max(sum(len(b) for b in c) for c, _ in shards), 1),
+                cfg.pad_multiple)
+            docs_cap = max(max(len(c) for c, _ in shards), 1)
+            bufs, ends_l, ids_l = [], [], []
+            tok_count = 0
+            for contents, ids in shards:
+                buf = np.full(shard_len, 0x20, np.uint8)
+                nb = 0
+                ends = np.full(docs_cap, shard_len, np.int32)
+                idv = np.full(docs_cap, 1, np.int32)
+                for j, (c, i) in enumerate(zip(contents, ids)):
+                    buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
+                    nb += len(c)
+                    ends[j] = nb
+                    idv[j] = i
+                # the padded tail of ends stays at shard_len: the pad
+                # region is all spaces, so those "docs" emit nothing
+                tok_count = max(tok_count, DT.count_token_starts(buf, ends))
+                bufs.append(buf)
+                ends_l.append(ends)
+                ids_l.append(idv)
+            tok_cap = _round_up(tok_count + 1, 1 << 14)
+
+        dist_stats: dict = {}
+        with timer.phase("device_index"):
+            owners, (max_len, _) = DDT.index_bytes_dist(
+                bufs, ends_l, ids_l, width=width, tok_cap=tok_cap,
+                mesh=mesh, stats=dist_stats)
+            if max_len > width:
+                raise DT.WidthOverflow(
+                    f"cleaned token of {max_len} letters exceeds "
+                    f"device_tokenize_width={width}")
+        for k, v in dist_stats.items():
+            timer.count(k, v)
+
+        with timer.phase("host_views"):
+            vocab_parts, df_parts, off_parts, post_parts = [], [], [], []
+            base = 0
+            for o in sorted(owners):
+                ow = owners[o]
+                if ow["num_words"] == 0:
+                    continue
+                vocab_parts.append(
+                    DT.decode_word_rows(ow["unique_cols"], width))
+                df_o = ow["df"].astype(np.int64)
+                off_parts.append(np.cumsum(df_o) - df_o + base)
+                df_parts.append(df_o)
+                post_parts.append(ow["postings"].astype(np.int32))
+                base += ow["num_pairs"]
+            num_words = sum(len(v) for v in vocab_parts)
+            num_pairs = base
+            timer.count("unique_terms", num_words)
+            timer.count("unique_pairs", num_pairs)
+            timer.count("tokens", num_pairs)
+            if num_pairs == 0:
+                with timer.phase("emit"):
+                    formatter.emit_grouped(out_dir, {})
+                return timer.report()
+            vocab = np.concatenate(vocab_parts)
+            df64 = np.concatenate(df_parts)
+            offsets = np.concatenate(off_parts)
+            postings = np.concatenate(post_parts)
+            letters = vocab.view(np.uint8).reshape(num_words, width)[:, 0] - ord("a")
+            # global emit order across the owner blocks: (letter asc,
+            # df desc, word asc) — the word array itself is the tiebreak
+            # (owner blocks are hash-ordered, not rank-ordered)
+            order = np.lexsort((vocab, -df64, letters))
+
+        with timer.phase("emit"):
+            from .. import native
+
+            if cfg.use_native and native.available():
+                bytes_written = native.emit_native(
+                    out_dir, vocab, order, df64, offsets, postings)
+                emit_stats = {"lines_written": num_words,
+                              "bytes_written": bytes_written}
+            else:
+                emit_stats = formatter.emit_index(
+                    out_dir, vocab=vocab, letter_of_term=letters,
+                    order=order, df=df64, offsets=offsets,
+                    postings=postings, max_doc_id=max_doc_id)
+        timer.count("lines_written", emit_stats["lines_written"])
+        return timer.report()
+
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
         if self.config.device_tokenize:
             from ..ops.device_tokenizer import WidthOverflow
 
-            if self._num_shards() > 1 and self.config.device_shards is not None:
-                raise ValueError(
-                    "device_tokenize is a single-chip engine "
-                    "(set device_shards=1 or leave it unset)")
             try:
+                if self._num_shards() > 1:
+                    return self._run_tpu_device_tokenize_dist(
+                        manifest, out_dir, timer)
                 return self._run_tpu_device_tokenize(manifest, out_dir, timer)
             except WidthOverflow as e:
                 # exactness guard tripped: restart on the host-scan path

@@ -72,25 +72,16 @@ def _byte_tables():
     return space, lower
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("width", "tok_cap", "num_docs"),
-)
-def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
-                       tok_cap: int, num_docs: int):
-    """bytes -> sorted/deduped index, entirely on device.
+def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
+                  tok_cap: int, num_docs: int):
+    """bytes -> packed word-row columns + doc column (device, traceable).
 
-    ``data``: uint8 (N,) — concatenated documents, padded with spaces
-    (0x20) to a static length.  ``doc_ends``: int32 (num_docs,)
-    exclusive end offsets.  ``doc_id_values``: int32 (num_docs,)
-    1-based ids.  ``width``: word-row bytes, multiple of 4.
-    ``tok_cap``: static token capacity — must be > the true token count
-    (callers compute it exactly with vectorized masks; note doc
-    boundaries split tokens, so up to one token per byte can exist).
-
-    Returns a dict of fixed-shape arrays; valid prefixes are bounded by
-    ``num_words`` / ``num_pairs`` (see caller).  ``max_word_len`` must
-    be checked against ``width`` host-side (WidthOverflow contract).
+    The map phase's tokenize/clean stage as pure array ops — shared by
+    the single-chip program below and the mesh variant
+    (parallel/dist_device_tokenizer.py), where it runs per shard inside
+    ``shard_map``.  Returns ``(cols, doc_col, max_word_len,
+    num_tokens)``: ``cols[0]`` carries INT32_MAX on empty/padding rows
+    (sorts last), ``doc_col`` likewise.
     """
     n = data.shape[0]
     space_np, lower_np = _byte_tables()
@@ -152,15 +143,26 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
     col0 = jnp.where(valid_tok, cols[0], INT32_MAX)
     doc_col = jnp.where(valid_tok, doc_of_tok, INT32_MAX)
 
-    # Lexicographic (word columns…, doc) order via LSD radix: stable
-    # single-key passes from least significant (doc) to most (col 0).
-    # Identical result to one variadic comparator sort, but the TPU AOT
-    # compiler takes ~80x longer on the wide comparator (measured:
-    # 1403 s for a 13-key sort vs 17.8 s for 13 stable passes at 2^21).
-    perm = jnp.arange(tok_cap, dtype=jnp.int32)
+    return (col0, *cols[1:]), doc_col, max_word_len, num_tokens
+
+
+def sort_dedup_rows(cols, doc_col, cap: int):
+    """Sorted/deduped index from word-row columns (device, traceable).
+
+    The reduce stage shared by both device engines: lexicographic
+    (word columns…, doc) order via LSD radix — stable single-key passes
+    from least significant (doc) to most (column 0).  Identical result
+    to one variadic comparator sort, but the TPU AOT compiler takes
+    ~80x longer on the wide comparator (measured: 1403 s for a 13-key
+    sort vs 17.8 s for 13 stable passes at 2^21).  INT32_MAX rows
+    (padding / empty) sort last and are dropped by the validity mask.
+    """
+    ncols = len(cols)
+    col0 = cols[0]
+    perm = jnp.arange(cap, dtype=jnp.int32)
     for key in (doc_col, *cols[ncols - 1:0:-1], col0):
         _, perm = lax.sort((key[perm], perm), num_keys=1, is_stable=True)
-    s_cols = tuple(c[perm] for c in (col0, *cols[1:]))
+    s_cols = tuple(c[perm] for c in cols)
     s_docs = doc_col[perm]
 
     def neq_prev(a):
@@ -175,13 +177,40 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
     word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
     num_words = first_word.sum(dtype=jnp.int32)
     num_pairs = first_pair.sum(dtype=jnp.int32)
-    df = jnp.zeros(tok_cap, jnp.int32).at[
-        jnp.where(first_pair, word_rank, tok_cap)
+    df = jnp.zeros(cap, jnp.int32).at[
+        jnp.where(first_pair, word_rank, cap)
     ].add(1, mode="drop")
-    postings = compact(s_docs, first_pair, tok_cap, jnp.int32(0))
+    postings = compact(s_docs, first_pair, cap, jnp.int32(0))
     unique_cols = tuple(
-        compact(c, first_word, tok_cap, jnp.int32(0)) for c in s_cols)
+        compact(c, first_word, cap, jnp.int32(0)) for c in s_cols)
+    return num_words, num_pairs, df, postings, unique_cols
 
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("width", "tok_cap", "num_docs"),
+)
+def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
+                       tok_cap: int, num_docs: int):
+    """bytes -> sorted/deduped index, entirely on device (single chip).
+
+    ``data``: uint8 (N,) — concatenated documents, padded with spaces
+    (0x20) to a static length.  ``doc_ends``: int32 (num_docs,)
+    exclusive end offsets.  ``doc_id_values``: int32 (num_docs,)
+    1-based ids.  ``width``: word-row bytes, multiple of 4.
+    ``tok_cap``: static token capacity — must be > the true token count
+    (callers compute it exactly with vectorized masks; note doc
+    boundaries split tokens, so up to one token per byte can exist).
+
+    Returns a dict of fixed-shape arrays; valid prefixes are bounded by
+    ``num_words`` / ``num_pairs`` (see caller).  ``max_word_len`` must
+    be checked against ``width`` host-side (WidthOverflow contract).
+    """
+    cols, doc_col, max_word_len, num_tokens = tokenize_rows(
+        data, doc_ends, doc_id_values, width=width, tok_cap=tok_cap,
+        num_docs=num_docs)
+    num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
+        cols, doc_col, tok_cap)
     return {
         # one 4-scalar array: ONE host sync fetches all counts (each
         # scalar fetched separately would pay the link RTT per scalar);
@@ -192,6 +221,28 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
         "postings": postings,        # (tok_cap,) valid prefix num_pairs
         "unique_cols": unique_cols,  # width//4 x (tok_cap,) prefix num_words
     }
+
+
+def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
+    """Exact host-side token count for a space-padded byte buffer.
+
+    MUST mirror the device classifier in :func:`tokenize_rows` byte for
+    byte (same whitespace set, same doc-boundary break rule) — both
+    engines size their static ``tok_cap`` from it, and the device's
+    reported ``num_tokens`` is asserted against the resulting bound so
+    any divergence fails loudly instead of silently dropping tokens.
+    Vectorized whole-array compares, not a scan.
+    """
+    sp = ((buf == 0x20) | (buf == 0x09) | (buf == 0x0A)
+          | (buf == 0x0B) | (buf == 0x0C) | (buf == 0x0D))
+    prev_sp = np.empty_like(sp)
+    prev_sp[0] = True
+    prev_sp[1:] = sp[:-1]
+    start = ~sp & prev_sp
+    start[0] = not sp[0]
+    de = ends[:-1][ends[:-1] < buf.shape[0]]
+    start[de] |= ~sp[de]
+    return int(np.count_nonzero(start))
 
 
 def decode_word_rows(cols: list[np.ndarray], width: int) -> np.ndarray:

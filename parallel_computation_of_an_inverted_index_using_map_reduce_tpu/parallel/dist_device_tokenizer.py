@@ -1,0 +1,193 @@
+"""Mesh variant of the all-device engine: sharded bytes in, index out.
+
+Completes the engine matrix — {host scan, device scan} x {single chip,
+multi chip}.  The single-chip all-device engine
+(ops/device_tokenizer.py) removes the host from the compute path; this
+module removes the single-chip limit: each chip receives a contiguous
+doc range's raw bytes, tokenizes/cleans them locally with the SAME
+traceable stages, and one ``all_to_all`` exchanges whole word rows
+(13 int32 columns carried side by side) bucketed by a word-content
+hash, so every term is deduped/counted by exactly one owner — the
+reference's reducer ownership (main.c:129-150) re-keyed from its
+~1000x-skewed letters to a near-uniform hash, at the level of raw
+text rather than pre-tokenized pairs.
+
+Per chip, as one ``shard_map`` program:
+
+    rows   <- tokenize_rows(bytes_shard)            # local scans/scatter
+    owner  <- mix32(word columns) % n
+    recv   <- all_to_all(bucket(rows, owner))       # ICI, 13 columns
+    index  <- sort_dedup_rows(recv)                 # owner-side radix
+
+Static exchange capacity with a provably-safe overflow retry
+(psum-reduced flag), the same discipline as the integer-pair engines
+(parallel/dist_engine.py).  Exactness story is inherited:
+byte-identical output or WidthOverflow fallback, never truncation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from ..ops.device_tokenizer import (
+    INT32_MAX,
+    sort_dedup_rows,
+    tokenize_rows,
+)
+from .dist_engine import default_capacity
+from .mesh import SHARD_AXIS, replicated_spec, shard_spec, sharding
+
+
+def _mix32(cols):
+    """Deterministic word-content hash from the packed columns (uint32
+    mul-xor mix; identical rows always hash identically)."""
+    h = cols[0].astype(jnp.uint32)
+    for c in cols[1:]:
+        h = (h ^ c.astype(jnp.uint32)) * jnp.uint32(0x9E3779B1)
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    return h
+
+
+def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
+          num_shards: int, capacity: int):
+    cols, doc_col, max_len, num_tokens = tokenize_rows(
+        data_l, ends_l, ids_l, width=width, tok_cap=tok_cap,
+        num_docs=num_docs)
+    rows = (*cols, doc_col)
+    nrows = len(rows)
+
+    valid = cols[0] != INT32_MAX
+    owner = jnp.where(valid, (_mix32(cols) % num_shards).astype(jnp.int32),
+                      num_shards)
+    # bucket rows by owner: stable sort of (owner, perm), then windowed
+    # gather per destination (the integer engines' exchange shape,
+    # dist_engine._bucket_exchange, carrying 13 columns side by side)
+    b_s, perm = lax.sort(
+        (owner, jnp.arange(tok_cap, dtype=jnp.int32)), num_keys=1,
+        is_stable=True)
+    counts = jnp.zeros((num_shards,), jnp.int32).at[b_s].add(1, mode="drop")
+    offsets = jnp.cumsum(counts) - counts
+    overflow_local = (counts > capacity).any()
+    slot = jnp.arange(capacity, dtype=jnp.int32)[None, :]
+    gather_idx = jnp.clip(offsets[:, None] + slot, 0, tok_cap - 1)
+    in_bucket = slot < counts[:, None]
+    pg = perm[gather_idx]  # compose the two gathers once, not per row
+    send = jnp.concatenate(
+        [jnp.where(in_bucket, r[pg], INT32_MAX) for r in rows],
+        axis=1)  # (num_shards, nrows * capacity)
+    recv = lax.all_to_all(send, SHARD_AXIS, 0, 0, tiled=True)
+    recv = recv.reshape(num_shards, nrows, capacity)
+    recv_rows = [recv[:, r, :].reshape(-1) for r in range(nrows)]
+
+    num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
+        tuple(recv_rows[:-1]), recv_rows[-1], num_shards * capacity)
+    return {
+        # per-owner counts, sharded (n, 2) once stacked over the mesh
+        "counts": jnp.stack([num_words, num_pairs])[None, :],
+        # replicated health scalars:
+        # [global max word len, overflow, max per-shard token count]
+        "globals": jnp.stack([
+            lax.pmax(max_len, SHARD_AXIS),
+            lax.psum(overflow_local.astype(jnp.int32), SHARD_AXIS),
+            lax.pmax(num_tokens, SHARD_AXIS),
+        ]),
+        "df": df,
+        "postings": postings,
+        "unique_cols": unique_cols,
+    }
+
+
+@functools.lru_cache(maxsize=32)
+def _build(mesh: Mesh, width: int, tok_cap: int, num_docs: int,
+           capacity: int):
+    n = mesh.devices.size
+    body = functools.partial(
+        _body, width=width, tok_cap=tok_cap, num_docs=num_docs,
+        num_shards=n, capacity=capacity)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(shard_spec(),) * 3,
+        out_specs={"counts": shard_spec(), "globals": replicated_spec(),
+                   "df": shard_spec(), "postings": shard_spec(),
+                   "unique_cols": (shard_spec(),) * (width // 4)},
+        check_vma=False,
+    ))
+
+
+def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
+                     tok_cap: int, mesh: Mesh, stats: dict | None = None):
+    """Sharded raw bytes -> per-owner index rows, over the mesh.
+
+    ``shard_bufs``: list of n equal-length uint8 buffers (space-padded
+    contiguous doc ranges).  ``shard_ends`` / ``shard_ids``: per-shard
+    int32 arrays, equal lengths across shards (pad ends with the buffer
+    length — padding spaces produce no tokens).  ``tok_cap``: per-shard
+    token capacity (callers bound it exactly per shard and take the
+    max).  Returns ``(owners, globals)`` where ``owners`` maps owner ->
+    dict(num_words, num_pairs, df, postings, unique_cols) with valid
+    prefixes already cut, and ``globals`` is ``(max_word_len,
+    exchange_retries)``.
+    """
+    n = mesh.devices.size
+    num_docs = shard_ends[0].shape[0]
+    data = jax.device_put(np.concatenate(shard_bufs),
+                          sharding(mesh, shard_spec()))
+    ends = jax.device_put(np.concatenate(shard_ends),
+                          sharding(mesh, shard_spec()))
+    ids = jax.device_put(np.concatenate(shard_ids),
+                         sharding(mesh, shard_spec()))
+    capacity = default_capacity(tok_cap, n)
+    retries = 0
+    while True:
+        out = _build(mesh, width, tok_cap, num_docs, capacity)(
+            data, ends, ids)
+        g = np.asarray(out["globals"])
+        if int(g[1]) > 0 and capacity < tok_cap:
+            capacity = tok_cap  # provably safe: a shard holds <= tok_cap rows
+            retries += 1
+            continue
+        break
+    max_len = int(g[0])
+    max_shard_tokens = int(g[2])
+    if max_shard_tokens + 1 > tok_cap:
+        raise AssertionError(
+            f"device token count {max_shard_tokens} exceeded tok_cap "
+            f"{tok_cap}: host mask count diverged from the device "
+            "classifier (bug)")
+
+    counts = np.asarray(out["counts"])  # (n, 2)
+    owners = {}
+    fetched = 0
+    per_owner = n * capacity
+    # dispatch every owner's prefix slices, then materialize them all —
+    # sequential fetches would each pay the link's fixed RTT
+    pending = {}
+    for o in range(n):
+        num_words, num_pairs = int(counts[o, 0]), int(counts[o, 1])
+        lo = o * per_owner
+        df_d = out["df"][lo:lo + num_words]
+        post_d = out["postings"][lo:lo + num_pairs]
+        cols_d = [c[lo:lo + num_words] for c in out["unique_cols"]]
+        for a in (df_d, post_d, *cols_d):
+            a.copy_to_host_async()
+        pending[o] = (num_words, num_pairs, df_d, post_d, cols_d)
+    for o, (num_words, num_pairs, df_d, post_d, cols_d) in pending.items():
+        df = np.asarray(df_d)
+        postings = np.asarray(post_d)
+        cols = [np.asarray(c) for c in cols_d]
+        fetched += df.nbytes + postings.nbytes + sum(c.nbytes for c in cols)
+        owners[o] = {"num_words": num_words, "num_pairs": num_pairs,
+                     "df": df, "postings": postings, "unique_cols": cols}
+    if stats is not None:
+        stats["dist_fetched_bytes"] = fetched
+        stats["exchange_retries"] = retries
+        stats["exchange_capacity"] = capacity
+    return owners, (max_len, retries)

@@ -34,6 +34,7 @@ def _cfg(**kw):
     kw.setdefault("backend", "tpu")
     kw.setdefault("device_tokenize", True)
     kw.setdefault("pad_multiple", 256)
+    kw.setdefault("device_shards", 1)  # 8 virtual devices otherwise -> dist
     return IndexConfig(**kw)
 
 
@@ -141,13 +142,75 @@ def test_tiny_docs_tok_cap_bound(tmp_path):
     assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
 
 
-def test_explicit_multichip_rejected(tmp_path):
-    (tmp_path / "d.txt").write_text("hello world")
-    write_manifest(tmp_path / "list.txt", [tmp_path / "d.txt"])
+# -- mesh variant (parallel/dist_device_tokenizer.py) ---------------------
+
+
+def _dist_cfg(**kw):
+    kw.setdefault("device_shards", None)  # all 8 virtual devices
+    return _cfg(**kw)
+
+
+def _needs_mesh():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("mesh device tokenizer needs >= 2 devices")
+
+
+def test_dist_matches_goldens_smoke(smoke_fixture, tmp_path):
+    _needs_mesh()
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(_dist_cfg()).run(m, output_dir=tmp_path)
+    assert report["device_shards"] > 1  # really took the mesh engine
+    assert "exchange_capacity" in report
+    assert read_letter_files(tmp_path) == read_letter_files(smoke_fixture / "golden")
+
+
+@pytest.mark.parametrize("seed", [4, 13])
+def test_dist_property_vs_oracle(tmp_path, seed):
+    _needs_mesh()
+    docs = zipf_corpus(num_docs=41, vocab_size=700, tokens_per_doc=55, seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
     m = read_manifest(tmp_path / "list.txt")
-    with pytest.raises(ValueError, match="single-chip"):
-        InvertedIndexModel(_cfg(device_shards=4)).run(
-            m, output_dir=tmp_path / "out")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _dist_cfg(), output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_dist_matches_single_chip(tmp_path):
+    _needs_mesh()
+    docs = zipf_corpus(num_docs=29, vocab_size=400, tokens_per_doc=45, seed=21)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    build_index(m, _cfg(), output_dir=tmp_path / "one")
+    build_index(m, _dist_cfg(), output_dir=tmp_path / "mesh")
+    assert read_letter_files(tmp_path / "mesh") == read_letter_files(tmp_path / "one")
+
+
+def test_dist_fewer_docs_than_chips(tmp_path):
+    _needs_mesh()
+    docs = [b"alpha beta", b"beta gamma"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _dist_cfg(), output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
+
+
+def test_dist_width_overflow_falls_back(tmp_path):
+    _needs_mesh()
+    docs = [b"regular words", b"a" * 40 + b" tail"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(
+        _dist_cfg(device_tokenize_width=16)).run(m, output_dir=tmp_path / "dev")
+    assert "device_tokenize_fallback" in report
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
 
 
 def test_decode_word_rows_roundtrip():
