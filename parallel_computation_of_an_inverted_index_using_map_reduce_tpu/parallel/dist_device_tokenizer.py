@@ -23,6 +23,13 @@ Static exchange capacity with a provably-safe overflow retry
 (psum-reduced flag), the same discipline as the integer-pair engines
 (parallel/dist_engine.py).  Exactness story is inherited:
 byte-identical output or WidthOverflow fallback, never truncation.
+
+Single-controller fetch: :func:`index_bytes_dist` materializes every
+owner's results in one process (fine for one host driving a mesh).  On
+a multi-host pod the fetch loop would read only addressable shards per
+process, like parallel/dist_engine's multi-host contract — wiring that
+seam is future work; the exchange program itself is already
+process-count agnostic.
 """
 
 from __future__ import annotations
