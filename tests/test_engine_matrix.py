@@ -1,0 +1,64 @@
+"""Cross-engine conformance sweep: every engine in the matrix —
+{host scan, device scan, streaming} x {single chip, mesh} plus the
+all-host backend and the letter-emit path — must produce byte-identical
+output on randomized Zipfian corpora.  The broad randomized analogue of
+the per-engine suites (slow-marked; `make test` runs it, `make
+test-fast` skips it)."""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+
+ENGINES = [
+    dict(backend="cpu"),
+    dict(backend="tpu", device_shards=1),                      # pipelined
+    dict(backend="tpu", device_shards=1, pipeline_chunk_docs=0),  # one-shot
+    dict(backend="tpu", device_shards=1, overlap_tail_fraction=0.4),
+    dict(backend="tpu"),                                       # mesh host-scan
+    dict(backend="tpu", stream_chunk_docs=7),                  # streaming (dist on mesh)
+    dict(backend="tpu", device_shards=1, device_tokenize=True),
+    dict(backend="tpu", device_tokenize=True),                 # mesh device-scan
+    dict(backend="tpu", emit_ownership="letter"),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("trial", [0, 1, 2])
+def test_all_engines_agree_on_random_corpus(tmp_path, trial):
+    if not native.available():
+        pytest.skip("several engines need the native tokenizer")
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("matrix sweep includes mesh engines (>= 2 devices)")
+    rng = np.random.default_rng(1000 + trial)
+    docs = zipf_corpus(
+        num_docs=int(rng.integers(5, 50)),
+        vocab_size=int(rng.integers(80, 1000)),
+        tokens_per_doc=int(rng.integers(8, 100)),
+        seed=2000 + trial)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    golden = read_letter_files(tmp_path / "oracle")
+    for e, cfg in enumerate(ENGINES):
+        out = tmp_path / f"e{e}"
+        build_index(m, IndexConfig(pad_multiple=64, **cfg), output_dir=out)
+        assert read_letter_files(out) == golden, f"engine {cfg} diverged"
