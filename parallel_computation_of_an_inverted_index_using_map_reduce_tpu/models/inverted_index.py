@@ -22,6 +22,7 @@ import os
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from ..config import IndexConfig
 from ..parallel import dist_engine
@@ -719,10 +720,21 @@ class InvertedIndexModel:
                 # tokens, so up to one token per byte).
                 tok_cap = _round_up(
                     DT.count_token_starts(buf, ends) + 1, 1 << 15)
+                # host-exact max cleaned length: abort a doomed launch
+                # before paying for it, and skip radix passes over
+                # provably all-zero word columns (sort_cols)
+                host_max_len = DT.max_cleaned_token_len(buf, ends)
+                if host_max_len > width:
+                    raise DT.WidthOverflow(
+                        f"cleaned token of {host_max_len} letters "
+                        f"exceeds device_tokenize_width={width}")
+                sort_cols = -(-max(host_max_len, 1) // 4)  # ceil div
+                timer.count("sort_cols", sort_cols)
                 out = DT.index_bytes_device(
                     jax.device_put(buf), jax.device_put(ends),
                     jax.device_put(np.asarray(doc_ids, np.int32)),
-                    width=width, tok_cap=tok_cap, num_docs=num_docs)
+                    width=width, tok_cap=tok_cap, num_docs=num_docs,
+                    sort_cols=sort_cols)
             with timer.phase("device_index"):
                 num_words, num_pairs, max_len, num_tokens = (
                     int(v) for v in np.asarray(out["counts"]))
@@ -731,6 +743,10 @@ class InvertedIndexModel:
                         f"device token count {num_tokens} exceeded "
                         f"tok_cap {tok_cap}: host mask count diverged "
                         "from the device classifier (bug)")
+                if max_len != host_max_len:
+                    raise AssertionError(
+                        f"device max word len {max_len} != host "
+                        f"{host_max_len}: classifier divergence (bug)")
                 if max_len > width:
                     raise DT.WidthOverflow(
                         f"cleaned token of {max_len} letters exceeds "
@@ -738,17 +754,30 @@ class InvertedIndexModel:
             with timer.phase("fetch"):
                 # dispatch every prefix slice, then fetch them all
                 # concurrently — sequential fetches would each pay the
-                # link's fixed RTT
+                # link's fixed RTT.  Transfer trimming: columns past
+                # sort_cols are provably all zero (host-exact max word
+                # length) and decode as zero padding for free, and
+                # df/postings values are <= max_doc_id, so they ride
+                # down as uint16 whenever doc ids fit.
                 nu = min(tok_cap, _round_up(max(num_words, 1), 1 << 13))
                 npairs = min(tok_cap, _round_up(max(num_pairs, 1), 1 << 13))
+                ncols_fetch = min(sort_cols, width // 4)
+                narrow = max_doc_id < (1 << 16)
                 df_d = out["df"][:nu]
-                cols_d = [c[:nu] for c in out["unique_cols"]]
                 post_d = out["postings"][:npairs]
+                if narrow:
+                    df_d = df_d.astype(jnp.uint16)
+                    post_d = post_d.astype(jnp.uint16)
+                cols_d = [c[:nu] for c in out["unique_cols"][:ncols_fetch]]
                 for a in (df_d, post_d, *cols_d):
                     a.copy_to_host_async()
-                df = np.asarray(df_d)[:num_words]
+                df = np.asarray(df_d)[:num_words].astype(np.int32)
                 cols = [np.asarray(c)[:num_words] for c in cols_d]
-                postings = np.asarray(post_d)[:num_pairs]
+                postings = np.asarray(post_d)[:num_pairs].astype(np.int32)
+                timer.count(
+                    "fetched_bytes",
+                    df_d.nbytes + post_d.nbytes
+                    + sum(c.nbytes for c in cols_d))
         timer.count("unique_terms", num_words)
         timer.count("unique_pairs", num_pairs)
         timer.count("device_shards", 1)
@@ -820,7 +849,7 @@ class InvertedIndexModel:
                 cfg.pad_multiple)
             docs_cap = max(max(len(c) for c, _ in shards), 1)
             bufs, ends_l, ids_l = [], [], []
-            tok_count = 0
+            tok_count = host_max_len = 0
             for contents, ids in shards:
                 buf = np.full(shard_len, 0x20, np.uint8)
                 nb = 0
@@ -834,16 +863,29 @@ class InvertedIndexModel:
                 # the padded tail of ends stays at shard_len: the pad
                 # region is all spaces, so those "docs" emit nothing
                 tok_count = max(tok_count, DT.count_token_starts(buf, ends))
+                host_max_len = max(host_max_len,
+                                   DT.max_cleaned_token_len(buf, ends))
                 bufs.append(buf)
                 ends_l.append(ends)
                 ids_l.append(idv)
             tok_cap = _round_up(tok_count + 1, 1 << 14)
+            if host_max_len > width:
+                raise DT.WidthOverflow(
+                    f"cleaned token of {host_max_len} letters exceeds "
+                    f"device_tokenize_width={width}")
+            sort_cols = -(-max(host_max_len, 1) // 4)  # ceil div
+            timer.count("sort_cols", sort_cols)
 
         dist_stats: dict = {}
         with timer.phase("device_index"):
             owners, (max_len, _) = DDT.index_bytes_dist(
                 bufs, ends_l, ids_l, width=width, tok_cap=tok_cap,
-                mesh=mesh, stats=dist_stats)
+                mesh=mesh, stats=dist_stats, sort_cols=sort_cols,
+                max_doc_id=max_doc_id)
+            if max_len != host_max_len:
+                raise AssertionError(
+                    f"device max word len {max_len} != host "
+                    f"{host_max_len}: classifier divergence (bug)")
             if max_len > width:
                 raise DT.WidthOverflow(
                     f"cleaned token of {max_len} letters exceeds "
@@ -1080,12 +1122,17 @@ class InvertedIndexModel:
                 out = engine.index_pairs(
                     term_dev, doc_dev, letters_dev,
                     vocab_size=vocab_size, max_doc_id=max_doc_id)
-            # dist path returns host-assembled numpy postings; block only
-            # device arrays.
-            out = {
-                k: v.block_until_ready() if hasattr(v, "block_until_ready") else v
-                for k, v in out.items()
-            }
+            # dist path returns host-assembled numpy postings; wait for
+            # device arrays so fetch below times the transfer, not the
+            # compute.  A 1-element fetch, NOT block_until_ready: on the
+            # tunneled axon platform block_until_ready returns once the
+            # dispatch is acked, before execution (measured — a ~500 ms
+            # program "blocks" in 0.1 ms); the in-order device stream
+            # makes one tiny fetch from the program a true barrier.
+            for v in out.values():
+                if hasattr(v, "block_until_ready"):
+                    np.asarray(v[:1] if getattr(v, "ndim", 0) else v)
+                    break
 
         with timer.phase("fetch"):
             if use_u16:

@@ -6,14 +6,26 @@ integers.  This module removes the host from the compute path entirely:
 raw corpus bytes go up, the finished index comes down.
 
     bytes (uint8, N) ──► classify: space/letter via 256-entry tables
-        ──► token segmentation: start mask, token ids, within-token
-            letter ranks — all cumsum/cummax scans, no loops
-        ──► scatter cleaned letters into fixed-width word rows
-        ──► pack rows into big-endian int32 columns
-            (cleaned bytes are a-z < 0x80, so signed int32 ascending
-             == byte-lexicographic ascending)
-        ──► ONE variadic ``lax.sort`` over (word columns…, doc)
+        ──► token segmentation: start mask, token ids — cumsum scans
+        ──► letter compaction: ONE position-keyed ``lax.sort`` moves
+            every cleaned letter to the front in byte order (the
+            byte stream with non-letters deleted, main.c:105-111)
+        ──► word rows: 12 windowed gathers off the compacted letter
+            stream pack big-endian int32 columns (cleaned bytes are
+            a-z < 0x80, so signed int32 ascending == byte-
+            lexicographic ascending); per-token offsets/lengths come
+            from ``searchsorted`` over the monotone letter→token map
+        ──► LSD radix ``lax.sort`` passes over (word columns…, doc)
         ──► boundary-diff word/pair dedup ► df ► postings ► unique rows
+
+    Why sorts/gathers and never large scatters: XLA lowers TPU scatter
+    to a serial per-update loop (~75 ns/update measured on v5e — a
+    single 1M-update scatter costs ~75 ms, 5x a whole 1M stable-sort
+    pass).  The first cut of this module scattered letters into rows
+    and compacted results with scatters; every token-scale scatter is
+    now a sort/cumsum/gather/searchsorted formulation.  Scatters are
+    kept only at trivial sizes (the num_docs-entry doc-boundary
+    marker).
 
 Exactness without strings-on-host: rows are the *actual cleaned bytes*
 (no hashing, no collisions); sorted-row order IS strcmp order because
@@ -43,8 +55,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-
-from .segment import compact
 
 INT32_MAX = np.iinfo(np.int32).max
 
@@ -91,47 +101,80 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
 
     pos = jnp.arange(n, dtype=jnp.int32)
     # first byte of each document forces a token break (tokens never
-    # span documents — the per-doc scan loop of every host frontend)
+    # span documents — the per-doc scan loop of every host frontend).
+    # num_docs-entry scatters: trivially small, the only ones kept.
     doc_starts = jnp.zeros(n, jnp.bool_).at[doc_ends[:-1]].set(
         True, mode="drop").at[0].set(True)
+    # manifest slot per byte: scatter-max doc slots at their start
+    # bytes (max resolves zero-length-doc collisions the same way as
+    # searchsorted side="right": the last doc starting there owns the
+    # byte), then cummax propagates slots forward
+    doc_slot_of_byte = lax.cummax(
+        jnp.zeros(n, jnp.int32).at[doc_ends[:-1]].max(
+            jnp.arange(1, num_docs, dtype=jnp.int32), mode="drop"))
     nonspace = ~is_space
     prev_space = jnp.concatenate([jnp.ones(1, jnp.bool_), is_space[:-1]])
     token_start = nonspace & (prev_space | doc_starts)
 
     tok_id = jnp.cumsum(token_start.astype(jnp.int32)) - 1  # per byte
-    # within-token letter rank: letters in [token_start, i)
     cs = jnp.cumsum(is_letter.astype(jnp.int32))
-    start_pos = lax.cummax(jnp.where(token_start, pos, -1))
-    cs_at_start = cs[jnp.maximum(start_pos, 0)]
-    letter_at_start = is_letter[jnp.maximum(start_pos, 0)].astype(jnp.int32)
-    k = cs - cs_at_start + letter_at_start - 1  # 0-based, valid where is_letter
+    num_letters = cs[-1] if n else jnp.int32(0)
 
-    # scatter cleaned letters straight into big-endian-packed int32 word
-    # columns, laid out column-major as ONE flat (width/4 * tok_cap)
-    # buffer — a (tok_cap, width) byte matrix (or any array with a tiny
-    # minor dimension) would be padded to the TPU's (8, 128) tile and
-    # blow HBM by ~32x.  Each (token, letter-rank) cell is written at
-    # most once, so scatter-add over zeros composes the shifted bytes.
-    ncols = width // 4
-    emit = is_letter & (k < width) & (tok_id >= 0)
-    shifted = lowered.astype(jnp.int32) << (8 * (3 - (k % 4)))
-    flat_idx = jnp.where(emit, (k // 4) * tok_cap + tok_id, ncols * tok_cap)
-    packed = jnp.zeros(ncols * tok_cap, jnp.int32).at[flat_idx].add(
-        shifted, mode="drop")
+    # letter compaction: ONE sort on (non-letter flag, byte position)
+    # packed into a single key moves every cleaned letter to the front
+    # in byte order — the reference's delete-non-letters pass
+    # (main.c:105-111) with no scatter.  Position fits the key's low
+    # bits; the flag rides above them, so ascending key order is
+    # "letters first, each group in byte order".
+    if n < (1 << 24):
+        key = jnp.where(is_letter, pos, pos + jnp.int32(1 << 24))
+        pos_s = (lax.sort(key) & ((1 << 24) - 1)).astype(jnp.int32)
+    else:  # buffers >= 16 MiB per program: flag no longer fits beside
+        # the position in an int32 (and int64 needs jax_enable_x64),
+        # so sort on (flag, position) as two keys instead
+        _, pos_s = lax.sort(
+            ((~is_letter).astype(jnp.int32), pos), num_keys=2)
+    # compacted letter stream: lowered[pos_s] is 0 past num_letters
+    # (non-letters map to 0 in the byte table), so the packed windows
+    # below read zero padding for free
+    letters = lowered[pos_s].astype(jnp.int32)
+    # letter index -> owning token, monotone nondecreasing over the
+    # valid prefix then pinned to INT32_MAX so searchsorted stays exact
+    tok_of_letter = jnp.where(jnp.arange(n, dtype=jnp.int32) < num_letters,
+                              tok_id[pos_s], INT32_MAX)
 
-    # cleaned length per token (for the exactness guard): letters with
-    # NO width clip — a token's true cleaned length, capped only by the
-    # reference's own 299 semantics at the caller
-    tok_len = jnp.zeros(tok_cap, jnp.int32).at[
-        jnp.where(is_letter & (tok_id >= 0), tok_id, tok_cap)
-    ].add(1, mode="drop")
+    # per-token letter offsets/lengths from the monotone letter->token
+    # map: F[t] = first compacted slot of token t's letters; a token
+    # with no letters (e.g. "42", skipped at main.c:113) gets F[t] ==
+    # F[t+1] => length 0 => masked invalid below
+    F = jnp.searchsorted(
+        tok_of_letter, jnp.arange(tok_cap + 1, dtype=jnp.int32))
+    tok_len = (F[1:] - F[:-1]).astype(jnp.int32)
+    F0 = F[:-1].astype(jnp.int32)
+    # true cleaned length, NO width clip (the exactness guard; the
+    # reference's own cap is 299, enforced by the caller)
     max_word_len = tok_len.max() if tok_cap else jnp.int32(0)
 
-    # doc id per token: token start byte -> manifest slot -> 1-based id
-    tok_start_byte = jnp.zeros(tok_cap, jnp.int32).at[
-        jnp.where(token_start, tok_id, tok_cap)
-    ].add(jnp.where(token_start, pos, 0), mode="drop")
-    slot = jnp.searchsorted(doc_ends, tok_start_byte, side="right")
+    # big-endian int32 word columns via windowed gathers: 4-byte packs
+    # of the letter stream at every alignment (elementwise shifts of
+    # padded slices), then one gather per column at F[t] + 4c, masked
+    # by how many of the window's 4 bytes belong to the token.  Mask
+    # values are uint32 byte prefixes viewed as int32.
+    lp = jnp.concatenate([letters, jnp.zeros(3, jnp.int32)])
+    l4 = ((lp[0:n] << 24) | (lp[1:n + 1] << 16)
+          | (lp[2:n + 2] << 8) | lp[3:n + 3])
+    masktab = jnp.array([0, -16777216, -65536, -256, -1], jnp.int32)
+    ncols = width // 4
+    cols = []
+    for c in range(ncols):
+        idx = jnp.clip(F0 + 4 * c, 0, n - 1)
+        nbytes = jnp.clip(tok_len - 4 * c, 0, 4)
+        cols.append(l4[idx] & masktab[nbytes])
+
+    # doc id per token: first letter's byte -> manifest slot -> 1-based
+    # id (tokens never span docs, so any of the token's letters agrees)
+    first_letter_byte = pos_s[jnp.clip(F0, 0, n - 1)]
+    slot = doc_slot_of_byte[first_letter_byte]
     doc_of_tok = doc_id_values[jnp.clip(slot, 0, num_docs - 1)]
 
     # valid rows (>= 1 letter) have column 0's top byte in [a-z] =>
@@ -139,14 +182,13 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     # they sort after every real word
     num_tokens = jnp.int32(0) + jnp.sum(token_start.astype(jnp.int32))
     valid_tok = (tok_len > 0) & (jnp.arange(tok_cap) < num_tokens)
-    cols = [packed[c * tok_cap:(c + 1) * tok_cap] for c in range(ncols)]
     col0 = jnp.where(valid_tok, cols[0], INT32_MAX)
     doc_col = jnp.where(valid_tok, doc_of_tok, INT32_MAX)
 
     return (col0, *cols[1:]), doc_col, max_word_len, num_tokens
 
 
-def sort_dedup_rows(cols, doc_col, cap: int):
+def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     """Sorted/deduped index from word-row columns (device, traceable).
 
     The reduce stage shared by both device engines: lexicographic
@@ -159,8 +201,13 @@ def sort_dedup_rows(cols, doc_col, cap: int):
     """
     ncols = len(cols)
     col0 = cols[0]
+    # sort_cols: statically known number of leading columns that can be
+    # non-constant (callers pass ceil(max_cleaned_token_len / 4)).
+    # Columns past it are all zero for every row, and a stable pass
+    # over a constant key is the identity — skip those passes outright.
+    nsort = ncols if sort_cols is None else max(1, min(sort_cols, ncols))
     perm = jnp.arange(cap, dtype=jnp.int32)
-    for key in (doc_col, *cols[ncols - 1:0:-1], col0):
+    for key in (doc_col, *cols[nsort - 1:0:-1], col0):
         _, perm = lax.sort((key[perm], perm), num_keys=1, is_stable=True)
     s_cols = tuple(c[perm] for c in cols)
     s_docs = doc_col[perm]
@@ -174,24 +221,46 @@ def sort_dedup_rows(cols, doc_col, cap: int):
         jnp.logical_or, (neq_prev(c) for c in s_cols))
     first_pair = word_valid & (first_word | neq_prev(s_docs))
 
-    word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
     num_words = first_word.sum(dtype=jnp.int32)
     num_pairs = first_pair.sum(dtype=jnp.int32)
-    df = jnp.zeros(cap, jnp.int32).at[
-        jnp.where(first_pair, word_rank, cap)
-    ].add(1, mode="drop")
-    postings = compact(s_docs, first_pair, cap, jnp.int32(0))
+
+    # Compaction WITHOUT scatters (TPU scatter is a serial per-update
+    # loop — see module docstring): ranks from the boundary masks are
+    # monotone over the sorted array, so the position of the w-th
+    # first-word / p-th first-pair is one searchsorted over the rank
+    # array, and every "compact" is then a plain gather.
+    word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
+    pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
+    slots = jnp.arange(cap, dtype=jnp.int32)
+    # W[w] = sorted-array position of word w (cap where w >= num_words)
+    W = jnp.searchsorted(word_rank, jnp.arange(cap + 1, dtype=jnp.int32))
+    P = jnp.searchsorted(pair_rank, slots)
+    word_live = slots < num_words
+    pair_live = slots < num_pairs
+    Wg = jnp.clip(W[:-1], 0, cap - 1).astype(jnp.int32)
+    Pg = jnp.clip(P, 0, cap - 1).astype(jnp.int32)
+
+    # df[w] = unique pairs inside word w's run = exclusive-pair-count
+    # difference at consecutive word starts (main.c:176-187's per-word
+    # counter, without the dictionary)
+    pair_excl = jnp.concatenate(
+        [pair_rank + 1 - first_pair.astype(jnp.int32),
+         jnp.full(1, num_pairs, jnp.int32)])
+    df = jnp.where(
+        word_live, pair_excl[jnp.minimum(W[1:], cap)] - pair_excl[Wg], 0)
+    postings = jnp.where(pair_live, s_docs[Pg], 0)
     unique_cols = tuple(
-        compact(c, first_word, cap, jnp.int32(0)) for c in s_cols)
+        jnp.where(word_live, c[Wg], 0) for c in s_cols)
     return num_words, num_pairs, df, postings, unique_cols
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("width", "tok_cap", "num_docs"),
+    static_argnames=("width", "tok_cap", "num_docs", "sort_cols"),
 )
 def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
-                       tok_cap: int, num_docs: int):
+                       tok_cap: int, num_docs: int,
+                       sort_cols: int | None = None):
     """bytes -> sorted/deduped index, entirely on device (single chip).
 
     ``data``: uint8 (N,) — concatenated documents, padded with spaces
@@ -205,12 +274,21 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
     Returns a dict of fixed-shape arrays; valid prefixes are bounded by
     ``num_words`` / ``num_pairs`` (see caller).  ``max_word_len`` must
     be checked against ``width`` host-side (WidthOverflow contract).
+    ``sort_cols``: optional static radix-pass bound from the host-exact
+    :func:`max_cleaned_token_len` (see :func:`sort_dedup_rows`).
     """
     cols, doc_col, max_word_len, num_tokens = tokenize_rows(
         data, doc_ends, doc_id_values, width=width, tok_cap=tok_cap,
         num_docs=num_docs)
+    if sort_cols is not None:
+        # columns past the host-exact bound are all zero for every row
+        # (valid and padding): substituting constants lets XLA dead-
+        # code-eliminate the windowed gathers that would build them
+        nsort = max(1, min(sort_cols, len(cols)))
+        zero = jnp.zeros(tok_cap, jnp.int32)
+        cols = (*cols[:nsort], *([zero] * (len(cols) - nsort)))
     num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
-        cols, doc_col, tok_cap)
+        cols, doc_col, tok_cap, sort_cols)
     return {
         # one 4-scalar array: ONE host sync fetches all counts (each
         # scalar fetched separately would pay the link RTT per scalar);
@@ -223,16 +301,11 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
     }
 
 
-def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
-    """Exact host-side token count for a space-padded byte buffer.
-
-    MUST mirror the device classifier in :func:`tokenize_rows` byte for
-    byte (same whitespace set, same doc-boundary break rule) — both
-    engines size their static ``tok_cap`` from it, and the device's
-    reported ``num_tokens`` is asserted against the resulting bound so
-    any divergence fails loudly instead of silently dropping tokens.
-    Vectorized whole-array compares, not a scan.
-    """
+def _host_start_mask(buf: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """Token-start mask, host side.  MUST mirror the device classifier
+    in :func:`tokenize_rows` byte for byte (same whitespace set, same
+    doc-boundary break rule); divergence is asserted loudly by callers.
+    Vectorized whole-array compares, not a scan."""
     sp = ((buf == 0x20) | (buf == 0x09) | (buf == 0x0A)
           | (buf == 0x0B) | (buf == 0x0C) | (buf == 0x0D))
     prev_sp = np.empty_like(sp)
@@ -242,7 +315,39 @@ def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
     start[0] = not sp[0]
     de = ends[:-1][ends[:-1] < buf.shape[0]]
     start[de] |= ~sp[de]
-    return int(np.count_nonzero(start))
+    return start
+
+
+def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
+    """Exact host-side token count for a space-padded byte buffer.
+
+    Both engines size their static ``tok_cap`` from it, and the
+    device's reported ``num_tokens`` is asserted against the resulting
+    bound so any divergence from the device classifier fails loudly
+    instead of silently dropping tokens.
+    """
+    return int(np.count_nonzero(_host_start_mask(buf, ends)))
+
+
+def max_cleaned_token_len(buf: np.ndarray, ends: np.ndarray) -> int:
+    """Exact host-side max cleaned (letters-only) token length.
+
+    Lets callers (a) raise :class:`WidthOverflow` before paying for a
+    doomed device launch and (b) pass a tight ``sort_cols`` to
+    :func:`index_bytes_device`, skipping radix passes over word columns
+    that are provably all zero.  The device's own ``max_word_len``
+    output is asserted equal by callers, so classifier divergence stays
+    loud.  Same vectorized style as :func:`count_token_starts`.
+    """
+    _, lower_np = _byte_tables()
+    is_letter = lower_np[buf] > 0
+    starts = np.flatnonzero(_host_start_mask(buf, ends))
+    if starts.size == 0:
+        return 0
+    excl = np.cumsum(is_letter, dtype=np.int64) - is_letter
+    total = int(excl[-1]) + int(is_letter[-1])
+    lens = np.diff(np.append(excl[starts], total))
+    return int(lens.max())
 
 
 def decode_word_rows(cols: list[np.ndarray], width: int) -> np.ndarray:

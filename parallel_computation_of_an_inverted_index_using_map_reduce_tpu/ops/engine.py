@@ -6,12 +6,13 @@ dedup, qsort by (df desc, word asc), bubble-sort postings, format
 
     sort packed (term, doc) keys          ->  lax.sort (radix under XLA)
     per-(term, doc) dedup                 ->  boundary diff on sorted keys
-    document frequency                    ->  segmented add
-    postings lists (ascending, compact)   ->  cumsum + scatter
+    document frequency                    ->  run-edge cumsum differences
+    postings lists (ascending, compact)   ->  rank searchsorted + gather
     final emit order (letter, -df, term)  ->  second key sort
 
-Everything is fixed-shape; padding keys sort to the tail and are dropped
-by bounds-checked scatters.  Control crosses host<->device exactly twice
+Everything is fixed-shape; padding keys sort to the tail and fall out of
+the searchsorted edges (ops/segment.py — scatter-free by design: TPU
+scatter serializes per update).  Control crosses host<->device exactly twice
 (feed pairs, fetch postings) vs. the reference's per-token lock/IO
 crossing (SURVEY.md §3.5).
 

@@ -47,6 +47,7 @@ from ..ops.device_tokenizer import (
     sort_dedup_rows,
     tokenize_rows,
 )
+from ..ops.segment import bucket_edges
 from .dist_engine import default_capacity
 from .mesh import SHARD_AXIS, replicated_spec, shard_spec, sharding
 
@@ -64,24 +65,31 @@ def _mix32(cols):
 
 
 def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
-          num_shards: int, capacity: int):
+          num_shards: int, capacity: int, sort_cols: int | None):
     cols, doc_col, max_len, num_tokens = tokenize_rows(
         data_l, ends_l, ids_l, width=width, tok_cap=tok_cap,
         num_docs=num_docs)
-    rows = (*cols, doc_col)
+    ncols = len(cols)
+    nsort = ncols if sort_cols is None else max(1, min(sort_cols, ncols))
+    # columns past the host-exact sort_cols bound are all zero for
+    # every row (valid AND padding): don't build, exchange, or sort
+    # them — XLA dead-code-eliminates their windowed gathers, and the
+    # all_to_all payload shrinks proportionally
+    rows = (*cols[:nsort], doc_col)
     nrows = len(rows)
 
     valid = cols[0] != INT32_MAX
-    owner = jnp.where(valid, (_mix32(cols) % num_shards).astype(jnp.int32),
+    owner = jnp.where(valid,
+                      (_mix32(rows[:-1]) % num_shards).astype(jnp.int32),
                       num_shards)
     # bucket rows by owner: stable sort of (owner, perm), then windowed
     # gather per destination (the integer engines' exchange shape,
-    # dist_engine._bucket_exchange, carrying 13 columns side by side)
+    # dist_engine._bucket_exchange, carrying the live columns side by
+    # side)
     b_s, perm = lax.sort(
         (owner, jnp.arange(tok_cap, dtype=jnp.int32)), num_keys=1,
         is_stable=True)
-    counts = jnp.zeros((num_shards,), jnp.int32).at[b_s].add(1, mode="drop")
-    offsets = jnp.cumsum(counts) - counts
+    counts, offsets = bucket_edges(b_s, num_shards)
     overflow_local = (counts > capacity).any()
     slot = jnp.arange(capacity, dtype=jnp.int32)[None, :]
     gather_idx = jnp.clip(offsets[:, None] + slot, 0, tok_cap - 1)
@@ -94,8 +102,10 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
     recv = recv.reshape(num_shards, nrows, capacity)
     recv_rows = [recv[:, r, :].reshape(-1) for r in range(nrows)]
 
+    zero = jnp.zeros(num_shards * capacity, jnp.int32)
+    recv_cols = (*recv_rows[:-1], *([zero] * (ncols - nsort)))
     num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
-        tuple(recv_rows[:-1]), recv_rows[-1], num_shards * capacity)
+        recv_cols, recv_rows[-1], num_shards * capacity, nsort)
     return {
         # per-owner counts, sharded (n, 2) once stacked over the mesh
         "counts": jnp.stack([num_words, num_pairs])[None, :],
@@ -114,11 +124,11 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
 
 @functools.lru_cache(maxsize=32)
 def _build(mesh: Mesh, width: int, tok_cap: int, num_docs: int,
-           capacity: int):
+           capacity: int, sort_cols: int | None):
     n = mesh.devices.size
     body = functools.partial(
         _body, width=width, tok_cap=tok_cap, num_docs=num_docs,
-        num_shards=n, capacity=capacity)
+        num_shards=n, capacity=capacity, sort_cols=sort_cols)
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(shard_spec(),) * 3,
@@ -130,7 +140,9 @@ def _build(mesh: Mesh, width: int, tok_cap: int, num_docs: int,
 
 
 def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
-                     tok_cap: int, mesh: Mesh, stats: dict | None = None):
+                     tok_cap: int, mesh: Mesh, stats: dict | None = None,
+                     sort_cols: int | None = None,
+                     max_doc_id: int | None = None):
     """Sharded raw bytes -> per-owner index rows, over the mesh.
 
     ``shard_bufs``: list of n equal-length uint8 buffers (space-padded
@@ -154,7 +166,7 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     capacity = default_capacity(tok_cap, n)
     retries = 0
     while True:
-        out = _build(mesh, width, tok_cap, num_docs, capacity)(
+        out = _build(mesh, width, tok_cap, num_docs, capacity, sort_cols)(
             data, ends, ids)
         g = np.asarray(out["globals"])
         if int(g[1]) > 0 and capacity < tok_cap:
@@ -175,22 +187,34 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     fetched = 0
     per_owner = n * capacity
     # dispatch every owner's prefix slices, then materialize them all —
-    # sequential fetches would each pay the link's fixed RTT
+    # sequential fetches would each pay the link's fixed RTT.  Transfer
+    # trimming mirrors the single-chip engine: columns past sort_cols
+    # are provably all zero (decode restores the zero padding for
+    # free); df/postings ride down as uint16 when doc ids fit.
+    ncols_fetch = len(out["unique_cols"])
+    if sort_cols is not None:
+        ncols_fetch = min(max(1, sort_cols), ncols_fetch)
+    narrow = max_doc_id is not None and max_doc_id < (1 << 16)
     pending = {}
     for o in range(n):
         num_words, num_pairs = int(counts[o, 0]), int(counts[o, 1])
         lo = o * per_owner
         df_d = out["df"][lo:lo + num_words]
         post_d = out["postings"][lo:lo + num_pairs]
-        cols_d = [c[lo:lo + num_words] for c in out["unique_cols"]]
+        if narrow:
+            df_d = df_d.astype(jnp.uint16)
+            post_d = post_d.astype(jnp.uint16)
+        cols_d = [c[lo:lo + num_words]
+                  for c in out["unique_cols"][:ncols_fetch]]
         for a in (df_d, post_d, *cols_d):
             a.copy_to_host_async()
         pending[o] = (num_words, num_pairs, df_d, post_d, cols_d)
     for o, (num_words, num_pairs, df_d, post_d, cols_d) in pending.items():
-        df = np.asarray(df_d)
-        postings = np.asarray(post_d)
+        df = np.asarray(df_d).astype(np.int32)
+        postings = np.asarray(post_d).astype(np.int32)
         cols = [np.asarray(c) for c in cols_d]
-        fetched += df.nbytes + postings.nbytes + sum(c.nbytes for c in cols)
+        fetched += np.asarray(df_d).nbytes + np.asarray(post_d).nbytes \
+            + sum(c.nbytes for c in cols)
         owners[o] = {"num_words": num_words, "num_pairs": num_pairs,
                      "df": df, "postings": postings, "unique_cols": cols}
     if stats is not None:

@@ -38,7 +38,12 @@ from jax.sharding import Mesh
 
 from ..ops import keys as K
 from ..ops.engine import emit_order
-from ..ops.segment import compact, first_occurrence_mask
+from ..ops.segment import (
+    bucket_edges,
+    compact,
+    first_occurrence_mask,
+    segment_counts,
+)
 from ..utils.rounding import round_up as _round_up
 from .mesh import SHARD_AXIS, make_mesh, replicated_spec, shard_spec, sharding
 
@@ -74,8 +79,7 @@ def _bucket_exchange(keys_local, valid_limit, *, num_shards: int,
              else owner_of_term[jnp.clip(term, 0, owner_of_term.shape[0] - 1)])
     bucket = jnp.where(keys_local < valid_limit, owner, num_shards)
     bucket_s, keys_s = lax.sort((bucket.astype(jnp.int32), keys_local), num_keys=2)
-    counts = jnp.zeros((num_shards,), jnp.int32).at[bucket_s].add(1, mode="drop")
-    offsets = jnp.cumsum(counts) - counts
+    counts, offsets = bucket_edges(bucket_s, num_shards)
     overflow_local = (counts > capacity).any()
 
     # fixed-shape send buffer (num_shards, capacity)
@@ -101,10 +105,8 @@ def _shuffle_body(keys_local, letter_of_term, *, num_shards: int, capacity: int,
     uniq = compact(recv_s, first, recv_s.shape[0], K.INT32_MAX)
 
     # --- vocab-sized aggregates only: df by psum, emit order replicated.
-    owned_term = recv_s // stride
-    df_local = jnp.zeros((vocab_size,), jnp.int32).at[
-        jnp.where(first, owned_term, vocab_size)
-    ].add(1, mode="drop")
+    owned_term = recv_s // stride  # nondecreasing: recv_s is sorted
+    df_local = segment_counts(owned_term, first.astype(jnp.int32), vocab_size)
     df = lax.psum(df_local, SHARD_AXIS)
     order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df

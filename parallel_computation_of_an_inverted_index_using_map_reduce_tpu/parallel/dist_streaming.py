@@ -50,7 +50,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from ..ops import keys as K
-from ..ops.segment import compact, first_occurrence_mask
+from ..ops.segment import bucket_edges, compact, first_occurrence_mask
 from ..utils.rounding import round_up
 from .dist_engine import _bucket_exchange, _build_prefix_slice, default_capacity
 from .mesh import SHARD_AXIS, replicated_spec, shard_spec, sharding
@@ -65,8 +65,7 @@ def _pair_bucket_exchange(term, doc, *, num_shards: int, capacity: int):
     bucket = jnp.where(valid, term % num_shards, num_shards)
     b_s, t_s, d_s = lax.sort(
         (bucket.astype(jnp.int32), term, doc), num_keys=3)
-    counts = jnp.zeros((num_shards,), jnp.int32).at[b_s].add(1, mode="drop")
-    offsets = jnp.cumsum(counts) - counts
+    counts, offsets = bucket_edges(b_s, num_shards)
     overflow_local = (counts > capacity).any()
     slot = jnp.arange(capacity, dtype=jnp.int32)[None, :]
     gather_idx = jnp.clip(offsets[:, None] + slot, 0, local - 1)

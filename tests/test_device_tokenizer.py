@@ -213,6 +213,60 @@ def test_dist_width_overflow_falls_back(tmp_path):
     assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
 
 
+def _pad_concat(docs, multiple=256):
+    total = sum(len(d) for d in docs)
+    padded = -(-max(total, 1) // multiple) * multiple
+    buf = np.full(padded, 0x20, np.uint8)
+    if total:
+        buf[:total] = np.frombuffer(b"".join(docs), np.uint8)
+    ends = np.cumsum([len(d) for d in docs]).astype(np.int32)
+    return buf, ends
+
+
+@pytest.mark.parametrize("docs", [
+    [b"don't foo-bar x1y2z3 I.Loomings supercalifragilistic"],
+    [b"a"] * 7 + [b"bb ccc"],
+    [b"", b"   ", b"42 --- !!!"],
+    [b"x" * 400 + b" tail", b"mid"],
+    [b"word\tword\nword\vword\fword\rword"],
+    [b"abc", b"", b"de"],  # zero-length doc between others
+])
+def test_max_cleaned_token_len_matches_python_reference(docs):
+    """Host helper vs a trivially-correct per-doc Python scan (the
+    reference's clean loop, main.c:105-111: letters-only length)."""
+    expect = 0
+    for d in docs:
+        for tok in d.split():
+            expect = max(expect, sum(1 for b in tok if
+                                     (65 <= b <= 90) or (97 <= b <= 122)))
+    buf, ends = _pad_concat(docs)
+    assert DT.max_cleaned_token_len(buf, ends) == expect
+
+
+def test_sort_cols_pass_skipping_is_exact(tmp_path):
+    """index_bytes_device with the host-measured sort_cols bound must
+    produce identical outputs to the full 13-pass sort."""
+    import jax
+
+    docs = [b"gamma beta alpha alpha", b"delta beta longishword here"]
+    buf, ends = _pad_concat(docs)
+    ids = np.arange(1, len(docs) + 1, dtype=np.int32)
+    tok_cap = 256
+    width = 48
+    max_len = DT.max_cleaned_token_len(buf, ends)
+    full = DT.index_bytes_device(
+        jax.device_put(buf), jax.device_put(ends), jax.device_put(ids),
+        width=width, tok_cap=tok_cap, num_docs=len(docs))
+    skip = DT.index_bytes_device(
+        jax.device_put(buf), jax.device_put(ends), jax.device_put(ids),
+        width=width, tok_cap=tok_cap, num_docs=len(docs),
+        sort_cols=-(-max_len // 4))
+    for k in ("counts", "df", "postings"):
+        np.testing.assert_array_equal(np.asarray(full[k]), np.asarray(skip[k]))
+    for a, b in zip(full["unique_cols"], skip["unique_cols"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_decode_word_rows_roundtrip():
     words = [b"cat", b"aardvark", b"z" * 12]
     width = 16
