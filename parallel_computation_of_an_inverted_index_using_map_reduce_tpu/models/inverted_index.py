@@ -718,12 +718,12 @@ class InvertedIndexModel:
                 # device array ~2.5x vs the worst-case bound; note
                 # N//2+1 is NOT a valid bound (doc boundaries split
                 # tokens, so up to one token per byte).
-                tok_cap = _round_up(
-                    DT.count_token_starts(buf, ends) + 1, 1 << 15)
-                # host-exact max cleaned length: abort a doomed launch
+                # one host pass: exact token count (snug tok_cap) and
+                # exact max cleaned length — abort a doomed launch
                 # before paying for it, and skip radix passes over
                 # provably all-zero word columns (sort_cols)
-                host_max_len = DT.max_cleaned_token_len(buf, ends)
+                tok_count, host_max_len = DT.host_token_stats(buf, ends)
+                tok_cap = _round_up(tok_count + 1, 1 << 15)
                 if host_max_len > width:
                     raise DT.WidthOverflow(
                         f"cleaned token of {host_max_len} letters "
@@ -862,9 +862,9 @@ class InvertedIndexModel:
                     idv[j] = i
                 # the padded tail of ends stays at shard_len: the pad
                 # region is all spaces, so those "docs" emit nothing
-                tok_count = max(tok_count, DT.count_token_starts(buf, ends))
-                host_max_len = max(host_max_len,
-                                   DT.max_cleaned_token_len(buf, ends))
+                cnt, ml = DT.host_token_stats(buf, ends)
+                tok_count = max(tok_count, cnt)
+                host_max_len = max(host_max_len, ml)
                 bufs.append(buf)
                 ends_l.append(ends)
                 ids_l.append(idv)

@@ -188,6 +188,24 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     return (col0, *cols[1:]), doc_col, max_word_len, num_tokens
 
 
+def clamp_sort_cols(sort_cols: int | None, ncols: int) -> int:
+    """The ONE clamp every consumer of ``sort_cols`` must share: the
+    number of leading word columns that can be non-constant.  Sorting,
+    exchange, and fetch all rely on the same bound — a desynchronized
+    copy would silently drop live columns."""
+    return ncols if sort_cols is None else max(1, min(sort_cols, ncols))
+
+
+def zero_tail_cols(cols, nsort: int, n: int):
+    """Splice constant zeros for the provably-all-zero trailing columns
+    (valid rows have no letters there; padding rows carry 0 in every
+    column but 0) so XLA dead-code-eliminates whatever built them."""
+    if nsort >= len(cols):
+        return tuple(cols)
+    zero = jnp.zeros(n, jnp.int32)
+    return (*cols[:nsort], *([zero] * (len(cols) - nsort)))
+
+
 def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     """Sorted/deduped index from word-row columns (device, traceable).
 
@@ -205,7 +223,7 @@ def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     # non-constant (callers pass ceil(max_cleaned_token_len / 4)).
     # Columns past it are all zero for every row, and a stable pass
     # over a constant key is the identity — skip those passes outright.
-    nsort = ncols if sort_cols is None else max(1, min(sort_cols, ncols))
+    nsort = clamp_sort_cols(sort_cols, ncols)
     perm = jnp.arange(cap, dtype=jnp.int32)
     for key in (doc_col, *cols[nsort - 1:0:-1], col0):
         _, perm = lax.sort((key[perm], perm), num_keys=1, is_stable=True)
@@ -284,9 +302,8 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
         # columns past the host-exact bound are all zero for every row
         # (valid and padding): substituting constants lets XLA dead-
         # code-eliminate the windowed gathers that would build them
-        nsort = max(1, min(sort_cols, len(cols)))
-        zero = jnp.zeros(tok_cap, jnp.int32)
-        cols = (*cols[:nsort], *([zero] * (len(cols) - nsort)))
+        cols = zero_tail_cols(cols, clamp_sort_cols(sort_cols, len(cols)),
+                              tok_cap)
     num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
         cols, doc_col, tok_cap, sort_cols)
     return {
@@ -318,36 +335,38 @@ def _host_start_mask(buf: np.ndarray, ends: np.ndarray) -> np.ndarray:
     return start
 
 
-def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
-    """Exact host-side token count for a space-padded byte buffer.
+def host_token_stats(buf: np.ndarray, ends: np.ndarray) -> tuple[int, int]:
+    """``(token_count, max_cleaned_len)`` in ONE pass over the buffer.
 
-    Both engines size their static ``tok_cap`` from it, and the
-    device's reported ``num_tokens`` is asserted against the resulting
-    bound so any divergence from the device classifier fails loudly
-    instead of silently dropping tokens.
+    The count sizes the static ``tok_cap`` (the device's reported
+    ``num_tokens`` is asserted against it, so classifier divergence
+    fails loudly instead of silently dropping tokens).  The exact max
+    cleaned (letters-only) length lets callers raise
+    :class:`WidthOverflow` before paying for a doomed launch and pass a
+    tight ``sort_cols`` bound (skipping radix passes and fetch bytes
+    over provably all-zero word columns); the device's own
+    ``max_word_len`` output is asserted equal by callers.
     """
+    start = _host_start_mask(buf, ends)
+    count = int(np.count_nonzero(start))
+    if count == 0:
+        return 0, 0
+    _, lower_np = _byte_tables()
+    is_letter = lower_np[buf] > 0
+    excl = np.cumsum(is_letter, dtype=np.int64) - is_letter
+    total = int(excl[-1]) + int(is_letter[-1])
+    lens = np.diff(np.append(excl[np.flatnonzero(start)], total))
+    return count, int(lens.max())
+
+
+def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
+    """Exact host-side token count (see :func:`host_token_stats`)."""
     return int(np.count_nonzero(_host_start_mask(buf, ends)))
 
 
 def max_cleaned_token_len(buf: np.ndarray, ends: np.ndarray) -> int:
-    """Exact host-side max cleaned (letters-only) token length.
-
-    Lets callers (a) raise :class:`WidthOverflow` before paying for a
-    doomed device launch and (b) pass a tight ``sort_cols`` to
-    :func:`index_bytes_device`, skipping radix passes over word columns
-    that are provably all zero.  The device's own ``max_word_len``
-    output is asserted equal by callers, so classifier divergence stays
-    loud.  Same vectorized style as :func:`count_token_starts`.
-    """
-    _, lower_np = _byte_tables()
-    is_letter = lower_np[buf] > 0
-    starts = np.flatnonzero(_host_start_mask(buf, ends))
-    if starts.size == 0:
-        return 0
-    excl = np.cumsum(is_letter, dtype=np.int64) - is_letter
-    total = int(excl[-1]) + int(is_letter[-1])
-    lens = np.diff(np.append(excl[starts], total))
-    return int(lens.max())
+    """Exact max cleaned token length (see :func:`host_token_stats`)."""
+    return host_token_stats(buf, ends)[1]
 
 
 def decode_word_rows(cols: list[np.ndarray], width: int) -> np.ndarray:

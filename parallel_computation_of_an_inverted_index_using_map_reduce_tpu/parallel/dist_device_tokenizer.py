@@ -44,6 +44,7 @@ from jax.sharding import Mesh
 
 from ..ops.device_tokenizer import (
     INT32_MAX,
+    clamp_sort_cols,
     sort_dedup_rows,
     tokenize_rows,
 )
@@ -70,7 +71,7 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
         data_l, ends_l, ids_l, width=width, tok_cap=tok_cap,
         num_docs=num_docs)
     ncols = len(cols)
-    nsort = ncols if sort_cols is None else max(1, min(sort_cols, ncols))
+    nsort = clamp_sort_cols(sort_cols, ncols)
     # columns past the host-exact sort_cols bound are all zero for
     # every row (valid AND padding): don't build, exchange, or sort
     # them — XLA dead-code-eliminates their windowed gathers, and the
@@ -102,6 +103,8 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
     recv = recv.reshape(num_shards, nrows, capacity)
     recv_rows = [recv[:, r, :].reshape(-1) for r in range(nrows)]
 
+    # un-exchanged tail columns are reconstructed as the constants they
+    # provably are (same zeros-splice contract as zero_tail_cols)
     zero = jnp.zeros(num_shards * capacity, jnp.int32)
     recv_cols = (*recv_rows[:-1], *([zero] * (ncols - nsort)))
     num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
@@ -191,9 +194,7 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     # trimming mirrors the single-chip engine: columns past sort_cols
     # are provably all zero (decode restores the zero padding for
     # free); df/postings ride down as uint16 when doc ids fit.
-    ncols_fetch = len(out["unique_cols"])
-    if sort_cols is not None:
-        ncols_fetch = min(max(1, sort_cols), ncols_fetch)
+    ncols_fetch = clamp_sort_cols(sort_cols, len(out["unique_cols"]))
     narrow = max_doc_id is not None and max_doc_id < (1 << 16)
     pending = {}
     for o in range(n):

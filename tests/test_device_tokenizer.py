@@ -267,6 +267,35 @@ def test_sort_cols_pass_skipping_is_exact(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_device_program_has_no_token_scale_scatter():
+    """Design guard: TPU scatter lowers to a serial per-update loop
+    (~75 ns/update measured), so the device program must stay
+    scatter-free at token scale — only the two num_docs-sized
+    doc-boundary scatters are allowed.  Lower the jit and count."""
+    import re
+
+    import jax
+
+    num_docs, tok_cap, n = 4, 256, 1024
+    lowered = jax.jit(
+        lambda d, e, i: DT.index_bytes_device(
+            d, e, i, width=48, tok_cap=tok_cap, num_docs=num_docs)
+    ).lower(
+        jax.ShapeDtypeStruct((n,), np.uint8),
+        jax.ShapeDtypeStruct((num_docs,), np.int32),
+        jax.ShapeDtypeStruct((num_docs,), np.int32),
+    )
+    text = lowered.as_text()
+    # exactly the three num_docs-sized doc-boundary scatters survive:
+    # doc_starts .at[ends].set / .at[0].set, and the doc-slot
+    # scatter-max — every one carries <= num_docs-1 updates
+    scatters = re.findall(r'= "stablehlo\.scatter"', text)
+    assert len(scatters) == 3, (
+        f"{len(scatters)} scatter ops in the device program (expected "
+        "the 3 tiny doc-boundary ones) — token-scale compactions must "
+        "stay sort/gather/searchsorted formulations")
+
+
 def test_decode_word_rows_roundtrip():
     words = [b"cat", b"aardvark", b"z" * 12]
     width = 16

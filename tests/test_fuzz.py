@@ -142,6 +142,12 @@ def test_simd_scan_boundary_cases():
         b"the the the the the the the",       # hot cache-hit path + combiner dedup
         b"a" * 63 + b" " + b"b" * 64,         # runs aligned to mask-word edges
         b"tail7zz",                           # 7-byte token at buffer end
+        # 9..16-byte tokens: the medium (128-bit-tag) raw cache —
+        # repeats (hits), punctuated variants (distinct tags, same
+        # cleaned word), and a 16-byte token at the exact buffer end
+        b"mediumtoken mediumtoken medium-token Mediumtoken",
+        b"d'argenson-like d'argenson-like 1234567890123 word",
+        b"x" * 15 + b" " + b"q" * 16,
     ]
     ids = list(range(1, len(docs) + 1))
     ref = tokenize(docs, ids, use_native=False, dedup_pairs=True)
