@@ -164,3 +164,159 @@ def test_two_process_letter_emit_matches_oracle(tmp_path):
     # each process emitted a disjoint half of the owners
     assert "owners [0, 1]" in outs[0][0]
     assert "owners [2, 3]" in outs[1][0]
+
+
+# -- mesh all-device engine (parallel/dist_device_tokenizer.py) -----------
+
+DEVTOK_WORKER = textwrap.dedent("""
+    import sys
+    repo, pid, coord, corpus_dir, out_dir = sys.argv[1:6]
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        iter_document_ranges, manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+        plan_contiguous_windows,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+        device_tokenizer as DT,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel import (
+        dist_device_tokenizer as DDT, distributed,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=int(pid))
+    n = 4
+    mesh = make_mesh(n)
+    width = 48
+
+    # Every process builds the same shard set deterministically (in a
+    # real pod each host reads only its ranges; the feed path uploads
+    # only local positions either way).
+    m = manifest_from_dir(corpus_dir)
+    windows = plan_contiguous_windows(m, n)
+    shards = list(iter_document_ranges(m, windows))
+    shard_len = max(max(sum(len(b) for b in c) for c, _ in shards), 1)
+    shard_len = -(-shard_len // 256) * 256
+    docs_cap = max(max(len(c) for c, _ in shards), 1)
+    bufs, ends_l, ids_l = [], [], []
+    tok_count = host_max_len = 0
+    for contents, ids in shards:
+        buf = np.full(shard_len, 0x20, np.uint8)
+        nb = 0
+        ends = np.full(docs_cap, shard_len, np.int64)
+        idv = np.full(docs_cap, 1, np.int32)
+        for j, (c, i) in enumerate(zip(contents, ids)):
+            buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
+            nb += len(c)
+            ends[j] = nb
+            idv[j] = i
+        cnt, ml = DT.host_token_stats(buf, ends)
+        tok_count = max(tok_count, cnt)
+        host_max_len = max(host_max_len, ml)
+        bufs.append(buf)
+        ends_l.append(ends.astype(np.int32))
+        ids_l.append(idv)
+    tok_cap = -(-(tok_count + 1) // (1 << 14)) * (1 << 14)
+    sort_cols = -(-max(host_max_len, 1) // 4)
+
+    owners, (max_len, retries) = DDT.index_bytes_dist(
+        bufs, ends_l, ids_l, width=width, tok_cap=tok_cap, mesh=mesh,
+        sort_cols=sort_cols, max_doc_id=len(m))
+    assert max_len == host_max_len, (max_len, host_max_len)
+
+    # each process must see exactly its local mesh positions as owners
+    got = sorted(owners)
+    want = sorted(DDT._local_mesh_positions(mesh))
+    assert got == want, (got, want)
+
+    import pathlib
+    for o, ow in owners.items():
+        words = DT.decode_word_rows(ow["unique_cols"], width)
+        np.savez(pathlib.Path(out_dir) / f"owner{o}.npz",
+                 words=words, df=ow["df"], postings=ow["postings"])
+    print(f"proc {pid} fetched owners {got}", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_device_tokenize_fetch(tmp_path):
+    """The mesh all-device engine's multi-controller seam: 2 OS
+    processes drive index_bytes_dist on a 4-device global mesh; each
+    fetches only its addressable owners, and the union of the fetched
+    blocks reconstructs the exact (word, doc) index."""
+    import numpy as np
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        load_documents, manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+        tokenize_documents,
+    )
+
+    docs = zipf_corpus(num_docs=22, vocab_size=250, tokens_per_doc=50, seed=31)
+    write_corpus(tmp_path / "docs", docs)
+    out_dir = tmp_path / "blocks"
+    out_dir.mkdir()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(DEVTOK_WORKER)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(REPO_ROOT), str(pid), coord,
+             str(tmp_path / "docs"), str(out_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+    assert "owners [0, 1]" in outs[0][0]
+    assert "owners [2, 3]" in outs[1][0]
+
+    # merge the four owner blocks and compare against the numpy frontend
+    got_pairs = set()
+    got_df = {}
+    for f in sorted(out_dir.glob("owner*.npz")):
+        blk = np.load(f)
+        words, df, postings = blk["words"], blk["df"], blk["postings"]
+        off = 0
+        for w, d in zip(words, df):
+            word = w.rstrip(b"\x00").decode()
+            got_df[word] = got_df.get(word, 0) + int(d)
+            for doc in postings[off:off + int(d)]:
+                got_pairs.add((word, int(doc)))
+            off += int(d)
+    m = manifest_from_dir(tmp_path / "docs")
+    contents, ids = load_documents(m)
+    corpus = tokenize_documents(contents, ids)
+    vocab = [w.rstrip(b"\x00").decode() for w in corpus.vocab.tolist()]
+    want_pairs = {(vocab[t], int(d))
+                  for t, d in zip(corpus.term_ids, corpus.doc_ids)}
+    assert got_pairs == want_pairs
+    want_df = {}
+    for w, _ in want_pairs:
+        want_df[w] = want_df.get(w, 0) + 1
+    assert got_df == want_df
