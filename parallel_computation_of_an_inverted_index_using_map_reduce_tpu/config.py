@@ -184,12 +184,17 @@ class IndexConfig:
                 raise ValueError(
                     "device_tokenize requires backend='tpu', "
                     f"got backend={self.backend!r}")
-            for flag in ("stream_chunk_docs", "checkpoint_path",
+            for flag in ("checkpoint_path",
                          "pipeline_chunk_docs", "overlap_tail_fraction"):
                 if getattr(self, flag) is not None:
                     raise ValueError(
                         f"device_tokenize is a complete engine; {flag} "
                         "belongs to the host-scan plans")
+            if self.stream_chunk_docs is not None and self.device_shards not in (None, 1):
+                raise ValueError(
+                    "device_tokenize streaming (stream_chunk_docs) is "
+                    "single-chip; the mesh engine shards the corpus "
+                    "spatially instead")
             if self.collect_skew_stats:
                 raise ValueError(
                     "device_tokenize is incompatible with collect_skew_stats "

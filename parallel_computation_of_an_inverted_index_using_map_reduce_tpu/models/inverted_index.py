@@ -63,7 +63,7 @@ class InvertedIndexModel:
             return {**stats, **timer.report()}
         if cfg.backend == "cpu":
             return self._run_cpu(manifest, out_dir, timer)
-        if cfg.stream_chunk_docs is not None:
+        if cfg.stream_chunk_docs is not None and not cfg.device_tokenize:
             return self._run_tpu_streaming(manifest, out_dir, timer)
         return self._run_tpu(manifest, out_dir, timer)
 
@@ -751,43 +751,60 @@ class InvertedIndexModel:
                     raise DT.WidthOverflow(
                         f"cleaned token of {max_len} letters exceeds "
                         f"device_tokenize_width={width}")
-            with timer.phase("fetch"):
-                # dispatch every prefix slice, then fetch them all
-                # concurrently — sequential fetches would each pay the
-                # link's fixed RTT.  Transfer trimming: columns past
-                # sort_cols are provably all zero (host-exact max word
-                # length) and decode as zero padding for free, and
-                # df/postings values are <= max_doc_id, so they ride
-                # down as uint16 whenever doc ids fit.
-                nu = min(tok_cap, _round_up(max(num_words, 1), 1 << 13))
-                npairs = min(tok_cap, _round_up(max(num_pairs, 1), 1 << 13))
-                ncols_fetch = min(sort_cols, width // 4)
-                narrow = max_doc_id < (1 << 16)
-                df_d = out["df"][:nu]
-                post_d = out["postings"][:npairs]
-                if narrow:
-                    df_d = df_d.astype(jnp.uint16)
-                    post_d = post_d.astype(jnp.uint16)
-                cols_d = [c[:nu] for c in out["unique_cols"][:ncols_fetch]]
-                for a in (df_d, post_d, *cols_d):
-                    a.copy_to_host_async()
-                df = np.asarray(df_d)[:num_words].astype(np.int32)
-                cols = [np.asarray(c)[:num_words] for c in cols_d]
-                postings = np.asarray(post_d)[:num_pairs].astype(np.int32)
-                timer.count(
-                    "fetched_bytes",
-                    df_d.nbytes + post_d.nbytes
-                    + sum(c.nbytes for c in cols_d))
         timer.count("unique_terms", num_words)
         timer.count("unique_pairs", num_pairs)
         timer.count("device_shards", 1)
         # raw token count is not materialized on host in this engine;
         # record the deduped pair count the device measured instead
         timer.count("tokens", num_pairs)
+        return self._fetch_decode_emit_device(
+            out, cap=tok_cap, num_words=num_words, num_pairs=num_pairs,
+            sort_cols=sort_cols, max_doc_id=max_doc_id, out_dir=out_dir,
+            timer=timer)
+
+    def _fetch_decode_emit_device(self, out, *, cap: int, num_words: int,
+                                  num_pairs: int, sort_cols: int,
+                                  max_doc_id: int, out_dir: str,
+                                  timer: PhaseTimer) -> dict:
+        """Shared tail of the single-chip device engines (one-shot and
+        streaming): prefix-slice fetch with transfer trimming, word-row
+        decode, and the letter-file emit.
+
+        Transfer trimming: columns past ``sort_cols`` are provably all
+        zero (host-exact max word length) and decode as zero padding
+        for free; df/postings values are <= max_doc_id, so they ride
+        down as uint16 whenever doc ids fit.  Every prefix slice is
+        dispatched before any is materialized — sequential fetches
+        would each pay the link's fixed RTT.
+        """
+        from ..ops import device_tokenizer as DT
+
+        cfg = self.config
+        width = cfg.device_tokenize_width
         if num_pairs == 0:
             with timer.phase("emit"):
                 formatter.emit_grouped(out_dir, {})
             return timer.report()
+        with timer.phase("fetch"):
+            nu = min(cap, _round_up(max(num_words, 1), 1 << 13))
+            npairs = min(cap, _round_up(max(num_pairs, 1), 1 << 13))
+            ncols_fetch = min(sort_cols, width // 4)
+            narrow = max_doc_id < (1 << 16)
+            df_d = out["df"][:nu]
+            post_d = out["postings"][:npairs]
+            if narrow:
+                df_d = df_d.astype(jnp.uint16)
+                post_d = post_d.astype(jnp.uint16)
+            cols_d = [c[:nu] for c in out["unique_cols"][:ncols_fetch]]
+            for a in (df_d, post_d, *cols_d):
+                a.copy_to_host_async()
+            df = np.asarray(df_d)[:num_words].astype(np.int32)
+            cols = [np.asarray(c)[:num_words] for c in cols_d]
+            postings = np.asarray(post_d)[:num_pairs].astype(np.int32)
+            timer.count(
+                "fetched_bytes",
+                df_d.nbytes + post_d.nbytes
+                + sum(c.nbytes for c in cols_d))
         with timer.phase("host_views"):
             vocab = DT.decode_word_rows(cols, width)
             letters = vocab.view(np.uint8).reshape(num_words, width)[:, 0] - ord("a")
@@ -798,8 +815,7 @@ class InvertedIndexModel:
 
             if cfg.use_native and native.available():
                 bytes_written = native.emit_native(
-                    out_dir, vocab, order, df64, offsets,
-                    postings.astype(np.int32))
+                    out_dir, vocab, order, df64, offsets, postings)
                 emit_stats = {"lines_written": num_words,
                               "bytes_written": bytes_written}
             else:
@@ -809,6 +825,67 @@ class InvertedIndexModel:
                     postings=postings, max_doc_id=max_doc_id)
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()
+
+    def _run_tpu_device_tokenize_stream(self, manifest: Manifest,
+                                        out_dir: str,
+                                        timer: PhaseTimer) -> dict:
+        """Streaming all-device engine: doc-aligned byte windows feed a
+        bounded on-device row accumulator (ops/device_streaming.py) —
+        the all-device engine's larger-than-HBM story, same exactness
+        contract (WidthOverflow aborts to the host path BEFORE the
+        offending window is fed)."""
+        from ..corpus.manifest import iter_document_chunks
+        from ..ops import device_streaming as DS
+        from ..ops import device_tokenizer as DT
+
+        cfg = self.config
+        width = cfg.device_tokenize_width
+        max_doc_id = len(manifest)
+        timer.count("device_tokenize_width", width)
+        timer.count("device_shards", 1)
+        timer.count("documents", len(manifest))
+        engine_s = DS.DeviceStreamEngine(width=width)
+        fed_tokens = 0
+        with timer.phase("stream_feed"):
+            for contents, ids in iter_document_chunks(
+                    manifest, cfg.stream_chunk_docs):
+                total = sum(len(c) for c in contents)
+                padded = _round_up(max(total, 1), cfg.pad_multiple)
+                buf = np.full(padded, 0x20, np.uint8)
+                nb = 0
+                ends = np.empty(len(contents), np.int32)
+                for j, c in enumerate(contents):
+                    buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
+                    nb += len(c)
+                    ends[j] = nb
+                cnt, ml = DT.host_token_stats(buf, ends)
+                if ml > width:
+                    raise DT.WidthOverflow(
+                        f"cleaned token of {ml} letters exceeds "
+                        f"device_tokenize_width={width}")
+                engine_s.feed(buf, ends, np.asarray(ids, np.int32),
+                              tok_count=cnt, max_len=ml)
+                fed_tokens += cnt
+        timer.count("stream_windows", engine_s.windows_fed)
+        timer.count("accumulator_capacity", engine_s.capacity)
+        if engine_s.windows_fed == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+        host_max_len = engine_s.max_word_len
+        sort_cols = -(-max(host_max_len, 1) // 4)  # ceil div
+        timer.count("sort_cols", sort_cols)
+
+        with timer.phase("device_index"):
+            out = engine_s.finalize()
+            num_words, num_pairs = (int(v) for v in np.asarray(out["counts"]))
+        timer.count("unique_terms", num_words)
+        timer.count("unique_pairs", num_pairs)
+        timer.count("tokens", fed_tokens)
+        return self._fetch_decode_emit_device(
+            out, cap=int(out["df"].shape[0]), num_words=num_words,
+            num_pairs=num_pairs, sort_cols=sort_cols,
+            max_doc_id=max_doc_id, out_dir=out_dir, timer=timer)
 
     def _run_tpu_device_tokenize_dist(self, manifest: Manifest, out_dir: str,
                                       timer: PhaseTimer) -> dict:
@@ -947,6 +1024,14 @@ class InvertedIndexModel:
             from ..ops.device_tokenizer import WidthOverflow
 
             try:
+                if self.config.stream_chunk_docs is not None:
+                    if self._num_shards() > 1:
+                        raise ValueError(
+                            "device_tokenize streaming is single-chip; "
+                            "set device_shards=1 (the mesh engine shards "
+                            "the corpus spatially instead)")
+                    return self._run_tpu_device_tokenize_stream(
+                        manifest, out_dir, timer)
                 if self._num_shards() > 1:
                     return self._run_tpu_device_tokenize_dist(
                         manifest, out_dir, timer)
@@ -959,6 +1044,10 @@ class InvertedIndexModel:
                 timer.count("num_reducers", self.config.num_reducers)
                 timer.count("device_tokenize_fallback", str(e))
                 timer.phases["aborted_device_tokenize"] = aborted_ms / 1e3
+                if self.config.stream_chunk_docs is not None:
+                    # a streaming config falls back to the HOST streaming
+                    # engine (same bounded-memory contract)
+                    return self._run_tpu_streaming(manifest, out_dir, timer)
         if self.config.emit_ownership == "letter":
             if self._num_shards() < 2:
                 raise ValueError(

@@ -1,0 +1,280 @@
+"""Streaming all-device engine: raw byte windows in, bounded rows kept.
+
+The one-shot all-device engine (ops/device_tokenizer.py) needs the
+whole corpus byte tensor and its token-capacity arrays in HBM at once.
+Here the corpus arrives in doc-aligned byte windows and the device
+carries only the **unique (word, doc) rows seen so far**, each row a
+compressed radix form — ``ceil(width/12)`` 30-bit (hi, lo) code pairs
+(ops/device_tokenizer.pack_groups) plus the doc id — bounded by the
+output's unique-pair count, not the stream length.  The same
+blockwise-accumulator discipline as the integer-pair streaming engine
+(ops/streaming.py), lifted from packed ints to word rows, so the
+"device scan" column of the engine matrix gets the same
+larger-than-HBM story the host-scan engines have:
+
+    per window:  rows  <- tokenize_rows ► pack_groups ► sort ► dedup
+                 acc   <- unique(merge_sort(acc, rows))
+
+as fused XLA programs with static shapes and NO device->host sync in
+the stream loop: the host bounds unique rows by the fed token count
+(host_token_stats, already computed per window for tok_cap), growing
+the accumulator by host-side doubling BEFORE a window that could
+overflow it.  Group passes whose chars the stream has not seen yet are
+skipped (the host's running max cleaned length is exact).
+
+Exactness: rows are the actual cleaned bytes under an injective code
+map — no hashing anywhere; a window whose max cleaned token exceeds
+``width`` raises WidthOverflow BEFORE that window is fed and the model
+restarts on the host path, so output stays byte-identical always
+(main.c:105-111 / main.c:227-234 semantics, like every other engine).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.rounding import round_up
+from .device_tokenizer import (
+    INT32_MAX,
+    clamp_sort_cols,
+    groups_sort_perm,
+    pack_groups,
+    tokenize_rows,
+    unpack_groups,
+    zero_tail_cols,
+)
+from .segment import first_occurrence_mask
+
+
+def _row_first_mask(rows):
+    """first-occurrence mask over sorted (group pairs…, doc) rows;
+    rows[0] (group-0 hi) carries INT32_MAX on padding."""
+    neq = first_occurrence_mask(rows[0])
+    for r in rows[1:]:
+        neq = neq | first_occurrence_mask(r)
+    return neq & (rows[0] != INT32_MAX)
+
+
+def _compact_rows(rows, mask, out_cap: int):
+    """Searchsorted/gather compaction of row tuples (no scatters —
+    ops/segment.py discipline); dropped slots become padding rows
+    (INT32_MAX in every column, so later sorts still push them last)."""
+    n = rows[0].shape[0]
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    slots = jnp.arange(out_cap, dtype=jnp.int32)
+    pos = jnp.clip(jnp.searchsorted(rank, slots), 0, n - 1).astype(jnp.int32)
+    live = slots < (rank[-1] + 1)
+    return tuple(jnp.where(live, r[pos], INT32_MAX) for r in rows)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("width", "tok_cap", "num_docs", "sort_cols",
+                     "num_groups", "out_cap"),
+)
+def window_rows(data, doc_ends, doc_id_values, *, width: int, tok_cap: int,
+                num_docs: int, sort_cols: int, num_groups: int,
+                out_cap: int):
+    """One byte window -> its deduped (group rows…, doc) pairs.
+
+    Returns ``(rows, counts)``: ``rows`` is ``2 * num_groups + 1``
+    int32 arrays of length ``out_cap`` (compressed unique pairs first,
+    INT32_MAX padding after), ``counts = [num_pairs, max_word_len,
+    num_tokens]`` for the caller's divergence asserts (fetched lazily,
+    never inside the stream loop).
+    """
+    cols, doc_col, max_word_len, num_tokens = tokenize_rows(
+        data, doc_ends, doc_id_values, width=width, tok_cap=tok_cap,
+        num_docs=num_docs)
+    nsort = clamp_sort_cols(sort_cols, len(cols))
+    cols = zero_tail_cols(cols, nsort, tok_cap)
+    groups = pack_groups(cols, nsort)
+    perm = groups_sort_perm(groups, doc_col, tok_cap)
+    zero = jnp.zeros(tok_cap, jnp.int32)
+    s_rows = tuple(
+        g[perm] for pair in groups for g in pair
+    ) + tuple([zero] * (2 * (num_groups - len(groups)))) + (doc_col[perm],)
+    first = _row_first_mask(s_rows)
+    rows = _compact_rows(s_rows, first, out_cap)
+    counts = jnp.stack([first.sum(dtype=jnp.int32), max_word_len,
+                        num_tokens])
+    return rows, counts
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "live_groups"),
+                   donate_argnums=(0,))
+def _merge_unique_rows(acc, window, *, cap: int, live_groups: int):
+    """Fold a window's row tuple into the sorted-unique accumulator;
+    also returns the accumulator's true unique-row count (the host
+    reads it one merge LATE, so it never stalls the stream loop).
+
+    ``live_groups``: groups the stream has produced a nonzero char for
+    so far (host-exact running max) — later groups are all zero in both
+    operands except on padding rows, where every column is INT32_MAX,
+    equal too; their sort passes are skipped, their dedup compares
+    kept (cheap elementwise, robustness)."""
+    cat = tuple(jnp.concatenate([a, w]) for a, w in zip(acc, window))
+    doc = cat[-1]
+    groups = [(cat[2 * g], cat[2 * g + 1]) for g in range(live_groups)]
+    perm = groups_sort_perm(groups, doc, doc.shape[0])
+    s_rows = tuple(r[perm] for r in cat)
+    first = _row_first_mask(s_rows)
+    return _compact_rows(s_rows, first, cap), first.sum(dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _regrow_rows(acc, *, cap: int):
+    """Copy row arrays into larger INT32_MAX-padded buffers."""
+    def one(a):
+        out = jnp.full((cap,), INT32_MAX, jnp.int32)
+        return lax.dynamic_update_slice(out, a, (0,))
+    return tuple(one(a) for a in acc)
+
+
+@functools.partial(jax.jit, static_argnames=("ncols", "num_groups"))
+def _finalize_rows(acc, *, ncols: int, num_groups: int):
+    """Accumulated sorted-unique rows -> the one-shot engine's output
+    contract (counts / df / postings / unique_cols).
+
+    Every valid row is one unique (word, doc) pair and the rows are
+    already in emit-ready lexicographic order, so: postings are the doc
+    column's valid prefix verbatim; df falls out of the word-run edges;
+    unique word columns decompress from the group pairs gathered at
+    each run's first row (ops/device_tokenizer.unpack_groups).
+    """
+    cap = acc[0].shape[0]
+    doc = acc[-1]
+    valid = acc[0] != INT32_MAX
+    word_cols = acc[:-1]
+    neq = first_occurrence_mask(word_cols[0])
+    for r in word_cols[1:]:
+        neq = neq | first_occurrence_mask(r)
+    first_word = neq & valid
+    num_words = first_word.sum(dtype=jnp.int32)
+    num_pairs = valid.sum(dtype=jnp.int32)
+
+    word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
+    slots = jnp.arange(cap, dtype=jnp.int32)
+    W = jnp.searchsorted(word_rank, jnp.arange(cap + 1, dtype=jnp.int32))
+    word_live = slots < num_words
+    Wg = jnp.clip(W[:-1], 0, cap - 1).astype(jnp.int32)
+    df = jnp.where(word_live, jnp.minimum(W[1:], num_pairs) - W[:-1], 0)
+    postings = jnp.where(slots < num_pairs, doc, 0)
+
+    groups = [(jnp.where(word_live, acc[2 * g][Wg], 0),
+               jnp.where(word_live, acc[2 * g + 1][Wg], 0))
+              for g in range(num_groups)]
+    unique_cols = unpack_groups(groups, ncols)
+    return {
+        "counts": jnp.stack([num_words, num_pairs]),
+        "df": df,
+        "postings": postings,
+        "unique_cols": unique_cols,
+    }
+
+
+class DeviceStreamEngine:
+    """Bounded-memory all-device reduction over a raw byte-window
+    stream.  ``width`` fixes the row shape for the whole stream; the
+    caller guards WidthOverflow per window BEFORE feeding (host-exact
+    max cleaned length), so the accumulator never holds a truncated
+    row.  ``window_pad`` rounds per-window token capacities so window
+    programs reuse across similar windows.
+    """
+
+    def __init__(self, *, width: int, window_pad: int = 1 << 14,
+                 initial_capacity: int = 1 << 16):
+        self._width = width
+        self._num_groups = (width // 4 + 2) // 3
+        self._window_pad = window_pad
+        self._cap = initial_capacity
+        self._acc = None
+        self._unique_bound = 0     # host bound on unique rows in acc
+        self._pending_count = None  # previous merge's true unique count
+        self._live_groups = 1      # running ceil(ceil(maxlen/4)/3)
+        self.windows_fed = 0
+        self.max_word_len = 0
+        self._window_checks = []   # (counts_dev, tok_cap, host_max_len)
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def _ensure_capacity(self, extra: int) -> None:
+        self._unique_bound += extra
+        while self._unique_bound > self._cap:
+            self._cap *= 2
+            if self._acc is not None:
+                self._acc = _regrow_rows(self._acc, cap=self._cap)
+
+    def feed(self, buf: np.ndarray, ends: np.ndarray, ids: np.ndarray,
+             *, tok_count: int, max_len: int) -> None:
+        """Tokenize one padded byte window on device and fold its
+        unique rows into the accumulator.  ``tok_count`` / ``max_len``
+        are the window's host-exact stats (host_token_stats) — the
+        caller has already rejected ``max_len > width``."""
+        if tok_count == 0:
+            return
+        self.max_word_len = max(self.max_word_len, max_len)
+        sort_cols = -(-max(self.max_word_len, 1) // 4)
+        self._live_groups = max(self._live_groups, (sort_cols + 2) // 3)
+        tok_cap = round_up(tok_count + 1, self._window_pad)
+        out_cap = round_up(min(tok_count, tok_cap), self._window_pad)
+        rows, counts = window_rows(
+            jax.device_put(buf), jax.device_put(ends), jax.device_put(ids),
+            width=self._width, tok_cap=tok_cap, num_docs=ends.shape[0],
+            sort_cols=sort_cols, num_groups=self._num_groups,
+            out_cap=out_cap)
+        counts.copy_to_host_async()
+        self._window_checks.append((counts, tok_cap, max_len))
+        # tighten the host bound to the PREVIOUS merge's true unique
+        # count: its program has had this whole window's host scan to
+        # finish, so the read stalls only when the device is already
+        # the bottleneck — the bound tracks unique rows + one window's
+        # tokens, never the stream length (the module's bounded-memory
+        # claim)
+        if self._pending_count is not None:
+            self._unique_bound = int(np.asarray(self._pending_count))
+        self._ensure_capacity(tok_count)
+        if self._acc is None:
+            pad = np.full(self._cap, INT32_MAX, np.int32)
+            self._acc = tuple(
+                jax.device_put(pad) for _ in range(2 * self._num_groups + 1))
+        self._acc, self._pending_count = _merge_unique_rows(
+            self._acc, rows, cap=self._cap, live_groups=self._live_groups)
+        self._pending_count.copy_to_host_async()
+        self.windows_fed += 1
+
+    def finalize(self):
+        """Device dict with the one-shot engine's output contract
+        (counts / df / postings / unique_cols valid prefixes).
+
+        Re-checks every window's device-computed stats against the
+        host classifier here — ONE lazy fetch per window, all outside
+        the stream loop — so host/device divergence fails as loudly as
+        the one-shot engine's asserts instead of silently truncating.
+        """
+        if self._acc is None:
+            raise ValueError("no windows fed")
+        for counts_dev, tok_cap, host_max_len in self._window_checks:
+            _pairs, dev_max_len, dev_tokens = (
+                int(v) for v in np.asarray(counts_dev))
+            if dev_tokens + 1 > tok_cap:
+                raise AssertionError(
+                    f"device token count {dev_tokens} exceeded tok_cap "
+                    f"{tok_cap}: host mask count diverged from the "
+                    "device classifier (bug)")
+            if dev_max_len != host_max_len:
+                raise AssertionError(
+                    f"device max word len {dev_max_len} != host "
+                    f"{host_max_len}: classifier divergence (bug)")
+        out = _finalize_rows(self._acc, ncols=self._width // 4,
+                             num_groups=self._num_groups)
+        self._acc = self._pending_count = None
+        self._window_checks = []
+        return out

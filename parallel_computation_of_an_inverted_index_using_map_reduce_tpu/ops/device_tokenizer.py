@@ -206,6 +206,86 @@ def zero_tail_cols(cols, nsort: int, n: int):
     return (*cols[:nsort], *([zero] * (len(cols) - nsort)))
 
 
+def pack_groups(cols, nsort: int):
+    """Radix compression of word-row byte columns: cleaned bytes are
+    only 0 or a..z, and ``byte & 31`` maps them order-preservingly to
+    5-bit codes (pad 0, a=1 .. z=26).  Three byte columns (12 chars)
+    repack into one 30-bit (hi, lo) int32 pair — a 2-key stable pass
+    over the pair replaces three single-key passes (int64 keys would
+    halve again but need jax_enable_x64).  Returns ``ceil(nsort/3)``
+    pairs; group 0 pins INT32_MAX padding rows so they sort last.
+    The mapping is injective on the charset, so group equality ==
+    column equality (see :func:`unpack_groups` for the exact inverse).
+    """
+    col0 = cols[0]
+
+    def _codes(c):
+        return ((c >> 24) & 31, (c >> 16) & 31, (c >> 8) & 31, c & 31)
+
+    zero_col = jnp.zeros_like(col0)
+    groups = []
+    for g in range((nsort + 2) // 3):
+        ga = cols[3 * g]
+        gb = cols[3 * g + 1] if 3 * g + 1 < nsort else zero_col
+        gc = cols[3 * g + 2] if 3 * g + 2 < nsort else zero_col
+        a0, a1, a2, a3 = _codes(ga)
+        b0, b1, b2, b3 = _codes(gb)
+        c0, c1, c2, c3 = _codes(gc)
+        hi = (a0 << 25) | (a1 << 20) | (a2 << 15) | (a3 << 10) | (b0 << 5) | b1
+        lo = (b2 << 25) | (b3 << 20) | (c0 << 15) | (c1 << 10) | (c2 << 5) | c3
+        if g == 0:
+            pad = col0 == INT32_MAX
+            hi = jnp.where(pad, INT32_MAX, hi)
+            lo = jnp.where(pad, INT32_MAX, lo)
+        groups.append((hi, lo))
+    return groups
+
+
+def unpack_groups(groups, ncols: int):
+    """Exact inverse of :func:`pack_groups` for non-padding rows:
+    (hi, lo) code pairs back to big-endian byte columns.  Callers mask
+    padding rows (their codes decode to garbage bytes) — every consumer
+    already filters by a validity mask before using columns."""
+    zero = jnp.zeros_like(groups[0][0])
+
+    def _byte(code):
+        return jnp.where(code > 0, code + 96, 0)
+
+    cols = []
+    for c in range(ncols):
+        g, r = divmod(c, 3)
+        if g >= len(groups):
+            cols.append(zero)
+            continue
+        hi, lo = groups[g]
+        if r == 0:
+            codes = (hi >> 25, hi >> 20, hi >> 15, hi >> 10)
+        elif r == 1:
+            codes = (hi >> 5, hi, lo >> 25, lo >> 20)
+        else:
+            codes = (lo >> 15, lo >> 10, lo >> 5, lo)
+        b = [_byte(x & 31) for x in codes]
+        cols.append((b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3])
+    return tuple(cols)
+
+
+def groups_sort_perm(groups, doc_col, cap: int):
+    """Sort permutation for lexicographic ((group pairs…), doc) order:
+    LSD from the least-significant segment — doc rides as a third key
+    of the most-minor group's pass (perm starts as the identity so the
+    first pass gathers nothing), then one 2-key stable pass per
+    remaining group.  Wide comparators blow up TPU AOT compile time
+    (~80x, see :func:`sort_dedup_rows`); 2-3-key ones are cheap."""
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    hi, lo = groups[-1]
+    _, _, _, perm = lax.sort((hi, lo, doc_col, perm), num_keys=3,
+                             is_stable=True)
+    for hi, lo in reversed(groups[:-1]):
+        _, _, perm = lax.sort((hi[perm], lo[perm], perm), num_keys=2,
+                              is_stable=True)
+    return perm
+
+
 def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     """Sorted/deduped index from word-row columns (device, traceable).
 
@@ -227,45 +307,8 @@ def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     # over a constant key is the identity — skip those passes outright.
     nsort = clamp_sort_cols(sort_cols, ncols)
 
-    # Radix compression: cleaned bytes are only 0 or a..z, and
-    # (byte & 31) maps them order-preservingly to 5-bit codes (pad 0,
-    # a=1 .. z=26).  Three byte columns (12 chars) repack into one
-    # 30-bit (hi, lo) int32 pair, and a 2-key stable pass over the pair
-    # replaces three single-key passes — 1 + ceil(nsort/3) passes
-    # instead of 1 + nsort (int64 keys would halve again but need
-    # jax_enable_x64; 2-key sorts are cheap, unlike the 13-key
-    # comparator the docstring measures).  Padding rows pin group 0's
-    # hi to INT32_MAX so they still sort last.
-    def _codes(c):
-        return ((c >> 24) & 31, (c >> 16) & 31, (c >> 8) & 31, c & 31)
-
-    zero_col = jnp.zeros(cap, jnp.int32)
-    groups = []
-    for g in range((nsort + 2) // 3):
-        ga = cols[3 * g]
-        gb = cols[3 * g + 1] if 3 * g + 1 < nsort else zero_col
-        gc = cols[3 * g + 2] if 3 * g + 2 < nsort else zero_col
-        a0, a1, a2, a3 = _codes(ga)
-        b0, b1, b2, b3 = _codes(gb)
-        c0, c1, c2, c3 = _codes(gc)
-        hi = (a0 << 25) | (a1 << 20) | (a2 << 15) | (a3 << 10) | (b0 << 5) | b1
-        lo = (b2 << 25) | (b3 << 20) | (c0 << 15) | (c1 << 10) | (c2 << 5) | c3
-        if g == 0:
-            pad = col0 == INT32_MAX
-            hi = jnp.where(pad, INT32_MAX, hi)
-            lo = jnp.where(pad, INT32_MAX, lo)
-        groups.append((hi, lo))
-
-    # LSD from the least-significant segment: doc rides as a third key
-    # of the most-minor group's pass (identical order, one fewer pass;
-    # perm starts as the identity so the first pass gathers nothing)
-    perm = jnp.arange(cap, dtype=jnp.int32)
-    hi, lo = groups[-1]
-    _, _, _, perm = lax.sort((hi, lo, doc_col, perm), num_keys=3,
-                             is_stable=True)
-    for hi, lo in reversed(groups[:-1]):
-        _, _, perm = lax.sort((hi[perm], lo[perm], perm), num_keys=2,
-                              is_stable=True)
+    groups = pack_groups(cols, nsort)
+    perm = groups_sort_perm(groups, doc_col, cap)
     s_cols = tuple(c[perm] for c in cols)
     s_docs = doc_col[perm]
 

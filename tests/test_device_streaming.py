@@ -1,0 +1,192 @@
+"""Streaming all-device engine (ops/device_streaming.py +
+device_tokenize=True, stream_chunk_docs=N): raw byte windows through a
+bounded on-device row accumulator.
+
+Exactness contract is the all-device engine's: byte-identical to the
+oracle whenever cleaned tokens fit the row width, WidthOverflow
+fallback otherwise — independent of chunk size, accumulator growth
+path, or window count."""
+
+import numpy as np
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    build_index,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+    device_streaming as DS,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+    device_tokenizer as DT,
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("device_tokenize", True)
+    kw.setdefault("stream_chunk_docs", 7)
+    kw.setdefault("pad_multiple", 256)
+    kw.setdefault("device_shards", 1)
+    return IndexConfig(**kw)
+
+
+def test_matches_goldens_smoke(smoke_fixture, tmp_path):
+    m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
+    report = InvertedIndexModel(_cfg(stream_chunk_docs=2)).run(
+        m, output_dir=tmp_path)
+    assert report["stream_windows"] >= 2  # really streamed
+    assert "sort_cols" in report          # really the DEVICE engine
+    assert "stream_feed" in report["phases_ms"]
+    assert read_letter_files(tmp_path) == read_letter_files(
+        smoke_fixture / "golden")
+
+
+@pytest.mark.parametrize("chunk", [1, 5, 1000])
+def test_chunk_size_invariant_vs_oracle(tmp_path, chunk):
+    docs = zipf_corpus(num_docs=33, vocab_size=700, tokens_per_doc=55, seed=5)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _cfg(stream_chunk_docs=chunk),
+                output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_matches_one_shot_engine(tmp_path):
+    docs = zipf_corpus(num_docs=29, vocab_size=500, tokens_per_doc=48, seed=8)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    build_index(m, _cfg(stream_chunk_docs=None), output_dir=tmp_path / "one")
+    build_index(m, _cfg(stream_chunk_docs=4), output_dir=tmp_path / "str")
+    assert read_letter_files(tmp_path / "str") == read_letter_files(
+        tmp_path / "one")
+
+
+def test_accumulator_growth_path(tmp_path):
+    """Tiny initial capacity forces the host-side doubling regrowth."""
+    docs = zipf_corpus(num_docs=25, vocab_size=900, tokens_per_doc=70, seed=3)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+
+    import parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.inverted_index as MI
+
+    orig = DS.DeviceStreamEngine
+
+    class Tiny(orig):
+        def __init__(self, **kw):
+            kw["initial_capacity"] = 256
+            kw["window_pad"] = 256
+            super().__init__(**kw)
+
+    DS.DeviceStreamEngine = Tiny
+    try:
+        report = InvertedIndexModel(_cfg(stream_chunk_docs=3)).run(
+            m, output_dir=tmp_path / "dev")
+    finally:
+        DS.DeviceStreamEngine = orig
+    assert report["accumulator_capacity"] > 256  # growth really happened
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_capacity_tracks_unique_rows_not_stream_length(tmp_path):
+    """The bounded-memory claim: a long stream over a SMALL vocabulary
+    must keep the accumulator at unique-pair scale (the host bound is
+    tightened from the previous merge's true count), not grow with
+    total fed tokens."""
+    rng = np.random.default_rng(12)
+    vocab = [("w%02d" % i).encode() for i in range(50)]
+    docs = [b" ".join(rng.choice(vocab, 200)) for _ in range(40)]  # 8k tokens
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+
+    orig = DS.DeviceStreamEngine
+
+    class Tiny(orig):
+        def __init__(self, **kw):
+            kw["initial_capacity"] = 1024
+            kw["window_pad"] = 256
+            super().__init__(**kw)
+
+    DS.DeviceStreamEngine = Tiny
+    try:
+        report = InvertedIndexModel(_cfg(stream_chunk_docs=2)).run(
+            m, output_dir=tmp_path / "dev")
+    finally:
+        DS.DeviceStreamEngine = orig
+    # unique pairs <= 50 words x 40 docs = 2000; a stream-length bound
+    # would have doubled past total tokens (8192)
+    assert report["accumulator_capacity"] <= 4096
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_width_overflow_falls_back_exactly(tmp_path):
+    """An over-width token in a LATER window must abort the whole run
+    to the host path with byte-identical output."""
+    docs = [b"early window words"] * 6 + [b"a" * 30 + b" tail"] + [b"end"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(
+        _cfg(stream_chunk_docs=3, device_tokenize_width=16)).run(
+        m, output_dir=tmp_path / "dev")
+    assert "device_tokenize_fallback" in report
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_empty_and_numbers_only_corpus(tmp_path):
+    docs = [b"", b"   ", b"123 456", b"--- !!!"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    InvertedIndexModel(_cfg(stream_chunk_docs=2)).run(
+        m, output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == b""
+
+
+def test_pack_unpack_groups_roundtrip():
+    """unpack_groups must be the exact inverse of pack_groups on valid
+    rows for every column count."""
+    rng = np.random.default_rng(0)
+    ncols = 12
+    n = 64
+    # random cleaned rows: 0-terminated lowercase prefixes
+    rows = np.zeros((n, 4 * ncols), np.uint8)
+    for i in range(n):
+        ln = int(rng.integers(1, 4 * ncols + 1))
+        rows[i, :ln] = rng.integers(97, 123, ln, np.uint8)
+    r32 = rows.reshape(n, ncols, 4).astype(np.int64)
+    cols = tuple(
+        ((r32[:, c, 0] << 24) | (r32[:, c, 1] << 16)
+         | (r32[:, c, 2] << 8) | r32[:, c, 3]).astype(np.int32)
+        for c in range(ncols))
+    import jax.numpy as jnp
+
+    jcols = tuple(jnp.asarray(c) for c in cols)
+    groups = DT.pack_groups(jcols, ncols)
+    back = DT.unpack_groups(groups, ncols)
+    for want, got in zip(cols, back):
+        np.testing.assert_array_equal(want, np.asarray(got))

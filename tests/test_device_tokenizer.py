@@ -120,8 +120,12 @@ def test_config_validation():
         IndexConfig(backend="cpu", device_tokenize=True)
     with pytest.raises(ValueError, match="host-scan"):
         IndexConfig(device_tokenize=True, overlap_tail_fraction=0.4)
-    with pytest.raises(ValueError, match="host-scan"):
-        IndexConfig(device_tokenize=True, stream_chunk_docs=10)
+    # device_tokenize + stream_chunk_docs is the STREAMING all-device
+    # engine (ops/device_streaming.py) — valid single-chip, mesh-rejected
+    IndexConfig(device_tokenize=True, stream_chunk_docs=10)
+    with pytest.raises(ValueError, match="single-chip"):
+        IndexConfig(device_tokenize=True, stream_chunk_docs=10,
+                    device_shards=4)
     with pytest.raises(ValueError, match="skew"):
         IndexConfig(device_tokenize=True, collect_skew_stats=True)
     with pytest.raises(ValueError, match="device_tokenize_width"):
