@@ -270,12 +270,23 @@ def _bench_scale() -> int:
     num_docs = int(os.environ.get("MRI_TPU_SCALE_DOCS", 1_000_000))
     vocab = int(os.environ.get("MRI_TPU_SCALE_VOCAB", 100_000))
     shards = int(os.environ.get("MRI_TPU_SCALE_SHARDS", 0))  # 0 = all devices
+    # MRI_TPU_SCALE_DEVTOK=1: the streaming ALL-DEVICE engine
+    # (ops/device_streaming.py, single chip) instead of the host-scan
+    # streaming engine — raw byte windows up, bounded row accumulator
+    devtok = bool(int(os.environ.get("MRI_TPU_SCALE_DEVTOK", 0)))
+    if devtok and shards not in (0, 1):
+        # fail loudly rather than silently ignore a flag the user
+        # passed (config.py's own policy; the engine is single-chip)
+        raise SystemExit(
+            "MRI_TPU_SCALE_DEVTOK=1 is the single-chip streaming "
+            f"all-device engine; MRI_TPU_SCALE_SHARDS={shards} conflicts")
     manifest = synthetic.synthetic_manifest(
         num_docs=num_docs, vocab_size=vocab, tokens_per_doc=40, seed=11)
     out_dir = tempfile.mkdtemp(prefix="bench_scale_")
     model = InvertedIndexModel(IndexConfig(
         backend="tpu", output_dir=out_dir,
-        device_shards=shards if shards else None,
+        device_shards=1 if devtok else (shards if shards else None),
+        device_tokenize=devtok,
         stream_chunk_docs=int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))))
     t0 = time.perf_counter()
     stats = model.run(manifest)
@@ -294,6 +305,7 @@ def _bench_scale() -> int:
             "accumulator_capacity", stats.get("accumulator_capacity_per_owner")),
         "device_shards": stats.get("device_shards", 1),
         "stream_windows": stats.get("stream_windows"),
+        "engine": "device-stream" if devtok else "host-stream",
     }
     if os.environ.get("MRI_TPU_SCALE_CROSSCHECK"):
         import hashlib

@@ -54,6 +54,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "longer than --device-tokenize-width)")
     p.add_argument("--device-tokenize-width", type=int, default=48,
                    help="device word-row bytes (multiple of 4)")
+    p.add_argument("--device-shards", type=int, default=None,
+                   help="mesh size: shard the device engine over this many "
+                        "chips (default: all visible devices; 1 = single "
+                        "chip — required for --device-tokenize streaming)")
     p.add_argument("--overlap-tail-fraction", type=float, default=None,
                    help="windowed overlap plan: this fraction of corpus "
                         "bytes (the last doc range) is indexed on host "
@@ -88,6 +92,7 @@ def main(argv: list[str] | None = None) -> int:
             overlap_tail_fraction=args.overlap_tail_fraction,
             device_tokenize=args.device_tokenize,
             device_tokenize_width=args.device_tokenize_width,
+            device_shards=args.device_shards,
             host_threads=args.host_threads,
             emit_ownership=args.emit_ownership,
         )
