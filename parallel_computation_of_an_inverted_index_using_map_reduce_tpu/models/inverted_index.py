@@ -568,7 +568,9 @@ class InvertedIndexModel:
         timer.count("window_plan_bytes", wstats["bytes_per_shard"])
         granule = min(1 << 14, cfg.pad_multiple)
 
-        dev_handles: list[tuple] = []  # (in-flight fetch, nvalid, term ids)
+        dev_handles: list[tuple] = []  # (in-flight fetch, nvalid)
+        dev_snaps: list[tuple] = []    # (df before, df after) per window
+        prev_snap = np.zeros(0, np.int32)
         tail_keys = None
         num_pairs = docs_loaded = 0
         # the trace must span dispatch THROUGH fetch — the device sorts
@@ -596,11 +598,8 @@ class InvertedIndexModel:
                     num_pairs += nvalid
                     if nvalid == 0:
                         continue
-                    if mode == "u16":
-                        terms = buf[: nvalid]  # terms half, valid prefix
-                    else:  # prov ids outgrew uint16: packed int32 keys
+                    if mode != "u16":  # prov ids outgrew uint16
                         keys = buf
-                        terms = keys // stride
                         padded = _round_up(nvalid, granule)
                         buf = np.full(padded, K.INT32_MAX, dtype=np.int32)
                         buf[:nvalid] = keys
@@ -608,7 +607,14 @@ class InvertedIndexModel:
                         (jax.device_put(buf),), stride=stride,
                         out_size=_round_up(nvalid, granule))
                     post.copy_to_host_async()
-                    dev_handles.append((post, nvalid, terms))
+                    dev_handles.append((post, nvalid))
+                    # per-window per-term pair counts come from combiner
+                    # df snapshot diffs (vocab-scale) — not token-scale
+                    # bincounts over the window's term ids
+                    snap = stream.df_snapshot(
+                        hint=max(1 << 16, prev_snap.shape[0] * 2))
+                    dev_snaps.append((prev_snap, snap))
+                    prev_snap = snap
             with timer.phase("finalize_vocab"):
                 vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
         except BaseException:
@@ -623,7 +629,7 @@ class InvertedIndexModel:
         timer.count("unique_terms", vocab_size)
         timer.count("upload_windows", len(dev_handles))
         timer.count("overlap_tail_fraction", tail_f)
-        dev_pairs = sum(n for _, n, _ in dev_handles)
+        dev_pairs = sum(n for _, n in dev_handles)
         timer.count("device_pairs", dev_pairs)
         timer.count("unique_pairs", num_pairs)
         timer.count("device_shards", 1)
@@ -637,29 +643,33 @@ class InvertedIndexModel:
             if tail_keys is not None and tail_keys.size:
                 tail_sorted = np.sort(tail_keys)
                 tail_docs = (tail_sorted % stride).astype(np.uint16)
-                tail_terms = tail_sorted // stride
             else:
                 tail_docs = np.empty(0, np.uint16)
-                tail_terms = np.empty(0, np.int64)
 
         with timer.phase("host_views"):
             # All vocab-scale, all while the device fetches are in
-            # flight: emit order, plus per-run rank-space segment tables.
+            # flight: emit order, plus per-run rank-space segment
+            # tables from combiner-snapshot diffs (nothing token-scale
+            # survives on the host).
             prov_of_rank = np.empty(vocab_size, dtype=np.int64)
             prov_of_rank[remap] = np.arange(vocab_size)
             df_rank = df_prov.astype(np.int64)[prov_of_rank]
             order, _ = engine.host_order_offsets(letters, df_rank)
-            runs_meta = []
-            for _, nvalid, terms in dev_handles:
-                c = np.bincount(terms, minlength=vocab_size).astype(np.int64)
+
+            def run_meta(prev, cur):
+                c = np.zeros(vocab_size, np.int64)
+                c[: cur.shape[0]] = cur
+                c[: prev.shape[0]] -= prev
                 off = np.cumsum(c) - c
-                runs_meta.append((off[prov_of_rank], c[prov_of_rank]))
-            c = np.bincount(tail_terms, minlength=vocab_size).astype(np.int64)
-            off = np.cumsum(c) - c
-            tail_meta = (off[prov_of_rank], c[prov_of_rank])
+                return off[prov_of_rank], c[prov_of_rank]
+
+            runs_meta = [run_meta(prev, cur) for prev, cur in dev_snaps]
+            # the tail window's counts: final combiner df minus the
+            # last device-window snapshot
+            tail_meta = run_meta(prev_snap, df_prov.astype(np.int64))
 
         with timer.phase("fetch"):
-            fetched = [np.asarray(post) for post, _, _ in dev_handles]
+            fetched = [np.asarray(post) for post, _ in dev_handles]
         trace.close()
 
         with timer.phase("emit"):

@@ -149,6 +149,9 @@ def load():
         lib.mri_stream_chunk_u16_free.restype = None
         lib.mri_stream_chunk_u16_free.argtypes = [
             ctypes.POINTER(_StreamChunkU16Result)]
+        lib.mri_stream_df_snapshot.restype = ctypes.c_int32
+        lib.mri_stream_df_snapshot.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
         lib.mri_stream_finalize.restype = ctypes.POINTER(_StreamFinalResult)
         lib.mri_stream_finalize.argtypes = [ctypes.c_void_p]
         lib.mri_stream_final_free.restype = None
@@ -340,6 +343,24 @@ class NativeKeyStream:
             return "keys", keys, n, raw
         finally:
             self._lib.mri_stream_chunk_u16_free(res)
+
+    def df_snapshot(self, hint: int = 1 << 16) -> np.ndarray:
+        """Current per-term deduped (term, doc) counts, GLOBAL prov-id
+        space (int32, one slot per provisional id seen so far).  Cheap
+        (vocab-scale copy; in MT mode a vocab-scale fold per worker) —
+        the overlap plan diffs consecutive snapshots for per-window
+        per-term pair counts instead of token-scale bincounts."""
+        buf = np.empty(max(hint, 1), np.int32)
+        n = self._lib.mri_stream_df_snapshot(
+            self._handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(buf.shape[0]))
+        if n < 0:
+            buf = np.empty(-n, np.int32)
+            n = self._lib.mri_stream_df_snapshot(
+                self._handle,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.c_int32(buf.shape[0]))
+        return buf[:n].copy()
 
     def finalize(self):
         """``(vocab, letter_of_term, remap, df_prov, raw_tokens, num_pairs)``.

@@ -682,15 +682,22 @@ StreamState& ResolveVocab(StreamState& global, std::vector<Worker>& workers) {
   return global;
 }
 
-// Fold the workers' combiner df counts (local prov space) into global
-// prov space.  Correct because each document is scanned by exactly one
-// worker, so per-(term, doc) dedup is complete thread-locally.
+// Fold the workers' combiner df counts (local prov space) into a
+// zeroed global-prov-space buffer.  Correct because each document is
+// scanned by exactly one worker, so per-(term, doc) dedup is complete
+// thread-locally.  THE one fold — finalize's GlobalDf and the
+// mid-stream mri_stream_df_snapshot must agree bit for bit (the
+// overlap plan diffs snapshots against finalize's totals).
+void FoldWorkerDf(const std::vector<Worker>& workers, int32_t* out) {
+  for (const Worker& w : workers)
+    for (int32_t lid = 0; lid < w.local.next_id; ++lid)
+      out[w.l2g[lid]] += w.local.combiner[lid].df;
+}
+
 std::vector<int32_t> GlobalDf(const StreamState& global,
                               const std::vector<Worker>& workers) {
   std::vector<int32_t> df(std::max(global.next_id, 1), 0);
-  for (const Worker& w : workers)
-    for (int32_t lid = 0; lid < w.local.next_id; ++lid)
-      df[w.l2g[lid]] += w.local.combiner[lid].df;
+  FoldWorkerDf(workers, df.data());
   return df;
 }
 
@@ -1035,6 +1042,27 @@ void mri_stream_chunk_u16_free(StreamChunkU16Result* r) {
   std::free(r->feed_u16);
   std::free(r->keys);
   std::free(r);
+}
+
+// Current document-frequency snapshot in GLOBAL provisional-id space
+// (the combiner's deduped per-(term, doc) counts so far).  Lets the
+// windowed overlap plan derive per-window per-term pair counts as
+// vocab-scale snapshot diffs instead of token-scale bincounts.  In MT
+// mode folds the workers' thread-local counts (each document is
+// scanned by exactly one worker, so the fold is exact; l2g is extended
+// every feed).  Returns the term count written, or -needed when the
+// caller's buffer is too small (call again with >= needed slots).
+int32_t mri_stream_df_snapshot(void* handle, int32_t* out, int32_t cap) {
+  auto& h = *static_cast<StreamHandle*>(handle);
+  const int32_t n = h.global.next_id;
+  if (n > cap) return -n;
+  std::memset(out, 0, static_cast<size_t>(n) * sizeof(int32_t));
+  if (h.workers.empty()) {
+    for (int32_t i = 0; i < n; ++i) out[i] = h.global.combiner[i].df;
+  } else {
+    FoldWorkerDf(h.workers, out);
+  }
+  return n;
 }
 
 StreamFinalResult* mri_stream_finalize(void* handle) try {
