@@ -199,10 +199,8 @@ class IndexConfig:
                 raise ValueError(
                     "device_tokenize is incompatible with collect_skew_stats "
                     "(no host-side pair ids exist)")
-            if self.emit_ownership == "letter":
-                raise ValueError(
-                    "device_tokenize is single-chip; emit_ownership='letter' "
-                    "is the multi-chip emit path")
+            # letter + stream_chunk_docs is rejected by the general
+            # emit_ownership='letter' block below
         if self.host_threads is not None and self.host_threads < 1:
             raise ValueError(
                 f"host_threads must be >= 1 or None (auto), got {self.host_threads}")

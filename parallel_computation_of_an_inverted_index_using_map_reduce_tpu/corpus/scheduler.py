@@ -133,6 +133,21 @@ def plan_letter_ranges(num_reducers: int) -> tuple[tuple[int, int], ...]:
     return tuple(ranges)
 
 
+def owner_of_letter_table(num_owners: int):
+    """``(ranges, owner_of_letter)``: the letter-ownership map every
+    per-owner emit path shares — ``owner_of_letter[l]`` is the
+    partition owning letter ``l`` under :func:`plan_letter_ranges`
+    (one table so the host pipelined and mesh device letter-emit
+    modes can never diverge)."""
+    import numpy as np
+
+    ranges = plan_letter_ranges(num_owners)
+    owner_of_letter = np.zeros(ALPHABET_SIZE, dtype=np.int32)
+    for o, (lo, hi) in enumerate(ranges):
+        owner_of_letter[lo:hi] = o
+    return ranges, owner_of_letter
+
+
 def _balance(loads: list[int]) -> dict:
     mean = sum(loads) / len(loads) if loads else 0.0
     return {

@@ -480,13 +480,10 @@ class InvertedIndexModel:
         host runs only its owner's iteration (``jax.process_index``);
         this single-controller loop simulates every host.
         """
-        from ..corpus.scheduler import plan_letter_ranges
+        from ..corpus.scheduler import owner_of_letter_table
 
         n = mesh.devices.size
-        ranges = plan_letter_ranges(n)
-        owner_of_letter = np.zeros(26, dtype=np.int32)
-        for o, (lo, hi) in enumerate(ranges):
-            owner_of_letter[lo:hi] = o
+        ranges, owner_of_letter = owner_of_letter_table(n)
         letters = np.asarray(letters)
         letters_prov = letters[np.asarray(remap)]
         owner_of_prov = owner_of_letter[letters_prov]
@@ -963,12 +960,20 @@ class InvertedIndexModel:
             sort_cols = -(-max(host_max_len, 1) // 4)  # ceil div
             timer.count("sort_cols", sort_cols)
 
+        letter_mode = cfg.emit_ownership == "letter"
+        owner_of_letter = ranges = None
+        if letter_mode:
+            from ..corpus.scheduler import owner_of_letter_table
+
+            ranges, owner_of_letter = owner_of_letter_table(n)
+            timer.count("emit_ownership", "letter")
+
         dist_stats: dict = {}
         with timer.phase("device_index"):
             owners, (max_len, _) = DDT.index_bytes_dist(
                 bufs, ends_l, ids_l, width=width, tok_cap=tok_cap,
                 mesh=mesh, stats=dist_stats, sort_cols=sort_cols,
-                max_doc_id=max_doc_id)
+                max_doc_id=max_doc_id, owner_of_letter=owner_of_letter)
             if max_len != host_max_len:
                 raise AssertionError(
                     f"device max word len {max_len} != host "
@@ -979,6 +984,46 @@ class InvertedIndexModel:
                     f"device_tokenize_width={width}")
         for k, v in dist_stats.items():
             timer.count(k, v)
+
+        if letter_mode:
+            # per-owner letter emission: owner o holds EVERY word of
+            # its letter range (the reference's reducer ownership,
+            # main.c:129-150, at raw-text level), so each owner's
+            # block emits its own letter files with no global merge —
+            # on a multi-host pod every process writes exactly its
+            # addressable owners' files (tests/test_distributed.py)
+            lines = 0
+            with timer.phase("host_views_emit"):
+                for o, ow in sorted(owners.items()):
+                    if ow["num_words"] == 0:
+                        formatter.emit_index(
+                            out_dir, vocab=np.empty(0, "S1"),
+                            letter_of_term=np.empty(0, np.int64),
+                            order=np.empty(0, np.int64),
+                            df=np.empty(0, np.int64),
+                            offsets=np.empty(0, np.int64),
+                            postings=np.empty(0, np.int32),
+                            max_doc_id=max_doc_id, letter_range=ranges[o])
+                        continue
+                    vocab_o = DT.decode_word_rows(ow["unique_cols"], width)
+                    df_o = ow["df"].astype(np.int64)
+                    letters_o = vocab_o.view(np.uint8).reshape(
+                        ow["num_words"], width)[:, 0] - ord("a")
+                    order_o = np.lexsort((vocab_o, -df_o, letters_o))
+                    stats_o = formatter.emit_index(
+                        out_dir, vocab=vocab_o, letter_of_term=letters_o,
+                        order=order_o, df=df_o,
+                        offsets=np.cumsum(df_o) - df_o,
+                        postings=ow["postings"].astype(np.int32),
+                        max_doc_id=max_doc_id, letter_range=ranges[o])
+                    lines += stats_o["lines_written"]
+            timer.count("letter_owners", n)
+            timer.count("unique_terms",
+                        sum(ow["num_words"] for ow in owners.values()))
+            timer.count("unique_pairs",
+                        sum(ow["num_pairs"] for ow in owners.values()))
+            timer.count("lines_written", lines)
+            return timer.report()
 
         with timer.phase("host_views"):
             vocab_parts, df_parts, off_parts, post_parts = [], [], [], []
@@ -1045,6 +1090,10 @@ class InvertedIndexModel:
                 if self._num_shards() > 1:
                     return self._run_tpu_device_tokenize_dist(
                         manifest, out_dir, timer)
+                if self.config.emit_ownership == "letter":
+                    raise ValueError(
+                        "emit_ownership='letter' requires a multi-chip "
+                        "mesh (device_shards > 1)")
                 return self._run_tpu_device_tokenize(manifest, out_dir, timer)
             except WidthOverflow as e:
                 # exactness guard tripped: restart on the host-scan path

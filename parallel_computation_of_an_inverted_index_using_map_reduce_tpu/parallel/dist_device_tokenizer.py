@@ -71,7 +71,8 @@ def _mix32(cols):
 
 
 def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
-          num_shards: int, capacity: int, sort_cols: int | None):
+          num_shards: int, capacity: int, sort_cols: int | None,
+          owner_of_letter: tuple | None):
     cols, doc_col, max_len, num_tokens = tokenize_rows(
         data_l, ends_l, ids_l, width=width, tok_cap=tok_cap,
         num_docs=num_docs)
@@ -85,9 +86,19 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
     nrows = len(rows)
 
     valid = cols[0] != INT32_MAX
-    owner = jnp.where(valid,
-                      (_mix32(rows[:-1]) % num_shards).astype(jnp.int32),
-                      num_shards)
+    if owner_of_letter is None:  # near-uniform content-hash ownership
+        dest = (_mix32(rows[:-1]) % num_shards).astype(jnp.int32)
+    else:
+        # letter ownership (the reference's reducer letter ranges,
+        # main.c:129-130, re-keyed at raw-text level): each owner
+        # receives whole letters and can emit its own letter files
+        # with no global merge — the multi-host emit mode.  Skewed by
+        # construction (SURVEY.md §2.3); the provably-safe capacity
+        # retry absorbs it.
+        letter = ((cols[0] >> 24) & 0xFF) - ord("a")
+        dest = jnp.asarray(np.asarray(owner_of_letter, np.int32))[
+            jnp.clip(letter, 0, 25)]
+    owner = jnp.where(valid, dest, num_shards)
     # bucket rows by owner: stable sort of (owner, perm), then windowed
     # gather per destination (the integer engines' exchange shape,
     # dist_engine._bucket_exchange, carrying the live columns side by
@@ -137,11 +148,13 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
 
 @functools.lru_cache(maxsize=32)
 def _build(mesh: Mesh, width: int, tok_cap: int, num_docs: int,
-           capacity: int, sort_cols: int | None):
+           capacity: int, sort_cols: int | None,
+           owner_of_letter: tuple | None):
     n = mesh.devices.size
     body = functools.partial(
         _body, width=width, tok_cap=tok_cap, num_docs=num_docs,
-        num_shards=n, capacity=capacity, sort_cols=sort_cols)
+        num_shards=n, capacity=capacity, sort_cols=sort_cols,
+        owner_of_letter=owner_of_letter)
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(shard_spec(),) * 3,
@@ -182,7 +195,8 @@ def _local_mesh_positions(mesh: Mesh):
 def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
                      tok_cap: int, mesh: Mesh, stats: dict | None = None,
                      sort_cols: int | None = None,
-                     max_doc_id: int | None = None):
+                     max_doc_id: int | None = None,
+                     owner_of_letter: np.ndarray | None = None):
     """Sharded raw bytes -> per-owner index rows, over the mesh.
 
     ``shard_bufs``: list of n equal-length uint8 buffers (space-padded
@@ -218,11 +232,13 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     data = _feed(shard_bufs)
     ends = _feed(shard_ends)
     ids = _feed(shard_ids)
+    owner_key = (tuple(int(x) for x in owner_of_letter)
+                 if owner_of_letter is not None else None)
     capacity = default_capacity(tok_cap, n)
     retries = 0
     while True:
-        out = _build(mesh, width, tok_cap, num_docs, capacity, sort_cols)(
-            data, ends, ids)
+        out = _build(mesh, width, tok_cap, num_docs, capacity, sort_cols,
+                     owner_key)(data, ends, ids)
         g = np.asarray(out["globals"])
         if int(g[1]) > 0 and capacity < tok_cap:
             capacity = tok_cap  # provably safe: a shard holds <= tok_cap rows

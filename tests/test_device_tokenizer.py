@@ -204,6 +204,36 @@ def test_dist_fewer_docs_than_chips(tmp_path):
     assert read_letter_files(tmp_path / "dev") == read_letter_files(tmp_path / "oracle")
 
 
+@pytest.mark.parametrize("seed", [6, 17])
+def test_dist_letter_emit_vs_oracle(tmp_path, seed):
+    """Letter-ownership emit on the mesh device engine: owners hold
+    whole letter ranges (main.c:129-150 at raw-text level) and emit
+    their own files — no global merge anywhere."""
+    _needs_mesh()
+    docs = zipf_corpus(num_docs=31, vocab_size=600, tokens_per_doc=50,
+                       seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(
+        _dist_cfg(emit_ownership="letter")).run(m, output_dir=tmp_path / "dev")
+    assert report.get("emit_ownership") == "letter"
+    assert "letter_owners" in report
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_dist_letter_emit_single_chip_rejected(tmp_path):
+    docs = [b"alpha beta"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    with pytest.raises(ValueError, match="multi-chip"):
+        build_index(m, _cfg(emit_ownership="letter"),
+                    output_dir=tmp_path / "dev")
+
+
 def test_dist_width_overflow_falls_back(tmp_path):
     _needs_mesh()
     docs = [b"regular words", b"a" * 40 + b" tail"]

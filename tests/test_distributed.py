@@ -320,3 +320,84 @@ def test_two_process_device_tokenize_fetch(tmp_path):
     for w, _ in want_pairs:
         want_df[w] = want_df.get(w, 0) + 1
     assert got_df == want_df
+
+
+DEVTOK_LETTER_WORKER = textwrap.dedent("""
+    import sys
+    repo, pid, coord, corpus_dir, out_dir = sys.argv[1:6]
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel import (
+        distributed,
+    )
+
+    distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=int(pid))
+    m = manifest_from_dir(corpus_dir)
+    report = InvertedIndexModel(IndexConfig(
+        backend="tpu", device_tokenize=True, device_shards=4,
+        emit_ownership="letter", pad_multiple=256,
+        output_dir=out_dir)).run(m)
+    # each process emitted only its ADDRESSABLE owners' letter ranges
+    print(f"proc {pid} letter_owners={report['letter_owners']} "
+          f"lines={report['lines_written']}", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_device_tokenize_letter_emit(tmp_path):
+    """The mesh all-device engine's full multi-host regime: 2 OS
+    processes run the MODEL with letter ownership; each writes only its
+    addressable owners' letter files into a shared directory, and the
+    union is byte-identical to the oracle — no host ever assembles the
+    global index."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        oracle_index,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    docs = zipf_corpus(num_docs=26, vocab_size=350, tokens_per_doc=45, seed=91)
+    write_corpus(tmp_path / "docs", docs)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(DEVTOK_LETTER_WORKER)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(REPO_ROOT), str(pid), coord,
+             str(tmp_path / "docs"), str(out_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+
+    m = manifest_from_dir(tmp_path / "docs")
+    oracle_index(m, tmp_path / "oracle")
+    assert read_letter_files(out_dir) == read_letter_files(tmp_path / "oracle")
