@@ -35,6 +35,10 @@ ENGINES = [
     dict(backend="tpu", device_shards=1, device_tokenize=True),
     dict(backend="tpu", device_tokenize=True),                 # mesh device-scan
     dict(backend="tpu", emit_ownership="letter"),
+    dict(backend="tpu", device_shards=1, device_tokenize=True,
+         stream_chunk_docs=5),                                 # device-stream
+    dict(backend="tpu", device_tokenize=True,
+         emit_ownership="letter"),                  # mesh device letter-emit
 ]
 
 
