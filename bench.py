@@ -274,18 +274,12 @@ def _bench_scale() -> int:
     # (ops/device_streaming.py, single chip) instead of the host-scan
     # streaming engine — raw byte windows up, bounded row accumulator
     devtok = bool(int(os.environ.get("MRI_TPU_SCALE_DEVTOK", 0)))
-    if devtok and shards not in (0, 1):
-        # fail loudly rather than silently ignore a flag the user
-        # passed (config.py's own policy; the engine is single-chip)
-        raise SystemExit(
-            "MRI_TPU_SCALE_DEVTOK=1 is the single-chip streaming "
-            f"all-device engine; MRI_TPU_SCALE_SHARDS={shards} conflicts")
     manifest = synthetic.synthetic_manifest(
         num_docs=num_docs, vocab_size=vocab, tokens_per_doc=40, seed=11)
     out_dir = tempfile.mkdtemp(prefix="bench_scale_")
     model = InvertedIndexModel(IndexConfig(
         backend="tpu", output_dir=out_dir,
-        device_shards=1 if devtok else (shards if shards else None),
+        device_shards=shards if shards else (1 if devtok else None),
         device_tokenize=devtok,
         stream_chunk_docs=int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))))
     t0 = time.perf_counter()

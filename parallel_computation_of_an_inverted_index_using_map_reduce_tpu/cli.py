@@ -57,7 +57,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--device-shards", type=int, default=None,
                    help="mesh size: shard the device engine over this many "
                         "chips (default: all visible devices; 1 = single "
-                        "chip — required for --device-tokenize streaming)")
+                        "chip)")
     p.add_argument("--overlap-tail-fraction", type=float, default=None,
                    help="windowed overlap plan: this fraction of corpus "
                         "bytes (the last doc range) is indexed on host "

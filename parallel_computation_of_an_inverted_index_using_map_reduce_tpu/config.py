@@ -190,11 +190,6 @@ class IndexConfig:
                     raise ValueError(
                         f"device_tokenize is a complete engine; {flag} "
                         "belongs to the host-scan plans")
-            if self.stream_chunk_docs is not None and self.device_shards not in (None, 1):
-                raise ValueError(
-                    "device_tokenize streaming (stream_chunk_docs) is "
-                    "single-chip; the mesh engine shards the corpus "
-                    "spatially instead")
             if self.collect_skew_stats:
                 raise ValueError(
                     "device_tokenize is incompatible with collect_skew_stats "

@@ -64,17 +64,24 @@ def plan_contiguous_windows(manifest: Manifest,
     (native/tokenizer.cc PlanRanges), so the same policy governs both
     levels of host map parallelism.
     """
+    return plan_contiguous_ranges(manifest.sizes, num_windows)
+
+
+def plan_contiguous_ranges(sizes, num_windows: int) -> tuple[tuple[int, int], ...]:
+    """:func:`plan_contiguous_windows` over a plain sizes sequence —
+    the ONE greedy-cut policy, shared by manifest-level windowing and
+    the mesh streaming engine's per-chunk doc split."""
     if num_windows < 1:
         raise ValueError("num_windows must be >= 1")
-    n = len(manifest)
-    total = sum(manifest.sizes)
+    n = len(sizes)
+    total = sum(sizes)
     cuts = [0]
     d = 0
     cum = 0
     for t in range(1, num_windows):
         target = total * t // num_windows
         while d < n and cum < target:
-            cum += manifest.sizes[d]
+            cum += sizes[d]
             d += 1
         cuts.append(d)
     cuts.append(n)

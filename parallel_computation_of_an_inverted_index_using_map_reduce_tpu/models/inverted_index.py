@@ -1025,6 +1025,18 @@ class InvertedIndexModel:
             timer.count("lines_written", lines)
             return timer.report()
 
+        return self._merge_emit_owner_blocks(
+            owners, max_doc_id=max_doc_id, out_dir=out_dir, timer=timer)
+
+    def _merge_emit_owner_blocks(self, owners, *, max_doc_id: int,
+                                 out_dir: str, timer: PhaseTimer) -> dict:
+        """Shared merged-emit tail of the mesh device engines: decode
+        per-owner vocab blocks and merge at vocab scale — token-scale
+        data never re-sorts on host."""
+        from ..ops import device_tokenizer as DT
+
+        cfg = self.config
+        width = cfg.device_tokenize_width
         with timer.phase("host_views"):
             vocab_parts, df_parts, off_parts, post_parts = [], [], [], []
             base = 0
@@ -1074,6 +1086,84 @@ class InvertedIndexModel:
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()
 
+    def _run_tpu_device_tokenize_stream_dist(self, manifest: Manifest,
+                                             out_dir: str,
+                                             timer: PhaseTimer) -> dict:
+        """Mesh streaming all-device engine: each window's raw bytes
+        are sharded over the mesh, tokenized per chip, exchanged by
+        content hash, and folded into bounded per-owner row
+        accumulators (parallel/dist_device_streaming.py)."""
+        from ..ops import device_tokenizer as DT
+        from ..corpus.manifest import iter_document_chunks
+        from ..parallel import dist_device_streaming as DDS
+
+        cfg = self.config
+        width = cfg.device_tokenize_width
+        n = self._num_shards()
+        mesh = make_mesh(n)
+        max_doc_id = len(manifest)
+        timer.count("device_tokenize_width", width)
+        timer.count("device_shards", n)
+        timer.count("documents", len(manifest))
+        engine_s = DDS.DistDeviceStreamEngine(width=width, mesh=mesh)
+        with timer.phase("stream_feed"):
+            from ..corpus.scheduler import plan_contiguous_ranges
+
+            for contents, ids in iter_document_chunks(
+                    manifest, cfg.stream_chunk_docs):
+                # byte-balanced contiguous doc split of this chunk —
+                # the scheduler's one greedy-cut policy
+                ranges_c = plan_contiguous_ranges(
+                    [len(c) for c in contents], n)
+                parts = [(contents[lo:hi], ids[lo:hi])
+                         for lo, hi in ranges_c]
+                shard_len = max(
+                    max((sum(len(c) for c in cs) for cs, _ in parts),
+                        default=1), 1)
+                shard_len = _round_up(shard_len, cfg.pad_multiple)
+                docs_cap = max(max(len(c) for c, _ in parts), 1)
+                bufs, ends_l, ids_l = [], [], []
+                tok_count = max_len = 0
+                for contents_s, ids_s in parts:
+                    buf = np.full(shard_len, 0x20, np.uint8)
+                    nb = 0
+                    ends = np.full(docs_cap, shard_len, np.int32)
+                    idv = np.full(docs_cap, 1, np.int32)
+                    for j, (c, i) in enumerate(zip(contents_s, ids_s)):
+                        buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
+                        nb += len(c)
+                        ends[j] = nb
+                        idv[j] = i
+                    cnt, ml = DT.host_token_stats(buf, ends)
+                    tok_count = max(tok_count, cnt)
+                    max_len = max(max_len, ml)
+                    bufs.append(buf)
+                    ends_l.append(ends)
+                    ids_l.append(idv)
+                if max_len > width:
+                    raise DT.WidthOverflow(
+                        f"cleaned token of {max_len} letters exceeds "
+                        f"device_tokenize_width={width}")
+                engine_s.feed(bufs, ends_l, ids_l, tok_count=tok_count,
+                              max_len=max_len)
+        timer.count("stream_windows", engine_s.windows_fed)
+        if engine_s.windows_fed == 0:
+            with timer.phase("emit"):
+                formatter.emit_grouped(out_dir, {})
+            return timer.report()
+        sort_cols = -(-max(engine_s.max_word_len, 1) // 4)  # ceil div
+        timer.count("sort_cols", sort_cols)
+
+        dist_stats: dict = {}
+        with timer.phase("device_index"):
+            owners = engine_s.finalize(
+                sort_cols=sort_cols, max_doc_id=max_doc_id,
+                stats=dist_stats)
+        for k, v in dist_stats.items():
+            timer.count(k, v)
+        return self._merge_emit_owner_blocks(
+            owners, max_doc_id=max_doc_id, out_dir=out_dir, timer=timer)
+
     def _run_tpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
         if self.config.device_tokenize:
             from ..ops.device_tokenizer import WidthOverflow
@@ -1081,10 +1171,8 @@ class InvertedIndexModel:
             try:
                 if self.config.stream_chunk_docs is not None:
                     if self._num_shards() > 1:
-                        raise ValueError(
-                            "device_tokenize streaming is single-chip; "
-                            "set device_shards=1 (the mesh engine shards "
-                            "the corpus spatially instead)")
+                        return self._run_tpu_device_tokenize_stream_dist(
+                            manifest, out_dir, timer)
                     return self._run_tpu_device_tokenize_stream(
                         manifest, out_dir, timer)
                 if self._num_shards() > 1:

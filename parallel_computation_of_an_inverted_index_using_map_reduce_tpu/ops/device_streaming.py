@@ -136,10 +136,11 @@ def _regrow_rows(acc, *, cap: int):
     return tuple(one(a) for a in acc)
 
 
-@functools.partial(jax.jit, static_argnames=("ncols", "num_groups"))
-def _finalize_rows(acc, *, ncols: int, num_groups: int):
-    """Accumulated sorted-unique rows -> the one-shot engine's output
-    contract (counts / df / postings / unique_cols).
+def finalize_rows_body(acc, *, ncols: int, num_groups: int):
+    """Traceable core of :func:`_finalize_rows` — also runs per shard
+    inside the mesh streaming engine's ``shard_map`` finalize
+    (parallel/dist_device_streaming.py), where each owner's
+    accumulator is one independent row set.
 
     Every valid row is one unique (word, doc) pair and the rows are
     already in emit-ready lexicographic order, so: postings are the doc
@@ -176,6 +177,10 @@ def _finalize_rows(acc, *, ncols: int, num_groups: int):
         "postings": postings,
         "unique_cols": unique_cols,
     }
+
+
+_finalize_rows = functools.partial(
+    jax.jit, static_argnames=("ncols", "num_groups"))(finalize_rows_body)
 
 
 class DeviceStreamEngine:

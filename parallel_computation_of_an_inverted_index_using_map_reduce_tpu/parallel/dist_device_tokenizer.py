@@ -256,25 +256,43 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     # per-owner counts from THIS process's shards only (the (n, 2)
     # counts array is device-sharded; a whole-array np.asarray would
     # need every shard addressable and break multi-controller)
+    owners = fetch_owner_blocks(
+        out, mesh=mesh, local_len=n * capacity, sort_cols=sort_cols,
+        max_doc_id=max_doc_id, max_words=int(g[3]), max_pairs=int(g[4]),
+        stats=stats)
+    if stats is not None:
+        stats["exchange_retries"] = retries
+        stats["exchange_capacity"] = capacity
+    return owners, (max_len, retries)
+
+
+def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int,
+                       sort_cols: int | None, max_doc_id: int | None,
+                       max_words: int, max_pairs: int,
+                       stats: dict | None = None):
+    """Addressable-shard fetch of per-owner index blocks — the shared
+    tail of the mesh device engines (one-shot and streaming).
+
+    ``out`` must carry device-sharded ``counts`` ((n, 2): words, pairs
+    per owner), ``df``, ``postings`` and ``unique_cols``;
+    ``max_words`` / ``max_pairs`` are the device-REPLICATED per-owner
+    maxima (identical prefix-slice shapes on every process).  Fetched
+    bytes track unique counts, not the overprovisioned capacity;
+    columns past ``sort_cols`` are provably all zero (decode restores
+    the zero padding for free) and df/postings ride down as uint16
+    when doc ids fit.
+    """
     counts = {
         (s.index[0].start or 0): np.asarray(s.data).reshape(2)
         for s in out["counts"].addressable_shards
     }
-    local_len = n * capacity
-    # prefix-slice every owner's valid data device-side at the
-    # REPLICATED count maxima (identical shapes on every process),
-    # rounded for program reuse — fetched bytes track unique counts,
-    # not the overprovisioned capacity.  Transfer trimming mirrors the
-    # single-chip engine: columns past sort_cols are provably all zero
-    # (decode restores the zero padding for free); df/postings ride
-    # down as uint16 when doc ids fit.
     ncols_fetch = clamp_sort_cols(sort_cols, len(out["unique_cols"]))
     narrow = max_doc_id is not None and max_doc_id < (1 << 16)
     # 1k granule: tight enough that fetched bytes track the max owner's
     # unique counts, coarse enough that slice programs reuse across
     # similar corpora
-    nu = min(local_len, _round_up(max(int(g[3]), 1), 1 << 10))
-    npairs = min(local_len, _round_up(max(int(g[4]), 1), 1 << 10))
+    nu = min(local_len, _round_up(max(max_words, 1), 1 << 10))
+    npairs = min(local_len, _round_up(max(max_pairs, 1), 1 << 10))
     sliced = _build_prefix_slice(mesh, nu, npairs, ncols_fetch, narrow)(
         out["df"], out["postings"], *out["unique_cols"][:ncols_fetch])
     for arr in sliced:
@@ -303,6 +321,4 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
         }
     if stats is not None:
         stats["dist_fetched_bytes"] = fetched
-        stats["exchange_retries"] = retries
-        stats["exchange_capacity"] = capacity
-    return owners, (max_len, retries)
+    return owners

@@ -167,6 +167,101 @@ def test_empty_and_numbers_only_corpus(tmp_path):
     assert read_letter_files(tmp_path / "dev") == b""
 
 
+# -- mesh variant (parallel/dist_device_streaming.py) ---------------------
+
+
+def _dist_cfg(**kw):
+    kw.setdefault("device_shards", None)  # all 8 virtual devices
+    return _cfg(**kw)
+
+
+def _needs_mesh():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("mesh streaming device engine needs >= 2 devices")
+
+
+@pytest.mark.parametrize("seed,chunk", [(3, 4), (14, 11)])
+def test_dist_stream_vs_oracle(tmp_path, seed, chunk):
+    _needs_mesh()
+    docs = zipf_corpus(num_docs=35, vocab_size=650, tokens_per_doc=50,
+                       seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(_dist_cfg(stream_chunk_docs=chunk)).run(
+        m, output_dir=tmp_path / "dev")
+    assert report["device_shards"] > 1
+    assert report["stream_windows"] >= 2
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_dist_stream_matches_single_chip_stream(tmp_path):
+    _needs_mesh()
+    docs = zipf_corpus(num_docs=27, vocab_size=400, tokens_per_doc=45,
+                       seed=22)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    build_index(m, _cfg(stream_chunk_docs=5), output_dir=tmp_path / "one")
+    build_index(m, _dist_cfg(stream_chunk_docs=5),
+                output_dir=tmp_path / "mesh")
+    assert read_letter_files(tmp_path / "mesh") == read_letter_files(
+        tmp_path / "one")
+
+
+def test_dist_stream_growth_and_retry_path(tmp_path):
+    """Tiny per-owner capacity forces the merge-retry + regrow path;
+    output must stay byte-identical."""
+    _needs_mesh()
+    docs = zipf_corpus(num_docs=30, vocab_size=800, tokens_per_doc=60,
+                       seed=9)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel import (
+        dist_device_streaming as DDS,
+    )
+
+    orig = DDS.DistDeviceStreamEngine
+
+    class Tiny(orig):
+        def __init__(self, **kw):
+            kw["initial_capacity"] = 128
+            kw["window_pad"] = 128
+            super().__init__(**kw)
+
+    DDS.DistDeviceStreamEngine = Tiny
+    try:
+        report = InvertedIndexModel(_dist_cfg(stream_chunk_docs=6)).run(
+            m, output_dir=tmp_path / "dev")
+    finally:
+        DDS.DistDeviceStreamEngine = orig
+    assert report["accumulator_capacity_per_owner"] > 128
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
+def test_dist_stream_width_overflow_falls_back(tmp_path):
+    _needs_mesh()
+    docs = [b"short words"] * 4 + [b"b" * 30 + b" tail"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    report = InvertedIndexModel(
+        _dist_cfg(stream_chunk_docs=2, device_tokenize_width=16)).run(
+        m, output_dir=tmp_path / "dev")
+    assert "device_tokenize_fallback" in report
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
 def test_pack_unpack_groups_roundtrip():
     """unpack_groups must be the exact inverse of pack_groups on valid
     rows for every column count."""

@@ -121,11 +121,10 @@ def test_config_validation():
     with pytest.raises(ValueError, match="host-scan"):
         IndexConfig(device_tokenize=True, overlap_tail_fraction=0.4)
     # device_tokenize + stream_chunk_docs is the STREAMING all-device
-    # engine (ops/device_streaming.py) — valid single-chip, mesh-rejected
+    # engine — single-chip (ops/device_streaming.py) or mesh
+    # (parallel/dist_device_streaming.py)
     IndexConfig(device_tokenize=True, stream_chunk_docs=10)
-    with pytest.raises(ValueError, match="single-chip"):
-        IndexConfig(device_tokenize=True, stream_chunk_docs=10,
-                    device_shards=4)
+    IndexConfig(device_tokenize=True, stream_chunk_docs=10, device_shards=4)
     with pytest.raises(ValueError, match="skew"):
         IndexConfig(device_tokenize=True, collect_skew_stats=True)
     with pytest.raises(ValueError, match="device_tokenize_width"):
