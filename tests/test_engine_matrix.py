@@ -39,6 +39,8 @@ ENGINES = [
          stream_chunk_docs=5),                                 # device-stream
     dict(backend="tpu", device_tokenize=True,
          emit_ownership="letter"),                  # mesh device letter-emit
+    dict(backend="tpu", device_tokenize=True,
+         stream_chunk_docs=6),                      # mesh device-stream
 ]
 
 
