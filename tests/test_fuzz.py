@@ -127,6 +127,24 @@ def test_backends_agree_on_byte_soup(tmp_path, seed):
     assert read_letter_files(tmp_path / "cpu") == golden
 
 
+@pytest.mark.parametrize("seed", [5, 6])
+def test_device_stream_engines_agree_on_byte_soup(tmp_path, seed):
+    """Byte soup (NULs, punctuation runs, width-overflow-adjacent
+    tokens) through the streaming all-device engines, single chip and
+    mesh — the device byte classifier + row accumulators against the
+    oracle on inputs far uglier than Zipf words."""
+    m, golden = _soup_corpus(tmp_path, seed)
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64,
+                               device_tokenize=True, device_shards=1,
+                               stream_chunk_docs=4),
+                output_dir=tmp_path / "ds1")
+    assert read_letter_files(tmp_path / "ds1") == golden
+    build_index(m, IndexConfig(backend="tpu", pad_multiple=64,
+                               device_tokenize=True, stream_chunk_docs=6),
+                output_dir=tmp_path / "dsm")
+    assert read_letter_files(tmp_path / "dsm") == golden
+
+
 def test_simd_scan_boundary_cases():
     """Deterministic adversarial cases for the mask-driven SIMD scan
     (native/tokenizer.cc ScanChunkSimd): tokens at the exact buffer
