@@ -247,6 +247,21 @@ def test_dist_stream_growth_and_retry_path(tmp_path):
         tmp_path / "oracle")
 
 
+def test_dist_stream_fewer_docs_than_chips(tmp_path):
+    """Chunks smaller than the mesh leave empty byte shards — they
+    must contribute nothing, not crash."""
+    _needs_mesh()
+    docs = [b"alpha beta", b"beta gamma", b"delta"]
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    build_index(m, _dist_cfg(stream_chunk_docs=2),
+                output_dir=tmp_path / "dev")
+    assert read_letter_files(tmp_path / "dev") == read_letter_files(
+        tmp_path / "oracle")
+
+
 def test_dist_stream_width_overflow_falls_back(tmp_path):
     _needs_mesh()
     docs = [b"short words"] * 4 + [b"b" * 30 + b" tail"]
