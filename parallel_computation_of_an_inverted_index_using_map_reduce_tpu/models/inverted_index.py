@@ -853,7 +853,9 @@ class InvertedIndexModel:
         timer.count("documents", len(manifest))
         engine_s = DS.DeviceStreamEngine(width=width)
         fed_tokens = 0
-        with timer.phase("stream_feed"):
+        profile = (jax.profiler.trace(cfg.profile_dir)
+                   if cfg.profile_dir else contextlib.nullcontext())
+        with profile, timer.phase("stream_feed"):
             for contents, ids in iter_document_chunks(
                     manifest, cfg.stream_chunk_docs):
                 total = sum(len(c) for c in contents)
@@ -1106,7 +1108,9 @@ class InvertedIndexModel:
         timer.count("device_shards", n)
         timer.count("documents", len(manifest))
         engine_s = DDS.DistDeviceStreamEngine(width=width, mesh=mesh)
-        with timer.phase("stream_feed"):
+        profile = (jax.profiler.trace(cfg.profile_dir)
+                   if cfg.profile_dir else contextlib.nullcontext())
+        with profile, timer.phase("stream_feed"):
             from ..corpus.scheduler import plan_contiguous_ranges
 
             for contents, ids in iter_document_chunks(
