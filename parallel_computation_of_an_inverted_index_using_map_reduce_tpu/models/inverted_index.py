@@ -38,6 +38,13 @@ from ..utils.timing import PhaseTimer
 from .oracle import oracle_index
 
 
+
+def _profile_ctx(profile_dir):
+    """One shared jax.profiler gate for every runner (8 call sites)."""
+    return (jax.profiler.trace(profile_dir) if profile_dir
+            else contextlib.nullcontext())
+
+
 class InvertedIndexModel:
     """Reusable pipeline object (compiled engine state is cached by jit).
 
@@ -145,10 +152,7 @@ class InvertedIndexModel:
         eng = StreamingIndexEngine(
             max_doc_id=max_doc_id, window_pad=cfg.pad_multiple)
         docs_loaded = raw_tokens = pairs_fed = 0
-        profile = (
-            jax.profiler.trace(cfg.profile_dir)
-            if cfg.profile_dir else contextlib.nullcontext()
-        )
+        profile = _profile_ctx(cfg.profile_dir)
         with timer.phase("stream"), profile:
             for contents, ids in iter_document_chunks(manifest, cfg.stream_chunk_docs):
                 chunk = tok.feed(contents, ids)
@@ -205,10 +209,7 @@ class InvertedIndexModel:
         eng = DistStreamingIndexEngine(
             max_doc_id=max_doc_id, mesh=mesh, window_pad=cfg.pad_multiple)
         docs_loaded = raw_tokens = 0
-        profile = (
-            jax.profiler.trace(cfg.profile_dir)
-            if cfg.profile_dir else contextlib.nullcontext()
-        )
+        profile = _profile_ctx(cfg.profile_dir)
         with timer.phase("stream"), profile:
             for contents, ids in iter_document_chunks(manifest, cfg.stream_chunk_docs):
                 chunk = tok.feed(contents, ids)
@@ -405,11 +406,7 @@ class InvertedIndexModel:
                 formatter.emit_grouped(out_dir, {})
             return timer.report()
 
-        profile = (
-            jax.profiler.trace(self.config.profile_dir)
-            if self.config.profile_dir
-            else contextlib.nullcontext()
-        )
+        profile = _profile_ctx(self.config.profile_dir)
         # Emit order / offsets in *prov* space from the combiner's df
         # counts: postings are grouped by prov id, so per-rank views
         # just indirect through rank -> prov.
@@ -709,10 +706,7 @@ class InvertedIndexModel:
                 formatter.emit_grouped(out_dir, {})
             return timer.report()
 
-        profile = (
-            jax.profiler.trace(cfg.profile_dir)
-            if cfg.profile_dir else contextlib.nullcontext()
-        )
+        profile = _profile_ctx(cfg.profile_dir)
         with profile:
             with timer.phase("feed"):
                 padded = _round_up(total, cfg.pad_multiple)
@@ -853,8 +847,7 @@ class InvertedIndexModel:
         timer.count("documents", len(manifest))
         engine_s = DS.DeviceStreamEngine(width=width)
         fed_tokens = 0
-        profile = (jax.profiler.trace(cfg.profile_dir)
-                   if cfg.profile_dir else contextlib.nullcontext())
+        profile = _profile_ctx(cfg.profile_dir)
         with profile, timer.phase("stream_feed"):
             for contents, ids in iter_document_chunks(
                     manifest, cfg.stream_chunk_docs):
@@ -1108,8 +1101,7 @@ class InvertedIndexModel:
         timer.count("device_shards", n)
         timer.count("documents", len(manifest))
         engine_s = DDS.DistDeviceStreamEngine(width=width, mesh=mesh)
-        profile = (jax.profiler.trace(cfg.profile_dir)
-                   if cfg.profile_dir else contextlib.nullcontext())
+        profile = _profile_ctx(cfg.profile_dir)
         with profile, timer.phase("stream_feed"):
             from ..corpus.scheduler import plan_contiguous_ranges
 
@@ -1310,11 +1302,7 @@ class InvertedIndexModel:
                 letters_dev = jax.device_put(corpus.letter_of_term)
                 packed = False
 
-        profile = (
-            jax.profiler.trace(self.config.profile_dir)
-            if self.config.profile_dir
-            else contextlib.nullcontext()
-        )
+        profile = _profile_ctx(self.config.profile_dir)
         if use_u16 and corpus.pairs_deduped:
             # Latency-pipelined fast path.  The device->host link has a
             # large fixed (RTT-like) issue cost; issuing the fetch right
