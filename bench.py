@@ -302,13 +302,9 @@ def _bench_scale() -> int:
         "engine": "device-stream" if devtok else "host-stream",
     }
     if os.environ.get("MRI_TPU_SCALE_CROSSCHECK"):
-        import hashlib
-
-        def letters_md5(d):
-            h = hashlib.md5()
-            for i in range(26):
-                h.update((Path(d) / f"{chr(97 + i)}.txt").read_bytes())
-            return h.hexdigest()
+        from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.formatter import (
+            letters_md5,
+        )
 
         cpu_dir = tempfile.mkdtemp(prefix="bench_scale_cpu_")
         InvertedIndexModel(IndexConfig(backend="cpu", output_dir=cpu_dir)).run(

@@ -74,6 +74,18 @@ def emit_index(
             "letters": letter_range[1] - letter_range[0]}
 
 
+def letters_md5(output_dir: str | Path) -> str:
+    """md5 over a.txt..z.txt concatenated in letter order — THE
+    conformance fingerprint every bench/measurement tool shares."""
+    import hashlib
+
+    output_dir = Path(output_dir)
+    h = hashlib.md5()
+    for letter in range(ALPHABET_SIZE):
+        h.update((output_dir / letter_filename(letter)).read_bytes())
+    return h.hexdigest()
+
+
 def emit_grouped(output_dir: str | Path,
                  per_letter: dict[int, list[tuple[bytes, list[int]]]]) -> None:
     """Write letter files from already-ordered (word, ids) groups (oracle path)."""

@@ -1,0 +1,134 @@
+"""On-chip measurement of the single-chip engines with honest barriers.
+
+Run whenever the axon tunnel is up:
+
+    python tools/measure_tpu.py            # host-scan + device-scan engines
+    python tools/measure_tpu.py --quick    # skip the streaming engine
+
+Single chip on purpose: the axon tunnel exposes ONE v5e, so the mesh
+engines (device_shards > 1) cannot run on real hardware here — they
+are validated on the virtual CPU mesh (tests/ + dryrun_multichip) and
+measured per-owner in SCALE_r02.json.
+
+Prints one JSON block per engine with end-to-end and phase timings.
+Methodology (see ops/device_tokenizer.py module docstring and
+BENCH_TPU_r02.json's post_capture_note):
+
+- every timing loop closes with a REAL host fetch of a tiny result —
+  on the tunneled axon platform ``block_until_ready`` returns after
+  dispatch is acked, BEFORE execution (measured: a ~500 ms program
+  "blocks" in 0.1 ms), so block-based loops time the dispatch stream;
+- best-of-N across reps, since the 1-core host VM's clock drifts
+  +-25% across hours — only interleaved best-of-N comparisons are
+  trustworthy;
+- the first invocation pays XLA compile over the tunnel (~20-40 s per
+  program); set JAX_COMPILATION_CACHE_DIR to amortize across runs.
+
+The interesting comparison for the scatter-free + compressed-radix
+redesign: ``device_index`` here vs the 817 ms (and 990 ms e2e)
+recorded pre-redesign in BENCH_TPU_r02.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# full-reference-corpus fingerprint; for any other --corpus the
+# engines are cross-checked against each other instead
+EXPECT_MD5 = "92600581e0685e69c056b65082326fc3"
+
+
+def measure(label, cfg_kwargs, manifest, reps=5, expect_md5=None):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.formatter import (
+        letters_md5,
+    )
+
+    out_dir = tempfile.mkdtemp(prefix=f"mtpu_{label}_")
+    model = InvertedIndexModel(IndexConfig(output_dir=out_dir, **cfg_kwargs))
+    model.run(manifest)  # compile + caches
+    best, rep = float("inf"), None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = model.run(manifest)
+        dt = time.perf_counter() - t0
+        if dt < best:
+            best, rep = dt, r
+    md5 = letters_md5(out_dir)
+    line = {
+        "engine": label,
+        "e2e_ms": round(best * 1e3, 2),
+        "phases_ms": {k: round(v, 2) for k, v in rep["phases_ms"].items()},
+        "md5": md5,
+    }
+    if expect_md5 is not None:
+        line["md5_ok"] = md5 == expect_md5
+    for k in ("sort_cols", "fetched_bytes", "dist_fetched_bytes",
+              "stream_windows", "accumulator_capacity",
+              "accumulator_capacity_per_owner", "device_shards"):
+        if k in rep:
+            line[k] = rep[k]
+    print(json.dumps(line), flush=True)
+    return line
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="one-shot engines only")
+    ap.add_argument("--corpus", default="/root/reference/test_in")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. cpu for a smoke "
+                         "run — env JAX_PLATFORMS alone is NOT enough: "
+                         "sitecustomize force-selects axon via "
+                         "jax.config, and a down tunnel then hangs "
+                         "any device call)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
+          flush=True)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        manifest_from_dir,
+    )
+
+    manifest = manifest_from_dir(args.corpus)
+    # full reference corpus -> absolute fingerprint; any other corpus
+    # -> the cpu backend's output is the cross-check baseline
+    if args.corpus == "/root/reference/test_in":
+        expect = EXPECT_MD5
+        cpu = measure("cpu_native", dict(backend="cpu"), manifest,
+                      expect_md5=expect)
+    else:
+        cpu = measure("cpu_native", dict(backend="cpu"), manifest)
+        expect = cpu["md5"]
+    # host-scan reference point, then the redesigned device engines
+    measure("overlap_0.5", dict(backend="tpu", device_shards=1,
+                                overlap_tail_fraction=0.5), manifest,
+            expect_md5=expect)
+    measure("device_tokenize_oneshot",
+            dict(backend="tpu", device_tokenize=True, device_shards=1),
+            manifest, expect_md5=expect)
+    if not args.quick:
+        measure("device_tokenize_stream",
+                dict(backend="tpu", device_tokenize=True, device_shards=1,
+                     stream_chunk_docs=60), manifest, reps=3,
+                expect_md5=expect)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
