@@ -21,6 +21,13 @@ Per window, as ONE ``shard_map`` program per chip:
     recv   <- all_to_all(bucket(rows, mix32 % n))          # ICI
     acc_o  <- compact(unique(sort(acc_o ++ recv)))         # owner merge
 
+Reference seams re-expressed: the mappers' shared spill-file shuffle
+(main.c:116, 332-341) is the per-window ``all_to_all``; the reducer's
+per-(word, doc) dedup (main.c:176-184) is the owner merge's
+boundary-diff — with the strict map->reduce barrier (main.c:367-369)
+dissolved into a window pipeline that never materializes the full
+token stream anywhere.
+
 Like the pair-mode mesh streaming engine (parallel/dist_streaming.py),
 a per-owner bound cannot be derived host-side without assuming hash
 uniformity, so each merge returns the replicated max per-owner count
