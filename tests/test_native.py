@@ -186,3 +186,41 @@ def test_stream_mt_rank_space_identical(threads):
 
     np.testing.assert_array_equal(rank_keys(k1, remap1), rank_keys(k2, remap2))
     np.testing.assert_array_equal(df1[np.argsort(remap1)], df2[np.argsort(remap2)])
+
+
+def test_stream_df_snapshot_matches_bincounts():
+    """mri_stream_df_snapshot diffs == per-window per-term deduped pair
+    counts (what the overlap plan derives segment tables from), for
+    single- and multi-threaded streams."""
+    if not native.available():
+        pytest.skip("native tokenizer unavailable")
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    vocab = [("w%03d" % i).encode() for i in range(120)]
+    windows = []
+    did = 1
+    for _ in range(3):
+        docs = [b" ".join(rng.choice(vocab, 25)) for _ in range(6)]
+        windows.append((docs, list(range(did, did + len(docs)))))
+        did += len(docs)
+    stride = did + 2
+    for threads in (1, 3):
+        s = native.NativeKeyStream(stride, num_threads=threads)
+        try:
+            prev = np.zeros(0, np.int32)
+            for docs, ids in windows:
+                keys, _ = s.feed(docs, ids)
+                snap = s.df_snapshot()
+                # expected per-term deduped count for THIS window
+                terms = np.asarray(keys) // stride
+                want = np.bincount(terms, minlength=snap.shape[0])
+                got = snap.astype(np.int64).copy()
+                got[: prev.shape[0]] -= prev
+                np.testing.assert_array_equal(got, want)
+                prev = snap
+            # final snapshot == finalize's df_prov
+            _, _, _, df_prov, _, _ = s.finalize()
+            np.testing.assert_array_equal(prev, df_prov)
+        finally:
+            s.close()
