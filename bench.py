@@ -17,10 +17,15 @@ the reference's best thread config (BASELINE.md measures its 1/1..8/13
 grid the same way).  The TPU line also records device-side
 Pallas-vs-XLA timings for the fused dedup kernel (``kernel_timings``).
 
-Tunnel-weather hardening (VERDICT r1 #1): the TPU measurement runs in a
-watchdog subprocess with up to ``TPU_ATTEMPTS`` tries and a persistent
-XLA compilation cache (first attempt pays the compile; retries and
-later rounds reuse it), so one hung tunnel RPC no longer erases the TPU
+Tunnel-weather hardening (VERDICT r1 #1, r2 #2): the TPU measurement
+runs in a watchdog subprocess with up to ``TPU_ATTEMPTS`` tries and a
+persistent XLA compilation cache (first attempt pays the compile;
+retries and later rounds reuse it).  The child is a FAST LANE followed
+by extensions: it compiles and measures the single best-known plan
+first and prints a complete result line immediately, then the full
+grid and the probes, each under its own alarm, re-printing after every
+stage — the parent parses the last complete line of a timed-out child,
+so one hung tunnel RPC costs at most the stage it hit, never the TPU
 story.  The native cpu backend is ALWAYS measured too (it never touches
 a device), and both numbers are reported; ``value`` is the TPU number
 when any attempt lands, else the cpu number with
@@ -69,6 +74,9 @@ def _manifest():
         write_corpus, zipf_corpus,
     )
 
+    override = os.environ.get("MRI_TPU_BENCH_CORPUS")
+    if override:
+        return manifest_from_dir(override), "custom_corpus_e2e_wall_ms"
     if REFERENCE_CORPUS.is_dir():
         return manifest_from_dir(REFERENCE_CORPUS), "test_in_e2e_wall_ms"
     tmp = Path(tempfile.mkdtemp(prefix="bench_corpus_"))
@@ -78,8 +86,9 @@ def _manifest():
     return read_manifest(tmp / "list.txt"), "synthetic_zipf_e2e_wall_ms"
 
 
-def _measure(backend: str, plans: list[dict]) -> dict:
-    """Best wall time (ms) over 5 rounds of every plan, after warmup.
+def _measure(backend: str, plans: list[dict], rounds: int = 5) -> dict:
+    """Best wall time (ms) over ``rounds`` rounds of every plan, after
+    warmup.
 
     Returns ``{"best_ms": .., "phases_ms": {..}}`` — phases from the
     best-timed run, so device vs host time is reported, not asserted.
@@ -96,7 +105,7 @@ def _measure(backend: str, plans: list[dict]) -> dict:
             IndexConfig(backend=backend, output_dir=out_dir, **plan)))
         models[-1].run(manifest)  # warmup: XLA compile + numpy/jit caches
     best, best_report, best_plan = float("inf"), {}, {}
-    for _ in range(5):
+    for _ in range(rounds):
         for model, plan in zip(models, plans):
             t0 = time.perf_counter()
             report = model.run(manifest)
@@ -166,28 +175,58 @@ def _kernel_timings() -> dict:
 
 
 def _tpu_child() -> int:
-    # Plan grid (like the reference's thread-count grid, BASELINE.md):
-    # pipelined, one-shot, and the windowed overlap plan at two tail
-    # fractions — overlap hides the link's ~60 ms RTT under the scan
-    # and wins on the tunneled chip; one-shot wins on a local PCIe link.
-    result = _measure("tpu", [
-        {},
-        {"pipeline_chunk_docs": 0},
-        {"overlap_tail_fraction": 0.4, "device_shards": 1},
-        {"overlap_tail_fraction": 0.5, "device_shards": 1},
-    ])
-    # The e2e grid is measured; emit it NOW so a probe failure cannot
-    # discard it (the parent parses the LAST stdout line) ...
-    print(json.dumps(result), flush=True)
-    # ... then try the kernel probe under its own alarm: a hung tunnel
-    # RPC inside block_until_ready would otherwise run out the child's
-    # whole watchdog budget and erase the completed measurement above.
+    # MRI_TPU_BENCH_PLATFORM=cpu lets the whole child run off-chip (CI
+    # smoke; env JAX_PLATFORMS alone is not enough — the axon
+    # sitecustomize force-selects the tpu platform via jax.config)
+    plat = os.environ.get("MRI_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     import signal
 
     def _probe_timeout(signum, frame):
-        raise TimeoutError("kernel probe exceeded its alarm")
+        raise TimeoutError("stage exceeded its alarm")
 
     signal.signal(signal.SIGALRM, _probe_timeout)
+
+    # FAST LANE (VERDICT r2 #2): compile + measure ONLY the best-known
+    # plan and print a complete result line IMMEDIATELY — one plan's
+    # compile fits even a sick tunnel's watchdog window, and the parent
+    # salvages the last complete line from a timed-out child, so this
+    # line alone already lands a real TPU number in the artifact.
+    fast_plan = {"overlap_tail_fraction": 0.5, "device_shards": 1}
+    result = _measure("tpu", [fast_plan], rounds=3)
+    result["stage"] = "fast-lane"
+    print(json.dumps(result), flush=True)
+
+    # Then extend: the full plan grid (like the reference's thread-count
+    # grid, BASELINE.md) — pipelined, one-shot, and the windowed overlap
+    # plan at the other tail fraction; overlap hides the link's ~60 ms
+    # RTT under the scan and wins on the tunneled chip, one-shot wins on
+    # a local PCIe link.  Under its own alarm so a mid-grid hang lets
+    # the child exit rc=0 with the fast-lane line intact.
+    signal.alarm(int(os.environ.get("MRI_TPU_GRID_PROBE_S", 240)))
+    try:
+        grid = _measure("tpu", [
+            {},
+            {"pipeline_chunk_docs": 0},
+            {"overlap_tail_fraction": 0.4, "device_shards": 1},
+            fast_plan,
+        ])
+        if grid["best_ms"] < result["best_ms"]:
+            grid["stage"] = "grid"
+            result = grid
+        else:
+            result["stage"] = "grid"
+    except BaseException as e:
+        result["grid_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        signal.alarm(0)
+    print(json.dumps(result), flush=True)
+    # ... then the kernel probe under its own alarm: a hung tunnel RPC
+    # inside a fetch would otherwise run out the child's whole watchdog
+    # budget and erase the completed measurements above.
     signal.alarm(int(os.environ.get("MRI_TPU_KERNEL_PROBE_S", 90)))
     try:
         result["kernel_timings"] = _kernel_timings()
@@ -329,7 +368,9 @@ def main() -> int:
               file=sys.stderr)
 
     baseline_ms = BASELINE_MS
-    if metric.startswith("synthetic"):
+    if metric != "test_in_e2e_wall_ms":
+        # synthetic or override corpus: scale the reference baseline by
+        # corpus bytes so vs_baseline stays meaningful
         manifest, _ = _manifest()
         baseline_ms = BASELINE_MS * manifest.total_bytes / BASELINE_BYTES
     line = {

@@ -58,6 +58,12 @@ from jax import lax
 
 INT32_MAX = np.iinfo(np.int32).max
 
+# Byte-buffer size above which the letter compaction's (flag, position)
+# key no longer fits in one int32 and tokenize_rows switches to a
+# two-key sort.  Module-level so tests can force the two-key branch on
+# small inputs and compare it against the one-key path.
+_ONE_KEY_COMPACTION_LIMIT = 1 << 24
+
 
 class WidthOverflow(Exception):
     """A cleaned token exceeded the row width — the device rows would be
@@ -126,7 +132,7 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     # (main.c:105-111) with no scatter.  Position fits the key's low
     # bits; the flag rides above them, so ascending key order is
     # "letters first, each group in byte order".
-    if n < (1 << 24):
+    if n < _ONE_KEY_COMPACTION_LIMIT:
         key = jnp.where(is_letter, pos, pos + jnp.int32(1 << 24))
         pos_s = (lax.sort(key) & ((1 << 24) - 1)).astype(jnp.int32)
     else:  # buffers >= 16 MiB per program: flag no longer fits beside

@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 
 from . import keys as K
-from .segment import compact, first_occurrence_mask, segment_counts
+from .segment import compact, first_occurrence_mask, sorted_segment_counts
 
 
 def _quiet_donation(fn):
@@ -131,7 +131,7 @@ def dedup_df_postings(keys_s, *, vocab_size: int, max_doc_id: int):
     valid_limit = vocab_size * (max_doc_id + 2)
     term_s, doc_s = K.unpack_pairs(keys_s, max_doc_id)
     first, count = _dedup_mask(keys_s, valid_limit)
-    df = segment_counts(term_s, first.astype(jnp.int32), vocab_size)
+    df = sorted_segment_counts(term_s, first.astype(jnp.int32), vocab_size)
     postings = compact(doc_s, first, keys_s.shape[0], jnp.int32(0))
     num_unique = count if count is not None else first.astype(jnp.int32).sum()
     return first, df, postings, num_unique
@@ -274,7 +274,7 @@ def index_pairs(term_ids, doc_ids, letter_of_term, *, vocab_size: int, max_doc_i
     term_s, doc_s = lax.sort((term_ids, doc_ids), num_keys=2)
     valid = term_s < vocab_size
     first = (first_occurrence_mask(term_s) | first_occurrence_mask(doc_s)) & valid
-    df = segment_counts(jnp.where(valid, term_s, vocab_size), first.astype(jnp.int32), vocab_size)
+    df = sorted_segment_counts(jnp.where(valid, term_s, vocab_size), first.astype(jnp.int32), vocab_size)
     postings = compact(doc_s, first, term_s.shape[0], jnp.int32(0))
     order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df

@@ -62,7 +62,8 @@ def supports(n: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _unique_kernel(keys_ref, limit_ref, mask_ref, count_ref, carry_ref):
+def _unique_kernel(keys_ref, limit_ref, mask_ref, count_ref, carry_ref, *,
+                   block_rows: int):
     i = pl.program_id(0)
     k = keys_ref[:]  # (R, 128) int32, ascending across the flattened array
 
@@ -79,30 +80,34 @@ def _unique_kernel(keys_ref, limit_ref, mask_ref, count_ref, carry_ref):
     # cross-block carry.
     rolled_lanes = pltpu.roll(k, shift=1, axis=1)
     rolled_both = pltpu.roll(rolled_lanes, shift=1, axis=0)
-    row = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_ROWS, _LANES), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_ROWS, _LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (block_rows, _LANES), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_rows, _LANES), 1)
     shifted = jnp.where(col == 0, rolled_both, rolled_lanes)
     shifted = jnp.where((col == 0) & (row == 0), carry_ref[0], shifted)
 
     mask = (k != shifted) & (k < limit_ref[0, 0])
     mask_ref[:] = mask.astype(jnp.int32)
     count_ref[0, 0] += jnp.sum(mask.astype(jnp.int32))
-    carry_ref[0] = k[_BLOCK_ROWS - 1, _LANES - 1]
+    carry_ref[0] = k[block_rows - 1, _LANES - 1]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _unique_call(keys2d, limit, *, interpret: bool):
-    grid = keys2d.shape[0] // _BLOCK_ROWS
+@functools.partial(jax.jit, static_argnames=("interpret", "block_rows"))
+def _unique_call(keys2d, limit, *, interpret: bool,
+                 block_rows: int = _BLOCK_ROWS):
+    if keys2d.shape[0] % block_rows:
+        raise ValueError(
+            f"{keys2d.shape[0]} rows not divisible by block_rows {block_rows}")
+    grid = keys2d.shape[0] // block_rows
     mask, count = pl.pallas_call(
-        _unique_kernel,
+        functools.partial(_unique_kernel, block_rows=block_rows),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
@@ -153,14 +158,19 @@ def _hist_kernel(vals_ref, counts_ref, *, num_buckets: int):
         counts_ref[0, b] += jnp.sum((v == b).astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("num_buckets", "interpret"))
-def _hist_call(vals2d, *, num_buckets: int, interpret: bool):
-    grid = vals2d.shape[0] // _BLOCK_ROWS
+@functools.partial(jax.jit,
+                   static_argnames=("num_buckets", "interpret", "block_rows"))
+def _hist_call(vals2d, *, num_buckets: int, interpret: bool,
+               block_rows: int = _BLOCK_ROWS):
+    if vals2d.shape[0] % block_rows:
+        raise ValueError(
+            f"{vals2d.shape[0]} rows not divisible by block_rows {block_rows}")
+    grid = vals2d.shape[0] // block_rows
     return pl.pallas_call(
         functools.partial(_hist_kernel, num_buckets=num_buckets),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, num_buckets), lambda i: (0, 0),

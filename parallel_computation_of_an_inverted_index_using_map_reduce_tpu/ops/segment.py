@@ -26,9 +26,11 @@ def first_occurrence_mask(sorted_keys):
     return sorted_keys != prev
 
 
-def segment_counts(segment_ids, weights, num_segments: int):
+def sorted_segment_counts(segment_ids, weights, num_segments: int):
     """Sum ``weights`` per segment id over a NONDECREASING id array;
-    ids >= num_segments are dropped.
+    ids >= num_segments are dropped.  The name carries the precondition:
+    the searchsorted run edges are silently wrong on unsorted ids (the
+    scatter-based formulation this replaced accepted any order).
 
     Used for document frequency: df[t] = number of unique (t, doc) pairs
     (the count the reference accumulates per dictionary entry at

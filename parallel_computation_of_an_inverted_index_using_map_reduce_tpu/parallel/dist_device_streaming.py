@@ -235,10 +235,20 @@ class DistDeviceStreamEngine:
         return self._cap
 
     def _empty(self, cap: int):
-        pad = np.full(self._n * cap, INT32_MAX, np.int32)
+        # built per addressable device (not one global device_put) so a
+        # multi-host pod process can create the accumulator without
+        # seeing the other hosts' devices — the same multi-controller
+        # contract as _feed_arr and the addressable-shard fetch
+        pad = np.full(cap, INT32_MAX, np.int32)
         sh = sharding(self._mesh, shard_spec())
-        return tuple(jax.device_put(pad, sh)
-                     for _ in range(2 * self._num_groups + 1))
+        local_pos = _local_mesh_positions(self._mesh)
+
+        def one():
+            arrays = [jax.device_put(pad, d) for d in local_pos.values()]
+            return jax.make_array_from_single_device_arrays(
+                (self._n * cap,), sh, arrays)
+
+        return tuple(one() for _ in range(2 * self._num_groups + 1))
 
     def _regrow(self, old_cap: int) -> None:
         if self._acc is not None and old_cap < self._cap:

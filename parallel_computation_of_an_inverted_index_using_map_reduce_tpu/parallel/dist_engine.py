@@ -42,7 +42,7 @@ from ..ops.segment import (
     bucket_edges,
     compact,
     first_occurrence_mask,
-    segment_counts,
+    sorted_segment_counts,
 )
 from ..utils.rounding import round_up as _round_up
 from .mesh import SHARD_AXIS, make_mesh, replicated_spec, shard_spec, sharding
@@ -106,7 +106,7 @@ def _shuffle_body(keys_local, letter_of_term, *, num_shards: int, capacity: int,
 
     # --- vocab-sized aggregates only: df by psum, emit order replicated.
     owned_term = recv_s // stride  # nondecreasing: recv_s is sorted
-    df_local = segment_counts(owned_term, first.astype(jnp.int32), vocab_size)
+    df_local = sorted_segment_counts(owned_term, first.astype(jnp.int32), vocab_size)
     df = lax.psum(df_local, SHARD_AXIS)
     order = emit_order(letter_of_term, df, vocab_size, max_doc_id)
     offsets = jnp.cumsum(df) - df
@@ -150,12 +150,31 @@ def _build(mesh: Mesh, num_shards: int, capacity: int, vocab_size: int,
     )
 
 
-def assemble_postings(uniq_sharded, max_doc_id: int, valid_limit: int) -> np.ndarray:
-    """Host-side merge of the sharded deduped pair keys into the global
-    term-major postings array (runs during emit, which is host-bound)."""
-    keys = np.asarray(uniq_sharded)
-    ks = np.sort(keys[keys < valid_limit], kind="stable")
-    return (ks % (max_doc_id + 2)).astype(np.int32)
+def assemble_postings(uniq_sharded, max_doc_id: int, valid_limit: int,
+                      offsets: np.ndarray, num_pairs: int) -> np.ndarray:
+    """O(N) host-side merge of the sharded deduped pair keys into the
+    global term-major postings array (runs during emit, which is
+    host-bound).
+
+    Each shard's keys are ascending (owner-side sort, INT32_MAX padding
+    packed at the tail) and every term's pairs live on exactly one
+    owner, so scattering each shard's term runs at the replicated
+    global ``offsets`` is a complete, collision-free merge — no
+    token-scale re-sort anywhere in the dist tails."""
+    stride = max_doc_id + 2
+    shards = uniq_sharded.addressable_shards
+    if len(shards) < uniq_sharded.sharding.num_devices:
+        raise RuntimeError(
+            "global postings assembly needs every shard addressable; in a "
+            "multi-host run use emit_ownership='letter' so each host emits "
+            "only its own owners' letters")
+    postings = np.empty(max(num_pairs, 1), dtype=np.int32)
+    for s in shards:
+        keys = np.asarray(s.data)
+        keys = keys[: np.searchsorted(keys, valid_limit)]
+        if keys.size:
+            _scatter_run(keys // stride, keys % stride, offsets, postings)
+    return postings[:num_pairs]
 
 
 def _prov_shuffle_body(window_locals, *, num_shards: int, capacity: int,
@@ -414,5 +433,6 @@ def dist_index(keys, letter_of_term, *, vocab_size: int, max_doc_id: int,
     out.pop("overflow", None)
     uniq = out.pop("uniq_sharded")
     out["postings"] = assemble_postings(
-        uniq, max_doc_id, vocab_size * (max_doc_id + 2))
+        uniq, max_doc_id, vocab_size * (max_doc_id + 2),
+        np.asarray(out["offsets"]), int(out["num_unique"]))
     return out

@@ -343,3 +343,30 @@ def test_decode_word_rows_roundtrip():
     ]
     decoded = DT.decode_word_rows(cols, width)
     assert [w.rstrip(b"\x00") for w in decoded.tolist()] == words
+
+
+def test_two_key_letter_compaction_branch_matches(monkeypatch):
+    """The n >= 2^24 letter-compaction fallback (the (flag, position)
+    key no longer fits in one int32, tokenize_rows) must agree exactly
+    with the one-key path — forced here by dropping the module
+    threshold so both branches run on the same small buffer."""
+    import jax
+
+    docs = [b"don't foo-bar x1y2z3 I.Loomings tail42", b"", b"  42 ",
+            b"pack my box with five dozen liquor jugs"]
+    buf, ends = _pad_concat(docs)
+    ids = np.arange(1, len(docs) + 1, dtype=np.int32)
+    kw = dict(width=48, tok_cap=256, num_docs=len(docs))
+    args = (jax.device_put(buf), jax.device_put(ends), jax.device_put(ids))
+
+    one = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+    monkeypatch.setattr(DT, "_ONE_KEY_COMPACTION_LIMIT", 0)
+    two = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+
+    one_cols, one_doc, one_len, one_cnt = one
+    two_cols, two_doc, two_len, two_cnt = two
+    assert int(one_len) == int(two_len)
+    assert int(one_cnt) == int(two_cnt)
+    np.testing.assert_array_equal(np.asarray(one_doc), np.asarray(two_doc))
+    for a, b in zip(one_cols, two_cols):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
