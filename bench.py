@@ -212,6 +212,8 @@ def _tpu_child() -> int:
             {},
             {"pipeline_chunk_docs": 0},
             {"overlap_tail_fraction": 0.4, "device_shards": 1},
+            {"overlap_tail_fraction": 0.5, "device_shards": 1,
+             "overlap_device_windows": 1},
             fast_plan,
         ])
         if grid["best_ms"] < result["best_ms"]:

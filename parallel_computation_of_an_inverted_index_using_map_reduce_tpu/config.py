@@ -81,6 +81,10 @@ class IndexConfig:
     # Emit concatenates the per-window runs in doc order (no merge
     # pass).  None = disabled (plain pipelined plan); must be in (0, 1).
     overlap_tail_fraction: float | None = None
+    # Device windows for the overlap plan: 2 issues the first fetch
+    # earlier; 1 halves the dispatch RPCs (wins when per-call link
+    # overhead dominates the hidden round trip).
+    overlap_device_windows: int = 2
     # Device-side tokenizer (ops/device_tokenizer.py): raw corpus bytes
     # go up, the finished index comes down — the ENTIRE map phase (byte
     # classify, token segmentation, cleaning, dedup, df, postings) as
@@ -171,6 +175,10 @@ class IndexConfig:
                 raise ValueError(
                     "overlap_tail_fraction is single-chip; "
                     "emit_ownership='letter' is the multi-chip emit path")
+        if self.overlap_device_windows not in (1, 2):
+            raise ValueError(
+                f"overlap_device_windows must be 1 or 2, "
+                f"got {self.overlap_device_windows}")
         # upper bound 296 (< MAX_WORD_LETTERS): a width that could hold
         # a 299+-letter token would silently skip the reference's 299
         # cap (main.c:105) instead of falling back to the host path

@@ -123,6 +123,55 @@ def iter_document_ranges(manifest: Manifest, ranges):
         yield contents, doc_ids
 
 
+def prefetch_document_ranges(manifest: Manifest, ranges, depth: int = 1):
+    """:func:`iter_document_ranges` with a reader thread ``depth``
+    windows ahead.
+
+    The native scan releases the GIL, so the next window's file reads
+    overlap the current window's tokenize — the reference reads and
+    scans serially per mapper (main.c:97-116).  Reader exceptions
+    re-raise in the consumer."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+    done = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded put that gives up when the consumer is gone, so an
+        # abandoned generator (e.g. a feed error mid-loop) cannot leave
+        # the reader blocked forever holding window buffers
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def reader():
+        try:
+            for item in iter_document_ranges(manifest, ranges):
+                if not _put(item):
+                    return
+            _put(done)
+        except BaseException as e:  # surfaced on the consumer side
+            _put(e)
+
+    threading.Thread(target=reader, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 def iter_document_chunks(manifest: Manifest, chunk_docs: int):
     """Yield ``(contents, doc_ids)`` windows of at most ``chunk_docs``
     whole documents, in manifest order — the streaming loader (host

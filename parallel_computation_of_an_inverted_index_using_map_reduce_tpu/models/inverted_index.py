@@ -318,7 +318,7 @@ class InvertedIndexModel:
         owner-side sort (parallel/dist_engine.dist_sort_prov_windows).
         """
         from .. import native
-        from ..corpus.manifest import iter_document_ranges
+        from ..corpus.manifest import prefetch_document_ranges
         from ..corpus.scheduler import plan_contiguous_windows
 
         cfg = self.config
@@ -359,7 +359,7 @@ class InvertedIndexModel:
         stream = native.NativeKeyStream(stride, num_threads=threads)
         try:
             with timer.phase("tokenize_feed"):
-                for contents, ids in iter_document_ranges(manifest, windows):
+                for contents, ids in prefetch_document_ranges(manifest, windows):
                     docs_loaded += len(contents)
                     if mesh is None:
                         # the native scan assembles the half-bandwidth
@@ -391,7 +391,8 @@ class InvertedIndexModel:
                     keys_capacity += padded
                     num_pairs += nvalid
             with timer.phase("finalize_vocab"):
-                vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
+                (vocab, letters, remap, df_prov, raw_tokens, _,
+                 emit_order) = stream.finalize()
         finally:
             stream.close()
 
@@ -417,8 +418,9 @@ class InvertedIndexModel:
             offsets_prov = np.cumsum(df64) - df64
             df_rank = df64[prov_of_rank]
             off_rank = offsets_prov[prov_of_rank]
-            order, _ = engine.host_order_offsets(letters, df_rank)
-            return df_rank, off_rank, order, offsets_prov, prov_of_rank
+            # emit order came from native finalize (C++ per-letter
+            # stable sort) — no vocab-scale lexsort on this path
+            return df_rank, off_rank, emit_order, offsets_prov, prov_of_rank
 
         if mesh is None:
             nfetch = min(keys_capacity, _round_up(num_pairs, 1 << 14))
@@ -540,18 +542,19 @@ class InvertedIndexModel:
         output byte-identical — is the point of the redesign.
         """
         from .. import native
-        from ..corpus.manifest import iter_document_ranges
+        from ..corpus.manifest import prefetch_document_ranges
         from ..corpus.scheduler import plan_fraction_windows, window_balance_stats
 
         cfg = self.config
         max_doc_id = len(manifest)
         stride = max_doc_id + 2
         tail_f = cfg.overlap_tail_fraction
-        # Two device windows when there is enough corpus to cut: the
-        # first window's fetch is issued as early as possible, the
-        # second balances upload sizes.
+        # Device windows when there is enough corpus to cut: with two,
+        # the first window's fetch is issued as early as possible and
+        # the second balances upload sizes; with one, half the dispatch
+        # RPCs (wins when per-call link overhead dominates).
         dev_f = 1.0 - tail_f
-        if len(manifest) >= 8:
+        if len(manifest) >= 8 and cfg.overlap_device_windows == 2:
             fractions = (0.55 * dev_f, 0.45 * dev_f, tail_f)
         else:
             fractions = (dev_f, tail_f)
@@ -577,7 +580,7 @@ class InvertedIndexModel:
         try:
             with timer.phase("tokenize_feed"):
                 for wi, (contents, ids) in enumerate(
-                        iter_document_ranges(manifest, windows)):
+                        prefetch_document_ranges(manifest, windows)):
                     docs_loaded += len(contents)
                     if wi == len(windows) - 1:
                         keys, _ = stream.feed(contents, ids)
@@ -610,7 +613,8 @@ class InvertedIndexModel:
                     dev_snaps.append((prev_snap, snap))
                     prev_snap = snap
             with timer.phase("finalize_vocab"):
-                vocab, letters, remap, df_prov, raw_tokens, _ = stream.finalize()
+                (vocab, letters, remap, df_prov, raw_tokens, _,
+                 emit_order) = stream.finalize()
         except BaseException:
             trace.close()
             raise
@@ -648,7 +652,9 @@ class InvertedIndexModel:
             prov_of_rank = np.empty(vocab_size, dtype=np.int64)
             prov_of_rank[remap] = np.arange(vocab_size)
             df_rank = df_prov.astype(np.int64)[prov_of_rank]
-            order, _ = engine.host_order_offsets(letters, df_rank)
+            # emit order came from native finalize (C++ per-letter
+            # stable sort) — no vocab-scale lexsort on this path
+            order = emit_order
 
             def run_meta(prev, cur):
                 c = np.zeros(vocab_size, np.int64)

@@ -75,6 +75,7 @@ class _StreamFinalResult(ctypes.Structure):
         ("letter_of_term", ctypes.POINTER(ctypes.c_int32)),
         ("remap", ctypes.POINTER(ctypes.c_int32)),
         ("df", ctypes.POINTER(ctypes.c_int32)),
+        ("emit_order", ctypes.POINTER(ctypes.c_int32)),
     ]
 
 
@@ -363,11 +364,15 @@ class NativeKeyStream:
         return buf[:n].copy()
 
     def finalize(self):
-        """``(vocab, letter_of_term, remap, df_prov, raw_tokens, num_pairs)``.
+        """``(vocab, letter_of_term, remap, df_prov, raw_tokens,
+        num_pairs, emit_order)``.
 
         ``vocab`` is the sorted 'S'-dtype array; ``letter_of_term`` is in
         rank space; ``remap`` maps prov id -> rank; ``df_prov`` holds the
-        combiner's per-term document frequencies in prov space.
+        combiner's per-term document frequencies in prov space;
+        ``emit_order`` lists ranks in the reducer's emit order
+        (letter, -df, word — main.c:55-64), computed in C++ so the emit
+        path skips its vocab-scale ``np.lexsort``.
         """
         res = self._lib.mri_stream_finalize(self._handle)
         if not res:
@@ -381,7 +386,10 @@ class NativeKeyStream:
             letters = np.ctypeslib.as_array(r.letter_of_term, shape=(max(v, 1),))[:v].copy()
             remap = np.ctypeslib.as_array(r.remap, shape=(max(v, 1),))[:v].copy()
             df = np.ctypeslib.as_array(r.df, shape=(max(v, 1),))[:v].copy()
-            return vocab, letters, remap, df, int(r.raw_tokens), int(r.num_pairs)
+            order = np.ctypeslib.as_array(
+                r.emit_order, shape=(max(v, 1),))[:v].astype(np.int64)
+            return (vocab, letters, remap, df, int(r.raw_tokens),
+                    int(r.num_pairs), order)
         finally:
             self._lib.mri_stream_final_free(res)
 

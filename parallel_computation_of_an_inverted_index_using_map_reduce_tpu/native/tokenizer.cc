@@ -823,6 +823,8 @@ struct StreamFinalResult {
   int32_t* letter_of_term;  // [vocab_size], rank space
   int32_t* remap;           // [vocab_size], prov id -> sorted rank
   int32_t* df;              // [vocab_size], prov space (combiner counts)
+  int32_t* emit_order;      // [vocab_size], ranks in emit order:
+                            // (letter, -df, word) per main.c:55-64
 };
 
 struct StreamHandle {
@@ -1065,6 +1067,8 @@ int32_t mri_stream_df_snapshot(void* handle, int32_t* out, int32_t cap) {
   return n;
 }
 
+void mri_stream_final_free(StreamFinalResult* r);
+
 StreamFinalResult* mri_stream_finalize(void* handle) try {
   auto& h = *static_cast<StreamHandle*>(handle);
   StreamState& st = h.global;
@@ -1110,9 +1114,13 @@ StreamFinalResult* mri_stream_finalize(void* handle) try {
       static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
   res->df =
       static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
-  if (!res->vocab_packed || !res->letter_of_term || !res->remap || !res->df) {
+  res->emit_order =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * std::max(vocab, 1)));
+  if (!res->vocab_packed || !res->letter_of_term || !res->remap || !res->df ||
+      !res->emit_order) {
     std::free(res->vocab_packed); std::free(res->letter_of_term);
-    std::free(res->remap); std::free(res->df); std::free(res);
+    std::free(res->remap); std::free(res->df); std::free(res->emit_order);
+    std::free(res);
     return nullptr;
   }
   for (int32_t rank = 0; rank < vocab; ++rank) {
@@ -1124,6 +1132,33 @@ StreamFinalResult* mri_stream_finalize(void* handle) try {
         res->vocab_packed[static_cast<int64_t>(rank) * width] - 'a';
   }
   if (vocab) std::memcpy(res->df, df_src, sizeof(int32_t) * vocab);
+  // Emit order (the reducer's per-letter by-df ordering, main.c:55-64):
+  // ranks are word-sorted, so first letters are nondecreasing — one
+  // stable by-df-descending sort per letter block, ties falling back
+  // to rank ascending == word ascending.  Saves the emit path a
+  // vocab-scale np.lexsort per run.  The vector and stable_sort can
+  // throw bad_alloc AFTER res's arrays exist, so free them on the way
+  // out instead of letting the function-level catch leak them.
+  try {
+    std::vector<int32_t> df_rank(std::max(vocab, 1));
+    for (int32_t rank = 0; rank < vocab; ++rank)
+      df_rank[rank] = df_src[order[rank]];
+    for (int32_t rank = 0; rank < vocab; ++rank) res->emit_order[rank] = rank;
+    int32_t b = 0;
+    while (b < vocab) {
+      const int32_t letter = res->letter_of_term[b];
+      int32_t e = b;
+      while (e < vocab && res->letter_of_term[e] == letter) ++e;
+      std::stable_sort(res->emit_order + b, res->emit_order + e,
+                       [&](int32_t a, int32_t c) {
+                         return df_rank[a] > df_rank[c];
+                       });
+      b = e;
+    }
+  } catch (const std::bad_alloc&) {
+    mri_stream_final_free(res);
+    return nullptr;
+  }
   return res;
 } catch (const std::bad_alloc&) {
   return nullptr;
@@ -1135,6 +1170,7 @@ void mri_stream_final_free(StreamFinalResult* r) {
   std::free(r->letter_of_term);
   std::free(r->remap);
   std::free(r->df);
+  std::free(r->emit_order);
   std::free(r);
 }
 

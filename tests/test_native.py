@@ -174,10 +174,11 @@ def test_stream_mt_rank_space_identical(threads):
             fin = s.finalize()
         return np.concatenate(keys), fin
 
-    k1, (vocab1, let1, remap1, df1, raw1, np1) = run(1)
-    k2, (vocab2, let2, remap2, df2, raw2, np2) = run(threads)
+    k1, (vocab1, let1, remap1, df1, raw1, np1, ord1) = run(1)
+    k2, (vocab2, let2, remap2, df2, raw2, np2, ord2) = run(threads)
     np.testing.assert_array_equal(vocab1, vocab2)
     np.testing.assert_array_equal(let1, let2)
+    np.testing.assert_array_equal(ord1, ord2)
     assert raw1 == raw2 and np1 == np2
 
     def rank_keys(k, remap):
@@ -220,7 +221,7 @@ def test_stream_df_snapshot_matches_bincounts():
                 np.testing.assert_array_equal(got, want)
                 prev = snap
             # final snapshot == finalize's df_prov
-            _, _, _, df_prov, _, _ = s.finalize()
+            _, _, _, df_prov, _, _, _ = s.finalize()
             np.testing.assert_array_equal(prev, df_prov)
         finally:
             s.close()

@@ -128,7 +128,8 @@ def test_key_stream_matches_one_shot_tokenizer(smoke_fixture):
         for i in range(len(contents)):  # one-doc windows: worst case
             keys, _ = stream.feed([contents[i]], [doc_ids[i]])
             all_keys.append(keys)
-        vocab, letters, remap, df_prov, raw_tokens, num_pairs = stream.finalize()
+        (vocab, letters, remap, df_prov, raw_tokens, num_pairs,
+         emit_order) = stream.finalize()
 
     assert np.array_equal(vocab, one.vocab)
     assert raw_tokens == one.raw_tokens

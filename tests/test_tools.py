@@ -22,14 +22,15 @@ def test_measure_tpu_cli_smoke_on_cpu():
     header, engines = lines[0], lines[1:]
     assert "devices" in header and header["devices"]
     labels = [e["engine"] for e in engines]
-    assert labels == ["cpu_native", "overlap_0.5", "device_tokenize_oneshot"]
+    assert labels == ["cpu_native", "overlap_0.5", "overlap_0.5_1win",
+                      "device_tokenize_oneshot"]
     for e in engines:
         assert e["e2e_ms"] > 0
         assert e["phases_ms"]
     # non-reference corpus: every tpu engine is cross-checked against
     # the cpu backend's md5
     assert all(e["md5_ok"] for e in engines if "md5_ok" in e)
-    assert sum("md5_ok" in e for e in engines) == 2
+    assert sum("md5_ok" in e for e in engines) == 3
 
 
 def test_bench_tpu_child_fast_lane_cpu_smoke():

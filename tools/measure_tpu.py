@@ -119,6 +119,10 @@ def main() -> int:
     measure("overlap_0.5", dict(backend="tpu", device_shards=1,
                                 overlap_tail_fraction=0.5), manifest,
             expect_md5=expect)
+    measure("overlap_0.5_1win", dict(backend="tpu", device_shards=1,
+                                     overlap_tail_fraction=0.5,
+                                     overlap_device_windows=1), manifest,
+            expect_md5=expect)
     measure("device_tokenize_oneshot",
             dict(backend="tpu", device_tokenize=True, device_shards=1),
             manifest, expect_md5=expect)

@@ -106,7 +106,7 @@ def main() -> int:
 
     t_u16, (fin, snaps, prev) = best_of(scan_u16, args.reps)
     out["feed_u16_loop_ms"] = t_u16
-    vocab, letters, remap, df_prov, raw_tokens, num_pairs = fin
+    vocab, letters, remap, df_prov, raw_tokens, num_pairs, emit_order = fin
     vocab_size = int(vocab.shape[0])
     out["vocab_size"] = vocab_size
     out["raw_tokens"] = int(raw_tokens)
