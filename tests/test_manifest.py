@@ -57,3 +57,37 @@ def test_manifest_from_dir_sorted(tmp_path):
     m = manifest_from_dir(tmp_path)
     rel = [p.split(str(tmp_path) + "/")[1] for p in m.paths]
     assert rel == ["a/x.txt", "a/y.txt", "b/x.txt"]
+
+
+def test_prefetch_document_ranges_matches_and_releases_reader(tmp_path):
+    """prefetch_document_ranges yields exactly what iter_document_ranges
+    does, and abandoning the generator mid-iteration releases the
+    reader thread (no permanently blocked q.put holding window
+    buffers)."""
+    import threading
+    import time
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        iter_document_ranges, prefetch_document_ranges,
+    )
+
+    names = []
+    for i in range(6):
+        p = tmp_path / f"d{i}.txt"
+        p.write_text(f"doc {i} words here")
+        names.append(f"d{i}.txt")
+    write_manifest(tmp_path / "list.txt", names)
+    m = read_manifest(tmp_path / "list.txt", base_dir=tmp_path)
+    ranges = [(0, 2), (2, 4), (4, 6)]
+
+    assert (list(prefetch_document_ranges(m, ranges))
+            == list(iter_document_ranges(m, ranges)))
+
+    before = threading.active_count()
+    gen = prefetch_document_ranges(m, ranges)
+    next(gen)
+    gen.close()  # abandon with windows still queued
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "reader thread leaked"
