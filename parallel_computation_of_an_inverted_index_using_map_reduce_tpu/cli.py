@@ -63,6 +63,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "bytes (the last doc range) is indexed on host "
                         "while earlier windows' device sorts + fetches fly "
                         "in the background (single chip; hides link RTT)")
+    p.add_argument("--overlap-device-windows", type=int, default=2,
+                   choices=(1, 2),
+                   help="overlap plan device windows: 2 = earliest first "
+                        "fetch, 1 = half the dispatch RPCs")
     p.add_argument("--host-threads", type=int, default=None,
                    help="host map-phase threads (default: num_mappers if > 1, "
                         "else min(cores, 8)); output-invariant")
@@ -90,6 +94,7 @@ def main(argv: list[str] | None = None) -> int:
             stream_chunk_docs=args.stream_chunk_docs,
             pipeline_chunk_docs=args.pipeline_chunk_docs,
             overlap_tail_fraction=args.overlap_tail_fraction,
+            overlap_device_windows=args.overlap_device_windows,
             device_tokenize=args.device_tokenize,
             device_tokenize_width=args.device_tokenize_width,
             device_shards=args.device_shards,

@@ -169,6 +169,8 @@ def assemble_postings(uniq_sharded, max_doc_id: int, valid_limit: int,
             "multi-host run use emit_ownership='letter' so each host emits "
             "only its own owners' letters")
     postings = np.empty(max(num_pairs, 1), dtype=np.int32)
+    for s in shards:  # overlap the D2H transfers before the serial reads
+        s.data.copy_to_host_async()
     for s in shards:
         keys = np.asarray(s.data)
         keys = keys[: np.searchsorted(keys, valid_limit)]
@@ -295,6 +297,8 @@ def _exchange_and_fetch_rows(windows, *, stride: int, mesh: Mesh,
     sliced = _build_prefix_slice(mesh, local_len, nfetch)(out["owned_sorted"])
     rows = {}
     fetched = 0
+    for s in sliced.addressable_shards:  # overlap the D2H transfers
+        s.data.copy_to_host_async()
     for s in sliced.addressable_shards:
         owner = (s.index[0].start or 0) // nfetch
         row = np.asarray(s.data)

@@ -117,6 +117,72 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_two_workers(tmp_path, worker_src: str, docs_dir, out_dir):
+    """Launch 2 coordinator-connected worker processes (2 virtual CPU
+    devices each -> a 4-device global mesh) and return their outputs."""
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(worker_src)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(REPO_ROOT), str(pid), coord,
+             str(docs_dir), str(out_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:  # no orphans holding the coordinator port
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+    return outs
+
+
+def _check_owner_blocks_vs_oracle(out_dir, docs_dir):
+    """Merge the workers' owner*.npz blocks and compare the (word, doc)
+    pair set + df against the numpy tokenizer frontend."""
+    import numpy as np
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        load_documents, manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+        tokenize_documents,
+    )
+
+    got_pairs = set()
+    got_df = {}
+    for f in sorted(Path(out_dir).glob("owner*.npz")):
+        blk = np.load(f)
+        words, df, postings = blk["words"], blk["df"], blk["postings"]
+        off = 0
+        for w, d in zip(words, df):
+            word = w.rstrip(b"\x00").decode()
+            got_df[word] = got_df.get(word, 0) + int(d)
+            for doc in postings[off:off + int(d)]:
+                got_pairs.add((word, int(doc)))
+            off += int(d)
+    m = manifest_from_dir(docs_dir)
+    contents, ids = load_documents(m)
+    corpus = tokenize_documents(contents, ids)
+    vocab = [w.rstrip(b"\x00").decode() for w in corpus.vocab.tolist()]
+    want_pairs = {(vocab[t], int(d))
+                  for t, d in zip(corpus.term_ids, corpus.doc_ids)}
+    assert got_pairs == want_pairs
+    want_df = {}
+    for w, _ in want_pairs:
+        want_df[w] = want_df.get(w, 0) + 1
+    assert got_df == want_df
+
+
 @pytest.mark.slow
 def test_two_process_letter_emit_matches_oracle(tmp_path):
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
@@ -133,30 +199,7 @@ def test_two_process_letter_emit_matches_oracle(tmp_path):
     write_corpus(tmp_path / "docs", docs)
     out_dir = tmp_path / "out"
     out_dir.mkdir()
-    worker_py = tmp_path / "worker.py"
-    worker_py.write_text(WORKER)
-
-    coord = f"127.0.0.1:{_free_port()}"
-    env = {
-        **os.environ,
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "JAX_PLATFORMS": "cpu",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker_py), str(REPO_ROOT), str(pid), coord,
-             str(tmp_path / "docs"), str(out_dir)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        for pid in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    finally:
-        for p in procs:  # no orphans holding the coordinator port
-            if p.poll() is None:
-                p.kill()
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+    outs = _run_two_workers(tmp_path, WORKER, tmp_path / "docs", out_dir)
 
     m = manifest_from_dir(tmp_path / "docs")
     oracle_index(m, tmp_path / "oracle")
@@ -253,73 +296,20 @@ def test_two_process_device_tokenize_fetch(tmp_path):
     processes drive index_bytes_dist on a 4-device global mesh; each
     fetches only its addressable owners, and the union of the fetched
     blocks reconstructs the exact (word, doc) index."""
-    import numpy as np
-
-    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
-        load_documents, manifest_from_dir,
-    )
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
         write_corpus, zipf_corpus,
-    )
-    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
-        tokenize_documents,
     )
 
     docs = zipf_corpus(num_docs=22, vocab_size=250, tokens_per_doc=50, seed=31)
     write_corpus(tmp_path / "docs", docs)
     out_dir = tmp_path / "blocks"
     out_dir.mkdir()
-    worker_py = tmp_path / "worker.py"
-    worker_py.write_text(DEVTOK_WORKER)
-
-    coord = f"127.0.0.1:{_free_port()}"
-    env = {
-        **os.environ,
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "JAX_PLATFORMS": "cpu",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker_py), str(REPO_ROOT), str(pid), coord,
-             str(tmp_path / "docs"), str(out_dir)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        for pid in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+    outs = _run_two_workers(tmp_path, DEVTOK_WORKER, tmp_path / "docs",
+                            out_dir)
     assert "owners [0, 1]" in outs[0][0]
     assert "owners [2, 3]" in outs[1][0]
-
     # merge the four owner blocks and compare against the numpy frontend
-    got_pairs = set()
-    got_df = {}
-    for f in sorted(out_dir.glob("owner*.npz")):
-        blk = np.load(f)
-        words, df, postings = blk["words"], blk["df"], blk["postings"]
-        off = 0
-        for w, d in zip(words, df):
-            word = w.rstrip(b"\x00").decode()
-            got_df[word] = got_df.get(word, 0) + int(d)
-            for doc in postings[off:off + int(d)]:
-                got_pairs.add((word, int(doc)))
-            off += int(d)
-    m = manifest_from_dir(tmp_path / "docs")
-    contents, ids = load_documents(m)
-    corpus = tokenize_documents(contents, ids)
-    vocab = [w.rstrip(b"\x00").decode() for w in corpus.vocab.tolist()]
-    want_pairs = {(vocab[t], int(d))
-                  for t, d in zip(corpus.term_ids, corpus.doc_ids)}
-    assert got_pairs == want_pairs
-    want_df = {}
-    for w, _ in want_pairs:
-        want_df[w] = want_df.get(w, 0) + 1
-    assert got_df == want_df
+    _check_owner_blocks_vs_oracle(out_dir, tmp_path / "docs")
 
 
 DEVTOK_LETTER_WORKER = textwrap.dedent("""
@@ -401,3 +391,109 @@ def test_two_process_device_tokenize_letter_emit(tmp_path):
     m = manifest_from_dir(tmp_path / "docs")
     oracle_index(m, tmp_path / "oracle")
     assert read_letter_files(out_dir) == read_letter_files(tmp_path / "oracle")
+
+
+DEVSTREAM_WORKER = textwrap.dedent("""
+    import sys
+    repo, pid, coord, corpus_dir, out_dir = sys.argv[1:6]
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        iter_document_chunks, manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+        plan_contiguous_ranges,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+        device_tokenizer as DT,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel import (
+        dist_device_streaming as DDS, dist_device_tokenizer as DDT, distributed,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=int(pid))
+    n = 4
+    mesh = make_mesh(n)
+    width = 48
+
+    # Every process builds the same shard windows deterministically (a
+    # real pod host reads only its ranges; feed uploads only local
+    # positions either way).  Tiny initial capacity forces regrows
+    # across the multi-controller accumulator too.
+    m = manifest_from_dir(corpus_dir)
+    eng = DDS.DistDeviceStreamEngine(width=width, mesh=mesh,
+                                     window_pad=1 << 10,
+                                     initial_capacity=32)
+    for contents, ids in iter_document_chunks(m, 8):
+        ranges_c = plan_contiguous_ranges([len(c) for c in contents], n)
+        parts = [(contents[lo:hi], ids[lo:hi]) for lo, hi in ranges_c]
+        shard_len = max(max((sum(len(c) for c in cs) for cs, _ in parts),
+                            default=1), 1)
+        shard_len = -(-shard_len // 256) * 256
+        docs_cap = max(max(len(c) for c, _ in parts), 1)
+        bufs, ends_l, ids_l = [], [], []
+        tok_count = max_len = 0
+        for contents_s, ids_s in parts:
+            buf = np.full(shard_len, 0x20, np.uint8)
+            nb = 0
+            ends = np.full(docs_cap, shard_len, np.int32)
+            idv = np.full(docs_cap, 1, np.int32)
+            for j, (c, i) in enumerate(zip(contents_s, ids_s)):
+                buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
+                nb += len(c)
+                ends[j] = nb
+                idv[j] = i
+            cnt, ml = DT.host_token_stats(buf, ends)
+            tok_count = max(tok_count, cnt)
+            max_len = max(max_len, ml)
+            bufs.append(buf)
+            ends_l.append(ends)
+            ids_l.append(idv)
+        assert max_len <= width
+        eng.feed(bufs, ends_l, ids_l, tok_count=tok_count, max_len=max_len)
+
+    sort_cols = -(-max(eng.max_word_len, 1) // 4)
+    owners = eng.finalize(sort_cols=sort_cols, max_doc_id=len(m))
+
+    # each process must see exactly its local mesh positions as owners
+    got = sorted(owners)
+    want = sorted(DDT._local_mesh_positions(mesh))
+    assert got == want, (got, want)
+
+    import pathlib
+    for o, ow in owners.items():
+        words = DT.decode_word_rows(ow["unique_cols"], width)
+        np.savez(pathlib.Path(out_dir) / f"owner{o}.npz",
+                 words=words, df=ow["df"], postings=ow["postings"])
+    print(f"proc {pid} stream owners {got} windows {eng.windows_fed} "
+          f"cap {eng.capacity}", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_device_stream_accumulator(tmp_path):
+    """The mesh streaming all-device engine's multi-controller seam
+    (ADVICE r2: _empty must not need every device addressable): 2 OS
+    processes drive DistDeviceStreamEngine over a 4-device global mesh
+    through several windows with regrows; the union of the fetched
+    owner blocks reconstructs the exact (word, doc) index."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        write_corpus, zipf_corpus,
+    )
+
+    docs = zipf_corpus(num_docs=26, vocab_size=220, tokens_per_doc=40, seed=37)
+    write_corpus(tmp_path / "docs", docs)
+    out_dir = tmp_path / "blocks"
+    out_dir.mkdir()
+    outs = _run_two_workers(tmp_path, DEVSTREAM_WORKER, tmp_path / "docs",
+                            out_dir)
+    assert "stream owners [0, 1]" in outs[0][0]
+    assert "stream owners [2, 3]" in outs[1][0]
+    _check_owner_blocks_vs_oracle(out_dir, tmp_path / "docs")
