@@ -64,6 +64,23 @@ INT32_MAX = np.iinfo(np.int32).max
 # small inputs and compare it against the one-key path.
 _ONE_KEY_COMPACTION_LIMIT = 1 << 24
 
+# Letter-compaction formulation: "sort" (position-keyed lax.sort, the
+# default) or "searchsorted" (cumsum-rank + binary-search gather — the
+# ops/segment.compact pattern; exact because every unmasked window read
+# stays inside its token's own letters).  Both are scatter-free; which
+# is faster at corpus scale is an on-chip measurement (run
+# tools/measure_tpu.py once per env value).  Read ONCE at import and
+# baked into every trace: set the env before importing, identically on
+# every process of a multi-controller run.  Validated here so a typo
+# cannot silently measure the wrong formulation.
+import os as _os
+
+_COMPACTION_MODE = _os.environ.get("MRI_TPU_LETTER_COMPACTION", "sort")
+if _COMPACTION_MODE not in ("sort", "searchsorted"):
+    raise ValueError(
+        f"MRI_TPU_LETTER_COMPACTION must be 'sort' or 'searchsorted', "
+        f"got {_COMPACTION_MODE!r}")
+
 
 class WidthOverflow(Exception):
     """A cleaned token exceeded the row width — the device rows would be
@@ -132,7 +149,17 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     # (main.c:105-111) with no scatter.  Position fits the key's low
     # bits; the flag rides above them, so ascending key order is
     # "letters first, each group in byte order".
-    if n < _ONE_KEY_COMPACTION_LIMIT:
+    if _COMPACTION_MODE == "searchsorted":
+        # j-th letter's byte index = first position where the inclusive
+        # letter-count cumsum reaches j+1 (ops/segment.compact's
+        # rank-gather).  Past num_letters this clips to n-1, whose
+        # lowered byte may be nonzero — safe: every unmasked window
+        # read below stays inside its own token's letters, and
+        # tok_of_letter pins the tail to INT32_MAX regardless.
+        pos_s = jnp.clip(
+            jnp.searchsorted(cs, jnp.arange(1, n + 1, dtype=cs.dtype)),
+            0, n - 1).astype(jnp.int32)
+    elif n < _ONE_KEY_COMPACTION_LIMIT:
         key = jnp.where(is_letter, pos, pos + jnp.int32(1 << 24))
         pos_s = (lax.sort(key) & ((1 << 24) - 1)).astype(jnp.int32)
     else:  # buffers >= 16 MiB per program: flag no longer fits beside
@@ -140,9 +167,12 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
         # so sort on (flag, position) as two keys instead
         _, pos_s = lax.sort(
             ((~is_letter).astype(jnp.int32), pos), num_keys=2)
-    # compacted letter stream: lowered[pos_s] is 0 past num_letters
-    # (non-letters map to 0 in the byte table), so the packed windows
-    # below read zero padding for free
+    # compacted letter stream.  In sort mode lowered[pos_s] is 0 past
+    # num_letters (non-letters map to 0 in the byte table); in
+    # searchsorted mode the clipped tail may repeat a nonzero byte —
+    # either way no consumer may rely on the tail: every unmasked
+    # window read below stays inside its own token's letters
+    # (masktab[nbytes]), and tok_of_letter pins the tail to INT32_MAX.
     letters = lowered[pos_s].astype(jnp.int32)
     # letter index -> owning token, monotone nondecreasing over the
     # valid prefix then pinned to INT32_MAX so searchsorted stays exact

@@ -370,3 +370,32 @@ def test_two_key_letter_compaction_branch_matches(monkeypatch):
     np.testing.assert_array_equal(np.asarray(one_doc), np.asarray(two_doc))
     for a, b in zip(one_cols, two_cols):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_searchsorted_letter_compaction_matches_sort(monkeypatch):
+    """The searchsorted letter-compaction variant (cumsum-rank gather,
+    MRI_TPU_LETTER_COMPACTION=searchsorted) must agree exactly with the
+    default position-keyed sort — including when the buffer's last byte
+    is a letter (the clipped tail reads nonzero garbage that every
+    unmasked window must avoid)."""
+    import jax
+
+    docs = [b"don't foo-bar x1y2z3 I.Loomings tail42", b"", b"  42 ",
+            b"pack my box with five dozen liquor jugz"]  # ends in a letter
+    buf, ends = _pad_concat(docs)
+    buf = buf[: int(ends[-1])]  # no trailing pad: last byte IS a letter
+    ids = np.arange(1, len(docs) + 1, dtype=np.int32)
+    kw = dict(width=48, tok_cap=256, num_docs=len(docs))
+    args = (jax.device_put(buf), jax.device_put(ends), jax.device_put(ids))
+
+    srt = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+    monkeypatch.setattr(DT, "_COMPACTION_MODE", "searchsorted")
+    ss = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+
+    s_cols, s_doc, s_len, s_cnt = srt
+    g_cols, g_doc, g_len, g_cnt = ss
+    assert int(s_len) == int(g_len)
+    assert int(s_cnt) == int(g_cnt)
+    np.testing.assert_array_equal(np.asarray(s_doc), np.asarray(g_doc))
+    for a, b in zip(s_cols, g_cols):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
