@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-3 on-chip capture sequence (run when the axon tunnel is up).
+# Each step has its own timeout so one hung RPC cannot eat the window;
+# outputs land in /tmp/r03_capture/ for triage and the artifacts are
+# assembled from there.  Order = VERDICT r2 priority.
+set -u
+OUT=${1:-/tmp/r03_capture}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/mri_tpu_xla_cache
+
+step() {  # step <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "=== $name (timeout ${t}s) ==="
+  timeout "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  echo "rc=$? ($name)"
+  tail -c 2000 "$OUT/$name.out"
+  echo
+}
+
+# 1. VERDICT #1: re-time the redesigned device engines (+ overlap A/B)
+step measure_tpu        900 python tools/measure_tpu.py
+# 2. searchsorted letter-compaction A/B (env read at import)
+step measure_tpu_ss     600 env MRI_TPU_LETTER_COMPACTION=searchsorted \
+                            python tools/measure_tpu.py --quick
+# 3. VERDICT #2: the bench itself (fast lane first; writes BENCH line)
+step bench              900 python bench.py
+# 4. VERDICT #7: pallas sweep (sizes x block_rows, dedup + hist8)
+step pallas_sweep       700 python tools/pallas_sweep.py
+# 5. VERDICT #4: 1M-doc scale — host-stream then device-stream
+step scale_host         900 env MRI_TPU_SCALE_CROSSCHECK=1 python bench.py --scale
+step scale_devtok      1500 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
+                            python bench.py --scale
+
+echo "=== capture complete; outputs in $OUT ==="
