@@ -45,6 +45,26 @@ def _profile_ctx(profile_dir):
             else contextlib.nullcontext())
 
 
+def _pack_window(contents, ids, shard_len: int, docs_cap: int):
+    """Pack one doc window into the device byte-feed layout:
+    ``(buf[shard_len] space-padded, ends[docs_cap], ids[docs_cap])``.
+    One join + one copy — no per-doc python loop (the loop was ~1 us a
+    doc, real money at 1M-doc streaming scale).  Padded ``ends``
+    entries stay at ``shard_len``: the pad region is all spaces, so
+    those "docs" emit nothing."""
+    joined = b"".join(contents)
+    buf = np.full(shard_len, 0x20, np.uint8)
+    buf[: len(joined)] = np.frombuffer(joined, np.uint8)
+    ends = np.full(docs_cap, shard_len, np.int32)
+    if contents:
+        lens = np.fromiter((len(c) for c in contents), np.int64,
+                           len(contents))
+        ends[: len(contents)] = np.cumsum(lens).astype(np.int32)
+    idv = np.full(docs_cap, 1, np.int32)
+    idv[: len(ids)] = np.asarray(ids, np.int32)
+    return buf, ends, idv
+
+
 class InvertedIndexModel:
     """Reusable pipeline object (compiled engine state is cached by jit).
 
@@ -716,10 +736,8 @@ class InvertedIndexModel:
         with profile:
             with timer.phase("feed"):
                 padded = _round_up(total, cfg.pad_multiple)
-                buf = np.full(padded, 0x20, np.uint8)  # space padding
-                buf[:total] = np.frombuffer(b"".join(contents), np.uint8)
-                ends = np.cumsum(
-                    [len(c) for c in contents]).astype(np.int32)
+                buf, ends, _ = _pack_window(
+                    contents, doc_ids, padded, num_docs)
                 # Exact token count (DT.count_token_starts mirrors the
                 # device classifier): a snug tok_cap shrinks every
                 # device array ~2.5x vs the worst-case bound; note
@@ -859,13 +877,9 @@ class InvertedIndexModel:
                     manifest, cfg.stream_chunk_docs):
                 total = sum(len(c) for c in contents)
                 padded = _round_up(max(total, 1), cfg.pad_multiple)
-                buf = np.full(padded, 0x20, np.uint8)
-                nb = 0
-                ends = np.empty(len(contents), np.int32)
-                for j, c in enumerate(contents):
-                    buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
-                    nb += len(c)
-                    ends[j] = nb
+                buf, ends, _ = _pack_window(
+                    contents, ids, padded, max(len(contents), 1))
+                ends = ends[: len(contents)]
                 cnt, ml = DT.host_token_stats(buf, ends)
                 if ml > width:
                     raise DT.WidthOverflow(
@@ -936,17 +950,10 @@ class InvertedIndexModel:
             bufs, ends_l, ids_l = [], [], []
             tok_count = host_max_len = 0
             for contents, ids in shards:
-                buf = np.full(shard_len, 0x20, np.uint8)
-                nb = 0
-                ends = np.full(docs_cap, shard_len, np.int32)
-                idv = np.full(docs_cap, 1, np.int32)
-                for j, (c, i) in enumerate(zip(contents, ids)):
-                    buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
-                    nb += len(c)
-                    ends[j] = nb
-                    idv[j] = i
                 # the padded tail of ends stays at shard_len: the pad
                 # region is all spaces, so those "docs" emit nothing
+                buf, ends, idv = _pack_window(
+                    contents, ids, shard_len, docs_cap)
                 cnt, ml = DT.host_token_stats(buf, ends)
                 tok_count = max(tok_count, cnt)
                 host_max_len = max(host_max_len, ml)
@@ -1127,15 +1134,8 @@ class InvertedIndexModel:
                 bufs, ends_l, ids_l = [], [], []
                 tok_count = max_len = 0
                 for contents_s, ids_s in parts:
-                    buf = np.full(shard_len, 0x20, np.uint8)
-                    nb = 0
-                    ends = np.full(docs_cap, shard_len, np.int32)
-                    idv = np.full(docs_cap, 1, np.int32)
-                    for j, (c, i) in enumerate(zip(contents_s, ids_s)):
-                        buf[nb:nb + len(c)] = np.frombuffer(c, np.uint8)
-                        nb += len(c)
-                        ends[j] = nb
-                        idv[j] = i
+                    buf, ends, idv = _pack_window(
+                        contents_s, ids_s, shard_len, docs_cap)
                     cnt, ml = DT.host_token_stats(buf, ends)
                     tok_count = max(tok_count, cnt)
                     max_len = max(max_len, ml)

@@ -164,6 +164,12 @@ def load():
             ctypes.c_int32, ctypes.c_char_p, ctypes.POINTER(_HostIndexStats),
             ctypes.c_int32,
         ]
+        lib.mri_token_stats.restype = ctypes.c_int32
+        lib.mri_token_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ]
         lib.mri_emit.restype = ctypes.c_int64
         lib.mri_emit.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
@@ -191,6 +197,30 @@ def load():
 
 def available() -> bool:
     return load() is not None
+
+
+def token_stats(buf: np.ndarray, ends: np.ndarray):
+    """Native ``(token_count, max_cleaned_len)`` over one byte window
+    (``mri_token_stats``, SIMD masks) — the fast path behind
+    ops/device_tokenizer.host_token_stats, byte-for-byte the same
+    contract as its numpy mirror.  ``None`` when the library is
+    unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    b = np.ascontiguousarray(buf, dtype=np.uint8)
+    e = np.ascontiguousarray(ends, dtype=np.int64)
+    count = ctypes.c_int64()
+    max_len = ctypes.c_int32()
+    rc = lib.mri_token_stats(
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(b.shape[0]),
+        e.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int32(e.shape[0]),
+        ctypes.byref(count), ctypes.byref(max_len))
+    if rc != 0:
+        return None
+    return int(count.value), int(max_len.value)
 
 
 def _marshal_docs(contents: list[bytes], doc_ids: list[int]):

@@ -464,7 +464,22 @@ def host_token_stats(buf: np.ndarray, ends: np.ndarray) -> tuple[int, int]:
     tight ``sort_cols`` bound (skipping radix passes and fetch bytes
     over provably all-zero word columns); the device's own
     ``max_word_len`` output is asserted equal by callers.
+
+    Delegates to the native SIMD scan when available (~10x the numpy
+    mirror below, which stays as the portable fallback and the
+    cross-check reference in tests).
     """
+    from .. import native
+
+    res = native.token_stats(buf, ends)
+    if res is not None:
+        return res
+    return _host_token_stats_numpy(buf, ends)
+
+
+def _host_token_stats_numpy(buf: np.ndarray, ends: np.ndarray) -> tuple[int, int]:
+    """Portable numpy mirror of ``mri_token_stats`` (the cross-check
+    reference in tests)."""
     start = _host_start_mask(buf, ends)
     count = int(np.count_nonzero(start))
     if count == 0:

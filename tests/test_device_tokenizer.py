@@ -399,3 +399,59 @@ def test_searchsorted_letter_compaction_matches_sort(monkeypatch):
     np.testing.assert_array_equal(np.asarray(s_doc), np.asarray(g_doc))
     for a, b in zip(s_cols, g_cols):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_native_token_stats_matches_numpy_mirror():
+    """mri_token_stats (SIMD masks) vs the numpy mirror on edge cases:
+    inner doc boundaries splitting runs, letter as the last byte,
+    non-space bytes past the last doc end, zero-length docs, padded
+    equal ends, empty input."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        native,
+    )
+
+    if not native.available():
+        pytest.skip("native tokenizer unavailable")
+
+    def both(buf, ends):
+        got = native.token_stats(buf, ends)
+        want = DT._host_token_stats_numpy(buf, ends)
+        assert got == want, (got, want, bytes(buf), ends.tolist())
+        return got
+
+    cases = []
+    # handcrafted edges
+    b = np.frombuffer(b"abXcd ef", np.uint8).copy()
+    cases.append((b, np.array([4, 8], np.int64)))       # boundary mid-token
+    cases.append((b, np.array([8], np.int64)))          # single doc
+    cases.append((b, np.array([2, 2, 8], np.int64)))    # zero-length doc
+    cases.append((b, np.array([3], np.int64)))          # bytes past last end
+    cases.append((np.frombuffer(b"  42!  ", np.uint8).copy(),
+                  np.array([7], np.int64)))             # letterless token
+    cases.append((np.frombuffer(b"z", np.uint8).copy(),
+                  np.array([1], np.int64)))             # last byte a letter
+    # padded-ends shape the streaming feed uses
+    pad = np.full(64, 0x20, np.uint8)
+    pad[:11] = np.frombuffer(b"hello world", np.uint8)
+    cases.append((pad, np.array([5, 11, 64, 64], np.int64)))
+    # randomized sweep incl. >64-byte tokens spanning mask words
+    rng = np.random.default_rng(9)
+    alphabet = np.frombuffer(b"ab XY.9\t\n-z", np.uint8)
+    for _ in range(25):
+        n = int(rng.integers(1, 400))
+        buf = rng.choice(alphabet, n).astype(np.uint8)
+        k = int(rng.integers(1, 6))
+        ends = np.sort(rng.integers(0, n + 1, k)).astype(np.int64)
+        ends[-1] = int(rng.integers(0, n + 1))
+        ends = np.sort(ends)
+        cases.append((buf, ends))
+    cases.append((np.frombuffer(b"a" * 200 + b" " + b"b" * 70, np.uint8).copy(),
+                  np.array([271], np.int64)))           # long tokens
+    for buf, ends in cases:
+        both(buf, ends)
+
+    # non-monotonic / negative ends: the native path refuses (None) so
+    # host_token_stats falls back to the numpy mirror instead of
+    # double-scanning or reading out of bounds
+    assert native.token_stats(b, np.array([9, 3, 11], np.int64)) is None
+    assert native.token_stats(b, np.array([-1, 8], np.int64)) is None
