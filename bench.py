@@ -256,12 +256,45 @@ def _tpu_child() -> int:
     return 0
 
 
+def _tunnel_alive(timeout_s: int) -> bool:
+    """Cheap liveness pre-probe: device enumeration + one tiny fetch in
+    a subprocess.  A fully-down tunnel hangs any device call, so
+    without this gate the bench would burn every watchdog window
+    (480+300+240 s) discovering what one short probe already proves.
+    Honors MRI_TPU_BENCH_PLATFORM so off-chip smoke runs probe the
+    platform they will actually measure."""
+    plat = os.environ.get("MRI_TPU_BENCH_PLATFORM")
+    pin = (f"jax.config.update('jax_platforms', {plat!r});" if plat else "")
+    probe = ("import jax;" + pin +
+             "import numpy as np, jax.numpy as jnp;"
+             "d = jax.devices();"
+             "v = np.asarray((jnp.ones((8,), jnp.int32) + 1)[:1]);"
+             "print('alive', d[0].platform)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            timeout=timeout_s, text=True)
+        return proc.returncode == 0 and "alive" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _run_tpu_attempts() -> tuple[dict | None, list[str]]:
     """Run the TPU child up to TPU_ATTEMPTS times; returns (result, log)."""
     CACHE_DIR.mkdir(parents=True, exist_ok=True)
     env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=str(CACHE_DIR))
     log: list[str] = []
-    for attempt in range(TPU_ATTEMPTS):
+    attempts = TPU_ATTEMPTS
+    probe_s = int(os.environ.get("MRI_TPU_BENCH_PROBE_S", 75))
+    if probe_s and not _tunnel_alive(probe_s):
+        # A dead tunnel fails this probe AND every attempt; a merely
+        # sick tunnel might pass a longer leash — so drop to ONE
+        # full-leash attempt rather than zero (the fast-lane line is
+        # salvageable from a timed-out child).
+        log.append(f"tunnel liveness probe failed within {probe_s}s; "
+                   "single salvage attempt only")
+        attempts = min(1, attempts)
+    for attempt in range(attempts):
         timeout = TPU_TIMEOUTS_S[min(attempt, len(TPU_TIMEOUTS_S) - 1)]
         try:
             proc = subprocess.run(
