@@ -225,3 +225,26 @@ def test_stream_df_snapshot_matches_bincounts():
             np.testing.assert_array_equal(prev, df_prov)
         finally:
             s.close()
+
+
+def test_stream_finalize_emit_order_matches_lexsort():
+    """The C++ emit order (stable per-letter by-df sort in
+    mri_stream_finalize) must equal the numpy lexsort reference
+    (letter asc, df desc, word asc — main.c:55-64), including df ties."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.engine import (
+        host_order_offsets,
+    )
+
+    rng = np.random.default_rng(23)
+    vocab = [b"aa", b"ab", b"ba", b"bb", b"ca", b"cb", b"cc"] + [
+        ("w%02d" % i).encode() for i in range(40)]
+    docs = [b" ".join(rng.choice(vocab, 30)) for _ in range(12)]
+    stride = len(docs) + 2
+    with native.NativeKeyStream(stride) as s:
+        for i, d in enumerate(docs):
+            s.feed([d], [i + 1])
+        vocab_s, letters, remap, df_prov, _, _, emit_order = s.finalize()
+    df_rank = np.zeros(len(vocab_s), np.int64)
+    df_rank[remap] = df_prov
+    want, _ = host_order_offsets(letters, df_rank)
+    np.testing.assert_array_equal(emit_order, want)
