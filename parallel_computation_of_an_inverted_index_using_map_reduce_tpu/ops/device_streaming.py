@@ -48,7 +48,7 @@ from .device_tokenizer import (
     unpack_groups,
     zero_tail_cols,
 )
-from .segment import first_occurrence_mask
+from .segment import first_occurrence_mask, set_bit_positions
 
 
 def _row_first_mask(rows):
@@ -61,14 +61,13 @@ def _row_first_mask(rows):
 
 
 def _compact_rows(rows, mask, out_cap: int):
-    """Searchsorted/gather compaction of row tuples (no scatters —
+    """Set-bit-sort/gather compaction of row tuples (no scatters —
     ops/segment.py discipline); dropped slots become padding rows
     (INT32_MAX in every column, so later sorts still push them last)."""
     n = rows[0].shape[0]
-    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
-    slots = jnp.arange(out_cap, dtype=jnp.int32)
-    pos = jnp.clip(jnp.searchsorted(rank, slots), 0, n - 1).astype(jnp.int32)
-    live = slots < (rank[-1] + 1)
+    kept = set_bit_positions(mask, out_cap)
+    live = kept != INT32_MAX
+    pos = jnp.clip(kept, 0, n - 1)
     return tuple(jnp.where(live, r[pos], INT32_MAX) for r in rows)
 
 
@@ -159,9 +158,12 @@ def finalize_rows_body(acc, *, ncols: int, num_groups: int):
     num_words = first_word.sum(dtype=jnp.int32)
     num_pairs = valid.sum(dtype=jnp.int32)
 
-    word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
     slots = jnp.arange(cap, dtype=jnp.int32)
-    W = jnp.searchsorted(word_rank, jnp.arange(cap + 1, dtype=jnp.int32))
+    # word-start positions via the shared set-bit sort (segment.py);
+    # W[cap] == cap keeps the df difference below always in range
+    W = jnp.concatenate([
+        jnp.minimum(set_bit_positions(first_word, cap), cap),
+        jnp.full(1, cap, jnp.int32)])
     word_live = slots < num_words
     Wg = jnp.clip(W[:-1], 0, cap - 1).astype(jnp.int32)
     df = jnp.where(word_live, jnp.minimum(W[1:], num_pairs) - W[:-1], 0)

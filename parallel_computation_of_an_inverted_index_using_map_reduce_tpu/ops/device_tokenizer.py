@@ -5,16 +5,23 @@ scans text (main.c:102-117 re-expressed in C++/numpy), device sorts
 integers.  This module removes the host from the compute path entirely:
 raw corpus bytes go up, the finished index comes down.
 
-    bytes (uint8, N) ──► classify: space/letter via 256-entry tables
-        ──► token segmentation: start mask, token ids — cumsum scans
+    bytes (uint8, N) ──► classify: space/letter as fused compares
+            (256-entry table gathers cost ~100 ms at 5.7M bytes on the
+            v5e; the compare chain is free — round-3 attribution)
+        ──► token segmentation: start mask, letter-count cumsum
         ──► letter compaction: ONE position-keyed ``lax.sort`` moves
             every cleaned letter to the front in byte order (the
-            byte stream with non-letters deleted, main.c:105-111)
-        ──► word rows: 12 windowed gathers off the compacted letter
+            byte stream with non-letters deleted, main.c:105-111),
+            carrying the lowered bytes as a sort payload
+        ──► per-token offsets/lengths: token start bytes via a second
+            single-key sort (set-bit positions), then F = one gather
+            of the exclusive letter cumsum — no token-scale
+            searchsorted (its scan lowering was the round-2 program's
+            dominant cost: 702 ms for 2^20 queries into 5.7M)
+        ──► word rows: windowed gathers off the compacted letter
             stream pack big-endian int32 columns (cleaned bytes are
             a-z < 0x80, so signed int32 ascending == byte-
-            lexicographic ascending); per-token offsets/lengths come
-            from ``searchsorted`` over the monotone letter→token map
+            lexicographic ascending)
         ──► LSD radix ``lax.sort`` passes over (word columns…, doc)
         ──► boundary-diff word/pair dedup ► df ► postings ► unique rows
 
@@ -23,9 +30,8 @@ raw corpus bytes go up, the finished index comes down.
     single 1M-update scatter costs ~75 ms, 5x a whole 1M stable-sort
     pass).  The first cut of this module scattered letters into rows
     and compacted results with scatters; every token-scale scatter is
-    now a sort/cumsum/gather/searchsorted formulation.  Scatters are
-    kept only at trivial sizes (the num_docs-entry doc-boundary
-    marker).
+    now a sort/cumsum/gather formulation.  Scatters are kept only at
+    trivial sizes (the num_docs-entry doc-boundary marker).
 
 Exactness without strings-on-host: rows are the *actual cleaned bytes*
 (no hashing, no collisions); sorted-row order IS strcmp order because
@@ -56,6 +62,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import segment
+
 INT32_MAX = np.iinfo(np.int32).max
 
 # Byte-buffer size above which the letter compaction's (flag, position)
@@ -64,22 +72,12 @@ INT32_MAX = np.iinfo(np.int32).max
 # small inputs and compare it against the one-key path.
 _ONE_KEY_COMPACTION_LIMIT = 1 << 24
 
-# Letter-compaction formulation: "sort" (position-keyed lax.sort, the
-# default) or "searchsorted" (cumsum-rank + binary-search gather — the
-# ops/segment.compact pattern; exact because every unmasked window read
-# stays inside its token's own letters).  Both are scatter-free; which
-# is faster at corpus scale is an on-chip measurement (run
-# tools/measure_tpu.py once per env value).  Read ONCE at import and
-# baked into every trace: set the env before importing, identically on
-# every process of a multi-controller run.  Validated here so a typo
-# cannot silently measure the wrong formulation.
-import os as _os
-
-_COMPACTION_MODE = _os.environ.get("MRI_TPU_LETTER_COMPACTION", "sort")
-if _COMPACTION_MODE not in ("sort", "searchsorted"):
-    raise ValueError(
-        f"MRI_TPU_LETTER_COMPACTION must be 'sort' or 'searchsorted', "
-        f"got {_COMPACTION_MODE!r}")
+# The round-2 MRI_TPU_LETTER_COMPACTION=searchsorted variant was
+# removed after the round-3 on-chip A/B: the cumsum-rank binary-search
+# compaction measured 2150.8 ms device_index vs 1156.6 ms for the
+# position-keyed sort on the v5e (BENCH_TPU_r03.json
+# letter_compaction_ab), and the sort formulation then absorbed the
+# letter payload for free.
 
 
 class WidthOverflow(Exception):
@@ -117,10 +115,18 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     (sorts last), ``doc_col`` likewise.
     """
     n = data.shape[0]
-    space_np, lower_np = _byte_tables()
-    is_space = jnp.asarray(space_np)[data]
-    lowered = jnp.asarray(lower_np)[data]
-    is_letter = lowered > 0
+    # byte classifiers as arithmetic, not 256-entry table gathers: a
+    # token-scale gather costs ~7 ms/2^20 rows on the v5e where the
+    # compare chain fuses for free (round-3 attribution,
+    # tools/attribute_device_stages.py — the two table lookups were
+    # ~100 ms of the program).  Exact C-locale contract of
+    # native/tokenizer.cc ByteTables: space = {0x20, 0x09..0x0D};
+    # A-Z|0x20 lands in [a-z] and no non-letter byte does (the only
+    # preimages of [0x61,0x7A] under |0x20 are the two letter ranges).
+    is_space = (data == 0x20) | ((data >= 0x09) & (data <= 0x0D))
+    lc = data | jnp.uint8(0x20)
+    is_letter = (lc >= 0x61) & (lc <= 0x7A)
+    lowered = jnp.where(is_letter, lc, jnp.uint8(0)).astype(jnp.int32)
 
     pos = jnp.arange(n, dtype=jnp.int32)
     # first byte of each document forces a token break (tokens never
@@ -139,54 +145,49 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     prev_space = jnp.concatenate([jnp.ones(1, jnp.bool_), is_space[:-1]])
     token_start = nonspace & (prev_space | doc_starts)
 
-    tok_id = jnp.cumsum(token_start.astype(jnp.int32)) - 1  # per byte
     cs = jnp.cumsum(is_letter.astype(jnp.int32))
-    num_letters = cs[-1] if n else jnp.int32(0)
 
     # letter compaction: ONE sort on (non-letter flag, byte position)
     # packed into a single key moves every cleaned letter to the front
     # in byte order — the reference's delete-non-letters pass
     # (main.c:105-111) with no scatter.  Position fits the key's low
     # bits; the flag rides above them, so ascending key order is
-    # "letters first, each group in byte order".
-    if _COMPACTION_MODE == "searchsorted":
-        # j-th letter's byte index = first position where the inclusive
-        # letter-count cumsum reaches j+1 (ops/segment.compact's
-        # rank-gather).  Past num_letters this clips to n-1, whose
-        # lowered byte may be nonzero — safe: every unmasked window
-        # read below stays inside its own token's letters, and
-        # tok_of_letter pins the tail to INT32_MAX regardless.
-        pos_s = jnp.clip(
-            jnp.searchsorted(cs, jnp.arange(1, n + 1, dtype=cs.dtype)),
-            0, n - 1).astype(jnp.int32)
-    elif n < _ONE_KEY_COMPACTION_LIMIT:
+    # "letters first, each group in byte order".  ``lowered`` rides
+    # along as a payload of the SAME sort, so the compacted letter
+    # stream needs no n-scale gather afterwards (round-3 on-chip
+    # attribution: each such gather is ~40 ms at 5.7M bytes).
+    if n < _ONE_KEY_COMPACTION_LIMIT:
         key = jnp.where(is_letter, pos, pos + jnp.int32(1 << 24))
-        pos_s = (lax.sort(key) & ((1 << 24) - 1)).astype(jnp.int32)
+        _, letters = lax.sort((key, lowered), num_keys=1, is_stable=True)
     else:  # buffers >= 16 MiB per program: flag no longer fits beside
         # the position in an int32 (and int64 needs jax_enable_x64),
         # so sort on (flag, position) as two keys instead
-        _, pos_s = lax.sort(
-            ((~is_letter).astype(jnp.int32), pos), num_keys=2)
-    # compacted letter stream.  In sort mode lowered[pos_s] is 0 past
-    # num_letters (non-letters map to 0 in the byte table); in
-    # searchsorted mode the clipped tail may repeat a nonzero byte —
-    # either way no consumer may rely on the tail: every unmasked
-    # window read below stays inside its own token's letters
-    # (masktab[nbytes]), and tok_of_letter pins the tail to INT32_MAX.
-    letters = lowered[pos_s].astype(jnp.int32)
-    # letter index -> owning token, monotone nondecreasing over the
-    # valid prefix then pinned to INT32_MAX so searchsorted stays exact
-    tok_of_letter = jnp.where(jnp.arange(n, dtype=jnp.int32) < num_letters,
-                              tok_id[pos_s], INT32_MAX)
+        _, _, letters = lax.sort(
+            ((~is_letter).astype(jnp.int32), pos, lowered), num_keys=2,
+            is_stable=True)
+    # compacted letter stream: past num_letters every payload is 0
+    # (non-letters carry lowered == 0), but no consumer may rely on
+    # the tail: every unmasked window read below stays inside its own
+    # token's letters (masktab[nbytes]).
 
-    # per-token letter offsets/lengths from the monotone letter->token
-    # map: F[t] = first compacted slot of token t's letters; a token
-    # with no letters (e.g. "42", skipped at main.c:113) gets F[t] ==
-    # F[t+1] => length 0 => masked invalid below
-    F = jnp.searchsorted(
-        tok_of_letter, jnp.arange(tok_cap + 1, dtype=jnp.int32))
-    tok_len = (F[1:] - F[:-1]).astype(jnp.int32)
-    F0 = F[:-1].astype(jnp.int32)
+    # per-token letter offsets/lengths WITHOUT a token-scale
+    # searchsorted (the round-2 formulation's dominant cost): token
+    # start bytes move to the front with the shared set-bit sort
+    # (segment.set_bit_positions), and F[t] = letters strictly before
+    # start byte t = one gather of the exclusive letter-count cumsum.
+    # Every letter between token t's start byte and token t+1's start
+    # byte belongs to token t (the gap is spaces / non-letters), so F
+    # is exactly "first compacted slot of token t's letters"; a token
+    # with no letters (e.g. "42", skipped at main.c:113) gets
+    # F[t] == F[t+1] => length 0 => masked invalid below.  Slots past
+    # num_tokens hold INT32_MAX -> clamp to n -> F = total letters =>
+    # length 0.
+    sb = segment.set_bit_positions(token_start, tok_cap + 1)
+    sbc = jnp.minimum(sb, jnp.int32(n))
+    cse = jnp.concatenate([jnp.zeros(1, jnp.int32), cs])  # exclusive
+    F = cse[sbc]
+    tok_len = F[1:] - F[:-1]
+    F0 = F[:-1]
     # true cleaned length, NO width clip (the exactness guard; the
     # reference's own cap is 299, enforced by the caller)
     max_word_len = tok_len.max() if tok_cap else jnp.int32(0)
@@ -207,10 +208,9 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
         nbytes = jnp.clip(tok_len - 4 * c, 0, 4)
         cols.append(l4[idx] & masktab[nbytes])
 
-    # doc id per token: first letter's byte -> manifest slot -> 1-based
-    # id (tokens never span docs, so any of the token's letters agrees)
-    first_letter_byte = pos_s[jnp.clip(F0, 0, n - 1)]
-    slot = doc_slot_of_byte[first_letter_byte]
+    # doc id per token: start byte -> manifest slot -> 1-based id
+    # (tokens never span docs, so the start byte's doc is the token's)
+    slot = doc_slot_of_byte[jnp.clip(sb[:-1], 0, n - 1)]
     doc_of_tok = doc_id_values[jnp.clip(slot, 0, num_docs - 1)]
 
     # valid rows (>= 1 letter) have column 0's top byte in [a-z] =>
@@ -361,16 +361,19 @@ def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     num_pairs = first_pair.sum(dtype=jnp.int32)
 
     # Compaction WITHOUT scatters (TPU scatter is a serial per-update
-    # loop — see module docstring): ranks from the boundary masks are
-    # monotone over the sorted array, so the position of the w-th
-    # first-word / p-th first-pair is one searchsorted over the rank
-    # array, and every "compact" is then a plain gather.
-    word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
+    # loop — see module docstring): the position of the w-th
+    # first-word / p-th first-pair comes from the shared set-bit sort
+    # (segment.set_bit_positions), and every "compact" is then a plain
+    # gather.  Cheaper than the equivalent searchsorted over the rank
+    # cumsum: one cap-sized 1-key sort vs two 2·cap-sized argsorts.
     pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
     slots = jnp.arange(cap, dtype=jnp.int32)
-    # W[w] = sorted-array position of word w (cap where w >= num_words)
-    W = jnp.searchsorted(word_rank, jnp.arange(cap + 1, dtype=jnp.int32))
-    P = jnp.searchsorted(pair_rank, slots)
+    # W[w] = sorted-array position of word w (cap where w >= num_words;
+    # W[cap] == cap so the df difference below can always read W[w+1])
+    W = jnp.concatenate([
+        jnp.minimum(segment.set_bit_positions(first_word, cap), cap),
+        jnp.full(1, cap, jnp.int32)])
+    P = jnp.minimum(segment.set_bit_positions(first_pair, cap), cap)
     word_live = slots < num_words
     pair_live = slots < num_pairs
     Wg = jnp.clip(W[:-1], 0, cap - 1).astype(jnp.int32)

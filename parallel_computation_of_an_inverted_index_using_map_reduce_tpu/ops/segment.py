@@ -2,18 +2,73 @@
 
 These replace the reference reducer's O(tokens x unique_words) linear
 dictionary scan and O(n^2) bubble sort (main.c:172-187, 217-226) with
-O(n) boundary diffs, cumsums and searchsorted/gather compactions over a
-sorted array — the shapes XLA vectorizes well on TPU.  None of them
-scatters: XLA lowers TPU scatter to a serial per-update loop
-(~75 ns/update measured on v5e — one 1M-update scatter costs more than
-five 1M-element stable-sort passes), so every compaction here is
-formulated as cumsum-rank + searchsorted + gather instead (see
-ops/device_tokenizer.py module docstring for the measurement).
+O(n) boundary diffs, cumsums and sort/gather compactions over a sorted
+array — the shapes XLA vectorizes well on TPU.  None of them scatters:
+XLA lowers TPU scatter to a serial per-update loop (~75 ns/update
+measured on v5e — one 1M-update scatter costs more than five
+1M-element stable-sort passes), so every compaction here is a
+set-bit-position ``lax.sort`` plus a gather (:func:`set_bit_positions`;
+the round-2 cumsum-rank + searchsorted formulation lost the round-3
+on-chip A/B — see :func:`searchsorted_device`, kept for run-edge
+lookups where the sought values are not mask positions).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
+
+from .keys import INT32_MAX as _INT32_MAX
+
+
+def searchsorted_device(a, v):
+    """``searchsorted(a, v, side='left')`` for NONDECREASING queries
+    ``v``, formulated for TPU (both inputs same int dtype).
+
+    ``jnp.searchsorted``'s default ``method='scan'`` binary search
+    lowers to a sequential log2(n)-step loop of dynamic slices —
+    measured on the v5e (round 3, tools/profile_device_stages.py):
+    173 ms for 2^20 sorted queries into a 2^20 array, 702 ms into a
+    5.7M array.  Three of those per run dominated the all-device
+    engine's 1157 ms device_index regression.
+
+    This is the co-sort formulation instead: stable-sort
+    ``concat([v, a])`` (ties put queries first = side='left'), invert
+    the permutation, and subtract each query's own rank — for
+    nondecreasing ``v`` that rank is just its index.  The inverse is a
+    second ``argsort`` rather than the iota-scatter
+    ``jnp.searchsorted(method='sort')`` uses, which keeps the device
+    program scatter-free (the design guard in
+    tests/test_device_tokenizer.py) AND measures faster: 72 ms / 90 ms
+    on the shapes above vs 88 / 135 for ``method='sort'`` (the
+    permutation scatter is not the serial per-update worst case, but
+    it still loses to the sort).
+    """
+    m = v.shape[0]
+    idx = jnp.argsort(jnp.concatenate([v, a]), stable=True)
+    inv = jnp.argsort(idx)
+    return inv[:m] - jnp.arange(m, dtype=inv.dtype)
+
+
+def set_bit_positions(mask, out_len: int):
+    """Positions of ``mask``'s True slots, in order, as an
+    ``out_len``-long int32 array padded with INT32_MAX.
+
+    ONE single-key ``lax.sort`` of (slot where set, INT32_MAX
+    elsewhere) front-compacts the positions; set bits past ``out_len``
+    are dropped.  This is the shared core of every compaction in the
+    device programs (``segment.compact``, the streaming row compactor,
+    and the W/P word/pair-start lookups of both dedup tails) — cheaper
+    on TPU than the rank-cumsum searchsorted it replaced (round-3
+    on-chip measurement, see :func:`searchsorted_device`).
+    """
+    n = mask.shape[0]
+    kept = lax.sort(
+        jnp.where(mask, jnp.arange(n, dtype=jnp.int32), _INT32_MAX))
+    if out_len <= n:
+        return kept[:out_len]
+    return jnp.concatenate(
+        [kept, jnp.full(out_len - n, _INT32_MAX, jnp.int32)])
 
 
 def first_occurrence_mask(sorted_keys):
@@ -41,7 +96,7 @@ def sorted_segment_counts(segment_ids, weights, num_segments: int):
     """
     wext = jnp.concatenate(
         [jnp.zeros(1, weights.dtype), jnp.cumsum(weights)])
-    edges = jnp.searchsorted(
+    edges = searchsorted_device(
         segment_ids, jnp.arange(num_segments + 1, dtype=segment_ids.dtype))
     return wext[edges[1:]] - wext[edges[:-1]]
 
@@ -54,7 +109,7 @@ def bucket_edges(sorted_bucket_ids, num_buckets: int):
     searchsorted over the sorted column (ids >= num_buckets — the
     padding bucket — land past the last edge and are dropped).
     """
-    edges = jnp.searchsorted(
+    edges = searchsorted_device(
         sorted_bucket_ids,
         jnp.arange(num_buckets + 1, dtype=jnp.int32)).astype(jnp.int32)
     return edges[1:] - edges[:-1], edges[:-1]
@@ -65,15 +120,12 @@ def compact(values, keep_mask, out_size: int, fill):
 
     The result's first ``keep_mask.sum()`` slots are the kept values in
     order, remaining slots are ``fill`` (kept values past ``out_size``
-    are dropped).  The kept ranks are nondecreasing, so the j-th kept
-    value's position is one searchsorted over the rank array and the
-    compaction is a plain gather — no scatter.
+    are dropped): :func:`set_bit_positions` then a plain gather — no
+    scatter.
     """
     n = values.shape[0]
     if n == 0:
         return jnp.full((out_size,), fill, dtype=values.dtype)
-    rank = jnp.cumsum(keep_mask.astype(jnp.int32)) - 1
-    slots = jnp.arange(out_size, dtype=jnp.int32)
-    pos = jnp.searchsorted(rank, slots)
-    live = slots < rank[-1] + 1
-    return jnp.where(live, values[jnp.clip(pos, 0, n - 1)], fill)
+    kept = set_bit_positions(keep_mask, out_size)
+    live = kept != _INT32_MAX
+    return jnp.where(live, values[jnp.clip(kept, 0, n - 1)], fill)

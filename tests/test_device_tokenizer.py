@@ -372,12 +372,12 @@ def test_two_key_letter_compaction_branch_matches(monkeypatch):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_searchsorted_letter_compaction_matches_sort(monkeypatch):
-    """The searchsorted letter-compaction variant (cumsum-rank gather,
-    MRI_TPU_LETTER_COMPACTION=searchsorted) must agree exactly with the
-    default position-keyed sort — including when the buffer's last byte
-    is a letter (the clipped tail reads nonzero garbage that every
-    unmasked window must avoid)."""
+def test_tokenize_rows_buffer_ending_in_letter():
+    """A buffer whose LAST byte is a letter (no trailing pad) must
+    tokenize exactly: the compaction tail and the final token's length
+    come from the clamped start-byte gather, which must not read past
+    the exclusive-cumsum array.  (This input guarded the removed
+    searchsorted compaction variant; kept for the sort path.)"""
     import jax
 
     docs = [b"don't foo-bar x1y2z3 I.Loomings tail42", b"", b"  42 ",
@@ -388,16 +388,17 @@ def test_searchsorted_letter_compaction_matches_sort(monkeypatch):
     kw = dict(width=48, tok_cap=256, num_docs=len(docs))
     args = (jax.device_put(buf), jax.device_put(ends), jax.device_put(ids))
 
-    srt = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
-    monkeypatch.setattr(DT, "_COMPACTION_MODE", "searchsorted")
-    ss = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+    trunc = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+    pad_buf, _ = _pad_concat(docs)  # same docs, space-padded tail
+    padded = jax.jit(lambda *a: DT.tokenize_rows(*a, **kw))(
+        jax.device_put(pad_buf), jax.device_put(ends), jax.device_put(ids))
 
-    s_cols, s_doc, s_len, s_cnt = srt
-    g_cols, g_doc, g_len, g_cnt = ss
-    assert int(s_len) == int(g_len)
-    assert int(s_cnt) == int(g_cnt)
-    np.testing.assert_array_equal(np.asarray(s_doc), np.asarray(g_doc))
-    for a, b in zip(s_cols, g_cols):
+    t_cols, t_doc, t_len, t_cnt = trunc
+    p_cols, p_doc, p_len, p_cnt = padded
+    assert int(t_len) == int(p_len)
+    assert int(t_cnt) == int(p_cnt)
+    np.testing.assert_array_equal(np.asarray(t_doc), np.asarray(p_doc))
+    for a, b in zip(t_cols, p_cols):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 

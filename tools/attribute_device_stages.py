@@ -1,0 +1,170 @@
+"""Attribute the all-device program's on-chip time by stage truncation.
+
+Round-3 finding (tools/profile_device_stages.py): standalone micro-ops
+cannot be timed below the tunnel's per-dispatch floor (~60 ms some
+hours), so stage costs are attributed by timing TRUNCATED variants of
+the real program instead — each variant runs the pipeline up to a cut
+point and reduces everything computed so far to one scalar (so XLA
+cannot dead-code-eliminate the work, and the fetch is 4 bytes).
+Successive differences are the stage costs; the dispatch floor and the
+reduction epsilon cancel.
+
+    python tools/attribute_device_stages.py [--corpus DIR] [--platform cpu]
+
+Cuts:
+  tokenize     tokenize_rows complete (all columns + doc col forced)
+  perm         + pack_groups + groups_sort_perm (the LSD radix passes)
+  gather       + s_cols/s_docs row gathers
+  masks        + boundary masks, ranks, counts (cumsum at token scale)
+  full         + W/P compactions, df, postings, unique_cols (the whole
+               index_bytes_device, its real counts fetch)
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def timed(fn, *args, reps=5):
+    import numpy as np
+
+    out = fn(*args)
+    np.asarray(out[:1] if getattr(out, "ndim", 0) else out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(out[:1] if getattr(out, "ndim", 0) else out)
+        best = min(best, time.perf_counter() - t0)
+    return round(best * 1e3, 2)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default="/root/reference/test_in")
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
+          flush=True)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, manifest_from_dir,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        load_documents,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.inverted_index import (
+        _pack_window, _round_up,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+        device_tokenizer as DT,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+        segment,
+    )
+
+    cfg = IndexConfig(output_dir="/tmp/ads_out", backend="tpu",
+                      device_tokenize=True)
+    manifest = manifest_from_dir(args.corpus)
+    contents, doc_ids = load_documents(manifest)
+    num_docs = len(contents)
+    total = sum(len(c) for c in contents)
+    padded = _round_up(total, cfg.pad_multiple)
+    buf, ends, _ = _pack_window(contents, doc_ids, padded, num_docs)
+    tok_count, host_max_len = DT.host_token_stats(buf, ends)
+    tok_cap = _round_up(tok_count + 1, 1 << 15)
+    width = cfg.device_tokenize_width
+    sort_cols = -(-max(host_max_len, 1) // 4)
+    print(json.dumps({"n_bytes": int(buf.shape[0]), "tok_cap": tok_cap,
+                      "sort_cols": sort_cols}), flush=True)
+
+    data = jax.device_put(buf)
+    ends_d = jax.device_put(ends)
+    ids_d = jax.device_put(np.asarray(doc_ids, np.int32))
+
+    def upto(stage):
+        @jax.jit
+        def run(data, doc_ends, ids):
+            cols, doc_col, max_word_len, num_tokens = DT.tokenize_rows(
+                data, doc_ends, ids, width=width, tok_cap=tok_cap,
+                num_docs=num_docs)
+            cols = DT.zero_tail_cols(
+                cols, DT.clamp_sort_cols(sort_cols, len(cols)), tok_cap)
+            if stage == "tokenize":
+                acc = sum(jnp.sum(c) for c in cols) + jnp.sum(doc_col)
+                return acc + max_word_len + num_tokens
+            nsort = DT.clamp_sort_cols(sort_cols, len(cols))
+            groups = DT.pack_groups(cols, nsort)
+            perm = DT.groups_sort_perm(groups, doc_col, tok_cap)
+            if stage == "perm":
+                return jnp.sum(perm) + max_word_len
+            s_cols = tuple(c[perm] for c in cols)
+            s_docs = doc_col[perm]
+            if stage == "gather":
+                return (sum(jnp.sum(c) for c in s_cols)
+                        + jnp.sum(s_docs) + max_word_len)
+            INT32_MAX = DT.INT32_MAX
+            word_valid = s_cols[0] != INT32_MAX
+
+            def neq_prev(a):
+                return jnp.concatenate(
+                    [jnp.ones(1, jnp.bool_), a[1:] != a[:-1]])
+
+            first_word = word_valid & functools.reduce(
+                jnp.logical_or, (neq_prev(c) for c in s_cols))
+            first_pair = word_valid & (first_word | neq_prev(s_docs))
+            word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
+            pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
+            if stage == "masks":
+                return (jnp.sum(word_rank[-1:]) + jnp.sum(pair_rank[-1:])
+                        + jnp.sum(first_word.astype(jnp.int32))
+                        + max_word_len)
+            raise AssertionError(stage)
+
+        return run
+
+    lines = {}
+    for stage in ("tokenize", "perm", "gather", "masks"):
+        lines[stage] = timed(upto(stage), data, ends_d, ids_d,
+                             reps=args.reps)
+        print(json.dumps({"cut": stage, "ms": lines[stage]}), flush=True)
+
+    full_fn = jax.jit(functools.partial(
+        DT.index_bytes_device, width=width, tok_cap=tok_cap,
+        num_docs=num_docs, sort_cols=sort_cols))
+
+    def full(data, doc_ends, ids):
+        return full_fn(data, doc_ends, ids)["counts"]
+
+    lines["full"] = timed(full, data, ends_d, ids_d, reps=args.reps)
+    print(json.dumps({"cut": "full", "ms": lines["full"]}), flush=True)
+
+    deltas = {}
+    order = ["tokenize", "perm", "gather", "masks", "full"]
+    prev = 0.0
+    for k in order:
+        deltas[k] = round(lines[k] - prev, 2)
+        prev = lines[k]
+    print(json.dumps({"cuts_ms": lines, "stage_deltas_ms": deltas}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,9 +20,9 @@ step() {  # step <name> <timeout_s> <cmd...>
 
 # 1. VERDICT #1: re-time the redesigned device engines (+ overlap A/B)
 step measure_tpu        900 python tools/measure_tpu.py
-# 2. searchsorted letter-compaction A/B (env read at import)
-step measure_tpu_ss     600 env MRI_TPU_LETTER_COMPACTION=searchsorted \
-                            python tools/measure_tpu.py --quick
+# (step 2, the MRI_TPU_LETTER_COMPACTION=searchsorted A/B, was removed
+# with the variant itself after it lost 2x on chip — see
+# BENCH_TPU_r03.json letter_compaction_ab)
 # 3. VERDICT #2: the bench itself (fast lane first; writes BENCH line)
 step bench              900 python bench.py
 # 4. VERDICT #7: pallas sweep (sizes x block_rows, dedup + hist8)
