@@ -795,12 +795,14 @@ class InvertedIndexModel:
         streaming): prefix-slice fetch with transfer trimming, word-row
         decode, and the letter-file emit.
 
-        Transfer trimming: columns past ``sort_cols`` are provably all
-        zero (host-exact max word length) and decode as zero padding
-        for free; df/postings values are <= max_doc_id, so they ride
-        down as uint16 whenever doc ids fit.  Every prefix slice is
-        dispatched before any is materialized — sequential fetches
-        would each pay the link's fixed RTT.
+        Transfer trimming: group pairs past the host-exact
+        ``sort_cols`` bound are provably all zero and decode as zero
+        padding for free (2 int32 ride down per 12 chars — the 5-bit
+        compressed rows, decoded at vocab scale on host); df/postings
+        values are <= max_doc_id, so they ride down as uint16 whenever
+        doc ids fit.  Every prefix slice is dispatched before any is
+        materialized — sequential fetches would each pay the link's
+        fixed RTT.
         """
         from ..ops import device_tokenizer as DT
 
@@ -813,25 +815,29 @@ class InvertedIndexModel:
         with timer.phase("fetch"):
             nu = min(cap, _round_up(max(num_words, 1), 1 << 13))
             npairs = min(cap, _round_up(max(num_pairs, 1), 1 << 13))
-            ncols_fetch = min(sort_cols, width // 4)
+            ngroups_fetch = DT.live_groups_for(sort_cols, width)
             narrow = max_doc_id < (1 << 16)
             df_d = out["df"][:nu]
             post_d = out["postings"][:npairs]
             if narrow:
                 df_d = df_d.astype(jnp.uint16)
                 post_d = post_d.astype(jnp.uint16)
-            cols_d = [c[:nu] for c in out["unique_cols"][:ncols_fetch]]
-            for a in (df_d, post_d, *cols_d):
+            halves_d = [h[:nu]
+                        for pair in out["unique_groups"][:ngroups_fetch]
+                        for h in pair]
+            for a in (df_d, post_d, *halves_d):
                 a.copy_to_host_async()
             df = np.asarray(df_d)[:num_words].astype(np.int32)
-            cols = [np.asarray(c)[:num_words] for c in cols_d]
+            halves = [np.asarray(h)[:num_words] for h in halves_d]
+            groups = [(halves[2 * g], halves[2 * g + 1])
+                      for g in range(ngroups_fetch)]
             postings = np.asarray(post_d)[:num_pairs].astype(np.int32)
             timer.count(
                 "fetched_bytes",
                 df_d.nbytes + post_d.nbytes
-                + sum(c.nbytes for c in cols_d))
+                + sum(h.nbytes for h in halves_d))
         with timer.phase("host_views"):
-            vocab = DT.decode_word_rows(cols, width)
+            vocab = DT.decode_word_groups(groups, width)
             letters = vocab.view(np.uint8).reshape(num_words, width)[:, 0] - ord("a")
             df64 = df.astype(np.int64)
             order, offsets = engine.host_order_offsets(letters, df64)
@@ -1013,7 +1019,8 @@ class InvertedIndexModel:
                             postings=np.empty(0, np.int32),
                             max_doc_id=max_doc_id, letter_range=ranges[o])
                         continue
-                    vocab_o = DT.decode_word_rows(ow["unique_cols"], width)
+                    vocab_o = DT.decode_word_groups(
+                        ow["unique_groups"], width)
                     df_o = ow["df"].astype(np.int64)
                     letters_o = vocab_o.view(np.uint8).reshape(
                         ow["num_words"], width)[:, 0] - ord("a")
@@ -1053,7 +1060,7 @@ class InvertedIndexModel:
                 if ow["num_words"] == 0:
                     continue
                 vocab_parts.append(
-                    DT.decode_word_rows(ow["unique_cols"], width))
+                    DT.decode_word_groups(ow["unique_groups"], width))
                 df_o = ow["df"].astype(np.int64)
                 off_parts.append(np.cumsum(df_o) - df_o + base)
                 df_parts.append(df_o)

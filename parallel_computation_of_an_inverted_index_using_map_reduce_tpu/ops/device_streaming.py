@@ -41,12 +41,10 @@ from jax import lax
 from ..utils.rounding import round_up
 from .device_tokenizer import (
     INT32_MAX,
-    clamp_sort_cols,
     groups_sort_perm,
-    pack_groups,
-    tokenize_rows,
-    unpack_groups,
-    zero_tail_cols,
+    live_groups_for,
+    num_groups_for,
+    tokenize_groups,
 )
 from .segment import first_occurrence_mask, set_bit_positions
 
@@ -87,17 +85,15 @@ def window_rows(data, doc_ends, doc_id_values, *, width: int, tok_cap: int,
     num_tokens]`` for the caller's divergence asserts (fetched lazily,
     never inside the stream loop).
     """
-    cols, doc_col, max_word_len, num_tokens = tokenize_rows(
+    groups, doc_col, max_word_len, num_tokens = tokenize_groups(
         data, doc_ends, doc_id_values, width=width, tok_cap=tok_cap,
-        num_docs=num_docs)
-    nsort = clamp_sort_cols(sort_cols, len(cols))
-    cols = zero_tail_cols(cols, nsort, tok_cap)
-    groups = pack_groups(cols, nsort)
-    perm = groups_sort_perm(groups, doc_col, tok_cap)
+        num_docs=num_docs, sort_cols=sort_cols)
+    live = live_groups_for(sort_cols, width)
+    perm = groups_sort_perm(groups[:live], doc_col, tok_cap)
     zero = jnp.zeros(tok_cap, jnp.int32)
     s_rows = tuple(
-        g[perm] for pair in groups for g in pair
-    ) + tuple([zero] * (2 * (num_groups - len(groups)))) + (doc_col[perm],)
+        g[perm] for pair in groups[:live] for g in pair
+    ) + tuple([zero] * (2 * (num_groups - live))) + (doc_col[perm],)
     first = _row_first_mask(s_rows)
     rows = _compact_rows(s_rows, first, out_cap)
     counts = jnp.stack([first.sum(dtype=jnp.int32), max_word_len,
@@ -135,7 +131,7 @@ def _regrow_rows(acc, *, cap: int):
     return tuple(one(a) for a in acc)
 
 
-def finalize_rows_body(acc, *, ncols: int, num_groups: int):
+def finalize_rows_body(acc, *, num_groups: int):
     """Traceable core of :func:`_finalize_rows` — also runs per shard
     inside the mesh streaming engine's ``shard_map`` finalize
     (parallel/dist_device_streaming.py), where each owner's
@@ -144,8 +140,10 @@ def finalize_rows_body(acc, *, ncols: int, num_groups: int):
     Every valid row is one unique (word, doc) pair and the rows are
     already in emit-ready lexicographic order, so: postings are the doc
     column's valid prefix verbatim; df falls out of the word-run edges;
-    unique word columns decompress from the group pairs gathered at
-    each run's first row (ops/device_tokenizer.unpack_groups).
+    unique word rows return AS the 5-bit group pairs gathered at each
+    run's first row — the host decodes them at vocab scale
+    (ops/device_tokenizer.decode_word_groups), matching the one-shot
+    engine's contract.
     """
     cap = acc[0].shape[0]
     doc = acc[-1]
@@ -172,17 +170,16 @@ def finalize_rows_body(acc, *, ncols: int, num_groups: int):
     groups = [(jnp.where(word_live, acc[2 * g][Wg], 0),
                jnp.where(word_live, acc[2 * g + 1][Wg], 0))
               for g in range(num_groups)]
-    unique_cols = unpack_groups(groups, ncols)
     return {
         "counts": jnp.stack([num_words, num_pairs]),
         "df": df,
         "postings": postings,
-        "unique_cols": unique_cols,
+        "unique_groups": tuple(groups),
     }
 
 
 _finalize_rows = functools.partial(
-    jax.jit, static_argnames=("ncols", "num_groups"))(finalize_rows_body)
+    jax.jit, static_argnames=("num_groups",))(finalize_rows_body)
 
 
 class DeviceStreamEngine:
@@ -197,7 +194,7 @@ class DeviceStreamEngine:
     def __init__(self, *, width: int, window_pad: int = 1 << 14,
                  initial_capacity: int = 1 << 16):
         self._width = width
-        self._num_groups = (width // 4 + 2) // 3
+        self._num_groups = num_groups_for(width)
         self._window_pad = window_pad
         self._cap = initial_capacity
         self._acc = None
@@ -229,7 +226,8 @@ class DeviceStreamEngine:
             return
         self.max_word_len = max(self.max_word_len, max_len)
         sort_cols = -(-max(self.max_word_len, 1) // 4)
-        self._live_groups = max(self._live_groups, (sort_cols + 2) // 3)
+        self._live_groups = max(self._live_groups,
+                                live_groups_for(sort_cols, self._width))
         tok_cap = round_up(tok_count + 1, self._window_pad)
         out_cap = round_up(min(tok_count, tok_cap), self._window_pad)
         rows, counts = window_rows(
@@ -259,7 +257,7 @@ class DeviceStreamEngine:
 
     def finalize(self):
         """Device dict with the one-shot engine's output contract
-        (counts / df / postings / unique_cols valid prefixes).
+        (counts / df / postings / unique_groups valid prefixes).
 
         Re-checks every window's device-computed stats against the
         host classifier here — ONE lazy fetch per window, all outside
@@ -280,8 +278,7 @@ class DeviceStreamEngine:
                 raise AssertionError(
                     f"device max word len {dev_max_len} != host "
                     f"{host_max_len}: classifier divergence (bug)")
-        out = _finalize_rows(self._acc, ncols=self._width // 4,
-                             num_groups=self._num_groups)
+        out = _finalize_rows(self._acc, num_groups=self._num_groups)
         self._acc = self._pending_count = None
         self._window_checks = []
         return out

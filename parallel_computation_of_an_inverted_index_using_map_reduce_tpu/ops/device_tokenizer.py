@@ -103,17 +103,13 @@ def _byte_tables():
     return space, lower
 
 
-def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
-                  tok_cap: int, num_docs: int):
-    """bytes -> packed word-row columns + doc column (device, traceable).
-
-    The map phase's tokenize/clean stage as pure array ops — shared by
-    the single-chip program below and the mesh variant
-    (parallel/dist_device_tokenizer.py), where it runs per shard inside
-    ``shard_map``.  Returns ``(cols, doc_col, max_word_len,
-    num_tokens)``: ``cols[0]`` carries INT32_MAX on empty/padding rows
-    (sorts last), ``doc_col`` likewise.
-    """
+def _tokenize_front(data, doc_ends, doc_id_values, *, tok_cap: int,
+                    num_docs: int):
+    """Shared front half of both tokenizer frontends: byte classify,
+    token segmentation, letter compaction, per-token offsets/lengths
+    and doc ids.  Returns ``(letters, F0, tok_len, max_word_len,
+    doc_of_tok, valid_tok, num_tokens, n)`` — everything the word-row
+    packers (:func:`tokenize_rows`, :func:`tokenize_groups`) need."""
     n = data.shape[0]
     # byte classifiers as arithmetic, not 256-entry table gathers: a
     # token-scale gather costs ~7 ms/2^20 rows on the v5e where the
@@ -192,6 +188,37 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
     # reference's own cap is 299, enforced by the caller)
     max_word_len = tok_len.max() if tok_cap else jnp.int32(0)
 
+    # doc id per token: start byte -> manifest slot -> 1-based id
+    # (tokens never span docs, so the start byte's doc is the token's)
+    slot = doc_slot_of_byte[jnp.clip(sb[:-1], 0, n - 1)]
+    doc_of_tok = doc_id_values[jnp.clip(slot, 0, num_docs - 1)]
+
+    num_tokens = jnp.int32(0) + jnp.sum(token_start.astype(jnp.int32))
+    valid_tok = (tok_len > 0) & (jnp.arange(tok_cap) < num_tokens)
+    return (letters, F0, tok_len, max_word_len, doc_of_tok, valid_tok,
+            num_tokens, n)
+
+
+def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
+                  tok_cap: int, num_docs: int):
+    """bytes -> packed word-row byte columns + doc column (device,
+    traceable).
+
+    The byte-column frontend: ``width // 4`` big-endian int32 columns
+    per word row.  :func:`tokenize_groups` (the 5-bit compressed
+    frontend the engines run) supersedes it on the hot paths — this
+    one is kept as the directly-byte-addressed reference whose output
+    the group frontend is property-tested against
+    (pack_groups(tokenize_rows(x)) == tokenize_groups(x)).  Returns
+    ``(cols, doc_col, max_word_len, num_tokens)``: ``cols[0]`` carries
+    INT32_MAX on empty/padding rows (sorts last), ``doc_col``
+    likewise.
+    """
+    (letters, F0, tok_len, max_word_len, doc_of_tok, valid_tok,
+     num_tokens, n) = _tokenize_front(
+        data, doc_ends, doc_id_values, tok_cap=tok_cap,
+        num_docs=num_docs)
+
     # big-endian int32 word columns via windowed gathers: 4-byte packs
     # of the letter stream at every alignment (elementwise shifts of
     # padded slices), then one gather per column at F[t] + 4c, masked
@@ -208,20 +235,92 @@ def tokenize_rows(data, doc_ends, doc_id_values, *, width: int,
         nbytes = jnp.clip(tok_len - 4 * c, 0, 4)
         cols.append(l4[idx] & masktab[nbytes])
 
-    # doc id per token: start byte -> manifest slot -> 1-based id
-    # (tokens never span docs, so the start byte's doc is the token's)
-    slot = doc_slot_of_byte[jnp.clip(sb[:-1], 0, n - 1)]
-    doc_of_tok = doc_id_values[jnp.clip(slot, 0, num_docs - 1)]
-
     # valid rows (>= 1 letter) have column 0's top byte in [a-z] =>
     # positive int32; empty/padding rows get INT32_MAX in column 0 so
     # they sort after every real word
-    num_tokens = jnp.int32(0) + jnp.sum(token_start.astype(jnp.int32))
-    valid_tok = (tok_len > 0) & (jnp.arange(tok_cap) < num_tokens)
     col0 = jnp.where(valid_tok, cols[0], INT32_MAX)
     doc_col = jnp.where(valid_tok, doc_of_tok, INT32_MAX)
 
     return (col0, *cols[1:]), doc_col, max_word_len, num_tokens
+
+
+def num_groups_for(width: int) -> int:
+    """Total (hi, lo) group pairs a ``width``-byte word row packs into
+    (12 chars per group — see :func:`pack_groups`)."""
+    return (width // 4 + 2) // 3
+
+
+def live_groups_for(sort_cols: int | None, width: int) -> int:
+    """Group pairs that can be non-constant given the host-exact
+    ``sort_cols`` byte-column bound (the :func:`clamp_sort_cols`
+    discipline, lifted to groups)."""
+    return (clamp_sort_cols(sort_cols, width // 4) + 2) // 3
+
+
+def tokenize_groups(data, doc_ends, doc_id_values, *, width: int,
+                    tok_cap: int, num_docs: int,
+                    sort_cols: int | None = None):
+    """bytes -> 5-bit-compressed word-row group pairs + doc column.
+
+    The frontend both device engines run: word rows come out directly
+    as the ``(hi, lo)`` 30-bit code pairs of :func:`pack_groups`
+    (12 chars per pair, order-preserving, injective), built by TWO
+    windowed gathers per group off a 6-char packed letter stream —
+    instead of 12 byte-column gathers then an elementwise repack.
+    Groups past the host-exact ``sort_cols`` bound are constant zeros
+    (XLA dead-code-eliminates their gathers), mirroring
+    :func:`zero_tail_cols`.  Group 0 pins INT32_MAX on empty/padding
+    rows so they sort last; ``doc_col`` likewise.
+
+    Returns ``(groups, doc_col, max_word_len, num_tokens)`` with
+    ``groups`` a list of ``num_groups_for(width)`` pairs, exactly
+    ``pack_groups(tokenize_rows(...), nsort)`` padded with zero pairs
+    (property-tested).
+    """
+    (letters, F0, tok_len, max_word_len, doc_of_tok, valid_tok,
+     num_tokens, n) = _tokenize_front(
+        data, doc_ends, doc_id_values, tok_cap=tok_cap,
+        num_docs=num_docs)
+
+    # 6-char packed stream: l6[i] = letters[i..i+5] as 5-bit codes
+    # (byte & 31: pad 0, a=1 .. z=26 — order-preserving), char k at
+    # shift 25-5k.  One gather at F[t]+12g yields group g's hi half,
+    # one at F[t]+12g+6 its lo half; the mask keeps only the token's
+    # own chars (the compacted stream runs straight into the next
+    # token's letters).
+    codes = letters & 31
+    cp = jnp.concatenate([codes, jnp.zeros(5, jnp.int32)])
+    l6 = ((cp[0:n] << 25) | (cp[1:n + 1] << 20) | (cp[2:n + 2] << 15)
+          | (cp[3:n + 3] << 10) | (cp[4:n + 4] << 5) | cp[5:n + 5])
+    full = (1 << 30) - 1
+    masktab6 = jnp.array(
+        [0] + [full ^ ((1 << (30 - 5 * m)) - 1) for m in range(1, 7)],
+        jnp.int32)
+
+    def half(char_off):
+        idx = jnp.clip(F0 + char_off, 0, n - 1)
+        # cap at width too: when 12 * num_groups_for(width) > width
+        # (width not divisible by 12), the last group's window reaches
+        # past the row — the byte-column reference drops those chars
+        # (it only builds width//4 columns), so the mask must as well
+        nchars = jnp.clip(
+            jnp.minimum(tok_len, jnp.int32(width)) - char_off, 0, 6)
+        return l6[idx] & masktab6[nchars]
+
+    total = num_groups_for(width)
+    live = live_groups_for(sort_cols, width)
+    groups = []
+    for g in range(live):
+        hi, lo = half(12 * g), half(12 * g + 6)
+        if g == 0:
+            hi = jnp.where(valid_tok, hi, INT32_MAX)
+            lo = jnp.where(valid_tok, lo, INT32_MAX)
+        groups.append((hi, lo))
+    zero = jnp.zeros(tok_cap, jnp.int32)
+    groups.extend((zero, zero) for _ in range(total - live))
+
+    doc_col = jnp.where(valid_tok, doc_of_tok, INT32_MAX)
+    return tuple(groups), doc_col, max_word_len, num_tokens
 
 
 def clamp_sort_cols(sort_cols: int | None, ncols: int) -> int:
@@ -393,6 +492,64 @@ def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
     return num_words, num_pairs, df, postings, unique_cols
 
 
+def sort_dedup_groups(groups, doc_col, cap: int, live: int):
+    """Sorted/deduped index from 5-bit group pairs (device, traceable).
+
+    :func:`sort_dedup_rows`'s reduce stage operating natively on the
+    compressed representation :func:`tokenize_groups` emits — no byte
+    columns ever materialize at token scale.  ``live``: group pairs
+    that can be non-constant (:func:`live_groups_for`); constant-zero
+    tail pairs are excluded from the radix passes (a stable pass over
+    a constant key is the identity) and returned as zeros.
+
+    Returns ``(num_words, num_pairs, df, postings, unique_groups)``
+    with ``unique_groups`` shaped like ``groups``.
+    """
+    live_pairs = list(groups[:max(1, live)])
+    perm = groups_sort_perm(live_pairs, doc_col, cap)
+    s_groups = [(hi[perm], lo[perm]) for hi, lo in live_pairs]
+    s_docs = doc_col[perm]
+
+    def neq_prev(a):
+        return jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), a[1:] != a[:-1]])
+
+    word_valid = s_groups[0][0] != INT32_MAX
+    first_word = word_valid & functools.reduce(
+        jnp.logical_or,
+        (neq_prev(h) for pair in s_groups for h in pair))
+    first_pair = word_valid & (first_word | neq_prev(s_docs))
+
+    num_words = first_word.sum(dtype=jnp.int32)
+    num_pairs = first_pair.sum(dtype=jnp.int32)
+
+    # Compaction WITHOUT scatters: the shared set-bit sort
+    # (segment.set_bit_positions) — see sort_dedup_rows.
+    pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
+    slots = jnp.arange(cap, dtype=jnp.int32)
+    W = jnp.concatenate([
+        jnp.minimum(segment.set_bit_positions(first_word, cap), cap),
+        jnp.full(1, cap, jnp.int32)])
+    P = jnp.minimum(segment.set_bit_positions(first_pair, cap), cap)
+    word_live = slots < num_words
+    pair_live = slots < num_pairs
+    Wg = jnp.clip(W[:-1], 0, cap - 1).astype(jnp.int32)
+    Pg = jnp.clip(P, 0, cap - 1).astype(jnp.int32)
+
+    pair_excl = jnp.concatenate(
+        [pair_rank + 1 - first_pair.astype(jnp.int32),
+         jnp.full(1, num_pairs, jnp.int32)])
+    df = jnp.where(
+        word_live, pair_excl[jnp.minimum(W[1:], cap)] - pair_excl[Wg], 0)
+    postings = jnp.where(pair_live, s_docs[Pg], 0)
+    zero = jnp.zeros(cap, jnp.int32)
+    unique_groups = tuple(
+        [(jnp.where(word_live, hi[Wg], 0),
+          jnp.where(word_live, lo[Wg], 0)) for hi, lo in s_groups]
+        + [(zero, zero)] * (len(groups) - len(live_pairs)))
+    return num_words, num_pairs, df, postings, unique_groups
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("width", "tok_cap", "num_docs", "sort_cols"),
@@ -414,19 +571,16 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
     ``num_words`` / ``num_pairs`` (see caller).  ``max_word_len`` must
     be checked against ``width`` host-side (WidthOverflow contract).
     ``sort_cols``: optional static radix-pass bound from the host-exact
-    :func:`max_cleaned_token_len` (see :func:`sort_dedup_rows`).
+    :func:`max_cleaned_token_len`.  Word rows live and return as the
+    5-bit ``unique_groups`` pairs (:func:`tokenize_groups`) — the
+    host decodes them at vocab scale (:func:`decode_word_groups`),
+    and the fetch rides 2 int32 per 12 chars instead of 3.
     """
-    cols, doc_col, max_word_len, num_tokens = tokenize_rows(
+    groups, doc_col, max_word_len, num_tokens = tokenize_groups(
         data, doc_ends, doc_id_values, width=width, tok_cap=tok_cap,
-        num_docs=num_docs)
-    if sort_cols is not None:
-        # columns past the host-exact bound are all zero for every row
-        # (valid and padding): substituting constants lets XLA dead-
-        # code-eliminate the windowed gathers that would build them
-        cols = zero_tail_cols(cols, clamp_sort_cols(sort_cols, len(cols)),
-                              tok_cap)
-    num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
-        cols, doc_col, tok_cap, sort_cols)
+        num_docs=num_docs, sort_cols=sort_cols)
+    num_words, num_pairs, df, postings, unique_groups = sort_dedup_groups(
+        groups, doc_col, tok_cap, live_groups_for(sort_cols, width))
     return {
         # one 4-scalar array: ONE host sync fetches all counts (each
         # scalar fetched separately would pay the link RTT per scalar);
@@ -435,7 +589,8 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
                              num_tokens]),
         "df": df,                    # (tok_cap,) valid prefix num_words
         "postings": postings,        # (tok_cap,) valid prefix num_pairs
-        "unique_cols": unique_cols,  # width//4 x (tok_cap,) prefix num_words
+        # num_groups_for(width) x (hi, lo), valid prefix num_words
+        "unique_groups": unique_groups,
     }
 
 
@@ -503,6 +658,27 @@ def count_token_starts(buf: np.ndarray, ends: np.ndarray) -> int:
 def max_cleaned_token_len(buf: np.ndarray, ends: np.ndarray) -> int:
     """Exact max cleaned token length (see :func:`host_token_stats`)."""
     return host_token_stats(buf, ends)[1]
+
+
+def decode_word_groups(groups, width: int) -> np.ndarray:
+    """Fetched (hi, lo) 5-bit group pairs -> numpy 'S(width)' word
+    array — the host-side inverse of :func:`tokenize_groups`'s packing
+    (same layout as :func:`unpack_groups`, but in numpy at vocab
+    scale).  Padding rows must already be sliced off by the caller
+    (their codes decode to garbage), exactly as for
+    :func:`decode_word_rows`."""
+    u = np.asarray(groups[0][0]).shape[0]
+    out = np.zeros((u, width), np.uint8)
+    for g, (hi, lo) in enumerate(groups):
+        for half_idx, arr in ((0, hi), (1, lo)):
+            a = np.asarray(arr).astype(np.int64)
+            for k in range(6):
+                ch = 12 * g + 6 * half_idx + k
+                if ch >= width:
+                    break
+                code = (a >> (25 - 5 * k)) & 31
+                out[:, ch] = np.where(code > 0, code + 96, 0)
+    return np.ascontiguousarray(out).view(f"S{width}").reshape(u)
 
 
 def decode_word_rows(cols: list[np.ndarray], width: int) -> np.ndarray:

@@ -17,7 +17,7 @@ prove separately:
 
 Per window, as ONE ``shard_map`` program per chip:
 
-    rows   <- tokenize_rows(local byte shard) ► pack_groups
+    rows   <- tokenize_groups(local byte shard)   # 5-bit pairs direct
     recv   <- all_to_all(bucket(rows, mix32 % n))          # ICI
     acc_o  <- compact(unique(sort(acc_o ++ recv)))         # owner merge
 
@@ -59,11 +59,10 @@ from jax.sharding import Mesh
 from ..ops.device_streaming import _compact_rows, _row_first_mask, finalize_rows_body
 from ..ops.device_tokenizer import (
     INT32_MAX,
-    clamp_sort_cols,
     groups_sort_perm,
-    pack_groups,
-    tokenize_rows,
-    zero_tail_cols,
+    live_groups_for,
+    num_groups_for,
+    tokenize_groups,
 )
 from ..ops.segment import bucket_edges
 from ..utils.rounding import round_up
@@ -82,25 +81,21 @@ def _window_merge_body(acc_and_window, *, width: int, tok_cap: int,
     acc = acc_and_window[:nrows_acc]
     data_l, ends_l, ids_l = acc_and_window[nrows_acc:]
 
-    cols, doc_col, max_len, num_tokens = tokenize_rows(
+    groups_all, doc_col, max_len, num_tokens = tokenize_groups(
         data_l, ends_l, ids_l, width=width, tok_cap=tok_cap,
-        num_docs=num_docs)
-    nsort = clamp_sort_cols(sort_cols, len(cols))
-    cols = zero_tail_cols(cols, nsort, tok_cap)
-    groups = pack_groups(cols, nsort)
-    live = groups[:live_groups] if len(groups) >= live_groups else groups
+        num_docs=num_docs, sort_cols=sort_cols)
+    live = groups_all[:live_groups]
     send_rows = tuple(g for pair in live for g in pair) + (doc_col,)
     nrows = len(send_rows)
 
-    valid = cols[0] != INT32_MAX
+    valid = groups_all[0][0] != INT32_MAX
     # STABLE ownership across the whole stream: live_groups grows as
     # longer words appear, so the hash folds a FIXED number of columns
-    # (all num_groups pairs, un-exchanged tails as the constant zeros
-    # they provably are) — hashing only the live columns would re-home
-    # a word mid-stream and split its postings across owners
-    zero_tok = jnp.zeros(tok_cap, jnp.int32)
-    hash_cols = (tuple(g for pair in live for g in pair)
-                 + tuple([zero_tok] * (2 * (num_groups - len(live)))))
+    # (all num_groups pairs — tokenize_groups emits the un-exchanged
+    # tails as the constant zeros they provably are) — hashing only
+    # the live columns would re-home a word mid-stream and split its
+    # postings across owners
+    hash_cols = tuple(g for pair in groups_all for g in pair)
     owner = jnp.where(
         valid, (_mix32(hash_cols) % num_shards).astype(jnp.int32),
         num_shards)
@@ -187,21 +182,22 @@ def _build_regrow(mesh: Mesh, old_cap: int, new_cap: int, nrows: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_finalize(mesh: Mesh, cap: int, ncols: int, num_groups: int):
+def _build_finalize(mesh: Mesh, cap: int, num_groups: int):
     def body(*acc):
-        out = finalize_rows_body(acc, ncols=ncols, num_groups=num_groups)
+        out = finalize_rows_body(acc, num_groups=num_groups)
         return {
             "counts": out["counts"][None, :],  # (n, 2) once stacked
             "df": out["df"],
             "postings": out["postings"],
-            "unique_cols": out["unique_cols"],
+            "unique_groups": out["unique_groups"],
         }
 
     return jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=(shard_spec(),) * (2 * num_groups + 1),
         out_specs={"counts": shard_spec(), "df": shard_spec(),
                    "postings": shard_spec(),
-                   "unique_cols": (shard_spec(),) * ncols},
+                   "unique_groups": ((shard_spec(), shard_spec()),)
+                   * num_groups},
         check_vma=False,
     ))
 
@@ -216,7 +212,7 @@ class DistDeviceStreamEngine:
                  window_pad: int = 1 << 13,
                  initial_capacity: int = 1 << 15):
         self._width = width
-        self._num_groups = (width // 4 + 2) // 3
+        self._num_groups = num_groups_for(width)
         self._mesh = mesh
         self._n = mesh.devices.size
         self._window_pad = window_pad
@@ -266,8 +262,12 @@ class DistDeviceStreamEngine:
         if tok_count == 0:
             return
         self.max_word_len = max(self.max_word_len, max_len)
+        # sort_cols tracks the stream's RUNNING max length, so the
+        # window's live group count below equals self._live_groups --
+        # the exchange payload never carries zero pairs past it
         sort_cols = -(-max(self.max_word_len, 1) // 4)
-        self._live_groups = max(self._live_groups, (sort_cols + 2) // 3)
+        self._live_groups = max(self._live_groups,
+                                live_groups_for(sort_cols, self._width))
         tok_cap = round_up(tok_count + 1, self._window_pad)
         exchange_cap = default_capacity(tok_cap, self._n)
 
@@ -339,14 +339,13 @@ class DistDeviceStreamEngine:
                     f"device max word len {dev_max_len} != host "
                     f"{host_max_len}: classifier divergence (bug)")
         out = _build_finalize(
-            self._mesh, self._cap, self._width // 4,
-            self._num_groups)(*self._acc)
+            self._mesh, self._cap, self._num_groups)(*self._acc)
         self._acc = None
         self._window_checks = []
         # per-owner word/pair counts are bounded by the merge-observed
         # max per-owner unique count
         owners = fetch_owner_blocks(
-            out, mesh=self._mesh, local_len=self._cap,
+            out, mesh=self._mesh, local_len=self._cap, width=self._width,
             sort_cols=sort_cols, max_doc_id=max_doc_id,
             max_words=self._count, max_pairs=self._count, stats=stats)
         if stats is not None:

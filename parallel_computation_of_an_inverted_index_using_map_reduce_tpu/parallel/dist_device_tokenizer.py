@@ -6,8 +6,9 @@ multi chip}.  The single-chip all-device engine
 module removes the single-chip limit: each chip receives a contiguous
 doc range's raw bytes, tokenizes/cleans them locally with the SAME
 traceable stages, and one ``all_to_all`` exchanges whole word rows
-(13 int32 columns carried side by side) bucketed by a word-content
-hash, so every term is deduped/counted by exactly one owner — the
+(the live 5-bit (hi, lo) group halves + doc, carried side by side)
+bucketed by a word-content hash, so every term is deduped/counted by
+exactly one owner — the
 reference's reducer ownership (main.c:129-150) re-keyed from its
 ~1000x-skewed letters to a near-uniform hash, at the level of raw
 text rather than pre-tokenized pairs.
@@ -48,9 +49,10 @@ from jax.sharding import Mesh
 
 from ..ops.device_tokenizer import (
     INT32_MAX,
-    clamp_sort_cols,
-    sort_dedup_rows,
-    tokenize_rows,
+    live_groups_for,
+    num_groups_for,
+    sort_dedup_groups,
+    tokenize_groups,
 )
 from ..ops.segment import bucket_edges
 from ..utils.rounding import round_up as _round_up
@@ -73,19 +75,18 @@ def _mix32(cols):
 def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
           num_shards: int, capacity: int, sort_cols: int | None,
           owner_of_letter: tuple | None):
-    cols, doc_col, max_len, num_tokens = tokenize_rows(
+    groups, doc_col, max_len, num_tokens = tokenize_groups(
         data_l, ends_l, ids_l, width=width, tok_cap=tok_cap,
-        num_docs=num_docs)
-    ncols = len(cols)
-    nsort = clamp_sort_cols(sort_cols, ncols)
-    # columns past the host-exact sort_cols bound are all zero for
+        num_docs=num_docs, sort_cols=sort_cols)
+    live = live_groups_for(sort_cols, width)
+    # group pairs past the host-exact sort_cols bound are all zero for
     # every row (valid AND padding): don't build, exchange, or sort
     # them — XLA dead-code-eliminates their windowed gathers, and the
     # all_to_all payload shrinks proportionally
-    rows = (*cols[:nsort], doc_col)
+    rows = (*(h for pair in groups[:live] for h in pair), doc_col)
     nrows = len(rows)
 
-    valid = cols[0] != INT32_MAX
+    valid = groups[0][0] != INT32_MAX
     if owner_of_letter is None:  # near-uniform content-hash ownership
         dest = (_mix32(rows[:-1]) % num_shards).astype(jnp.int32)
     else:
@@ -94,8 +95,9 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
         # receives whole letters and can emit its own letter files
         # with no global merge — the multi-host emit mode.  Skewed by
         # construction (SURVEY.md §2.3); the provably-safe capacity
-        # retry absorbs it.
-        letter = ((cols[0] >> 24) & 0xFF) - ord("a")
+        # retry absorbs it.  First char's 5-bit code sits at group 0
+        # hi's top field (pad 0, a=1 .. z=26).
+        letter = ((groups[0][0] >> 25) & 31) - 1
         dest = jnp.asarray(np.asarray(owner_of_letter, np.int32))[
             jnp.clip(letter, 0, 25)]
     owner = jnp.where(valid, dest, num_shards)
@@ -119,12 +121,14 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
     recv = recv.reshape(num_shards, nrows, capacity)
     recv_rows = [recv[:, r, :].reshape(-1) for r in range(nrows)]
 
-    # un-exchanged tail columns are reconstructed as the constants they
-    # provably are (same zeros-splice contract as zero_tail_cols)
+    # un-exchanged tail group pairs are reconstructed as the constants
+    # they provably are (tokenize_groups' zero-tail contract)
     zero = jnp.zeros(num_shards * capacity, jnp.int32)
-    recv_cols = (*recv_rows[:-1], *([zero] * (ncols - nsort)))
-    num_words, num_pairs, df, postings, unique_cols = sort_dedup_rows(
-        recv_cols, recv_rows[-1], num_shards * capacity, nsort)
+    recv_groups = tuple(
+        [(recv_rows[2 * g], recv_rows[2 * g + 1]) for g in range(live)]
+        + [(zero, zero)] * (num_groups_for(width) - live))
+    num_words, num_pairs, df, postings, unique_groups = sort_dedup_groups(
+        recv_groups, recv_rows[-1], num_shards * capacity, live)
     return {
         # per-owner counts, sharded (n, 2) once stacked over the mesh
         "counts": jnp.stack([num_words, num_pairs])[None, :],
@@ -142,7 +146,7 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
         ]),
         "df": df,
         "postings": postings,
-        "unique_cols": unique_cols,
+        "unique_groups": unique_groups,
     }
 
 
@@ -160,26 +164,28 @@ def _build(mesh: Mesh, width: int, tok_cap: int, num_docs: int,
         in_specs=(shard_spec(),) * 3,
         out_specs={"counts": shard_spec(), "globals": replicated_spec(),
                    "df": shard_spec(), "postings": shard_spec(),
-                   "unique_cols": (shard_spec(),) * (width // 4)},
+                   "unique_groups": ((shard_spec(), shard_spec()),)
+                   * num_groups_for(width)},
         check_vma=False,
     ))
 
 
 @functools.lru_cache(maxsize=32)
 def _build_prefix_slice(mesh: Mesh, nu: int, npairs: int,
-                        ncols_fetch: int, narrow: bool):
+                        nhalves_fetch: int, narrow: bool):
     """Per-owner valid-prefix slice (+ optional uint16 narrowing),
     device side, so the D2H transfer tracks unique counts — the fetch
-    discipline of dist_engine._dist_prov_exchange (VERDICT r1 #7)."""
-    def body(df, postings, *cols):
+    discipline of dist_engine._dist_prov_exchange (VERDICT r1 #7).
+    ``nhalves_fetch``: flat (hi, lo) group halves riding down."""
+    def body(df, postings, *halves):
         dfp, pp = df[:nu], postings[:npairs]
         if narrow:
             dfp, pp = dfp.astype(jnp.uint16), pp.astype(jnp.uint16)
-        return (dfp, pp, *(c[:nu] for c in cols))
+        return (dfp, pp, *(h[:nu] for h in halves))
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(shard_spec(),) * (2 + ncols_fetch),
-        out_specs=(shard_spec(),) * (2 + ncols_fetch),
+        in_specs=(shard_spec(),) * (2 + nhalves_fetch),
+        out_specs=(shard_spec(),) * (2 + nhalves_fetch),
         check_vma=False,
     ))
 
@@ -205,7 +211,7 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     length — padding spaces produce no tokens).  ``tok_cap``: per-shard
     token capacity (callers bound it exactly per shard and take the
     max).  Returns ``(owners, globals)`` where ``owners`` maps owner ->
-    dict(num_words, num_pairs, df, postings, unique_cols) with valid
+    dict(num_words, num_pairs, df, postings, unique_groups) with valid
     prefixes already cut, and ``globals`` is ``(max_word_len,
     exchange_retries)``.
 
@@ -257,16 +263,16 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
     # counts array is device-sharded; a whole-array np.asarray would
     # need every shard addressable and break multi-controller)
     owners = fetch_owner_blocks(
-        out, mesh=mesh, local_len=n * capacity, sort_cols=sort_cols,
-        max_doc_id=max_doc_id, max_words=int(g[3]), max_pairs=int(g[4]),
-        stats=stats)
+        out, mesh=mesh, local_len=n * capacity, width=width,
+        sort_cols=sort_cols, max_doc_id=max_doc_id, max_words=int(g[3]),
+        max_pairs=int(g[4]), stats=stats)
     if stats is not None:
         stats["exchange_retries"] = retries
         stats["exchange_capacity"] = capacity
     return owners, (max_len, retries)
 
 
-def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int,
+def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int, width: int,
                        sort_cols: int | None, max_doc_id: int | None,
                        max_words: int, max_pairs: int,
                        stats: dict | None = None):
@@ -274,27 +280,30 @@ def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int,
     tail of the mesh device engines (one-shot and streaming).
 
     ``out`` must carry device-sharded ``counts`` ((n, 2): words, pairs
-    per owner), ``df``, ``postings`` and ``unique_cols``;
+    per owner), ``df``, ``postings`` and ``unique_groups``;
     ``max_words`` / ``max_pairs`` are the device-REPLICATED per-owner
     maxima (identical prefix-slice shapes on every process).  Fetched
     bytes track unique counts, not the overprovisioned capacity;
-    columns past ``sort_cols`` are provably all zero (decode restores
-    the zero padding for free) and df/postings ride down as uint16
-    when doc ids fit.
+    group pairs past ``sort_cols`` are provably all zero (decode
+    restores the zero padding for free) and df/postings ride down as
+    uint16 when doc ids fit.
     """
     counts = {
         (s.index[0].start or 0): np.asarray(s.data).reshape(2)
         for s in out["counts"].addressable_shards
     }
-    ncols_fetch = clamp_sort_cols(sort_cols, len(out["unique_cols"]))
+    ngroups_fetch = min(len(out["unique_groups"]),
+                        live_groups_for(sort_cols, width))
     narrow = max_doc_id is not None and max_doc_id < (1 << 16)
     # 1k granule: tight enough that fetched bytes track the max owner's
     # unique counts, coarse enough that slice programs reuse across
     # similar corpora
     nu = min(local_len, _round_up(max(max_words, 1), 1 << 10))
     npairs = min(local_len, _round_up(max(max_pairs, 1), 1 << 10))
-    sliced = _build_prefix_slice(mesh, nu, npairs, ncols_fetch, narrow)(
-        out["df"], out["postings"], *out["unique_cols"][:ncols_fetch])
+    halves = [h for pair in out["unique_groups"][:ngroups_fetch]
+              for h in pair]
+    sliced = _build_prefix_slice(mesh, nu, npairs, len(halves), narrow)(
+        out["df"], out["postings"], *halves)
     for arr in sliced:
         for s in arr.addressable_shards:
             s.data.copy_to_host_async()
@@ -308,16 +317,19 @@ def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int,
 
     df_sh = _per_owner(sliced[0], nu)
     post_sh = _per_owner(sliced[1], npairs)
-    cols_sh = [_per_owner(c, nu) for c in sliced[2:]]
+    halves_sh = [_per_owner(h, nu) for h in sliced[2:]]
     for o, cnt in counts.items():
         num_words, num_pairs = int(cnt[0]), int(cnt[1])
         fetched += df_sh[o].nbytes + post_sh[o].nbytes \
-            + sum(c[o].nbytes for c in cols_sh)
+            + sum(h[o].nbytes for h in halves_sh)
         owners[o] = {
             "num_words": num_words, "num_pairs": num_pairs,
             "df": df_sh[o][:num_words].astype(np.int32),
             "postings": post_sh[o][:num_pairs].astype(np.int32),
-            "unique_cols": [c[o][:num_words] for c in cols_sh],
+            "unique_groups": [
+                (halves_sh[2 * g][o][:num_words],
+                 halves_sh[2 * g + 1][o][:num_words])
+                for g in range(ngroups_fetch)],
         }
     if stats is not None:
         stats["dist_fetched_bytes"] = fetched

@@ -296,8 +296,58 @@ def test_sort_cols_pass_skipping_is_exact(tmp_path):
         sort_cols=-(-max_len // 4))
     for k in ("counts", "df", "postings"):
         np.testing.assert_array_equal(np.asarray(full[k]), np.asarray(skip[k]))
-    for a, b in zip(full["unique_cols"], skip["unique_cols"]):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for (ah, al), (bh, bl) in zip(full["unique_groups"],
+                                  skip["unique_groups"]):
+        np.testing.assert_array_equal(np.asarray(ah), np.asarray(bh))
+        np.testing.assert_array_equal(np.asarray(al), np.asarray(bl))
+
+
+@pytest.mark.parametrize("width", [40, 48, 64])
+@pytest.mark.parametrize("docs", [
+    [b"don't foo-bar x1y2z3 I.Loomings tail42", b"", b"  42 ",
+     b"pack my box with five dozen liquor jugs"],
+    [b"supercalifragilisticexpialidocious antidisestablishmentarianism",
+     b"zz top zz top aa"],
+    # 39- and 37-letter words reach into the partial last group at
+    # width 40 (chars 36-39 of a 36..41 window)
+    [b"a" * 39 + b" zz " + b"q" * 37, b"mid"],
+])
+def test_tokenize_groups_matches_pack_of_tokenize_rows(docs, width):
+    """The 5-bit group frontend must emit EXACTLY
+    pack_groups(tokenize_rows(x)) padded with zero pairs — the
+    property that lets tokenize_rows stand as the directly-
+    byte-addressed reference implementation.  Widths 40 and 64 are NOT
+    multiples of 12, so the last group's window reaches past the row
+    and the width cap in tokenize_groups' mask is what keeps the two
+    frontends identical there."""
+    import jax
+
+    buf, ends = _pad_concat(docs)
+    ids = np.arange(1, len(docs) + 1, dtype=np.int32)
+    kw = dict(width=width, tok_cap=256, num_docs=len(docs))
+    args = (jax.device_put(buf), jax.device_put(ends), jax.device_put(ids))
+    max_len = DT.max_cleaned_token_len(buf, ends)
+    sort_cols = -(-max_len // 4)
+
+    cols, doc_r, len_r, cnt_r = jax.jit(
+        lambda *a: DT.tokenize_rows(*a, **kw))(*args)
+    nsort = DT.clamp_sort_cols(sort_cols, len(cols))
+    ref_groups = DT.pack_groups(
+        DT.zero_tail_cols(cols, nsort, 256), nsort)
+
+    groups, doc_g, len_g, cnt_g = jax.jit(
+        lambda *a: DT.tokenize_groups(*a, **kw, sort_cols=sort_cols))(*args)
+    assert len(groups) == DT.num_groups_for(width)
+    assert int(len_r) == int(len_g)
+    assert int(cnt_r) == int(cnt_g)
+    np.testing.assert_array_equal(np.asarray(doc_r), np.asarray(doc_g))
+    for g, (hi, lo) in enumerate(groups):
+        if g < len(ref_groups):
+            eh, el = ref_groups[g]
+        else:
+            eh = el = np.zeros(256, np.int32)
+        np.testing.assert_array_equal(np.asarray(hi), np.asarray(eh))
+        np.testing.assert_array_equal(np.asarray(lo), np.asarray(el))
 
 
 def test_device_program_has_no_token_scale_scatter():

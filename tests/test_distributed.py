@@ -283,7 +283,7 @@ DEVTOK_WORKER = textwrap.dedent("""
 
     import pathlib
     for o, ow in owners.items():
-        words = DT.decode_word_rows(ow["unique_cols"], width)
+        words = DT.decode_word_groups(ow["unique_groups"], width)
         np.savez(pathlib.Path(out_dir) / f"owner{o}.npz",
                  words=words, df=ow["df"], postings=ow["postings"])
     print(f"proc {pid} fetched owners {got}", flush=True)
@@ -469,7 +469,7 @@ DEVSTREAM_WORKER = textwrap.dedent("""
 
     import pathlib
     for o, ow in owners.items():
-        words = DT.decode_word_rows(ow["unique_cols"], width)
+        words = DT.decode_word_groups(ow["unique_groups"], width)
         np.savez(pathlib.Path(out_dir) / f"owner{o}.npz",
                  words=words, df=ow["df"], postings=ow["postings"])
     print(f"proc {pid} stream owners {got} windows {eng.windows_fed} "
