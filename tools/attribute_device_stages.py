@@ -11,13 +11,14 @@ reduction epsilon cancel.
 
     python tools/attribute_device_stages.py [--corpus DIR] [--platform cpu]
 
-Cuts:
-  tokenize     tokenize_rows complete (all columns + doc col forced)
-  perm         + pack_groups + groups_sort_perm (the LSD radix passes)
-  gather       + s_cols/s_docs row gathers
-  masks        + boundary masks, ranks, counts (cumsum at token scale)
-  full         + W/P compactions, df, postings, unique_cols (the whole
-               index_bytes_device, its real counts fetch)
+Cuts (the production group pipeline, mirrored stage by stage):
+  tokenize     tokenize_groups complete (5-bit group pairs + doc col
+               forced; includes the windowed packing gathers)
+  perm         + groups_sort_perm over the live pairs (LSD radix)
+  gather       + s_groups/s_docs row gathers
+  masks        + boundary masks, pair-rank cumsum, counts
+  full         + W/P set-bit compactions, df, postings, unique_groups
+               (the whole index_bytes_device, its real counts fetch)
 """
 
 from __future__ import annotations
@@ -101,38 +102,37 @@ def main() -> int:
     def upto(stage):
         @jax.jit
         def run(data, doc_ends, ids):
-            cols, doc_col, max_word_len, num_tokens = DT.tokenize_rows(
+            # mirrors index_bytes_device's group pipeline stage by stage
+            groups, doc_col, max_word_len, num_tokens = DT.tokenize_groups(
                 data, doc_ends, ids, width=width, tok_cap=tok_cap,
-                num_docs=num_docs)
-            cols = DT.zero_tail_cols(
-                cols, DT.clamp_sort_cols(sort_cols, len(cols)), tok_cap)
+                num_docs=num_docs, sort_cols=sort_cols)
             if stage == "tokenize":
-                acc = sum(jnp.sum(c) for c in cols) + jnp.sum(doc_col)
-                return acc + max_word_len + num_tokens
-            nsort = DT.clamp_sort_cols(sort_cols, len(cols))
-            groups = DT.pack_groups(cols, nsort)
-            perm = DT.groups_sort_perm(groups, doc_col, tok_cap)
+                acc = sum(jnp.sum(h) + jnp.sum(l) for h, l in groups)
+                return acc + jnp.sum(doc_col) + max_word_len + num_tokens
+            live = DT.live_groups_for(sort_cols, width)
+            live_pairs = list(groups[:max(1, live)])
+            perm = DT.groups_sort_perm(live_pairs, doc_col, tok_cap)
             if stage == "perm":
                 return jnp.sum(perm) + max_word_len
-            s_cols = tuple(c[perm] for c in cols)
+            s_groups = [(hi[perm], lo[perm]) for hi, lo in live_pairs]
             s_docs = doc_col[perm]
             if stage == "gather":
-                return (sum(jnp.sum(c) for c in s_cols)
+                return (sum(jnp.sum(h) + jnp.sum(l) for h, l in s_groups)
                         + jnp.sum(s_docs) + max_word_len)
             INT32_MAX = DT.INT32_MAX
-            word_valid = s_cols[0] != INT32_MAX
+            word_valid = s_groups[0][0] != INT32_MAX
 
             def neq_prev(a):
                 return jnp.concatenate(
                     [jnp.ones(1, jnp.bool_), a[1:] != a[:-1]])
 
             first_word = word_valid & functools.reduce(
-                jnp.logical_or, (neq_prev(c) for c in s_cols))
+                jnp.logical_or,
+                (neq_prev(h) for pair in s_groups for h in pair))
             first_pair = word_valid & (first_word | neq_prev(s_docs))
-            word_rank = jnp.cumsum(first_word.astype(jnp.int32)) - 1
             pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
             if stage == "masks":
-                return (jnp.sum(word_rank[-1:]) + jnp.sum(pair_rank[-1:])
+                return (jnp.sum(pair_rank[-1:])
                         + jnp.sum(first_word.astype(jnp.int32))
                         + max_word_len)
             raise AssertionError(stage)

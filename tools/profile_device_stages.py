@@ -10,15 +10,21 @@ every loop closes with a real host fetch of a tiny output).
     python tools/profile_device_stages.py [--corpus DIR] [--platform cpu]
 
 Stages (all on the real corpus's shapes):
-  full            index_bytes_device end to end
-  tokenize_rows   map phase only (byte scans, letter-compaction sort,
-                  windowed gathers)
-  sort_dedup      reduce phase only, on tokenize_rows' materialized
-                  output (pack -> LSD passes -> boundary masks -> ranks)
-  micro-ops       the individual primitives: the n-element letter-
-                  compaction lax.sort, one 3-key and one 2-key stable
-                  sort at tok_cap, the (cap+1)-point searchsorted, and
-                  a cumsum over n — lets the stage costs be attributed.
+  full             index_bytes_device end to end
+  tokenize_groups  map phase only (byte scans, letter-compaction sort,
+                   windowed 5-bit group packing gathers)
+  sort_dedup       reduce phase only (sort_dedup_groups on
+                   tokenize_groups' materialized output: LSD passes ->
+                   boundary masks -> set-bit compactions)
+  micro-ops        the individual primitives: the n-element letter-
+                   compaction lax.sort, one 3-key and one 2-key stable
+                   sort at tok_cap, the (cap+1)-point searchsorted, and
+                   a cumsum over n — lets the stage costs be attributed
+                   (CAVEAT: each stands alone in its own dispatch, so
+                   anything under the tunnel's per-dispatch floor
+                   (~60 ms some hours) is unmeasurable here — trust the
+                   truncated-cut deltas of attribute_device_stages.py
+                   for intra-program attribution).
 
 Caveat shared with measure_tpu.py: absolute numbers include one link
 round-trip (~6.5 ms floor measured round 3); comparisons within one
@@ -119,22 +125,22 @@ def main() -> int:
         data, ends_d, ids_d, reps=args.reps)
     print(json.dumps({"stage": "full", "ms": lines["full"]}), flush=True)
 
-    tok_jit = jax.jit(partial(DT.tokenize_rows, width=width,
-                              tok_cap=tok_cap, num_docs=num_docs))
-    lines["tokenize_rows"] = timed(tok_jit, data, ends_d, ids_d,
-                                   reps=args.reps)
-    print(json.dumps({"stage": "tokenize_rows",
-                      "ms": lines["tokenize_rows"]}), flush=True)
+    tok_jit = jax.jit(partial(DT.tokenize_groups, width=width,
+                              tok_cap=tok_cap, num_docs=num_docs,
+                              sort_cols=sort_cols))
+    lines["tokenize_groups"] = timed(tok_jit, data, ends_d, ids_d,
+                                     reps=args.reps)
+    print(json.dumps({"stage": "tokenize_groups",
+                      "ms": lines["tokenize_groups"]}), flush=True)
 
-    cols, doc_col, _, _ = tok_jit(data, ends_d, ids_d)
-    cols = DT.zero_tail_cols(cols, DT.clamp_sort_cols(sort_cols, len(cols)),
-                             tok_cap)
-    cols = tuple(jax.device_put(np.asarray(c)) for c in cols)
+    groups, doc_col, _, _ = tok_jit(data, ends_d, ids_d)
+    groups = tuple((jax.device_put(np.asarray(h)),
+                    jax.device_put(np.asarray(l))) for h, l in groups)
     doc_col = jax.device_put(np.asarray(doc_col))
 
-    sd_jit = jax.jit(partial(DT.sort_dedup_rows, cap=tok_cap,
-                             sort_cols=sort_cols))
-    lines["sort_dedup"] = timed(sd_jit, cols, doc_col, reps=args.reps)
+    sd_jit = jax.jit(partial(DT.sort_dedup_groups, cap=tok_cap,
+                             live=DT.live_groups_for(sort_cols, width)))
+    lines["sort_dedup"] = timed(sd_jit, groups, doc_col, reps=args.reps)
     print(json.dumps({"stage": "sort_dedup", "ms": lines["sort_dedup"]}),
           flush=True)
 
