@@ -12,7 +12,7 @@ blockwise-accumulator discipline as the integer-pair streaming engine
 "device scan" column of the engine matrix gets the same
 larger-than-HBM story the host-scan engines have:
 
-    per window:  rows  <- tokenize_rows ► pack_groups ► sort ► dedup
+    per window:  rows  <- tokenize_groups ► sort ► dedup
                  acc   <- unique(merge_sort(acc, rows))
 
 as fused XLA programs with static shapes and NO device->host sync in

@@ -410,7 +410,8 @@ def groups_sort_perm(groups, doc_col, cap: int):
     of the most-minor group's pass (perm starts as the identity so the
     first pass gathers nothing), then one 2-key stable pass per
     remaining group.  Wide comparators blow up TPU AOT compile time
-    (~80x, see :func:`sort_dedup_rows`); 2-3-key ones are cheap."""
+    (~80x — measured: 1403 s AOT-compiling a 13-key comparator sort vs
+    17.8 s for 13 single-key passes at 2^21); 2-3-key ones are cheap."""
     perm = jnp.arange(cap, dtype=jnp.int32)
     hi, lo = groups[-1]
     _, _, _, perm = lax.sort((hi, lo, doc_col, perm), num_keys=3,
@@ -421,83 +422,15 @@ def groups_sort_perm(groups, doc_col, cap: int):
     return perm
 
 
-def sort_dedup_rows(cols, doc_col, cap: int, sort_cols: int | None = None):
-    """Sorted/deduped index from word-row columns (device, traceable).
-
-    The reduce stage shared by both device engines: lexicographic
-    (word columns…, doc) order via LSD radix — one stable doc pass,
-    then one 2-key stable pass per 12-char group of 5-bit-compressed
-    codes (see below).  Identical result to one variadic comparator
-    sort, but the TPU AOT compiler takes ~80x longer on the wide
-    comparator (measured: 1403 s for a 13-key sort vs 17.8 s for 13
-    single-key passes at 2^21; narrow 2-3-key comparators compile
-    fine).  INT32_MAX rows (padding / empty) sort last and are dropped
-    by the validity mask.
-    """
-    ncols = len(cols)
-    col0 = cols[0]
-    # sort_cols: statically known number of leading columns that can be
-    # non-constant (callers pass ceil(max_cleaned_token_len / 4)).
-    # Columns past it are all zero for every row, and a stable pass
-    # over a constant key is the identity — skip those passes outright.
-    nsort = clamp_sort_cols(sort_cols, ncols)
-
-    groups = pack_groups(cols, nsort)
-    perm = groups_sort_perm(groups, doc_col, cap)
-    s_cols = tuple(c[perm] for c in cols)
-    s_docs = doc_col[perm]
-
-    def neq_prev(a):
-        return jnp.concatenate(
-            [jnp.ones(1, jnp.bool_), a[1:] != a[:-1]])
-
-    word_valid = s_cols[0] != INT32_MAX
-    first_word = word_valid & functools.reduce(
-        jnp.logical_or, (neq_prev(c) for c in s_cols))
-    first_pair = word_valid & (first_word | neq_prev(s_docs))
-
-    num_words = first_word.sum(dtype=jnp.int32)
-    num_pairs = first_pair.sum(dtype=jnp.int32)
-
-    # Compaction WITHOUT scatters (TPU scatter is a serial per-update
-    # loop — see module docstring): the position of the w-th
-    # first-word / p-th first-pair comes from the shared set-bit sort
-    # (segment.set_bit_positions), and every "compact" is then a plain
-    # gather.  Cheaper than the equivalent searchsorted over the rank
-    # cumsum: one cap-sized 1-key sort vs two 2·cap-sized argsorts.
-    pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
-    slots = jnp.arange(cap, dtype=jnp.int32)
-    # W[w] = sorted-array position of word w (cap where w >= num_words;
-    # W[cap] == cap so the df difference below can always read W[w+1])
-    W = jnp.concatenate([
-        jnp.minimum(segment.set_bit_positions(first_word, cap), cap),
-        jnp.full(1, cap, jnp.int32)])
-    P = jnp.minimum(segment.set_bit_positions(first_pair, cap), cap)
-    word_live = slots < num_words
-    pair_live = slots < num_pairs
-    Wg = jnp.clip(W[:-1], 0, cap - 1).astype(jnp.int32)
-    Pg = jnp.clip(P, 0, cap - 1).astype(jnp.int32)
-
-    # df[w] = unique pairs inside word w's run = exclusive-pair-count
-    # difference at consecutive word starts (main.c:176-187's per-word
-    # counter, without the dictionary)
-    pair_excl = jnp.concatenate(
-        [pair_rank + 1 - first_pair.astype(jnp.int32),
-         jnp.full(1, num_pairs, jnp.int32)])
-    df = jnp.where(
-        word_live, pair_excl[jnp.minimum(W[1:], cap)] - pair_excl[Wg], 0)
-    postings = jnp.where(pair_live, s_docs[Pg], 0)
-    unique_cols = tuple(
-        jnp.where(word_live, c[Wg], 0) for c in s_cols)
-    return num_words, num_pairs, df, postings, unique_cols
-
-
 def sort_dedup_groups(groups, doc_col, cap: int, live: int):
     """Sorted/deduped index from 5-bit group pairs (device, traceable).
 
-    :func:`sort_dedup_rows`'s reduce stage operating natively on the
-    compressed representation :func:`tokenize_groups` emits — no byte
-    columns ever materialize at token scale.  ``live``: group pairs
+    The reduce stage, operating natively on the compressed
+    representation :func:`tokenize_groups` emits — no byte columns
+    ever materialize at token scale.  Lexicographic ((group pairs…),
+    doc) order via the LSD radix passes of :func:`groups_sort_perm`;
+    INT32_MAX rows (padding / empty) sort last and are dropped by the
+    validity mask.  ``live``: group pairs
     that can be non-constant (:func:`live_groups_for`); constant-zero
     tail pairs are excluded from the radix passes (a stable pass over
     a constant key is the identity) and returned as zeros.
@@ -524,7 +457,9 @@ def sort_dedup_groups(groups, doc_col, cap: int, live: int):
     num_pairs = first_pair.sum(dtype=jnp.int32)
 
     # Compaction WITHOUT scatters: the shared set-bit sort
-    # (segment.set_bit_positions) — see sort_dedup_rows.
+    # (segment.set_bit_positions) — one cap-sized 1-key sort per
+    # compaction, cheaper than the rank-cumsum searchsorted it
+    # replaced (round 3 on-chip).
     pair_rank = jnp.cumsum(first_pair.astype(jnp.int32)) - 1
     slots = jnp.arange(cap, dtype=jnp.int32)
     W = jnp.concatenate([
@@ -666,7 +601,8 @@ def decode_word_groups(groups, width: int) -> np.ndarray:
     (same layout as :func:`unpack_groups`, but in numpy at vocab
     scale).  Padding rows must already be sliced off by the caller
     (their codes decode to garbage), exactly as for
-    :func:`decode_word_rows`."""
+the
+    valid prefix contract of the engines' fetch tails."""
     u = np.asarray(groups[0][0]).shape[0]
     out = np.zeros((u, width), np.uint8)
     for g, (hi, lo) in enumerate(groups):
@@ -678,21 +614,4 @@ def decode_word_groups(groups, width: int) -> np.ndarray:
                     break
                 code = (a >> (25 - 5 * k)) & 31
                 out[:, ch] = np.where(code > 0, code + 96, 0)
-    return np.ascontiguousarray(out).view(f"S{width}").reshape(u)
-
-
-def decode_word_rows(cols: list[np.ndarray], width: int) -> np.ndarray:
-    """Fetched big-endian int32 columns -> numpy 'S(width)' word array.
-
-    Column 0 of row 0..U-1 had INT32_MAX replaced only for padding rows,
-    which the caller already sliced off, so a plain byte-reassembly is
-    exact."""
-    u = cols[0].shape[0]
-    out = np.zeros((u, width), np.uint8)
-    for c, col in enumerate(cols):
-        col = col.astype(np.uint32)
-        out[:, 4 * c + 0] = (col >> 24) & 0xFF
-        out[:, 4 * c + 1] = (col >> 16) & 0xFF
-        out[:, 4 * c + 2] = (col >> 8) & 0xFF
-        out[:, 4 * c + 3] = col & 0xFF
     return np.ascontiguousarray(out).view(f"S{width}").reshape(u)

@@ -15,10 +15,10 @@ text rather than pre-tokenized pairs.
 
 Per chip, as one ``shard_map`` program:
 
-    rows   <- tokenize_rows(bytes_shard)            # local scans/scatter
+    rows   <- tokenize_groups(bytes_shard)          # local scans/sorts
     owner  <- mix32(word columns) % n
-    recv   <- all_to_all(bucket(rows, owner))       # ICI, 13 columns
-    index  <- sort_dedup_rows(recv)                 # owner-side radix
+    recv   <- all_to_all(bucket(rows, owner))       # ICI, 2*live+1 rows
+    index  <- sort_dedup_groups(recv)               # owner-side radix
 
 Static exchange capacity with a provably-safe overflow retry
 (psum-reduced flag), the same discipline as the integer-pair engines

@@ -379,8 +379,10 @@ def test_device_program_has_no_token_scale_scatter():
         "stay sort/gather/searchsorted formulations")
 
 
-def test_decode_word_rows_roundtrip():
-    words = [b"cat", b"aardvark", b"z" * 12]
+def test_decode_word_groups_roundtrip():
+    """Host decoder vs pack_groups on hand-built byte columns: the
+    5-bit group pairs must decode back to the original words."""
+    words = [b"cat", b"aardvark", b"z" * 12, b"q" * 16]
     width = 16
     rows = np.zeros((len(words), width), np.uint8)
     for i, w in enumerate(words):
@@ -391,7 +393,11 @@ def test_decode_word_rows_roundtrip():
          | (r32[:, c, 2] << 8) | r32[:, c, 3]).astype(np.int32)
         for c in range(width // 4)
     ]
-    decoded = DT.decode_word_rows(cols, width)
+    import jax.numpy as jnp
+
+    groups = DT.pack_groups([jnp.asarray(c) for c in cols], width // 4)
+    decoded = DT.decode_word_groups(
+        [(np.asarray(h), np.asarray(l)) for h, l in groups], width)
     assert [w.rstrip(b"\x00") for w in decoded.tolist()] == words
 
 

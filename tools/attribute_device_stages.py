@@ -76,9 +76,6 @@ def main() -> int:
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
         device_tokenizer as DT,
     )
-    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
-        segment,
-    )
 
     cfg = IndexConfig(output_dir="/tmp/ads_out", backend="tpu",
                       device_tokenize=True)
