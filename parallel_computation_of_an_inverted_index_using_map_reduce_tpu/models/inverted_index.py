@@ -761,7 +761,7 @@ class InvertedIndexModel:
                     width=width, tok_cap=tok_cap, num_docs=num_docs,
                     sort_cols=sort_cols)
             with timer.phase("device_index"):
-                num_words, num_pairs, max_len, num_tokens = (
+                num_words, num_pairs, max_len, num_tokens, num_long = (
                     int(v) for v in np.asarray(out["counts"]))
                 if num_tokens + 1 > tok_cap:
                     raise AssertionError(
@@ -784,23 +784,25 @@ class InvertedIndexModel:
         timer.count("tokens", num_pairs)
         return self._fetch_decode_emit_device(
             out, cap=tok_cap, num_words=num_words, num_pairs=num_pairs,
-            sort_cols=sort_cols, max_doc_id=max_doc_id, out_dir=out_dir,
-            timer=timer)
+            num_long=num_long, sort_cols=sort_cols, max_doc_id=max_doc_id,
+            out_dir=out_dir, timer=timer)
 
     def _fetch_decode_emit_device(self, out, *, cap: int, num_words: int,
-                                  num_pairs: int, sort_cols: int,
-                                  max_doc_id: int, out_dir: str,
+                                  num_pairs: int, num_long: int,
+                                  sort_cols: int, max_doc_id: int,
+                                  out_dir: str,
                                   timer: PhaseTimer) -> dict:
         """Shared tail of the single-chip device engines (one-shot and
         streaming): prefix-slice fetch with transfer trimming, word-row
         decode, and the letter-file emit.
 
-        Transfer trimming: group pairs past the host-exact
-        ``sort_cols`` bound are provably all zero and decode as zero
-        padding for free (2 int32 ride down per 12 chars — the 5-bit
-        compressed rows, decoded at vocab scale on host); df/postings
-        values are <= max_doc_id, so they ride down as uint16 whenever
-        doc ids fit.  Every prefix slice is dispatched before any is
+        Transfer trimming (DT.fetch_pack, ONE jitted prep program so
+        the tunnel pays one dispatch): group pairs past the host-exact
+        ``sort_cols`` bound are provably all zero; tail groups ride
+        SPARSELY (indices + values for only the >12-char words, the
+        dense arrays rebuilt by host scatter at vocab scale); postings
+        pack 3 doc ids per int32 when ids fit 10 bits, else uint16
+        when they fit 16.  Every transfer is dispatched before any is
         materialized — sequential fetches would each pay the link's
         fixed RTT.
         """
@@ -817,25 +819,32 @@ class InvertedIndexModel:
             npairs = min(cap, _round_up(max(num_pairs, 1), 1 << 13))
             ngroups_fetch = DT.live_groups_for(sort_cols, width)
             narrow = max_doc_id < (1 << 16)
-            df_d = out["df"][:nu]
-            post_d = out["postings"][:npairs]
-            if narrow:
-                df_d = df_d.astype(jnp.uint16)
-                post_d = post_d.astype(jnp.uint16)
-            halves_d = [h[:nu]
-                        for pair in out["unique_groups"][:ngroups_fetch]
-                        for h in pair]
-            for a in (df_d, post_d, *halves_d):
+            k = DT.doc_pack_width(max_doc_id)
+            nlong = (min(nu, _round_up(num_long, 1 << 10))
+                     if ngroups_fetch > 1 and num_long else 0)
+            packed = DT.fetch_pack(out, nu=nu, npairs=npairs,
+                                   nlong=nlong, k=k, live=ngroups_fetch,
+                                   narrow=narrow)
+            leaves = jax.tree_util.tree_leaves(packed)
+            for a in leaves:
                 a.copy_to_host_async()
-            df = np.asarray(df_d)[:num_words].astype(np.int32)
-            halves = [np.asarray(h)[:num_words] for h in halves_d]
-            groups = [(halves[2 * g], halves[2 * g + 1])
-                      for g in range(ngroups_fetch)]
-            postings = np.asarray(post_d)[:num_pairs].astype(np.int32)
-            timer.count(
-                "fetched_bytes",
-                df_d.nbytes + post_d.nbytes
-                + sum(h.nbytes for h in halves_d))
+            df = np.asarray(packed["df"])[:num_words].astype(np.int32)
+            postings = DT.unpack_postings(packed["post"], num_pairs, k)
+            g0 = tuple(np.asarray(h)[:num_words] for h in packed["g0"])
+            groups = [g0]
+            zero = np.zeros(num_words, np.int32)
+            if nlong:
+                idx = np.asarray(packed["long_idx"])[:num_long]
+                for th, tl in packed["tail"]:
+                    h = zero.copy()
+                    l = zero.copy()
+                    h[idx] = np.asarray(th)[:num_long]
+                    l[idx] = np.asarray(tl)[:num_long]
+                    groups.append((h, l))
+            else:
+                groups.extend(
+                    (zero, zero) for _ in range(ngroups_fetch - 1))
+            timer.count("fetched_bytes", sum(a.nbytes for a in leaves))
         with timer.phase("host_views"):
             vocab = DT.decode_word_groups(groups, width)
             letters = vocab.view(np.uint8).reshape(num_words, width)[:, 0] - ord("a")
@@ -906,13 +915,14 @@ class InvertedIndexModel:
 
         with timer.phase("device_index"):
             out = engine_s.finalize()
-            num_words, num_pairs = (int(v) for v in np.asarray(out["counts"]))
+            num_words, num_pairs, num_long = (
+                int(v) for v in np.asarray(out["counts"]))
         timer.count("unique_terms", num_words)
         timer.count("unique_pairs", num_pairs)
         timer.count("tokens", fed_tokens)
         return self._fetch_decode_emit_device(
             out, cap=int(out["df"].shape[0]), num_words=num_words,
-            num_pairs=num_pairs, sort_cols=sort_cols,
+            num_pairs=num_pairs, num_long=num_long, sort_cols=sort_cols,
             max_doc_id=max_doc_id, out_dir=out_dir, timer=timer)
 
     def _run_tpu_device_tokenize_dist(self, manifest: Manifest, out_dir: str,

@@ -170,8 +170,12 @@ def finalize_rows_body(acc, *, num_groups: int):
     groups = [(jnp.where(word_live, acc[2 * g][Wg], 0),
                jnp.where(word_live, acc[2 * g + 1][Wg], 0))
               for g in range(num_groups)]
+    # >12-char word count so the sparse tail-group fetch can size its
+    # transfer (device_tokenizer.fetch_pack contract)
+    num_long = ((word_live & (groups[1][0] != 0)).sum(dtype=jnp.int32)
+                if num_groups > 1 else jnp.int32(0))
     return {
-        "counts": jnp.stack([num_words, num_pairs]),
+        "counts": jnp.stack([num_words, num_pairs, num_long]),
         "df": df,
         "postings": postings,
         "unique_groups": tuple(groups),

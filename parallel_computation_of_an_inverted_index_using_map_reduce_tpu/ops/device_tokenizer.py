@@ -516,17 +516,98 @@ def index_bytes_device(data, doc_ends, doc_id_values, *, width: int,
         num_docs=num_docs, sort_cols=sort_cols)
     num_words, num_pairs, df, postings, unique_groups = sort_dedup_groups(
         groups, doc_col, tok_cap, live_groups_for(sort_cols, width))
+    # words needing any tail group (cleaned length > 12): group 1's hi
+    # is nonzero iff char 13 exists.  The count rides with the other
+    # counts so the fetch can size a SPARSE tail-group transfer
+    # (long words are rare in real text; see fetch_pack).
+    slots = jnp.arange(tok_cap, dtype=jnp.int32)
+    if len(unique_groups) > 1:
+        long_mask = (slots < num_words) & (unique_groups[1][0] != 0)
+        num_long = long_mask.sum(dtype=jnp.int32)
+    else:
+        num_long = jnp.int32(0)
     return {
-        # one 4-scalar array: ONE host sync fetches all counts (each
+        # one 5-scalar array: ONE host sync fetches all counts (each
         # scalar fetched separately would pay the link RTT per scalar);
         # num_tokens lets the caller verify its tok_cap bound held
         "counts": jnp.stack([num_words, num_pairs, max_word_len,
-                             num_tokens]),
+                             num_tokens, num_long]),
         "df": df,                    # (tok_cap,) valid prefix num_words
         "postings": postings,        # (tok_cap,) valid prefix num_pairs
         # num_groups_for(width) x (hi, lo), valid prefix num_words
         "unique_groups": unique_groups,
     }
+
+
+def doc_pack_width(max_doc_id: int) -> int:
+    """Doc ids per packed int32 for the postings fetch: 3 when ids fit
+    10 bits, else 1 (below 2^16 the uint16 cast already covers
+    2-per-4-bytes and packing would only add shifts for the same
+    transfer size; above it ids must ride int32 untouched)."""
+    return 3 if 0 < max_doc_id < (1 << 10) else 1
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nu", "npairs", "nlong", "k", "live",
+                                    "narrow"))
+def fetch_pack(out, *, nu: int, npairs: int, nlong: int, k: int,
+               live: int, narrow: bool):
+    """Device-side fetch packer for the single-chip engines' tail.
+
+    Returns the minimal transfer set (everything int32/uint16, every
+    array dispatched before any is read by the caller):
+
+    - ``df``: valid prefix, uint16 when ``narrow`` (df <= max_doc_id,
+      so the same bound governs both; packing further would save
+      little — df is the smallest array), int32 otherwise;
+    - ``post``: postings packed ``k`` ids per int32 (10-bit fields,
+      :func:`doc_pack_width`), else the uint16 cast when ``narrow``,
+      else untouched int32 (doc ids >= 2^16 MUST ride wide —
+      truncation here would silently corrupt the index);
+    - ``g0``: group 0's (hi, lo) prefix — every word's first 12 chars;
+    - ``long_idx`` + ``tail``: row indices and tail-group halves for
+      ONLY the words longer than 12 chars (``num_long`` of them, from
+      the program's counts) — the dense tail arrays are provably zero
+      everywhere else, so the host rebuilds them by scatter at vocab
+      scale.  Real-text corpora put ~1-5% of the vocab here, cutting
+      the dominant group transfer ~(live-1)/live.
+    """
+    df = out["df"][:nu]
+    post = out["postings"][:npairs]
+    if narrow:
+        df = df.astype(jnp.uint16)
+    if k > 1:
+        pad = (-npairs) % k
+        p = jnp.concatenate(
+            [post, jnp.zeros(pad, post.dtype)]).reshape(-1, k)
+        post = (p[:, 0] | (p[:, 1] << 10) | (p[:, 2] << 20)
+                if k == 3 else p[:, 0])
+    elif narrow:
+        post = post.astype(jnp.uint16)
+    hi0, lo0 = out["unique_groups"][0]
+    res = {"df": df, "post": post, "g0": (hi0[:nu], lo0[:nu])}
+    if live > 1 and nlong > 0:
+        long_mask = out["unique_groups"][1][0][:nu] != 0
+        idx = segment.set_bit_positions(long_mask, nlong)
+        gi = jnp.clip(idx, 0, nu - 1)
+        res["long_idx"] = idx  # INT32_MAX past num_long; caller slices
+        res["tail"] = tuple(
+            (pair[0][:nu][gi], pair[1][:nu][gi])
+            for pair in out["unique_groups"][1:live])
+    return res
+
+
+def unpack_postings(packed: np.ndarray, num_pairs: int,
+                    k: int) -> np.ndarray:
+    """Host-side inverse of :func:`fetch_pack`'s postings packing —
+    kept next to the pack so field width and ``k`` can never drift
+    apart.  ``k == 1`` input is the uint16/int32 passthrough."""
+    if k == 1:
+        return np.asarray(packed)[:num_pairs].astype(np.int32)
+    pw = np.asarray(packed).astype(np.int64)
+    return np.stack(
+        [pw & 1023, (pw >> 10) & 1023, (pw >> 20) & 1023],
+        axis=1).reshape(-1)[:num_pairs].astype(np.int32)
 
 
 def _host_start_mask(buf: np.ndarray, ends: np.ndarray) -> np.ndarray:
@@ -600,9 +681,8 @@ def decode_word_groups(groups, width: int) -> np.ndarray:
     array — the host-side inverse of :func:`tokenize_groups`'s packing
     (same layout as :func:`unpack_groups`, but in numpy at vocab
     scale).  Padding rows must already be sliced off by the caller
-    (their codes decode to garbage), exactly as for
-the
-    valid prefix contract of the engines' fetch tails."""
+    (their codes decode to garbage) — the valid-prefix contract of the
+    engines' fetch tails."""
     u = np.asarray(groups[0][0]).shape[0]
     out = np.zeros((u, width), np.uint8)
     for g, (hi, lo) in enumerate(groups):

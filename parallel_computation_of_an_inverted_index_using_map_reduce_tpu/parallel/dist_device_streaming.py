@@ -186,7 +186,8 @@ def _build_finalize(mesh: Mesh, cap: int, num_groups: int):
     def body(*acc):
         out = finalize_rows_body(acc, num_groups=num_groups)
         return {
-            "counts": out["counts"][None, :],  # (n, 2) once stacked
+            "counts": out["counts"][None, :2],  # (n, 2) once stacked
+            # (num_long is dropped: the mesh fetch ships dense tails)
             "df": out["df"],
             "postings": out["postings"],
             "unique_groups": out["unique_groups"],

@@ -512,3 +512,56 @@ def test_native_token_stats_matches_numpy_mirror():
     # double-scanning or reading out of bounds
     assert native.token_stats(b, np.array([9, 3, 11], np.int64)) is None
     assert native.token_stats(b, np.array([-1, 8], np.int64)) is None
+
+
+@pytest.mark.parametrize("k,narrow", [(1, True), (3, True), (1, False)])
+def test_fetch_pack_roundtrip(k, narrow):
+    """fetch_pack's transfer set must reconstruct exactly the dense
+    df/postings/unique_groups prefixes: packed postings (3 doc ids per
+    int32 when they fit 10 bits / uint16 otherwise) and the SPARSE
+    tail-group form (indices + values for >12-char words only)."""
+    import jax
+
+    docs = [b"short words here on every line",
+            b"supercalifragilisticexpialidocious floccinaucinihilipilification",
+            b"medium sized tokens xyz pneumonoultramicroscopicsilicovolcanoconiosis"]
+    buf, ends = _pad_concat(docs)
+    ids = np.arange(1, len(docs) + 1, dtype=np.int32)
+    width, tok_cap = 48, 256
+    max_len = DT.max_cleaned_token_len(buf, ends)
+    sort_cols = -(-max_len // 4)
+    out = DT.index_bytes_device(
+        jax.device_put(buf), jax.device_put(ends), jax.device_put(ids),
+        width=width, tok_cap=tok_cap, num_docs=len(docs),
+        sort_cols=sort_cols)
+    num_words, num_pairs, _, _, num_long = (
+        int(v) for v in np.asarray(out["counts"]))
+    assert num_long == 3  # the three >12-char words above
+    live = DT.live_groups_for(sort_cols, width)
+    nu = npairs = tok_cap
+    nlong = 64
+    packed = DT.fetch_pack(out, nu=nu, npairs=npairs, nlong=nlong,
+                           k=k, live=live, narrow=narrow)
+
+    dense_df = np.asarray(out["df"])[:num_words]
+    dense_post = np.asarray(out["postings"])[:num_pairs]
+    np.testing.assert_array_equal(
+        np.asarray(packed["df"])[:num_words].astype(np.int32), dense_df)
+    if not narrow:  # wide path must NOT narrow the dtypes
+        assert np.asarray(packed["df"]).dtype == np.int32
+        assert np.asarray(packed["post"]).dtype == np.int32
+    np.testing.assert_array_equal(
+        DT.unpack_postings(packed["post"], num_pairs, k), dense_post)
+
+    # rebuild dense tails from the sparse transfer and compare
+    idx = np.asarray(packed["long_idx"])[:num_long]
+    for g in range(1, live):
+        eh = np.asarray(out["unique_groups"][g][0])[:num_words]
+        el = np.asarray(out["unique_groups"][g][1])[:num_words]
+        h = np.zeros(num_words, np.int32)
+        l = np.zeros(num_words, np.int32)
+        th, tl = packed["tail"][g - 1]
+        h[idx] = np.asarray(th)[:num_long]
+        l[idx] = np.asarray(tl)[:num_long]
+        np.testing.assert_array_equal(h, eh)
+        np.testing.assert_array_equal(l, el)
