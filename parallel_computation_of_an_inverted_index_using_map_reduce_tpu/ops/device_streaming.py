@@ -106,7 +106,7 @@ def window_rows(data, doc_ends, doc_id_values, *, width: int, tok_cap: int,
 def _merge_unique_rows(acc, window, *, cap: int, live_groups: int):
     """Fold a window's row tuple into the sorted-unique accumulator;
     also returns the accumulator's true unique-row count (the host
-    reads it one merge LATE, so it never stalls the stream loop).
+    reads it two merges LATE, keeping two merges in flight).
 
     ``live_groups``: groups the stream has produced a nonzero char for
     so far (host-exact running max) — later groups are all zero in both
@@ -203,7 +203,11 @@ class DeviceStreamEngine:
         self._cap = initial_capacity
         self._acc = None
         self._unique_bound = 0     # host bound on unique rows in acc
-        self._pending_count = None  # previous merge's true unique count
+        # in-flight merges' (true-count handle, tokens folded) pairs,
+        # oldest first; depth 2 keeps one merge always dispatchable
+        # while the previous still runs (see feed)
+        self._pending = []
+        self._max_inflight = 2
         self._live_groups = 1      # running ceil(ceil(maxlen/4)/3)
         self.windows_fed = 0
         self.max_word_len = 0
@@ -241,22 +245,28 @@ class DeviceStreamEngine:
             out_cap=out_cap)
         counts.copy_to_host_async()
         self._window_checks.append((counts, tok_cap, max_len))
-        # tighten the host bound to the PREVIOUS merge's true unique
-        # count: its program has had this whole window's host scan to
-        # finish, so the read stalls only when the device is already
-        # the bottleneck — the bound tracks unique rows + one window's
-        # tokens, never the stream length (the module's bounded-memory
-        # claim)
-        if self._pending_count is not None:
-            self._unique_bound = int(np.asarray(self._pending_count))
+        # tighten the host bound against resolved merge counts, read
+        # TWO merges late: resolving merge i-2 before dispatching
+        # merge i keeps two merges in flight (the previous count sync
+        # serialized the stream — each window paid a full link RTT
+        # with the device idle during the host scan).  The bound stays
+        # provably safe: true count of the last RESOLVED merge plus
+        # every token folded by the still-unresolved ones — unique
+        # rows + two windows' tokens, never the stream length (the
+        # module's bounded-memory claim).
+        while len(self._pending) >= self._max_inflight:
+            handle, _ = self._pending.pop(0)
+            self._unique_bound = (int(np.asarray(handle))
+                                  + sum(tc for _, tc in self._pending))
         self._ensure_capacity(tok_count)
         if self._acc is None:
             pad = np.full(self._cap, INT32_MAX, np.int32)
             self._acc = tuple(
                 jax.device_put(pad) for _ in range(2 * self._num_groups + 1))
-        self._acc, self._pending_count = _merge_unique_rows(
+        self._acc, pending_count = _merge_unique_rows(
             self._acc, rows, cap=self._cap, live_groups=self._live_groups)
-        self._pending_count.copy_to_host_async()
+        pending_count.copy_to_host_async()
+        self._pending.append((pending_count, tok_count))
         self.windows_fed += 1
 
     def finalize(self):
@@ -283,6 +293,7 @@ class DeviceStreamEngine:
                     f"device max word len {dev_max_len} != host "
                     f"{host_max_len}: classifier divergence (bug)")
         out = _finalize_rows(self._acc, num_groups=self._num_groups)
-        self._acc = self._pending_count = None
+        self._acc = None
+        self._pending = []
         self._window_checks = []
         return out
