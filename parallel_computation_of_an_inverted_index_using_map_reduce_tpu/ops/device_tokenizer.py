@@ -547,6 +547,34 @@ def doc_pack_width(max_doc_id: int) -> int:
     return 3 if 0 < max_doc_id < (1 << 10) else 1
 
 
+def pack_postings(post, k: int):
+    """Traceable postings packer: ``k`` doc ids per int32 in 10-bit
+    fields (``k == 1`` passes through).  The ONE pack implementation —
+    the single-chip tail (:func:`fetch_pack`) and the mesh prefix
+    slice both call it, and :func:`unpack_postings` is its pinned
+    inverse; a second copy could silently drift from the decoder."""
+    if k == 1:
+        return post
+    npairs = post.shape[0]
+    pad = (-npairs) % k
+    p = jnp.concatenate([post, jnp.zeros(pad, post.dtype)]).reshape(-1, k)
+    return (p[:, 0] | (p[:, 1] << 10) | (p[:, 2] << 20)
+            if k == 3 else p[:, 0])
+
+
+def gather_long_tails(halves, nu: int, nlong: int):
+    """Traceable sparse tail-group gather: set-bit indices of the
+    >12-char rows (group 1's hi is nonzero exactly there; tail halves
+    are zero past ``num_words``, so padding never matches) and every
+    tail half gathered at them.  Returns ``(idx, gathered_halves)``
+    with ``idx`` INT32_MAX past the true long count — callers slice by
+    the count they carried in their counts array."""
+    long_mask = halves[0][:nu] != 0
+    idx = segment.set_bit_positions(long_mask, nlong)
+    gi = jnp.clip(idx, 0, nu - 1)
+    return idx, tuple(h[:nu][gi] for h in halves)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("nu", "npairs", "nlong", "k", "live",
                                     "narrow"))
@@ -577,23 +605,19 @@ def fetch_pack(out, *, nu: int, npairs: int, nlong: int, k: int,
     if narrow:
         df = df.astype(jnp.uint16)
     if k > 1:
-        pad = (-npairs) % k
-        p = jnp.concatenate(
-            [post, jnp.zeros(pad, post.dtype)]).reshape(-1, k)
-        post = (p[:, 0] | (p[:, 1] << 10) | (p[:, 2] << 20)
-                if k == 3 else p[:, 0])
+        post = pack_postings(post, k)
     elif narrow:
         post = post.astype(jnp.uint16)
     hi0, lo0 = out["unique_groups"][0]
     res = {"df": df, "post": post, "g0": (hi0[:nu], lo0[:nu])}
     if live > 1 and nlong > 0:
-        long_mask = out["unique_groups"][1][0][:nu] != 0
-        idx = segment.set_bit_positions(long_mask, nlong)
-        gi = jnp.clip(idx, 0, nu - 1)
+        halves = [h for pair in out["unique_groups"][1:live]
+                  for h in pair]
+        idx, gathered = gather_long_tails(halves, nu, nlong)
         res["long_idx"] = idx  # INT32_MAX past num_long; caller slices
         res["tail"] = tuple(
-            (pair[0][:nu][gi], pair[1][:nu][gi])
-            for pair in out["unique_groups"][1:live])
+            (gathered[2 * g], gathered[2 * g + 1])
+            for g in range(live - 1))
     return res
 
 

@@ -185,9 +185,14 @@ def _build_regrow(mesh: Mesh, old_cap: int, new_cap: int, nrows: int):
 def _build_finalize(mesh: Mesh, cap: int, num_groups: int):
     def body(*acc):
         out = finalize_rows_body(acc, num_groups=num_groups)
+        c = out["counts"]
         return {
-            "counts": out["counts"][None, :2],  # (n, 2) once stacked
-            # (num_long is dropped: the mesh fetch ships dense tails)
+            "counts": c[None, :],  # (n, 3) once stacked
+            # replicated per-owner maxima [words, pairs, long] so every
+            # process sizes the same prefix-slice fetch (the one-shot
+            # mesh engine's globals discipline); one pmax over the
+            # counts vector
+            "maxima": lax.pmax(c, SHARD_AXIS),
             "df": out["df"],
             "postings": out["postings"],
             "unique_groups": out["unique_groups"],
@@ -195,8 +200,8 @@ def _build_finalize(mesh: Mesh, cap: int, num_groups: int):
 
     return jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=(shard_spec(),) * (2 * num_groups + 1),
-        out_specs={"counts": shard_spec(), "df": shard_spec(),
-                   "postings": shard_spec(),
+        out_specs={"counts": shard_spec(), "maxima": replicated_spec(),
+                   "df": shard_spec(), "postings": shard_spec(),
                    "unique_groups": ((shard_spec(), shard_spec()),)
                    * num_groups},
         check_vma=False,
@@ -343,12 +348,12 @@ class DistDeviceStreamEngine:
             self._mesh, self._cap, self._num_groups)(*self._acc)
         self._acc = None
         self._window_checks = []
-        # per-owner word/pair counts are bounded by the merge-observed
-        # max per-owner unique count
+        mx = np.asarray(out["maxima"])
         owners = fetch_owner_blocks(
             out, mesh=self._mesh, local_len=self._cap, width=self._width,
             sort_cols=sort_cols, max_doc_id=max_doc_id,
-            max_words=self._count, max_pairs=self._count, stats=stats)
+            max_words=int(mx[0]), max_pairs=int(mx[1]),
+            max_long=int(mx[2]), stats=stats)
         if stats is not None:
             stats["merge_retries"] = self.merge_retries
             stats["accumulator_capacity_per_owner"] = self._cap

@@ -129,20 +129,28 @@ def _body(data_l, ends_l, ids_l, *, width: int, tok_cap: int, num_docs: int,
         + [(zero, zero)] * (num_groups_for(width) - live))
     num_words, num_pairs, df, postings, unique_groups = sort_dedup_groups(
         recv_groups, recv_rows[-1], num_shards * capacity, live)
+    # per-owner >12-char word count for the sparse tail-group fetch
+    # (ops/device_tokenizer.fetch_pack discipline, per owner here);
+    # unique_groups are already zero past num_words, so the nonzero
+    # count IS the long-word count
+    num_long = ((unique_groups[1][0] != 0).sum(dtype=jnp.int32)
+                if len(unique_groups) > 1 else jnp.int32(0))
     return {
-        # per-owner counts, sharded (n, 2) once stacked over the mesh
-        "counts": jnp.stack([num_words, num_pairs])[None, :],
+        # per-owner counts, sharded (n, 3) once stacked over the mesh
+        "counts": jnp.stack([num_words, num_pairs, num_long])[None, :],
         # replicated health scalars: [global max word len, overflow,
-        # max per-shard token count, max owner words, max owner pairs]
-        # — the two maxima size the prefix-slice fetch identically on
-        # every process (a host-side max over counts would only see
-        # the local shards in a multi-controller run)
+        # max per-shard token count, max owner words, max owner pairs,
+        # max owner long-words] — the maxima size the prefix-slice
+        # fetch identically on every process (a host-side max over
+        # counts would only see the local shards in a
+        # multi-controller run)
         "globals": jnp.stack([
             lax.pmax(max_len, SHARD_AXIS),
             lax.psum(overflow_local.astype(jnp.int32), SHARD_AXIS),
             lax.pmax(num_tokens, SHARD_AXIS),
             lax.pmax(num_words, SHARD_AXIS),
             lax.pmax(num_pairs, SHARD_AXIS),
+            lax.pmax(num_long, SHARD_AXIS),
         ]),
         "df": df,
         "postings": postings,
@@ -171,21 +179,39 @@ def _build(mesh: Mesh, width: int, tok_cap: int, num_docs: int,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_prefix_slice(mesh: Mesh, nu: int, npairs: int,
-                        nhalves_fetch: int, narrow: bool):
-    """Per-owner valid-prefix slice (+ optional uint16 narrowing),
-    device side, so the D2H transfer tracks unique counts — the fetch
-    discipline of dist_engine._dist_prov_exchange (VERDICT r1 #7).
-    ``nhalves_fetch``: flat (hi, lo) group halves riding down."""
+def _build_prefix_slice(mesh: Mesh, nu: int, npairs: int, live: int,
+                        narrow: bool, k: int, nlong: int):
+    """Per-owner valid-prefix slice with the single-chip tail's
+    transfer trimming (ops/device_tokenizer.fetch_pack), device side,
+    so the D2H transfer tracks unique counts — the fetch discipline of
+    dist_engine._dist_prov_exchange (VERDICT r1 #7).  Per owner:
+    postings pack ``k`` doc ids per int32 / narrow to uint16; group 0
+    rides dense; tail groups ride sparsely (set-bit indices + values
+    for the ``nlong``-capped >12-char words).  Output order:
+    ``(df, post, g0_hi, g0_lo[, long_idx, *tail_halves])``."""
+    from ..ops.device_tokenizer import gather_long_tails, pack_postings
+
     def body(df, postings, *halves):
         dfp, pp = df[:nu], postings[:npairs]
         if narrow:
-            dfp, pp = dfp.astype(jnp.uint16), pp.astype(jnp.uint16)
-        return (dfp, pp, *(h[:nu] for h in halves))
+            dfp = dfp.astype(jnp.uint16)
+        if k > 1:
+            pp = pack_postings(pp, k)
+        elif narrow:
+            pp = pp.astype(jnp.uint16)
+        out = [dfp, pp, halves[0][:nu], halves[1][:nu]]
+        if nlong:
+            idx, gathered = gather_long_tails(
+                halves[2:2 * live], nu, nlong)
+            out.append(idx)
+            out.extend(gathered)
+        return tuple(out)
+
+    nout = 4 + ((1 + 2 * (live - 1)) if nlong else 0)
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(shard_spec(),) * (2 + nhalves_fetch),
-        out_specs=(shard_spec(),) * (2 + nhalves_fetch),
+        in_specs=(shard_spec(),) * (2 + 2 * live),
+        out_specs=(shard_spec(),) * nout,
         check_vma=False,
     ))
 
@@ -259,13 +285,13 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
             f"{tok_cap}: host mask count diverged from the device "
             "classifier (bug)")
 
-    # per-owner counts from THIS process's shards only (the (n, 2)
+    # per-owner counts from THIS process's shards only (the (n, 3)
     # counts array is device-sharded; a whole-array np.asarray would
     # need every shard addressable and break multi-controller)
     owners = fetch_owner_blocks(
         out, mesh=mesh, local_len=n * capacity, width=width,
         sort_cols=sort_cols, max_doc_id=max_doc_id, max_words=int(g[3]),
-        max_pairs=int(g[4]), stats=stats)
+        max_pairs=int(g[4]), max_long=int(g[5]), stats=stats)
     if stats is not None:
         stats["exchange_retries"] = retries
         stats["exchange_capacity"] = capacity
@@ -274,35 +300,43 @@ def index_bytes_dist(shard_bufs, shard_ends, shard_ids, *, width: int,
 
 def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int, width: int,
                        sort_cols: int | None, max_doc_id: int | None,
-                       max_words: int, max_pairs: int,
+                       max_words: int, max_pairs: int, max_long: int,
                        stats: dict | None = None):
     """Addressable-shard fetch of per-owner index blocks — the shared
     tail of the mesh device engines (one-shot and streaming).
 
-    ``out`` must carry device-sharded ``counts`` ((n, 2): words, pairs
-    per owner), ``df``, ``postings`` and ``unique_groups``;
-    ``max_words`` / ``max_pairs`` are the device-REPLICATED per-owner
-    maxima (identical prefix-slice shapes on every process).  Fetched
-    bytes track unique counts, not the overprovisioned capacity;
-    group pairs past ``sort_cols`` are provably all zero (decode
-    restores the zero padding for free) and df/postings ride down as
-    uint16 when doc ids fit.
+    ``out`` must carry device-sharded ``counts`` ((n, 3): words,
+    pairs, >12-char words per owner), ``df``, ``postings`` and
+    ``unique_groups``; ``max_words`` / ``max_pairs`` / ``max_long``
+    are the device-REPLICATED per-owner maxima (identical prefix-slice
+    shapes on every process).  Transfer trimming matches the
+    single-chip tail (ops/device_tokenizer.fetch_pack): fetched bytes
+    track unique counts, postings pack 3 doc ids per int32 when they
+    fit 10 bits (uint16 under 2^16, untouched int32 above), and tail
+    group pairs ride sparsely — indices + values for each owner's
+    long words, the dense arrays rebuilt by host scatter.
     """
     counts = {
-        (s.index[0].start or 0): np.asarray(s.data).reshape(2)
+        (s.index[0].start or 0): np.asarray(s.data).reshape(3)
         for s in out["counts"].addressable_shards
     }
+    from ..ops.device_tokenizer import doc_pack_width, unpack_postings
+
     ngroups_fetch = min(len(out["unique_groups"]),
                         live_groups_for(sort_cols, width))
     narrow = max_doc_id is not None and max_doc_id < (1 << 16)
+    k = doc_pack_width(max_doc_id) if max_doc_id else 1
     # 1k granule: tight enough that fetched bytes track the max owner's
     # unique counts, coarse enough that slice programs reuse across
     # similar corpora
     nu = min(local_len, _round_up(max(max_words, 1), 1 << 10))
     npairs = min(local_len, _round_up(max(max_pairs, 1), 1 << 10))
+    nlong = (min(nu, _round_up(max_long, 1 << 10))
+             if ngroups_fetch > 1 and max_long else 0)
     halves = [h for pair in out["unique_groups"][:ngroups_fetch]
               for h in pair]
-    sliced = _build_prefix_slice(mesh, nu, npairs, len(halves), narrow)(
+    sliced = _build_prefix_slice(
+        mesh, nu, npairs, ngroups_fetch, narrow, k, nlong)(
         out["df"], out["postings"], *halves)
     for arr in sliced:
         for s in arr.addressable_shards:
@@ -316,20 +350,38 @@ def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int, width: int,
                 for s in arr.addressable_shards}
 
     df_sh = _per_owner(sliced[0], nu)
-    post_sh = _per_owner(sliced[1], npairs)
-    halves_sh = [_per_owner(h, nu) for h in sliced[2:]]
+    post_sh = _per_owner(sliced[1], (npairs + k - 1) // k if k > 1
+                         else npairs)
+    g0_sh = (_per_owner(sliced[2], nu), _per_owner(sliced[3], nu))
+    if nlong:
+        idx_sh = _per_owner(sliced[4], nlong)
+        tails_sh = [_per_owner(h, nlong) for h in sliced[5:]]
     for o, cnt in counts.items():
-        num_words, num_pairs = int(cnt[0]), int(cnt[1])
+        num_words, num_pairs, num_long = (int(v) for v in cnt)
         fetched += df_sh[o].nbytes + post_sh[o].nbytes \
-            + sum(h[o].nbytes for h in halves_sh)
+            + g0_sh[0][o].nbytes + g0_sh[1][o].nbytes
+        groups = [(g0_sh[0][o][:num_words], g0_sh[1][o][:num_words])]
+        zero = np.zeros(num_words, np.int32)
+        if nlong:
+            fetched += idx_sh[o].nbytes + sum(
+                t[o].nbytes for t in tails_sh)
+            idx = idx_sh[o][:num_long]
+            for g in range(ngroups_fetch - 1):
+                h = zero.copy()
+                l = zero.copy()
+                h[idx] = tails_sh[2 * g][o][:num_long]
+                l[idx] = tails_sh[2 * g + 1][o][:num_long]
+                groups.append((h, l))
+        else:
+            groups.extend(
+                (np.zeros(num_words, np.int32),
+                 np.zeros(num_words, np.int32))
+                for _ in range(ngroups_fetch - 1))
         owners[o] = {
             "num_words": num_words, "num_pairs": num_pairs,
             "df": df_sh[o][:num_words].astype(np.int32),
-            "postings": post_sh[o][:num_pairs].astype(np.int32),
-            "unique_groups": [
-                (halves_sh[2 * g][o][:num_words],
-                 halves_sh[2 * g + 1][o][:num_words])
-                for g in range(ngroups_fetch)],
+            "postings": unpack_postings(post_sh[o], num_pairs, k),
+            "unique_groups": groups,
         }
     if stats is not None:
         stats["dist_fetched_bytes"] = fetched
