@@ -214,6 +214,10 @@ def _tpu_child() -> int:
             {"overlap_tail_fraction": 0.4, "device_shards": 1},
             {"overlap_tail_fraction": 0.5, "device_shards": 1,
              "overlap_device_windows": 1},
+            # bigger first window -> smaller LAST window -> smaller
+            # residual fetch wait after the scan (config knob docs)
+            {"overlap_tail_fraction": 0.5, "device_shards": 1,
+             "overlap_window_split": 0.75},
             fast_plan,
         ])
         if grid["best_ms"] < result["best_ms"]:

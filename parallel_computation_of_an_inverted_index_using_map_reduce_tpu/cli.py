@@ -67,6 +67,10 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=(1, 2),
                    help="overlap plan device windows: 2 = earliest first "
                         "fetch, 1 = half the dispatch RPCs")
+    p.add_argument("--overlap-window-split", type=float, default=0.55,
+                   help="first device window's share of the overlap "
+                        "plan's device bytes; larger shrinks the LAST "
+                        "window and with it the residual fetch wait")
     p.add_argument("--host-threads", type=int, default=None,
                    help="host map-phase threads (default: num_mappers if > 1, "
                         "else min(cores, 8)); output-invariant")
@@ -95,6 +99,7 @@ def main(argv: list[str] | None = None) -> int:
             pipeline_chunk_docs=args.pipeline_chunk_docs,
             overlap_tail_fraction=args.overlap_tail_fraction,
             overlap_device_windows=args.overlap_device_windows,
+            overlap_window_split=args.overlap_window_split,
             device_tokenize=args.device_tokenize,
             device_tokenize_width=args.device_tokenize_width,
             device_shards=args.device_shards,

@@ -85,6 +85,13 @@ class IndexConfig:
     # earlier; 1 halves the dispatch RPCs (wins when per-call link
     # overhead dominates the hidden round trip).
     overlap_device_windows: int = 2
+    # Byte split between the two device windows (first window's share
+    # of the device fraction).  The fetch wait left after the scan is
+    # proportional to the LAST window's bytes (its fetch is issued
+    # latest), so a larger first window shrinks the residual — at the
+    # cost of issuing that bigger upload later into the scan.  A grid
+    # probe, like the tail fraction.
+    overlap_window_split: float = 0.55
     # Device-side tokenizer (ops/device_tokenizer.py): raw corpus bytes
     # go up, the finished index comes down — the ENTIRE map phase (byte
     # classify, token segmentation, cleaning, dedup, df, postings) as
@@ -179,6 +186,10 @@ class IndexConfig:
             raise ValueError(
                 f"overlap_device_windows must be 1 or 2, "
                 f"got {self.overlap_device_windows}")
+        if not (0.0 < self.overlap_window_split < 1.0):
+            raise ValueError(
+                f"overlap_window_split must be in (0, 1), "
+                f"got {self.overlap_window_split}")
         # upper bound 296 (< MAX_WORD_LETTERS): a width that could hold
         # a 299+-letter token would silently skip the reference's 299
         # cap (main.c:105) instead of falling back to the host path

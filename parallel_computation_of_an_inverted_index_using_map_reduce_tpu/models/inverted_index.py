@@ -575,7 +575,8 @@ class InvertedIndexModel:
         # RPCs (wins when per-call link overhead dominates).
         dev_f = 1.0 - tail_f
         if len(manifest) >= 8 and cfg.overlap_device_windows == 2:
-            fractions = (0.55 * dev_f, 0.45 * dev_f, tail_f)
+            split = cfg.overlap_window_split
+            fractions = (split * dev_f, (1.0 - split) * dev_f, tail_f)
         else:
             fractions = (dev_f, tail_f)
         windows = plan_fraction_windows(manifest, fractions)
@@ -831,19 +832,12 @@ class InvertedIndexModel:
             df = np.asarray(packed["df"])[:num_words].astype(np.int32)
             postings = DT.unpack_postings(packed["post"], num_pairs, k)
             g0 = tuple(np.asarray(h)[:num_words] for h in packed["g0"])
-            groups = [g0]
-            zero = np.zeros(num_words, np.int32)
-            if nlong:
-                idx = np.asarray(packed["long_idx"])[:num_long]
-                for th, tl in packed["tail"]:
-                    h = zero.copy()
-                    l = zero.copy()
-                    h[idx] = np.asarray(th)[:num_long]
-                    l[idx] = np.asarray(tl)[:num_long]
-                    groups.append((h, l))
-            else:
-                groups.extend(
-                    (zero, zero) for _ in range(ngroups_fetch - 1))
+            groups = [g0] + DT.rebuild_tail_groups(
+                num_words, ngroups_fetch,
+                idx=(np.asarray(packed["long_idx"])[:num_long]
+                     if nlong else None),
+                tails=packed.get("tail", ()),
+                num_long=num_long if nlong else 0)
             timer.count("fetched_bytes", sum(a.nbytes for a in leaves))
         with timer.phase("host_views"):
             vocab = DT.decode_word_groups(groups, width)

@@ -621,6 +621,26 @@ def fetch_pack(out, *, nu: int, npairs: int, nlong: int, k: int,
     return res
 
 
+def rebuild_tail_groups(num_words: int, ngroups_fetch: int, *,
+                        idx=None, tails=(), num_long: int = 0):
+    """Host-side inverse of the sparse tail-group transfer
+    (:func:`gather_long_tails`): dense (hi, lo) pairs for groups
+    1..ngroups_fetch-1, zeros everywhere except the ``num_long`` long
+    words' rows scattered back at ``idx``.  The ONE rebuild
+    implementation — the single-chip tail and the mesh owner fetch
+    both call it (same anti-drift rationale as
+    :func:`unpack_postings`)."""
+    out = []
+    for g in range(ngroups_fetch - 1):
+        h = np.zeros(num_words, np.int32)
+        l = np.zeros(num_words, np.int32)
+        if num_long:
+            h[idx] = np.asarray(tails[g][0])[:num_long]
+            l[idx] = np.asarray(tails[g][1])[:num_long]
+        out.append((h, l))
+    return out
+
+
 def unpack_postings(packed: np.ndarray, num_pairs: int,
                     k: int) -> np.ndarray:
     """Host-side inverse of :func:`fetch_pack`'s postings packing —

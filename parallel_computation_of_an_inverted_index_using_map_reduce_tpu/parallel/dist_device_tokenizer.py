@@ -49,10 +49,15 @@ from jax.sharding import Mesh
 
 from ..ops.device_tokenizer import (
     INT32_MAX,
+    doc_pack_width,
+    gather_long_tails,
     live_groups_for,
     num_groups_for,
+    pack_postings,
+    rebuild_tail_groups,
     sort_dedup_groups,
     tokenize_groups,
+    unpack_postings,
 )
 from ..ops.segment import bucket_edges
 from ..utils.rounding import round_up as _round_up
@@ -189,8 +194,6 @@ def _build_prefix_slice(mesh: Mesh, nu: int, npairs: int, live: int,
     rides dense; tail groups ride sparsely (set-bit indices + values
     for the ``nlong``-capped >12-char words).  Output order:
     ``(df, post, g0_hi, g0_lo[, long_idx, *tail_halves])``."""
-    from ..ops.device_tokenizer import gather_long_tails, pack_postings
-
     def body(df, postings, *halves):
         dfp, pp = df[:nu], postings[:npairs]
         if narrow:
@@ -320,8 +323,6 @@ def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int, width: int,
         (s.index[0].start or 0): np.asarray(s.data).reshape(3)
         for s in out["counts"].addressable_shards
     }
-    from ..ops.device_tokenizer import doc_pack_width, unpack_postings
-
     ngroups_fetch = min(len(out["unique_groups"]),
                         live_groups_for(sort_cols, width))
     narrow = max_doc_id is not None and max_doc_id < (1 << 16)
@@ -360,23 +361,18 @@ def fetch_owner_blocks(out, *, mesh: Mesh, local_len: int, width: int,
         num_words, num_pairs, num_long = (int(v) for v in cnt)
         fetched += df_sh[o].nbytes + post_sh[o].nbytes \
             + g0_sh[0][o].nbytes + g0_sh[1][o].nbytes
-        groups = [(g0_sh[0][o][:num_words], g0_sh[1][o][:num_words])]
-        zero = np.zeros(num_words, np.int32)
         if nlong:
             fetched += idx_sh[o].nbytes + sum(
                 t[o].nbytes for t in tails_sh)
-            idx = idx_sh[o][:num_long]
-            for g in range(ngroups_fetch - 1):
-                h = zero.copy()
-                l = zero.copy()
-                h[idx] = tails_sh[2 * g][o][:num_long]
-                l[idx] = tails_sh[2 * g + 1][o][:num_long]
-                groups.append((h, l))
-        else:
-            groups.extend(
-                (np.zeros(num_words, np.int32),
-                 np.zeros(num_words, np.int32))
-                for _ in range(ngroups_fetch - 1))
+        groups = (
+            [(g0_sh[0][o][:num_words], g0_sh[1][o][:num_words])]
+            + rebuild_tail_groups(
+                num_words, ngroups_fetch,
+                idx=idx_sh[o][:num_long] if nlong else None,
+                tails=[(tails_sh[2 * g][o], tails_sh[2 * g + 1][o])
+                       for g in range(ngroups_fetch - 1)] if nlong
+                else (),
+                num_long=num_long if nlong else 0))
         owners[o] = {
             "num_words": num_words, "num_pairs": num_pairs,
             "df": df_sh[o][:num_words].astype(np.int32),

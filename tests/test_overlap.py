@@ -260,3 +260,21 @@ def test_emit_runs_empty_runs(tmp_path):
         [(np.empty(0, np.uint16), zero, zero),
          (np.array([3], np.uint16), zero, one)])
     assert (tmp_path / "out" / "a.txt").read_bytes() == b"abc:[3]\n"
+
+
+def test_overlap_window_split_is_exact_and_validated(tmp_path):
+    """Any window split must stay byte-identical (the split only moves
+    the upload boundary); out-of-range splits are rejected loudly."""
+    docs = zipf_corpus(num_docs=24, vocab_size=300, tokens_per_doc=50, seed=5)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "oracle")
+    for split in (0.25, 0.75):
+        InvertedIndexModel(
+            _cfg(overlap_tail_fraction=0.5, overlap_window_split=split)
+        ).run(m, output_dir=tmp_path / f"s{split}")
+        assert read_letter_files(tmp_path / f"s{split}") == \
+            read_letter_files(tmp_path / "oracle")
+    with pytest.raises(ValueError, match="overlap_window_split"):
+        IndexConfig(overlap_window_split=1.5)
