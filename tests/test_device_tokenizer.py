@@ -376,7 +376,15 @@ def test_device_program_has_no_token_scale_scatter():
     assert len(scatters) == 3, (
         f"{len(scatters)} scatter ops in the device program (expected "
         "the 3 tiny doc-boundary ones) — token-scale compactions must "
-        "stay sort/gather/searchsorted formulations")
+        "stay sort/gather formulations")
+    # and NO loops: jnp.searchsorted's default method='scan' lowers to
+    # a sequential log2(n) while-loop of dynamic slices, the round-3
+    # regression's root cause (702 ms at 2^20 queries into 5.7M on the
+    # v5e, BENCH_TPU_r03.json) — the program must stay loop-free
+    assert 'stablehlo.while' not in text, (
+        "a while loop appeared in the device program — most likely a "
+        "scan-lowered searchsorted crept back in; use "
+        "segment.searchsorted_device / segment.set_bit_positions")
 
 
 def test_decode_word_groups_roundtrip():
