@@ -573,3 +573,26 @@ def test_fetch_pack_roundtrip(k, narrow):
         l[idx] = np.asarray(tl)[:num_long]
         np.testing.assert_array_equal(h, eh)
         np.testing.assert_array_equal(l, el)
+
+
+@pytest.mark.parametrize("npairs", [1, 2, 3, 7, 4096])
+def test_pack_unpack_postings_boundary_values(npairs):
+    """pack_postings/unpack_postings at the 10-bit field boundary:
+    doc ids up to 1023 (doc_pack_width's k=3 threshold is < 1024) and
+    lengths not divisible by k must round-trip exactly."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(npairs)
+    post = rng.integers(0, 1024, npairs).astype(np.int32)
+    post[0] = 1023  # field-boundary value
+    packed = np.asarray(DT.pack_postings(jnp.asarray(post), 3))
+    assert packed.shape[0] == -(-npairs // 3)
+    np.testing.assert_array_equal(
+        DT.unpack_postings(packed, npairs, 3), post)
+    # k=1 passthrough
+    np.testing.assert_array_equal(
+        DT.unpack_postings(post, npairs, 1), post)
+    # the k selector: packing only when ids fit 10 bits
+    assert DT.doc_pack_width(1023) == 3
+    assert DT.doc_pack_width(1024) == 1
+    assert DT.doc_pack_width(70000) == 1
