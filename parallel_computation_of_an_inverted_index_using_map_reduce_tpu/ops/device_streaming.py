@@ -3,10 +3,12 @@
 The one-shot all-device engine (ops/device_tokenizer.py) needs the
 whole corpus byte tensor and its token-capacity arrays in HBM at once.
 Here the corpus arrives in doc-aligned byte windows and the device
-carries only the **unique (word, doc) rows seen so far**, each row a
-compressed radix form — ``ceil(width/12)`` 30-bit (hi, lo) code pairs
-(ops/device_tokenizer.pack_groups) plus the doc id — bounded by the
-output's unique-pair count, not the stream length.  The same
+carries only the **unique (word, doc) rows seen so far**, each row the
+``ceil(width/12)`` 30-bit (hi, lo) 5-bit-group code pairs that
+``ops/device_tokenizer.tokenize_groups`` emits directly, plus the doc
+id — bounded by the output's unique-pair count, not the stream length.
+(``pack_groups`` survives only as the property-test reference for this
+code layout; the hot path never materializes byte columns.)  The same
 blockwise-accumulator discipline as the integer-pair streaming engine
 (ops/streaming.py), lifted from packed ints to word rows, so the
 "device scan" column of the engine matrix gets the same
@@ -106,7 +108,11 @@ def window_rows(data, doc_ends, doc_id_values, *, width: int, tok_cap: int,
 def _merge_unique_rows(acc, window, *, cap: int, live_groups: int):
     """Fold a window's row tuple into the sorted-unique accumulator;
     also returns the accumulator's true unique-row count (the host
-    reads it two merges LATE, keeping two merges in flight).
+    reads it two merges LATE, keeping two merges in flight).  "True"
+    is exact, not an upper bound: _row_first_mask masks all-INT32_MAX
+    padding rows, so no padding row counts as a first occurrence
+    (pinned by tests/test_device_streaming.py::
+    test_merge_count_is_exact_not_upper_bound).
 
     ``live_groups``: groups the stream has produced a nonzero char for
     so far (host-exact running max) — later groups are all zero in both

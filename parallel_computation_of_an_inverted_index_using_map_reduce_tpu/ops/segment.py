@@ -25,6 +25,12 @@ def searchsorted_device(a, v):
     """``searchsorted(a, v, side='left')`` for NONDECREASING queries
     ``v``, formulated for TPU (both inputs same int dtype).
 
+    CONTRACT: ``v`` must be nondecreasing — the formulation takes each
+    query's index as its rank among queries, so unsorted queries get
+    silently wrong edges (failure mode pinned by
+    tests/test_segment.py::test_searchsorted_device_requires_monotone_
+    queries).  Every in-tree caller passes an ``arange``.
+
     ``jnp.searchsorted``'s default ``method='scan'`` binary search
     lowers to a sequential log2(n)-step loop of dynamic slices —
     measured on the v5e (round 3, tools/profile_device_stages.py):

@@ -43,6 +43,44 @@ def _cfg(**kw):
     return IndexConfig(**kw)
 
 
+def test_merge_count_is_exact_not_upper_bound():
+    """The count _merge_unique_rows returns is the TRUE unique-row
+    count: _row_first_mask masks all-INT32_MAX padding rows, so the
+    first padding row is NOT counted as a first occurrence (advisor r3
+    flagged the opposite; this pins the verified behavior — if the
+    handle ever over-counts, _unique_bound loses its 'true count after
+    resolution' meaning)."""
+    def win(texts, first_id):
+        buf = ("\x00".join(texts) + "\x00").encode()
+        data = np.frombuffer(buf, np.uint8).copy()
+        ends, pos = [], 0
+        for t in texts:
+            pos += len(t) + 1
+            ends.append(pos)
+        return (data, np.array(ends, np.int32),
+                np.arange(first_id, first_id + len(texts), dtype=np.int32))
+
+    windows = [["the cat sat", "a cat ran"], ["the dog sat", "cat cat cat"]]
+    eng = DS.DeviceStreamEngine(width=12)
+    for i, texts in enumerate(windows):
+        data, ends, ids = win(texts, 1 + 2 * i)
+        eng.feed(data, ends, ids,
+                 tok_count=sum(len(t.split()) for t in texts),
+                 max_len=max(len(w) for t in texts for w in t.split()))
+    # both merge handles are still pending (depth-2 pipeline): resolve
+    # them directly and compare against the ground-truth running counts
+    truth, seen, doc = [], set(), 0
+    for texts in windows:
+        for t in texts:
+            doc += 1
+            seen.update((w, doc) for w in t.split())
+        truth.append(len(seen))
+    got = [int(np.asarray(h)) for h, _ in eng._pending]
+    assert got == truth  # exact, not an upper bound
+    counts = np.asarray(eng.finalize()["counts"])
+    assert counts[1] == truth[-1]
+
+
 def test_matches_goldens_smoke(smoke_fixture, tmp_path):
     m = read_manifest(smoke_fixture / "manifest.txt", base_dir=smoke_fixture)
     report = InvertedIndexModel(_cfg(stream_chunk_docs=2)).run(

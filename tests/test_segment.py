@@ -1,0 +1,118 @@
+"""Property tests for ops/segment.py — the sorted-array primitives
+that replace the reference reducer's linear dict scan and bubble sort
+(main.c:172-187, 217-226).
+
+The searchsorted_device contract test exists because of a round-3
+advisor finding: the co-sort formulation is only correct for
+NONDECREASING query arrays ``v`` (each query's own rank must equal its
+index), and the precondition was documented but nothing in the tree
+demonstrated what breaks without it.  test_searchsorted_device_requires
+_monotone_queries pins the failure mode so a future caller who reaches
+for it with unsorted queries finds a named test, not a silent wrong
+answer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.keys import (
+    INT32_MAX,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops.segment import (
+    bucket_edges,
+    compact,
+    first_occurrence_mask,
+    searchsorted_device,
+    set_bit_positions,
+    sorted_segment_counts,
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("n,m", [(1, 1), (64, 16), (1000, 1000), (37, 257)])
+def test_searchsorted_device_matches_numpy_on_monotone_queries(seed, n, m):
+    rng = np.random.default_rng(seed)
+    a = np.sort(rng.integers(0, 1 << 20, size=n, dtype=np.int32))
+    v = np.sort(rng.integers(0, 1 << 20, size=m, dtype=np.int32))
+    got = np.asarray(searchsorted_device(a, v))
+    want = np.searchsorted(a, v, side="left")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_searchsorted_device_arange_queries_exact():
+    # the shape every in-tree caller uses: v = arange over segment ids
+    a = np.array([0, 0, 1, 1, 1, 3, 7, 7], dtype=np.int32)
+    v = np.arange(9, dtype=np.int32)
+    got = np.asarray(searchsorted_device(a, v))
+    np.testing.assert_array_equal(got, np.searchsorted(a, v))
+
+
+def test_searchsorted_device_requires_monotone_queries():
+    """FAILURE-MODE PIN (advisor r3): non-monotone ``v`` silently
+    returns wrong edges — the formulation subtracts each query's index
+    as its rank among queries, which only holds when ``v`` is sorted.
+    If this test ever starts passing with equality, the implementation
+    grew real unsorted-query support and the docstring should change.
+    """
+    a = np.array([0, 2, 4, 6, 8], dtype=np.int32)
+    v = np.array([9, 1, 5], dtype=np.int32)  # deliberately descending-ish
+    got = np.asarray(searchsorted_device(a, v))
+    want = np.searchsorted(a, v, side="left")
+    assert not np.array_equal(got, want), (
+        "searchsorted_device unexpectedly handled non-monotone queries; "
+        "update its contract docstring and this pin")
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_set_bit_positions_and_compact(seed):
+    rng = np.random.default_rng(seed)
+    n = 513
+    mask = rng.random(n) < 0.3
+    pos = np.asarray(set_bit_positions(mask, n))
+    want = np.flatnonzero(mask)
+    np.testing.assert_array_equal(pos[: want.size], want)
+    assert (pos[want.size:] == INT32_MAX).all()
+
+    vals = rng.integers(0, 1000, size=n).astype(np.int32)
+    out = np.asarray(compact(vals, mask, n, -1))
+    np.testing.assert_array_equal(out[: want.size], vals[mask])
+    assert (out[want.size:] == -1).all()
+
+
+def test_set_bit_positions_out_len_shorter_and_longer():
+    mask = np.array([True, False, True, True])
+    short = np.asarray(set_bit_positions(mask, 2))
+    np.testing.assert_array_equal(short, [0, 2])
+    long = np.asarray(set_bit_positions(mask, 6))
+    np.testing.assert_array_equal(long, [0, 2, 3, INT32_MAX, INT32_MAX,
+                                         INT32_MAX])
+
+
+def test_first_occurrence_mask_runs():
+    keys = np.array([5, 5, 5, 7, 9, 9], dtype=np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(first_occurrence_mask(keys)),
+        [True, False, False, True, True, False])
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_sorted_segment_counts_matches_bincount(seed):
+    rng = np.random.default_rng(seed)
+    ids = np.sort(rng.integers(0, 40, size=300).astype(np.int32))
+    w = rng.integers(0, 5, size=300).astype(np.int32)
+    got = np.asarray(sorted_segment_counts(ids, w, 40))
+    want = np.bincount(ids, weights=w, minlength=40).astype(np.int32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bucket_edges_counts_and_offsets():
+    ids = np.array([0, 0, 2, 2, 2, 5], dtype=np.int32)
+    counts, offsets = (np.asarray(x) for x in bucket_edges(ids, 6))
+    np.testing.assert_array_equal(counts, [2, 0, 3, 0, 0, 1])
+    np.testing.assert_array_equal(offsets, [0, 2, 2, 5, 5, 5])
+    # padding bucket (>= num_buckets) rows are dropped
+    ids_pad = np.array([0, 1, 6, 6], dtype=np.int32)
+    counts, _ = (np.asarray(x) for x in bucket_edges(ids_pad, 2))
+    np.testing.assert_array_equal(counts, [1, 1])

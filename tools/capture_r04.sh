@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-4 on-chip capture sequence (run when the axon tunnel is up).
+# Value order = VERDICT r3 "Next round" list:
+#   1. measure_tpu       -> re-time the post-redesign device engines
+#      (group rows end-to-end, 61% fetch trim, 2-deep stream pipeline)
+#   2. bench             -> driver-format line; grid includes the
+#      pending overlap_window_split=0.75 probe (VERDICT #4)
+#   3. attribute         -> dispatch-floor-cancelling stage splits for
+#      the redesigned device program
+#   4. scale_ab          -> >=3 interleaved host-stream reps with link
+#      RTT bracketing every rep (VERDICT #5)
+#   5. scale_devtok      -> the 1M-doc device-stream retry (VERDICT #3)
+# Each step has its own timeout so one hung RPC cannot eat the window.
+set -u
+OUT=${1:-/tmp/r04_capture}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/mri_tpu_xla_cache
+
+step() {  # step <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "=== $name (timeout ${t}s) $(date +%H:%M:%S) ==="
+  timeout "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  echo "rc=$? ($name)"
+  tail -c 2000 "$OUT/$name.out"
+  echo
+}
+
+step measure_tpu        900 python tools/measure_tpu.py
+step bench              900 python bench.py
+step attribute          600 python tools/attribute_device_stages.py
+step scale_ab          1800 python tools/scale_ab.py --reps 3
+step scale_devtok      1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
+                            python bench.py --scale
+
+echo "=== capture complete; outputs in $OUT ==="

@@ -1,0 +1,120 @@
+"""Controlled A/B for the scale story (VERDICT r3 #5).
+
+The host-stream 1M-doc throughput halved between rounds 2 and 3
+(4,941.8 -> 2,553 docs/s, SCALE_r02.json vs SCALE_r03.json) on
+identical code; both rounds blamed "tunnel weather" without measuring
+it.  This tool makes the confound measurable: it runs N interleaved
+host-stream reps in ONE tunnel window and brackets every rep with a
+link round-trip probe, so the artifact records (rtt_ms, docs_per_s)
+pairs and the spread can be attributed.
+
+    python tools/scale_ab.py [--reps 3] [--docs 1000000]
+
+Prints one JSON line per rep plus a summary line; the caller assembles
+them into SCALE_r04.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def link_rtt_ms(reps: int = 7) -> dict:
+    """Best/median round-trip of a tiny dispatch+fetch.
+
+    This is the per-dispatch floor of tpu-measurement lore: ~6.5 ms in
+    good hours, ~60 ms in bad ones.  A real host fetch closes each
+    probe — block_until_ready returns at dispatch-ACK on this platform.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.ones((8,), jnp.int32)
+    np.asarray((x + 1)[:1])  # warm the program
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray((x + 1)[:1])
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return {"rtt_best_ms": round(times[0], 2),
+            "rtt_median_ms": round(times[len(times) // 2], 2)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3, choices=range(1, 100),
+                    metavar="N")
+    ap.add_argument("--docs", type=int, default=1_000_000)
+    ap.add_argument("--vocab", type=int, default=100_000)
+    ap.add_argument("--chunk", type=int, default=100_000)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
+          flush=True)
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus import (
+        synthetic,
+    )
+
+    manifest = synthetic.synthetic_manifest(
+        num_docs=args.docs, vocab_size=args.vocab, tokens_per_doc=40,
+        seed=11)
+    model = InvertedIndexModel(IndexConfig(
+        backend="tpu", output_dir=tempfile.mkdtemp(prefix="scale_ab_"),
+        device_shards=None, stream_chunk_docs=args.chunk))
+
+    lines = []
+    for rep in range(args.reps):
+        pre = link_rtt_ms()
+        t0 = time.perf_counter()
+        stats = model.run(manifest)
+        wall = time.perf_counter() - t0
+        post = link_rtt_ms()
+        line = {
+            "rep": rep,
+            "docs_per_s": round(args.docs / wall, 1),
+            "wall_s": round(wall, 2),
+            "rtt_before": pre,
+            "rtt_after": post,
+            "stream_windows": stats.get("stream_windows"),
+            "unique_pairs": stats.get("unique_pairs"),
+        }
+        lines.append(line)
+        print(json.dumps(line), flush=True)
+
+    rates = sorted(l["docs_per_s"] for l in lines)
+    print(json.dumps({
+        "summary": "scale_ab",
+        "engine": "host-stream",
+        "num_docs": args.docs,
+        "reps": args.reps,
+        "docs_per_s_min": rates[0],
+        "docs_per_s_max": rates[-1],
+        "docs_per_s_spread_pct": round(
+            100.0 * (rates[-1] - rates[0]) / rates[-1], 1),
+        "rtt_best_ms_across_reps": min(
+            l["rtt_before"]["rtt_best_ms"] for l in lines),
+        "rtt_worst_median_ms": max(
+            l["rtt_after"]["rtt_median_ms"] for l in lines),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
