@@ -111,6 +111,19 @@ class IndexConfig:
     # at any count).  None = ``num_mappers`` if > 1, else auto
     # (min(cores, 8)).
     host_threads: int | None = None
+    # Crash-resumable streaming for the single-chip all-device engine:
+    # persist the bounded row accumulator's VERIFIED valid prefix plus
+    # the stream position here every ``stream_checkpoint_every``
+    # windows (utils/checkpoint.save_stream_state, atomic).  A rerun
+    # with the same manifest + stream config resumes at the last
+    # checkpointed window instead of restarting — the durable-spill
+    # role of the reference's partial_<letter>.txt files
+    # (main.c:332-341), which survive a crash and make the remaining
+    # work re-runnable.  Motivated by a real failure: the round-3
+    # 1M-doc on-chip run lost ~9 minutes of stream to a TPU worker
+    # crash (SCALE_r03.json device_stream_real_tpu).
+    stream_checkpoint: str | None = None
+    stream_checkpoint_every: int = 2
     # Emit-side ownership for the multi-chip pipelined path:
     #   "merged" — one host assembles and writes all 26 files (default)
     #   "letter" — pairs are exchanged by *letter owner*
@@ -234,6 +247,22 @@ class IndexConfig:
                 raise ValueError(
                     "emit_ownership='letter' requires the pipelined multi-chip "
                     "path (pipeline_chunk_docs=0 disables it)")
+        if self.stream_checkpoint_every < 1:
+            raise ValueError(
+                f"stream_checkpoint_every must be >= 1, "
+                f"got {self.stream_checkpoint_every}")
+        if self.stream_checkpoint is not None:
+            if not (self.device_tokenize
+                    and self.stream_chunk_docs is not None):
+                raise ValueError(
+                    "stream_checkpoint requires the streaming all-device "
+                    "engine (device_tokenize=True with stream_chunk_docs)")
+            if self.device_shards != 1:
+                raise ValueError(
+                    "stream_checkpoint is single-chip only: pass "
+                    "device_shards=1 explicitly (None routes to the mesh "
+                    "streaming engine when several devices are visible, "
+                    f"which has no checkpoint); got {self.device_shards}")
         if self.stream_chunk_docs is not None:
             if self.stream_chunk_docs < 1:
                 raise ValueError(

@@ -69,6 +69,14 @@ class SyntheticManifest:
         self.tokens_per_doc = tokens_per_doc
         self.seed = seed
         self.gen_chunk = gen_chunk
+        # corpus identity for checkpoint fingerprints: the virtual
+        # paths are just '<synthetic doc i>', so without this, two
+        # synthetic corpora with equal num_docs would fingerprint
+        # identically and a resume could silently mix windows from
+        # different generator parameters
+        self.fingerprint_extra = (
+            f"zipf:v{vocab_size}:t{tokens_per_doc}:a{alpha}"
+            f":s{seed}:g{gen_chunk}")
         self._vocab = np.array(make_vocab(vocab_size, seed=seed), dtype=object)
         ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
         probs = ranks ** (-alpha)
@@ -122,6 +130,14 @@ class _VirtualPaths:
         return self._n
 
     def __getitem__(self, i: int) -> str:
+        # real sequence semantics: without the bounds check, iteration
+        # (which falls back to __getitem__(0..) until IndexError) never
+        # terminates — found when checkpoint.manifest_fingerprint first
+        # iterated a SyntheticManifest's paths
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
         return f"<synthetic doc {i}>"
 
 

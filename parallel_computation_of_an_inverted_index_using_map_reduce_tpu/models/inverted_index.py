@@ -18,6 +18,7 @@ from __future__ import annotations
 import contextlib
 import math
 import os
+import time
 
 import numpy as np
 
@@ -880,10 +881,40 @@ class InvertedIndexModel:
         timer.count("documents", len(manifest))
         engine_s = DS.DeviceStreamEngine(width=width)
         fed_tokens = 0
+
+        # Crash-resumable stream (config.stream_checkpoint): restore
+        # the verified accumulator prefix and skip already-folded
+        # windows.  iter_document_chunks is deterministic for a given
+        # (manifest, chunk size), so window index identifies position.
+        ckpt_path = cfg.stream_checkpoint
+        resume_from = 0
+        if ckpt_path:
+            stream_fp = checkpoint.stream_fingerprint(
+                manifest, width=width, chunk_docs=cfg.stream_chunk_docs,
+                pad_multiple=cfg.pad_multiple)
+            if os.path.exists(ckpt_path):
+                state = checkpoint.load_stream_state(ckpt_path, stream_fp)
+                engine_s.restore(state)
+                fed_tokens = state["fed_tokens"]
+                # loop position, NOT engine windows_fed: the engine
+                # skips empty (tok_count == 0) windows, so its count
+                # can run behind the iteration index
+                resume_from = state["window_pos"]
+                timer.count("resumed_from_window", resume_from)
+        # test hook: simulate the round-3 on-chip TPU worker crash
+        # (SCALE_r03.json) at a deterministic stream position
+        crash_after = int(os.environ.get(
+            "MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", 0))
+        total_windows = -(-len(manifest) // cfg.stream_chunk_docs)
+        ckpt_seconds, ckpt_saves = 0.0, 0
+
         profile = _profile_ctx(cfg.profile_dir)
         with profile, timer.phase("stream_feed"):
-            for contents, ids in iter_document_chunks(
-                    manifest, cfg.stream_chunk_docs):
+            for win_i, (contents, ids) in enumerate(
+                    iter_document_chunks(manifest, cfg.stream_chunk_docs),
+                    start=1):
+                if win_i <= resume_from:
+                    continue
                 total = sum(len(c) for c in contents)
                 padded = _round_up(max(total, 1), cfg.pad_multiple)
                 buf, ends, _ = _pack_window(
@@ -897,6 +928,30 @@ class InvertedIndexModel:
                 engine_s.feed(buf, ends, np.asarray(ids, np.int32),
                               tok_count=cnt, max_len=ml)
                 fed_tokens += cnt
+                # skip the checkpoint that would land on the LAST
+                # window: finalize deletes it moments later
+                if (ckpt_path and win_i < total_windows
+                        and (win_i - resume_from)
+                        % cfg.stream_checkpoint_every == 0):
+                    t0 = time.perf_counter()
+                    snap = engine_s.snapshot()
+                    if snap is not None:
+                        checkpoint.save_stream_state(
+                            ckpt_path, snap, fed_tokens, win_i, stream_fp)
+                    ckpt_seconds += time.perf_counter() - t0
+                    ckpt_saves += 1
+                if crash_after and win_i >= crash_after:
+                    raise RuntimeError(
+                        "injected stream crash after window "
+                        f"{win_i} "
+                        "(MRI_TPU_STREAM_CRASH_AFTER_WINDOWS)")
+        if ckpt_saves:
+            # inside stream_feed's wall time — recorded separately so
+            # checkpointed docs/s is comparable to uncheckpointed runs
+            # (each snapshot drains the 2-deep merge pipeline and
+            # fetches the accumulator over the link)
+            timer.count("checkpoint_saves", ckpt_saves)
+            timer.count("checkpoint_ms", round(ckpt_seconds * 1e3, 2))
         timer.count("stream_windows", engine_s.windows_fed)
         timer.count("accumulator_capacity", engine_s.capacity)
         if engine_s.windows_fed == 0:
@@ -911,6 +966,10 @@ class InvertedIndexModel:
             out = engine_s.finalize()
             num_words, num_pairs, num_long = (
                 int(v) for v in np.asarray(out["counts"]))
+        if ckpt_path and os.path.exists(ckpt_path):
+            # the stream completed; a stale checkpoint would make the
+            # next identical run skip every window and re-finalize
+            os.remove(ckpt_path)
         timer.count("unique_terms", num_words)
         timer.count("unique_pairs", num_pairs)
         timer.count("tokens", fed_tokens)
@@ -1198,6 +1257,12 @@ class InvertedIndexModel:
                 return self._run_tpu_device_tokenize(manifest, out_dir, timer)
             except WidthOverflow as e:
                 # exactness guard tripped: restart on the host-scan path
+                if (self.config.stream_checkpoint
+                        and os.path.exists(self.config.stream_checkpoint)):
+                    # the stream is abandoned for good — a stale
+                    # checkpoint would make every later identical run
+                    # restore, re-stream, and re-trip the overflow
+                    os.remove(self.config.stream_checkpoint)
                 aborted_ms = timer.total_seconds * 1e3
                 self.timer = timer = PhaseTimer()
                 timer.count("num_mappers", self.config.num_mappers)

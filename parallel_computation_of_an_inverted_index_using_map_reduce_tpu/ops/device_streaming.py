@@ -275,17 +275,10 @@ class DeviceStreamEngine:
         self._pending.append((pending_count, tok_count))
         self.windows_fed += 1
 
-    def finalize(self):
-        """Device dict with the one-shot engine's output contract
-        (counts / df / postings / unique_groups valid prefixes).
-
-        Re-checks every window's device-computed stats against the
-        host classifier here — ONE lazy fetch per window, all outside
-        the stream loop — so host/device divergence fails as loudly as
-        the one-shot engine's asserts instead of silently truncating.
-        """
-        if self._acc is None:
-            raise ValueError("no windows fed")
+    def _verify_window_checks(self) -> None:
+        """Fetch + verify the accumulated per-window device stats
+        against the host classifier (shared by finalize and snapshot —
+        a snapshot must not persist an unverified prefix)."""
         for counts_dev, tok_cap, host_max_len in self._window_checks:
             _pairs, dev_max_len, dev_tokens = (
                 int(v) for v in np.asarray(counts_dev))
@@ -298,8 +291,80 @@ class DeviceStreamEngine:
                 raise AssertionError(
                     f"device max word len {dev_max_len} != host "
                     f"{host_max_len}: classifier divergence (bug)")
+        self._window_checks = []
+
+    def snapshot(self) -> dict | None:
+        """Verified host snapshot of the stream state — the durable
+        form of the reference's spill files (main.c:332-341, which
+        persist after the run and make the reduce phase re-runnable;
+        SURVEY.md §5 checkpoint row).
+
+        Drains the in-flight merges (paying the pipeline depth once),
+        verifies every window fed so far, then fetches the accumulator
+        and keeps only the valid row prefix.  Returns ``None`` when
+        nothing has been fed.  The engine stays live — streaming
+        continues after a snapshot.
+        """
+        if self._acc is None:
+            return None
+        while self._pending:
+            handle, _ = self._pending.pop(0)
+            self._unique_bound = int(np.asarray(handle))
+        self._verify_window_checks()
+        count = self._unique_bound
+        cols = jax.device_get(self._acc)
+        return {
+            "width": self._width,
+            "count": count,
+            "cap": self._cap,
+            "live_groups": self._live_groups,
+            "max_word_len": self.max_word_len,
+            "windows_fed": self.windows_fed,
+            "columns": [np.asarray(c[:count]) for c in cols],
+        }
+
+    def restore(self, state: dict) -> None:
+        """Rebuild the device accumulator from :meth:`snapshot` output.
+        The engine must be freshly constructed with the same ``width``."""
+        if self._acc is not None or self.windows_fed:
+            raise ValueError("restore() requires a fresh engine")
+        if state["width"] != self._width:
+            raise ValueError(
+                f"checkpoint width {state['width']} != engine width "
+                f"{self._width}")
+        ncols = 2 * self._num_groups + 1
+        if len(state["columns"]) != ncols:
+            raise ValueError(
+                f"checkpoint has {len(state['columns'])} row columns, "
+                f"engine width {self._width} needs {ncols}")
+        count = int(state["count"])
+        self._cap = int(state["cap"])
+        cols = []
+        for c in state["columns"]:
+            buf = np.full(self._cap, INT32_MAX, np.int32)
+            buf[:count] = c
+            cols.append(jax.device_put(buf))
+        self._acc = tuple(cols)
+        self._unique_bound = count
+        self._live_groups = int(state["live_groups"])
+        self.max_word_len = int(state["max_word_len"])
+        self.windows_fed = int(state["windows_fed"])
+        self._pending = []
+        self._window_checks = []
+
+    def finalize(self):
+        """Device dict with the one-shot engine's output contract
+        (counts / df / postings / unique_groups valid prefixes).
+
+        Re-checks every window's device-computed stats against the
+        host classifier here — ONE lazy fetch per window, all outside
+        the stream loop — so host/device divergence fails as loudly as
+        the one-shot engine's asserts instead of silently truncating.
+        """
+        if self._acc is None:
+            raise ValueError("no windows fed")
+        self._verify_window_checks()
         out = _finalize_rows(self._acc, num_groups=self._num_groups)
         self._acc = None
         self._pending = []
-        self._window_checks = []
         return out

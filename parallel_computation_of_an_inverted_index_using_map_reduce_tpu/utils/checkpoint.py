@@ -30,6 +30,9 @@ def manifest_fingerprint(manifest) -> str:
     """
     h = hashlib.md5()
     h.update(str(len(manifest)).encode())
+    # virtual manifests (corpus/synthetic.py) carry their generator
+    # parameters here — their path labels alone are not an identity
+    h.update(getattr(manifest, "fingerprint_extra", "").encode())
     for p in manifest.paths:
         h.update(b"\0" + p.encode("utf-8", "surrogateescape"))
     return h.hexdigest()
@@ -52,6 +55,82 @@ def save_pairs(path: str | Path, corpus, fingerprint: str = "") -> None:
             raw_tokens=np.int64(corpus.raw_tokens if corpus.raw_tokens is not None else -1),
         )
     os.replace(tmp, path)
+
+
+_STREAM_FORMAT_VERSION = 1
+
+
+def stream_fingerprint(manifest, *, width: int, chunk_docs: int,
+                       pad_multiple: int) -> str:
+    """Identity of a resumable stream: the manifest PLUS every config
+    knob that moves window boundaries or row shape.  Resuming under a
+    different chunking would re-feed or skip documents; a different
+    width changes the row layout — both are rejected at load."""
+    return (f"{manifest_fingerprint(manifest)}:w{width}"
+            f":c{chunk_docs}:p{pad_multiple}")
+
+
+def save_stream_state(path: str | Path, state: dict, fed_tokens: int,
+                      window_pos: int, fingerprint: str) -> None:
+    """Atomically persist a DeviceStreamEngine snapshot.
+
+    Uncompressed ``np.savez`` on purpose: at the 1M-doc scale the
+    accumulator prefix is hundreds of MB and this container has one
+    core — compression would cost minutes per checkpoint while local
+    disk takes seconds.
+    """
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    cols = {f"col_{i}": c for i, c in enumerate(state["columns"])}
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            version=np.int64(_STREAM_FORMAT_VERSION),
+            fingerprint=np.bytes_(fingerprint.encode()),
+            width=np.int64(state["width"]),
+            count=np.int64(state["count"]),
+            cap=np.int64(state["cap"]),
+            live_groups=np.int64(state["live_groups"]),
+            max_word_len=np.int64(state["max_word_len"]),
+            windows_fed=np.int64(state["windows_fed"]),
+            # loop position in the window iteration — distinct from
+            # windows_fed, which skips empty (tok_count == 0) windows
+            window_pos=np.int64(window_pos),
+            fed_tokens=np.int64(fed_tokens),
+            num_columns=np.int64(len(state["columns"])),
+            **cols,
+        )
+    os.replace(tmp, path)
+
+
+def load_stream_state(path: str | Path,
+                      expect_fingerprint: str) -> dict:
+    """Restore a stream snapshot; reject version/fingerprint mismatch."""
+    with np.load(path) as z:
+        version = int(z["version"])
+        if version != _STREAM_FORMAT_VERSION:
+            raise ValueError(
+                f"stream checkpoint {path!r} has version {version}, "
+                f"expected {_STREAM_FORMAT_VERSION}")
+        saved_fp = bytes(z["fingerprint"]).decode()
+        if saved_fp != expect_fingerprint:
+            raise ValueError(
+                f"stream checkpoint {path!r} was written for a different "
+                f"manifest or stream config (saved {saved_fp[:20]}…, "
+                f"current {expect_fingerprint[:20]}…); delete it or "
+                "restore the original run configuration")
+        return {
+            "width": int(z["width"]),
+            "count": int(z["count"]),
+            "cap": int(z["cap"]),
+            "live_groups": int(z["live_groups"]),
+            "max_word_len": int(z["max_word_len"]),
+            "windows_fed": int(z["windows_fed"]),
+            "window_pos": int(z["window_pos"]),
+            "fed_tokens": int(z["fed_tokens"]),
+            "columns": [z[f"col_{i}"]
+                        for i in range(int(z["num_columns"]))],
+        }
 
 
 def load_pairs(path: str | Path, expect_fingerprint: str | None = None):

@@ -30,7 +30,19 @@ step measure_tpu        900 python tools/measure_tpu.py
 step bench              900 python bench.py
 step attribute          600 python tools/attribute_device_stages.py
 step scale_ab          1800 python tools/scale_ab.py --reps 3
+# Crash-hardened 1M-doc device-stream (VERDICT r3 #3): checkpoint
+# every 2 windows; on failure (the r3 run died to a TPU worker crash
+# ~9 min in) wait for the worker to come back and RESUME from the
+# checkpoint instead of restarting.
 step scale_devtok      1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
+                            MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
                             python bench.py --scale
+if ! grep -q '"metric"' "$OUT/scale_devtok.out" 2>/dev/null; then
+  echo "scale_devtok failed; sleeping 90s then resuming from checkpoint"
+  sleep 90
+  step scale_devtok_resume 1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
+                              MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
+                              python bench.py --scale
+fi
 
 echo "=== capture complete; outputs in $OUT ==="
