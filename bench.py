@@ -352,8 +352,25 @@ def _bench_scale() -> int:
     # (ops/device_streaming.py, single chip) instead of the host-scan
     # streaming engine — raw byte windows up, bounded row accumulator
     devtok = bool(int(os.environ.get("MRI_TPU_SCALE_DEVTOK", 0)))
-    manifest = synthetic.synthetic_manifest(
-        num_docs=num_docs, vocab_size=vocab, tokens_per_doc=40, seed=11)
+    # MRI_TPU_SCALE_REALTEXT=1: BASELINE.json config 5's regime — the
+    # reference books resharded at paragraph granularity and cycled to
+    # magnitude (corpus/realtext.py) instead of Zipf synthesis: real
+    # vocabulary growth, real letter skew, real cleaning work.
+    realtext = bool(int(os.environ.get("MRI_TPU_SCALE_REALTEXT", 0)))
+    if realtext:
+        from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.realtext import (
+            ParagraphManifest,
+        )
+
+        manifest = ParagraphManifest(
+            REFERENCE_CORPUS,
+            num_docs=(num_docs if "MRI_TPU_SCALE_DOCS" in os.environ
+                      else None),
+            repeats=int(os.environ.get("MRI_TPU_SCALE_REPEATS", 8)))
+        num_docs = len(manifest)
+    else:
+        manifest = synthetic.synthetic_manifest(
+            num_docs=num_docs, vocab_size=vocab, tokens_per_doc=40, seed=11)
     out_dir = tempfile.mkdtemp(prefix="bench_scale_")
     # MRI_TPU_SCALE_CKPT=path: crash-resumable stream (single-chip
     # devtok only) — a rerun of the same command resumes at the last
@@ -392,7 +409,20 @@ def _bench_scale() -> int:
         "device_shards": stats.get("device_shards", 1),
         "stream_windows": stats.get("stream_windows"),
         "engine": "device-stream" if devtok else "host-stream",
+        "corpus": ("realtext-paragraphs" if realtext else "zipf"),
     }
+    if realtext:
+        line["source_paragraphs"] = manifest.source_paragraphs
+        line["corpus_bytes"] = manifest.total_bytes
+        # docs/s is not comparable across corpora (a paragraph is
+        # ~430 B, a reference chapter ~16 KB): vs_baseline for the
+        # real-text regime is BYTES throughput over the reference's
+        # 7.28 MB/s (5,793,058 B / 0.796 s, BASELINE.md)
+        bytes_streamed = manifest.total_bytes * docs_streamed / num_docs
+        line["vs_baseline"] = round(
+            (bytes_streamed / wall) / (BASELINE_BYTES / (BASELINE_MS / 1e3)),
+            3)
+        line["vs_baseline_basis"] = "bytes_throughput"
     if "resumed_from_window" in stats:
         line["resumed_from_window"] = stats["resumed_from_window"]
         line["docs_streamed"] = docs_streamed
@@ -403,17 +433,44 @@ def _bench_scale() -> int:
     for k in ("checkpoint_saves", "checkpoint_ms"):
         if k in stats:
             line[k] = stats[k]
+    # print the measurement NOW: the probes below re-print an enriched
+    # line, but if one of them crashes or overruns a capture window's
+    # timeout, the expensive scale measurement above must already be on
+    # stdout (same salvage discipline as _run_tpu_attempts)
+    print(json.dumps(line), flush=True)
+    if realtext and os.environ.get("MRI_TPU_SCALE_SKEW"):
+        # hash-vs-letter partition skew on the real text: ONE source
+        # cycle through the skew-collecting one-shot engine (cycling
+        # multiplies every partition count by the same factor, so one
+        # cycle IS the full corpus's distribution)
+        try:
+            one = ParagraphManifest(REFERENCE_CORPUS, repeats=1)
+            skew_stats = InvertedIndexModel(IndexConfig(
+                backend="tpu", output_dir=tempfile.mkdtemp(
+                    prefix="bench_scale_skew_"),
+                device_shards=1, collect_skew_stats=True)).run(one)
+            line["skew_one_cycle"] = {
+                k: skew_stats[k]
+                for k in ("letter_imbalance", "bucket_imbalance")
+                if k in skew_stats}
+        except BaseException as e:
+            line["skew_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(line), flush=True)
     if os.environ.get("MRI_TPU_SCALE_CROSSCHECK"):
         from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.formatter import (
             letters_md5,
         )
 
-        cpu_dir = tempfile.mkdtemp(prefix="bench_scale_cpu_")
-        InvertedIndexModel(IndexConfig(backend="cpu", output_dir=cpu_dir)).run(
-            manifest)
-        line["md5"] = letters_md5(out_dir)
-        line["md5_matches_cpu_backend"] = line["md5"] == letters_md5(cpu_dir)
-    print(json.dumps(line))
+        try:
+            cpu_dir = tempfile.mkdtemp(prefix="bench_scale_cpu_")
+            InvertedIndexModel(IndexConfig(
+                backend="cpu", output_dir=cpu_dir)).run(manifest)
+            line["md5"] = letters_md5(out_dir)
+            line["md5_matches_cpu_backend"] = (
+                line["md5"] == letters_md5(cpu_dir))
+        except BaseException as e:
+            line["crosscheck_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(line), flush=True)
     return 0
 
 

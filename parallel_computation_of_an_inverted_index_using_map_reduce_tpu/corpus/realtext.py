@@ -1,0 +1,91 @@
+"""Real-text corpus at magnitude: the reference books resharded at
+paragraph granularity.
+
+BASELINE.json config 5 names a "real-text streaming corpus (Wikipedia
+abstracts)" regime; with zero egress the same regime is built from the
+corpus already on disk — the six Gutenberg books of
+``/root/reference/test_in`` (SURVEY.md §2.2: 355 chapter files,
+5.79 MB) split at blank-line paragraph boundaries (~13.4K paragraphs)
+and cycled to the target document count.  Unlike the Zipf synthesizer
+(:mod:`.synthetic`), this preserves everything synthetic text lacks:
+real vocabulary growth curves, real word-length distribution, real
+letter skew (the reference's 1000x partial_t-vs-partial_x spread,
+SURVEY.md §2.3), punctuation/UTF-8 cleaning work, and natural
+paragraph-length variance.
+
+Manifest-shaped like :class:`.synthetic.SyntheticManifest` (duck-types
+``__len__`` / ``doc_id`` / ``read_doc`` / ``paths`` / ``sizes`` /
+``total_bytes``), so every loader — streaming chunks, byte-balanced
+range plans — works unchanged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+from .virtualseq import VirtualSeq
+
+
+class ParagraphManifest:
+    """Paragraph-resharded real-text corpus, cycled to ``num_docs``.
+
+    Holds the source paragraphs in memory once (~5.8 MB for the
+    reference corpus) and serves document ``i`` as paragraph
+    ``i % P`` — documents are never materialized as files.
+    """
+
+    def __init__(self, src_dir: str | Path, num_docs: int | None = None,
+                 repeats: int = 1):
+        src_dir = Path(src_dir)
+        files = sorted(p for p in src_dir.rglob("*.txt") if p.is_file())
+        if not files:
+            raise ValueError(f"no .txt files under {src_dir}")
+        corpus_h = hashlib.md5()
+        paras: list[bytes] = []
+        for f in files:
+            data = f.read_bytes()
+            corpus_h.update(data)
+            for p in data.replace(b"\r\n", b"\n").split(b"\n\n"):
+                if p.strip():
+                    paras.append(p)
+        self._paras = paras
+        self.num_docs = (num_docs if num_docs is not None
+                         else repeats * len(paras))
+        if self.num_docs < 1:
+            raise ValueError(f"num_docs must be >= 1, got {self.num_docs}")
+        self.source_paragraphs = len(paras)
+        self.source_files = len(files)
+        # corpus identity for stream-checkpoint fingerprints (the
+        # virtual path labels are not an identity — see
+        # checkpoint.manifest_fingerprint)
+        self.fingerprint_extra = (
+            f"paras:{corpus_h.hexdigest()}:n{self.num_docs}")
+        lens = [len(p) for p in paras]
+        full, rem = divmod(self.num_docs, len(paras))
+        self.total_bytes = full * sum(lens) + sum(lens[:rem])
+        # built once: the planners index sizes per document, and a
+        # fresh per-property list rebuild would be O(num_docs * P)
+        self._sizes = VirtualSeq(self.num_docs,
+                                 lambda i: lens[i % len(lens)])
+        self._paths = VirtualSeq(self.num_docs,
+                                 lambda i: f"<paragraph doc {i}>")
+
+    def __len__(self) -> int:
+        return self.num_docs
+
+    def doc_id(self, index: int) -> int:
+        return index + 1
+
+    def read_doc(self, index: int) -> bytes:
+        if not 0 <= index < self.num_docs:
+            raise IndexError(index)
+        return self._paras[index % len(self._paras)]
+
+    @property
+    def paths(self):
+        return self._paths
+
+    @property
+    def sizes(self):
+        return self._sizes

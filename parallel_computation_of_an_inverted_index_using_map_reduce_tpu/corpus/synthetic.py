@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from .virtualseq import VirtualSeq
+
 _LETTERS = np.frombuffer(b"abcdefghijklmnopqrstuvwxyz", dtype=np.uint8)
 
 
@@ -120,43 +122,17 @@ class SyntheticManifest:
         return self._cache[1][index - chunk_idx * self.gen_chunk]
 
 
-class _VirtualPaths:
-    """Lazy path labels for SyntheticManifest error messages."""
-
-    def __init__(self, n: int):
-        self._n = n
-
-    def __len__(self) -> int:
-        return self._n
-
-    def __getitem__(self, i: int) -> str:
-        # real sequence semantics: without the bounds check, iteration
-        # (which falls back to __getitem__(0..) until IndexError) never
-        # terminates — found when checkpoint.manifest_fingerprint first
-        # iterated a SyntheticManifest's paths
-        if i < 0:
-            i += self._n
-        if not 0 <= i < self._n:
-            raise IndexError(i)
-        return f"<synthetic doc {i}>"
+def _VirtualPaths(n: int):
+    """Lazy path labels for SyntheticManifest error messages
+    (iteration-terminating sequence semantics live in VirtualSeq —
+    found when checkpoint.manifest_fingerprint first iterated a
+    SyntheticManifest's paths)."""
+    return VirtualSeq(n, lambda i: f"<synthetic doc {i}>")
 
 
-class _ConstSeq:
+def _ConstSeq(value: int, n: int):
     """Constant-valued virtual size list (no 1M-element tuple)."""
-
-    def __init__(self, value: int, n: int):
-        self._value, self._n = value, n
-
-    def __len__(self) -> int:
-        return self._n
-
-    def __getitem__(self, i) -> int:
-        if isinstance(i, slice):
-            return [self._value] * len(range(*i.indices(self._n)))
-        return self._value
-
-    def __iter__(self):
-        return (self._value for _ in range(self._n))
+    return VirtualSeq(n, lambda i: value)
 
 
 def synthetic_manifest(num_docs: int, vocab_size: int, tokens_per_doc: int,

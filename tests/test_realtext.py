@@ -1,0 +1,117 @@
+"""corpus/realtext.py — the paragraph-resharded real-text manifest
+(BASELINE.json config 5's regime without egress).
+
+The duck-typed surface must behave exactly like a file manifest: the
+loaders iterate it, the oracle indexes it, and the device engines must
+produce byte-identical output on it.
+"""
+
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    oracle_index,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    iter_document_chunks,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.realtext import (
+    ParagraphManifest,
+)
+
+
+@pytest.fixture(scope="module")
+def src_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rt_src")
+    (d / "a.txt").write_bytes(
+        b"First paragraph here.\n\nSecond one, with Words!\n\n\n"
+        b"Third after a blank run.")
+    (d / "b.txt").write_bytes(b"Only paragraph of file two\r\n\r\nAnd another")
+    return d
+
+
+def test_paragraph_split_and_cycling(src_dir):
+    m = ParagraphManifest(src_dir, repeats=1)
+    assert m.source_files == 2
+    assert m.source_paragraphs == 5
+    assert len(m) == 5
+    m3 = ParagraphManifest(src_dir, repeats=3)
+    assert len(m3) == 15
+    # cycling: doc i is paragraph i % P, ids are 1-based positions
+    assert m3.read_doc(0) == m3.read_doc(5) == m3.read_doc(10)
+    assert m3.doc_id(7) == 8
+    with pytest.raises(IndexError):
+        m3.read_doc(15)
+
+
+def test_sizes_paths_and_total_bytes(src_dir):
+    m = ParagraphManifest(src_dir, num_docs=7)
+    assert len(m.sizes) == 7 and len(m.paths) == 7
+    for i in range(7):
+        assert m.sizes[i] == len(m.read_doc(i))
+    assert m.total_bytes == sum(m.sizes[i] for i in range(7))
+    # sequence-protocol iteration must terminate (the _VirtualPaths bug)
+    assert len(list(m.paths)) == 7
+    assert sum(1 for _ in m.sizes) == 7
+
+
+def test_fingerprint_distinguishes_counts_and_sources(src_dir, tmp_path):
+    a = ParagraphManifest(src_dir, num_docs=5)
+    b = ParagraphManifest(src_dir, num_docs=10)
+    assert a.fingerprint_extra != b.fingerprint_extra
+    other = tmp_path / "other_src"
+    other.mkdir()
+    (other / "c.txt").write_bytes(b"different corpus text")
+    c = ParagraphManifest(other, num_docs=5)
+    assert c.fingerprint_extra != a.fingerprint_extra
+
+
+def test_streaming_loader_covers_every_doc(src_dir):
+    m = ParagraphManifest(src_dir, repeats=2)
+    seen = []
+    for contents, ids in iter_document_chunks(m, 4):
+        assert len(contents) == len(ids) <= 4
+        seen.extend(ids)
+    assert seen == list(range(1, 11))
+
+
+def test_default_engine_on_paragraph_manifest(src_dir, tmp_path):
+    """The DEFAULT tpu engine (pipelined plan) slices manifest.sizes in
+    its byte-balance planner — the virtual sizes sequence must support
+    slices (regression: _ParaSizes without slice handling crashed
+    here with TypeError)."""
+    m = ParagraphManifest(src_dir, repeats=3)
+    oracle_index(m, tmp_path / "golden")
+    InvertedIndexModel(IndexConfig(
+        backend="tpu", output_dir=str(tmp_path / "default"),
+        device_shards=1, pad_multiple=256)).run(m)
+    assert read_letter_files(tmp_path / "default") == read_letter_files(
+        tmp_path / "golden")
+
+
+def test_engines_byte_identical_on_paragraph_manifest(src_dir, tmp_path):
+    m = ParagraphManifest(src_dir, repeats=4)  # 20 docs, heavy dedup
+    oracle_index(m, tmp_path / "golden")
+    InvertedIndexModel(IndexConfig(
+        backend="tpu", output_dir=str(tmp_path / "stream"),
+        device_shards=1, stream_chunk_docs=3)).run(m)
+    assert read_letter_files(tmp_path / "stream") == read_letter_files(
+        tmp_path / "golden")
+    InvertedIndexModel(IndexConfig(
+        backend="tpu", output_dir=str(tmp_path / "devtok"),
+        device_shards=1, device_tokenize=True, pad_multiple=256,
+        stream_chunk_docs=4)).run(m)
+    assert read_letter_files(tmp_path / "devtok") == read_letter_files(
+        tmp_path / "golden")
+
+
+def test_empty_source_and_zero_docs_rejected(src_dir, tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no .txt files"):
+        ParagraphManifest(empty)
+    with pytest.raises(ValueError, match="num_docs"):
+        ParagraphManifest(src_dir, num_docs=0)

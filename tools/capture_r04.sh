@@ -30,6 +30,12 @@ step measure_tpu        900 python tools/measure_tpu.py
 step bench              900 python bench.py
 step attribute          600 python tools/attribute_device_stages.py
 step scale_ab          1800 python tools/scale_ab.py --reps 3
+# Real-text config-5 regime on chip (VERDICT r3 #6): 107K paragraph
+# docs through the host-stream engine, md5 cross-checked, with the
+# one-cycle skew probe
+step scale_realtext     900 env MRI_TPU_SCALE_REALTEXT=1 MRI_TPU_SCALE_CHUNK=20000 \
+                            MRI_TPU_SCALE_SKEW=1 MRI_TPU_SCALE_CROSSCHECK=1 \
+                            python bench.py --scale
 # Crash-hardened 1M-doc device-stream (VERDICT r3 #3): checkpoint
 # every 2 windows; on failure (the r3 run died to a TPU worker crash
 # ~9 min in) wait for the worker to come back and RESUME from the
