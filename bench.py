@@ -474,6 +474,40 @@ def _bench_scale() -> int:
     return 0
 
 
+ATTEST_PATH = Path(os.environ.get(
+    "MRI_TPU_BENCH_ATTEST",
+    Path(__file__).resolve().parent / "BENCH_ATTEST.json"))
+
+
+def _git_rev() -> str:
+    try:
+        # --dirty: a measurement from an uncommitted tree must not be
+        # attributed to the clean commit it will later land in
+        return subprocess.run(
+            ["git", "-C", str(ATTEST_PATH.parent), "describe", "--always",
+             "--dirty"], capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _write_attestation(line: dict) -> None:
+    """Persist the freshest builder-side TPU measurement (VERDICT r3
+    #2): when the tunnel is down at driver time, the fallback artifact
+    embeds this — a timestamped, rev-stamped pointer to the last real
+    on-chip number instead of a bare cpu line."""
+    try:
+        ATTEST_PATH.write_text(json.dumps({
+            "captured_unix": int(time.time()),
+            "captured_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "git_rev": _git_rev(),
+            "tpu_line": line,
+        }, indent=2) + "\n")
+    except OSError as e:
+        print(f"bench: could not write attestation: {e}", file=sys.stderr)
+
+
 def main() -> int:
     _, metric = _manifest()
     tpu, tpu_log = _run_tpu_attempts()
@@ -512,6 +546,30 @@ def main() -> int:
             line["kernel_timings"] = tpu["kernel_timings"]
     if tpu_log:
         line["tpu_attempt_log"] = tpu_log
+    if tpu is not None:
+        # never attest an off-chip smoke run (MRI_TPU_BENCH_PLATFORM
+        # forces a non-TPU platform into the child) or a non-reference
+        # corpus (smoke/synthetic numbers must not masquerade as the
+        # test_in story the fallback reader cites)
+        if (not os.environ.get("MRI_TPU_BENCH_PLATFORM")
+                and metric == "test_in_e2e_wall_ms"):
+            _write_attestation(line)
+    elif ATTEST_PATH.exists():
+        try:
+            att = json.loads(ATTEST_PATH.read_text())
+            line["last_builder_tpu"] = {
+                "captured_utc": att.get("captured_utc"),
+                "git_rev": att.get("git_rev"),
+                "metric": att.get("tpu_line", {}).get("metric"),
+                "value_ms": att.get("tpu_line", {}).get("value"),
+                "vs_baseline": att.get("tpu_line", {}).get("vs_baseline"),
+                "tpu_plan": att.get("tpu_line", {}).get("tpu_plan"),
+                "note": "most recent builder-side on-chip measurement "
+                        "(BENCH_ATTEST.json); the tunnel was down at "
+                        "driver bench time",
+            }
+        except (OSError, json.JSONDecodeError) as e:
+            line["last_builder_tpu_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(line))
     return 0
 
