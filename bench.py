@@ -195,9 +195,16 @@ def _tpu_child() -> int:
     # compile fits even a sick tunnel's watchdog window, and the parent
     # salvages the last complete line from a timed-out child, so this
     # line alone already lands a real TPU number in the artifact.
+    import jax
+
+    # the platform actually measured (attestation gate: JAX_PLATFORMS
+    # alone can redirect the child on hosts without the axon
+    # sitecustomize, so the parent must not infer the platform from env)
+    measured_platform = jax.devices()[0].platform
     fast_plan = {"overlap_tail_fraction": 0.5, "device_shards": 1}
     result = _measure("tpu", [fast_plan], rounds=3)
     result["stage"] = "fast-lane"
+    result["platform"] = measured_platform
     print(json.dumps(result), flush=True)
 
     # Then extend: the full plan grid (like the reference's thread-count
@@ -482,10 +489,13 @@ ATTEST_PATH = Path(os.environ.get(
 def _git_rev() -> str:
     try:
         # --dirty: a measurement from an uncommitted tree must not be
-        # attributed to the clean commit it will later land in
+        # attributed to the clean commit it will later land in.  -C is
+        # the REPO (bench.py's dir) — the attest file may live outside
+        # it (e.g. a capture directory).
         return subprocess.run(
-            ["git", "-C", str(ATTEST_PATH.parent), "describe", "--always",
-             "--dirty"], capture_output=True, text=True, timeout=10,
+            ["git", "-C", str(Path(__file__).resolve().parent), "describe",
+             "--always", "--dirty"], capture_output=True, text=True,
+            timeout=10,
         ).stdout.strip() or "unknown"
     except Exception:
         return "unknown"
@@ -537,6 +547,7 @@ def main() -> int:
         "cpu_host_threads": cpu.get("host_threads"),
     }
     if tpu is not None:
+        line["tpu_platform"] = tpu.get("platform")
         line["tpu_ms"] = round(tpu["best_ms"], 2)
         line["tpu_plan"] = tpu.get("best_plan", {})
         line["tpu_phases_ms"] = {
@@ -547,28 +558,31 @@ def main() -> int:
     if tpu_log:
         line["tpu_attempt_log"] = tpu_log
     if tpu is not None:
-        # never attest an off-chip smoke run (MRI_TPU_BENCH_PLATFORM
-        # forces a non-TPU platform into the child) or a non-reference
-        # corpus (smoke/synthetic numbers must not masquerade as the
-        # test_in story the fallback reader cites)
-        if (not os.environ.get("MRI_TPU_BENCH_PLATFORM")
+        # Attest ONLY a genuine on-chip measurement of the reference
+        # corpus: the child records the platform it actually ran on
+        # (env like JAX_PLATFORMS / MRI_TPU_BENCH_PLATFORM can redirect
+        # it off-chip on some hosts), and smoke/synthetic corpora must
+        # not masquerade as the test_in story the fallback reader cites.
+        if (tpu.get("platform") not in (None, "cpu", "gpu")
                 and metric == "test_in_e2e_wall_ms"):
             _write_attestation(line)
     elif ATTEST_PATH.exists():
         try:
             att = json.loads(ATTEST_PATH.read_text())
+            tl = att.get("tpu_line") or {}
             line["last_builder_tpu"] = {
                 "captured_utc": att.get("captured_utc"),
                 "git_rev": att.get("git_rev"),
-                "metric": att.get("tpu_line", {}).get("metric"),
-                "value_ms": att.get("tpu_line", {}).get("value"),
-                "vs_baseline": att.get("tpu_line", {}).get("vs_baseline"),
-                "tpu_plan": att.get("tpu_line", {}).get("tpu_plan"),
+                "metric": tl.get("metric"),
+                "value_ms": tl.get("value"),
+                "vs_baseline": tl.get("vs_baseline"),
+                "tpu_plan": tl.get("tpu_plan"),
                 "note": "most recent builder-side on-chip measurement "
                         "(BENCH_ATTEST.json); the tunnel was down at "
                         "driver bench time",
             }
-        except (OSError, json.JSONDecodeError) as e:
+        except Exception as e:
+            # a malformed auxiliary file must never sink the bench line
             line["last_builder_tpu_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(line))
     return 0

@@ -71,6 +71,14 @@ def make_parser() -> argparse.ArgumentParser:
                    help="first device window's share of the overlap "
                         "plan's device bytes; larger shrinks the LAST "
                         "window and with it the residual fetch wait")
+    p.add_argument("--stream-checkpoint", default=None,
+                   help="crash-resumable streaming (single-chip "
+                        "--device-tokenize --stream-chunk-docs "
+                        "--device-shards 1): persist the verified "
+                        "accumulator here; a rerun of the same command "
+                        "resumes at the last checkpointed window")
+    p.add_argument("--stream-checkpoint-every", type=int, default=2,
+                   help="windows between stream checkpoints")
     p.add_argument("--host-threads", type=int, default=None,
                    help="host map-phase threads (default: num_mappers if > 1, "
                         "else min(cores, 8)); output-invariant")
@@ -103,6 +111,8 @@ def main(argv: list[str] | None = None) -> int:
             device_tokenize=args.device_tokenize,
             device_tokenize_width=args.device_tokenize_width,
             device_shards=args.device_shards,
+            stream_checkpoint=args.stream_checkpoint,
+            stream_checkpoint_every=args.stream_checkpoint_every,
             host_threads=args.host_threads,
             emit_ownership=args.emit_ownership,
         )

@@ -126,6 +126,33 @@ def test_cli_emit_ownership_letter(tmp_path):
     assert read_letter_files(out_l) == read_letter_files(out_o)
 
 
+def test_cli_stream_checkpoint_kill_resume(tmp_path, capsys, monkeypatch):
+    """README's crash-resume example shape through the real parser:
+    crash mid-stream, rerun the SAME command, resume at the checkpoint."""
+    listfile = _mk_corpus(tmp_path)
+    out = tmp_path / "out"
+    ckpt = tmp_path / "run.ckpt.npz"
+    argv = ["1", "1", str(listfile), "--output-dir", str(out),
+            "--device-tokenize", "--stream-chunk-docs", "1",
+            "--device-shards", "1", "--pad-multiple", "64",
+            "--stream-checkpoint", str(ckpt),
+            "--stream-checkpoint-every", "1", "--stats"]
+    monkeypatch.setenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", "1")
+    import pytest
+
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        main(argv)
+    assert ckpt.exists()
+    monkeypatch.delenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS")
+    capsys.readouterr()
+    assert main(argv) == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["resumed_from_window"] == 1
+    assert not ckpt.exists()
+    data = read_letter_files(out)
+    assert b"alpha:[1]\n" in data and b"beta:[1 2]\n" in data
+
+
 def test_cli_device_stream_engine(tmp_path, capsys):
     """README's streaming all-device example shape: --device-tokenize
     --stream-chunk-docs N --device-shards 1 through the real parser."""

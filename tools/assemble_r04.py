@@ -1,0 +1,120 @@
+"""Assemble BENCH_TPU_r04.json from a capture_r04.sh output directory.
+
+Run right after the capture finishes (the tunnel may die at any
+moment — artifact assembly must not require the chip):
+
+    python tools/assemble_r04.py /tmp/r04_capture
+    git add BENCH_TPU_r04.json SCALE_r04.json BENCH_ATTEST.json && git commit
+
+Parses whatever steps completed — a partial capture still yields a
+partial artifact (same salvage discipline as bench.py's fast lane).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def read_json_lines(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def main() -> int:
+    cap = Path(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r04_capture")
+    art: dict = {
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "capture_dir": str(cap),
+    }
+
+    # 1. measure_tpu: header + one line per engine
+    mt = read_json_lines(cap / "measure_tpu.out")
+    if mt:
+        art["devices"] = mt[0].get("devices")
+        art["engines"] = {l["engine"]: l for l in mt[1:] if "engine" in l}
+
+    # 2. bench: the driver-format line (grid includes the 0.75 split
+    # probe); the LAST parseable line is the most complete
+    bench = read_json_lines(cap / "bench.out")
+    if bench:
+        art["bench_line"] = bench[-1]
+
+    # 3. stage attribution
+    attr = read_json_lines(cap / "attribute.out")
+    if attr:
+        art["stage_attribution"] = attr
+
+    # 4. scale A/B reps with RTT bracketing
+    ab = read_json_lines(cap / "scale_ab.out")
+    if ab:
+        art["scale_ab"] = {
+            "reps": [l for l in ab if "rep" in l],
+            "summary": next((l for l in ab if l.get("summary") == "scale_ab"),
+                            None),
+        }
+
+    # 5. real-text config-5 on chip (last line carries skew + md5)
+    rt = read_json_lines(cap / "scale_realtext.out")
+    if rt:
+        art["scale_realtext"] = rt[-1]
+
+    # 6. 1M-doc device-stream (+ the checkpoint-resume retry)
+    for name, key in (("scale_devtok", "scale_device_stream"),
+                      ("scale_devtok_resume", "scale_device_stream_resume")):
+        lines = read_json_lines(cap / f"{name}.out")
+        if lines:
+            art[key] = lines[-1]
+        err = cap / f"{name}.err"
+        if err.exists() and err.stat().st_size and not lines:
+            art[key + "_error"] = err.read_text()[-1500:]
+
+    out_path = REPO / "BENCH_TPU_r04.json"
+    out_path.write_text(json.dumps(art, indent=2) + "\n")
+    done = [k for k in ("engines", "bench_line", "stage_attribution",
+                        "scale_ab", "scale_realtext", "scale_device_stream")
+            if k in art]
+    print(f"wrote {out_path} with: {', '.join(done) or 'NOTHING (empty capture?)'}")
+
+    # merge the on-chip scale results into SCALE_r04.json next to the
+    # virtual-platform section already committed there
+    scale_path = REPO / "SCALE_r04.json"
+    try:
+        scale = json.loads(scale_path.read_text()) if scale_path.exists() else {}
+    except json.JSONDecodeError:
+        scale = {}
+    stamp = {"captured_utc": art["captured_utc"]}
+    if "scale_ab" in art:
+        scale["host_stream_ab_real_tpu"] = {**stamp, **art["scale_ab"]}
+    if "scale_realtext" in art:
+        scale["realtext_config5_real_tpu"] = {**stamp,
+                                              **art["scale_realtext"]}
+    for key in ("scale_device_stream", "scale_device_stream_resume",
+                "scale_device_stream_error",
+                "scale_device_stream_resume_error"):
+        if key in art:
+            val = art[key]
+            scale[key.replace("scale_", "") + "_real_tpu"] = (
+                {**stamp, **val} if isinstance(val, dict)
+                else {**stamp, "error_tail": val})
+    scale_path.write_text(json.dumps(scale, indent=2) + "\n")
+    print(f"merged on-chip sections into {scale_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
