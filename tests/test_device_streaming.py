@@ -215,6 +215,64 @@ def test_stream_checkpoint_kill_and_resume(tmp_path, monkeypatch):
         tmp_path / "golden")
 
 
+@pytest.mark.parametrize("crash_at,every", [(2, 1), (3, 2), (7, 3)])
+def test_stream_checkpoint_resume_any_crash_point(tmp_path, monkeypatch,
+                                                  crash_at, every):
+    """Property: crash at ANY window under ANY cadence, resume, output
+    byte-identical — resume position must be exactly the last saved
+    loop index regardless of alignment."""
+    docs = zipf_corpus(num_docs=32, vocab_size=90, tokens_per_doc=9, seed=21)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "golden")
+    ckpt = tmp_path / "s.npz"
+    cfg = _cfg(stream_chunk_docs=4, stream_checkpoint=str(ckpt),
+               stream_checkpoint_every=every)
+
+    monkeypatch.setenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", str(crash_at))
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+    monkeypatch.delenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS")
+    report = InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+    # last checkpointed window at or before the crash, on cadence (the
+    # save at an aligned win_i runs BEFORE the crash hook fires)
+    expected_resume = (crash_at // every) * every
+    assert report["resumed_from_window"] == expected_resume
+    assert report["stream_windows"] == 8
+    assert read_letter_files(tmp_path / "out") == read_letter_files(
+        tmp_path / "golden")
+
+
+def test_stream_checkpoint_with_empty_windows(tmp_path, monkeypatch):
+    """Windows that tokenize to nothing (digits/punctuation only) make
+    the engine's windows_fed run BEHIND the loop index; the checkpoint
+    stores the loop position, so resume must still land on the right
+    window (the round-4 review's divergence scenario, now pinned)."""
+    docs = [b"alpha beta", b"   \n  ", b" \t ",
+            b"gamma delta", b"epsilon zeta", b"beta alpha",
+            b"eta theta", b"iota kappa"]
+    # chunk=1: 8 windows; windows 2 and 3 are whitespace-only — zero
+    # TOKENS, so feed() returns before counting them (an all-digit doc
+    # would still count: host_token_stats counts raw tokens)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "golden")
+    ckpt = tmp_path / "s.npz"
+    cfg = _cfg(stream_chunk_docs=1, stream_checkpoint=str(ckpt),
+               stream_checkpoint_every=2)
+    monkeypatch.setenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", "5")
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+    monkeypatch.delenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS")
+    report = InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+    assert report["resumed_from_window"] == 4      # loop position
+    assert report["stream_windows"] == 6           # non-empty windows
+    assert read_letter_files(tmp_path / "out") == read_letter_files(
+        tmp_path / "golden")
+
+
 def test_stream_checkpoint_rejects_changed_config(tmp_path, monkeypatch):
     """A checkpoint written under one chunking must not silently feed a
     resume under another (window index would mean a different doc
