@@ -68,6 +68,15 @@ def main() -> int:
                             None),
         }
 
+    # 4b. stream-engine stage attribution — only a line with real stage
+    # data counts (the tool's first lines are a devices header and a
+    # cold-wall note; an early-killed step must not masquerade as a
+    # completed attribution)
+    ss = [l for l in read_json_lines(cap / "stream_stages.out")
+          if "serialized_wall_s" in l]
+    if ss:
+        art["stream_stage_attribution"] = ss[-1]
+
     # 5. real-text config-5 on chip (last line carries skew + md5)
     rt = read_json_lines(cap / "scale_realtext.out")
     if rt:
@@ -86,7 +95,8 @@ def main() -> int:
     out_path = REPO / "BENCH_TPU_r04.json"
     out_path.write_text(json.dumps(art, indent=2) + "\n")
     done = [k for k in ("engines", "bench_line", "stage_attribution",
-                        "scale_ab", "scale_realtext", "scale_device_stream")
+                        "stream_stage_attribution", "scale_ab",
+                        "scale_realtext", "scale_device_stream")
             if k in art]
     print(f"wrote {out_path} with: {', '.join(done) or 'NOTHING (empty capture?)'}")
 

@@ -51,4 +51,11 @@ if ! grep -q '"metric"' "$OUT/scale_devtok.out" 2>/dev/null; then
                               python bench.py --scale
 fi
 
+# Stream-engine stage attribution at the r3 virtual-revalidation size
+# (120K docs, comparable to SCALE_r03's 3,696 docs/s virtual line):
+# serialized fetch-barrier splits vs the pipelined wall shows where
+# the on-chip stream time goes (upload vs window_rows vs merge).
+step stream_stages     1200 python tools/profile_stream_stages.py \
+                            --docs 120000 --vocab 30000 --chunk 20000
+
 echo "=== capture complete; outputs in $OUT ==="

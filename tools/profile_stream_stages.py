@@ -1,0 +1,185 @@
+"""Stage attribution for the streaming all-device engine.
+
+The one-shot program has truncated-cut attribution
+(attribute_device_stages.py); the stream engine's unit of work is a
+window, and at scale-bench window sizes (tens of MB, seconds per
+stage) every stage sits far above the tunnel's per-dispatch floor —
+so a SERIALIZED run with a real fetch barrier after each stage gives
+honest per-stage sums, and a second, normally-pipelined run gives the
+true wall clock.  The gap between them is what the 2-deep merge
+pipeline buys on this link.
+
+    python tools/profile_stream_stages.py [--docs N] [--vocab V]
+        [--chunk C] [--platform cpu]
+
+Prints one JSON line: per-stage totals (host window prep, upload,
+window_rows, merge) from the serialized run, plus pipelined wall and
+docs/s for both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=120_000)
+    ap.add_argument("--vocab", type=int, default=30_000)
+    ap.add_argument("--chunk", type=int, default=20_000)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
+          flush=True)
+
+    import numpy as np
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        iter_document_chunks,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+        synthetic_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models.inverted_index import (
+        _pack_window, _round_up,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+        device_streaming as DS,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.ops import (
+        device_tokenizer as DT,
+    )
+
+    manifest = synthetic_manifest(
+        num_docs=args.docs, vocab_size=args.vocab, tokens_per_doc=40,
+        seed=11)
+    pad_multiple = 1 << 16
+    width = 48
+
+    def windows():
+        for contents, ids in iter_document_chunks(manifest, args.chunk):
+            total = sum(len(c) for c in contents)
+            padded = _round_up(max(total, 1), pad_multiple)
+            buf, ends, _ = _pack_window(
+                contents, ids, padded, max(len(contents), 1))
+            ends = ends[: len(contents)]
+            cnt, ml = DT.host_token_stats(buf, ends)
+            yield buf, ends, np.asarray(ids, np.int32), cnt, ml
+
+    def fetch_barrier(x):
+        """Real host fetch of a tiny slice — block_until_ready returns
+        at dispatch-ACK on the tunneled platform (measurement lore)."""
+        if isinstance(x, tuple):
+            x = x[0]
+        np.asarray(x if getattr(x, "ndim", 0) == 0 else x[:1])
+
+    # --- pass 1 (cold, pipelined): pays every XLA compile so the two
+    # timed passes below compare warm programs; its wall is reported
+    # separately (compile included)
+    eng0 = DS.DeviceStreamEngine(width=width)
+    t_all = time.perf_counter()
+    for buf, ends, ids, cnt, ml in windows():
+        if cnt:
+            eng0.feed(buf, ends, ids, tok_count=cnt, max_len=ml)
+    eng0.finalize()
+    cold_wall = time.perf_counter() - t_all
+    del eng0  # free its device accumulator before the timed passes
+    print(json.dumps({"pipelined_cold_wall_s": round(cold_wall, 2),
+                      "note": "includes XLA compile"}), flush=True)
+
+    # --- pass 2 (warm, serialized): fetch barrier after every stage
+    stage = {"host_prep_s": 0.0, "upload_s": 0.0, "window_rows_s": 0.0,
+             "merge_s": 0.0}
+    eng = DS.DeviceStreamEngine(width=width)
+    t_all = time.perf_counter()
+    t0 = time.perf_counter()
+    for buf, ends, ids, cnt, ml in windows():
+        stage["host_prep_s"] += time.perf_counter() - t0
+        if cnt == 0:
+            t0 = time.perf_counter()
+            continue
+        # replicate DeviceStreamEngine.feed stage by stage
+        eng.max_word_len = max(eng.max_word_len, ml)
+        sort_cols = -(-max(eng.max_word_len, 1) // 4)
+        eng._live_groups = max(eng._live_groups,
+                               DT.live_groups_for(sort_cols, width))
+        tok_cap = _round_up(cnt + 1, eng._window_pad)
+        out_cap = _round_up(min(cnt, tok_cap), eng._window_pad)
+
+        t0 = time.perf_counter()
+        d_buf = jax.device_put(buf)
+        d_ends = jax.device_put(ends)
+        d_ids = jax.device_put(ids)
+        fetch_barrier(d_buf)
+        stage["upload_s"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        rows, counts = DS.window_rows(
+            d_buf, d_ends, d_ids, width=width, tok_cap=tok_cap,
+            num_docs=ends.shape[0], sort_cols=sort_cols,
+            num_groups=eng._num_groups, out_cap=out_cap)
+        fetch_barrier(counts)
+        stage["window_rows_s"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        eng._ensure_capacity(cnt)
+        if eng._acc is None:
+            pad = np.full(eng._cap, DT.INT32_MAX, np.int32)
+            eng._acc = tuple(jax.device_put(pad)
+                             for _ in range(2 * eng._num_groups + 1))
+        eng._acc, cnt_dev = DS._merge_unique_rows(
+            eng._acc, rows, cap=eng._cap, live_groups=eng._live_groups)
+        fetch_barrier(cnt_dev)
+        # production tightens the bound from resolved merge counts;
+        # serialized mode has every count in hand — without this the
+        # bound grows as the raw token sum, the cap overshoots
+        # production's, and the 'warm' pass recompiles mid-measurement
+        eng._unique_bound = int(np.asarray(cnt_dev))
+        eng.windows_fed += 1
+        stage["merge_s"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+    serialized_wall = time.perf_counter() - t_all
+    out = {
+        "docs": args.docs, "vocab": args.vocab, "chunk": args.chunk,
+        "windows": eng.windows_fed,
+        "accumulator_capacity": eng.capacity,
+        "serialized_wall_s": round(serialized_wall, 2),
+        "serialized_docs_per_s": round(args.docs / serialized_wall, 1),
+        **{k: round(v, 2) for k, v in stage.items()},
+    }
+    print(json.dumps(out), flush=True)
+
+    del eng  # free the serialized pass's accumulator HBM
+    # --- pass 3 (warm, pipelined): the production feed loop (2-deep
+    # merges, no mid-stream syncs) on a FRESH engine
+    eng2 = DS.DeviceStreamEngine(width=width)
+    t_all = time.perf_counter()
+    for buf, ends, ids, cnt, ml in windows():
+        if cnt == 0:
+            continue
+        eng2.feed(buf, ends, ids, tok_count=cnt, max_len=ml)
+    final = eng2.finalize()
+    counts = np.asarray(final["counts"])
+    pipelined_wall = time.perf_counter() - t_all
+    out["pipelined_wall_s"] = round(pipelined_wall, 2)
+    out["pipelined_docs_per_s"] = round(args.docs / pipelined_wall, 1)
+    out["pipeline_gain_pct"] = round(
+        100.0 * (serialized_wall - pipelined_wall) / serialized_wall, 1)
+    out["unique_pairs"] = int(counts[1])
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
