@@ -228,10 +228,11 @@ def _tpu_child() -> int:
             fast_plan,
         ])
         if grid["best_ms"] < result["best_ms"]:
-            grid["stage"] = "grid"
             result = grid
-        else:
-            result["stage"] = "grid"
+        # stamp the winner once — per-branch stamping is how the
+        # dropped-platform bug happened
+        result["stage"] = "grid"
+        result["platform"] = measured_platform
     except BaseException as e:
         result["grid_error"] = f"{type(e).__name__}: {e}"
     finally:
