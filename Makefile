@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast bench clean
+.PHONY: build test test-fast bench bench-scale capture rehearse clean
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -20,6 +20,20 @@ test-fast:
 
 bench:
 	$(PY) bench.py
+
+# 1M-doc streaming benchmark (BASELINE config 4); see bench.py for the
+# MRI_TPU_SCALE_* knobs (REALTEXT=1 switches to the config-5 regime)
+bench-scale:
+	$(PY) bench.py --scale
+
+# full on-chip capture (run when the tunnel is up); outputs to
+# /tmp/r04_capture, then: $(PY) tools/assemble_r04.py
+capture:
+	PY=$(PY) bash tools/capture_r04.sh
+
+# CPU rehearsal of every capture step at tiny sizes (no chip needed)
+rehearse:
+	PY=$(PY) bash tools/rehearse_r04.sh
 
 clean:
 	rm -rf parallel_computation_of_an_inverted_index_using_map_reduce_tpu/native/_build

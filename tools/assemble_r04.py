@@ -37,6 +37,9 @@ def read_json_lines(path: Path) -> list[dict]:
 
 def main() -> int:
     cap = Path(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r04_capture")
+    # optional second arg: destination dir for the artifacts (the
+    # rehearsal writes to a scratch dir instead of the repo's)
+    dest = Path(sys.argv[2]) if len(sys.argv) > 2 else REPO
     art: dict = {
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "capture_dir": str(cap),
@@ -92,7 +95,7 @@ def main() -> int:
         if err.exists() and err.stat().st_size and not lines:
             art[key + "_error"] = err.read_text()[-1500:]
 
-    out_path = REPO / "BENCH_TPU_r04.json"
+    out_path = dest / "BENCH_TPU_r04.json"
     out_path.write_text(json.dumps(art, indent=2) + "\n")
     done = [k for k in ("engines", "bench_line", "stage_attribution",
                         "stream_stage_attribution", "scale_ab",
@@ -102,7 +105,9 @@ def main() -> int:
 
     # merge the on-chip scale results into SCALE_r04.json next to the
     # virtual-platform section already committed there
-    scale_path = REPO / "SCALE_r04.json"
+    scale_path = dest / "SCALE_r04.json"
+    if dest != REPO and (REPO / "SCALE_r04.json").exists() and not scale_path.exists():
+        scale_path.write_text((REPO / "SCALE_r04.json").read_text())
     try:
         scale = json.loads(scale_path.read_text()) if scale_path.exists() else {}
     except json.JSONDecodeError:

@@ -16,6 +16,7 @@ OUT=${1:-/tmp/r04_capture}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR=/tmp/mri_tpu_xla_cache
+PY=${PY:-python}
 
 step() {  # step <name> <timeout_s> <cmd...>
   local name=$1 t=$2; shift 2
@@ -26,36 +27,36 @@ step() {  # step <name> <timeout_s> <cmd...>
   echo
 }
 
-step measure_tpu        900 python tools/measure_tpu.py
-step bench              900 python bench.py
-step attribute          600 python tools/attribute_device_stages.py
-step scale_ab          1800 python tools/scale_ab.py --reps 3
+step measure_tpu        900 $PY tools/measure_tpu.py
+step bench              900 $PY bench.py
+step attribute          600 $PY tools/attribute_device_stages.py
+step scale_ab          1800 $PY tools/scale_ab.py --reps 3
 # Real-text config-5 regime on chip (VERDICT r3 #6): 107K paragraph
 # docs through the host-stream engine, md5 cross-checked, with the
 # one-cycle skew probe
 step scale_realtext     900 env MRI_TPU_SCALE_REALTEXT=1 MRI_TPU_SCALE_CHUNK=20000 \
                             MRI_TPU_SCALE_SKEW=1 MRI_TPU_SCALE_CROSSCHECK=1 \
-                            python bench.py --scale
+                            $PY bench.py --scale
 # Crash-hardened 1M-doc device-stream (VERDICT r3 #3): checkpoint
 # every 2 windows; on failure (the r3 run died to a TPU worker crash
 # ~9 min in) wait for the worker to come back and RESUME from the
 # checkpoint instead of restarting.
 step scale_devtok      1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
                             MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
-                            python bench.py --scale
+                            $PY bench.py --scale
 if ! grep -q '"metric"' "$OUT/scale_devtok.out" 2>/dev/null; then
   echo "scale_devtok failed; sleeping 90s then resuming from checkpoint"
   sleep 90
   step scale_devtok_resume 1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
                               MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
-                              python bench.py --scale
+                              $PY bench.py --scale
 fi
 
 # Stream-engine stage attribution at the r3 virtual-revalidation size
 # (120K docs, comparable to SCALE_r03's 3,696 docs/s virtual line):
 # serialized fetch-barrier splits vs the pipelined wall shows where
 # the on-chip stream time goes (upload vs window_rows vs merge).
-step stream_stages     1200 python tools/profile_stream_stages.py \
+step stream_stages     1200 $PY tools/profile_stream_stages.py \
                             --docs 120000 --vocab 30000 --chunk 20000
 
 echo "=== capture complete; outputs in $OUT ==="

@@ -1,0 +1,51 @@
+#!/bin/bash
+# CPU rehearsal of every capture_r04.sh step at tiny sizes: validates
+# plumbing (commands, env, output files, checkpoint RESUME, assembler)
+# without the chip.  Unlike the capture (salvage-what-you-can), a
+# rehearsal is a VALIDATION: any failing step fails the script.
+set -u
+PY=${PY:-python}
+OUT=${1:-/tmp/r04_rehearsal}
+rm -rf "$OUT"; mkdir -p "$OUT"
+OUT=$(cd "$OUT" && pwd)          # absolute BEFORE we cd to the repo
+cd "$(dirname "$0")/.."
+SMOKE=tests/fixtures/smoke/docs
+fail=0
+step() { local name=$1 t=$2; shift 2
+  timeout "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  local rc=$?
+  echo "rc=$rc ($name)"
+  [ "$rc" -eq 0 ] || { fail=$((fail+1)); tail -3 "$OUT/$name.err"; }
+}
+step measure_tpu 400 $PY tools/measure_tpu.py --platform cpu --quick --corpus $SMOKE
+step bench       500 env MRI_TPU_BENCH_PLATFORM=cpu MRI_TPU_BENCH_CORPUS=$SMOKE $PY bench.py
+step attribute   400 $PY tools/attribute_device_stages.py --platform cpu --corpus $SMOKE --reps 2
+step scale_ab    400 $PY tools/scale_ab.py --platform cpu --reps 2 --docs 4000 --vocab 800 --chunk 1000
+step scale_realtext 400 env MRI_TPU_SCALE_PLATFORM=cpu MRI_TPU_SCALE_REALTEXT=1 \
+    MRI_TPU_SCALE_DOCS=13397 MRI_TPU_SCALE_CHUNK=8000 MRI_TPU_SCALE_SKEW=1 \
+    MRI_TPU_SCALE_CROSSCHECK=1 $PY bench.py --scale
+# the 1M-doc step's CRASH + RESUME path (the r3 worker-crash recovery):
+# first run dies at window 2 by injection, second resumes from the
+# checkpoint — rc of the first is EXPECTED nonzero
+DEVTOK_ENV="MRI_TPU_SCALE_PLATFORM=cpu MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1
+    MRI_TPU_SCALE_DOCS=8000 MRI_TPU_SCALE_VOCAB=2000 MRI_TPU_SCALE_CHUNK=2000
+    MRI_TPU_SCALE_CKPT=$OUT/devtok.ckpt.npz"
+timeout 400 env $DEVTOK_ENV MRI_TPU_STREAM_CRASH_AFTER_WINDOWS=2 $PY bench.py --scale \
+    >"$OUT/scale_devtok_crash.out" 2>&1
+if [ ! -f "$OUT/devtok.ckpt.npz" ]; then
+  echo "rc=1 (scale_devtok_crash: no checkpoint written)"; fail=$((fail+1))
+else
+  echo "rc=0 (scale_devtok_crash: checkpoint written)"
+fi
+step scale_devtok 400 env $DEVTOK_ENV $PY bench.py --scale
+grep -q '"resumed_from_window"' "$OUT/scale_devtok.out" \
+  && echo "rc=0 (scale_devtok resumed from checkpoint)" \
+  || { echo "rc=1 (scale_devtok did NOT resume)"; fail=$((fail+1)); }
+step stream_stages 400 $PY tools/profile_stream_stages.py --platform cpu --docs 8000 --vocab 2000 --chunk 2000
+# assembler is the step that must work after the tunnel dies — always
+# rehearse it, into the scratch dir so repo artifacts stay untouched
+step assemble 60 $PY tools/assemble_r04.py "$OUT" "$OUT"
+grep -q '"engines"' "$OUT/BENCH_TPU_r04.json" 2>/dev/null \
+  || { echo "rc=1 (assembled artifact missing engines)"; fail=$((fail+1)); }
+echo "rehearsal failures: $fail"
+exit $fail
