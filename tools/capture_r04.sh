@@ -18,11 +18,56 @@ cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR=/tmp/mri_tpu_xla_cache
 PY=${PY:-python}
 
+alive() {  # liveness probe: a dead tunnel hangs any device call.
+  # Output kept so "import jax failed instantly" is distinguishable
+  # from "device RPC hung 75 s" (rc 124) when triaging a wasted window.
+  timeout 75 $PY -c "import jax; jax.devices(); import numpy as np, jax.numpy as jnp; np.asarray((jnp.ones((8,), jnp.int32) + 1)[:1])" \
+    >"$OUT/probe.out" 2>"$OUT/probe.err"
+  local rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "probe rc=$rc at $(date +%H:%M:%S) (124=hang/timeout)" \
+      >>"$OUT/probe_history.log"
+    tail -2 "$OUT/probe.err" >>"$OUT/probe_history.log" 2>/dev/null
+  fi
+  return "$rc"
+}
+
+recover() {  # bounded re-probe for the r3 worker-crash scenario: the
+  # TPU worker can crash and come back a minute later — a single
+  # failed probe must not cancel the rest of a scarce window.
+  local tries=$1 pause=$2 i
+  for i in $(seq 1 "$tries"); do
+    if alive; then DEAD=0; PREV_RC=0; return 0; fi
+    echo "recovery probe $i/$tries failed; sleeping ${pause}s"
+    sleep "$pause"
+  done
+  DEAD=1
+  return 1
+}
+
+DEAD=0
+PREV_RC=0
 step() {  # step <name> <timeout_s> <cmd...>
   local name=$1 t=$2; shift 2
+  # Probe ONLY after a failed step (a healthy capture pays no probe
+  # tax; the watcher probed immediately before spawning this script).
+  # A failed probe latches DEAD so later steps skip instantly —
+  # recover() can clear it.
+  if [ "$DEAD" = 1 ]; then
+    echo "=== $name SKIPPED $(date +%H:%M:%S): tunnel down (latched) ==="
+    echo "skipped: tunnel down at $(date +%H:%M:%S)" >"$OUT/$name.err"
+    return 1
+  fi
+  if [ "$PREV_RC" -ne 0 ] && ! alive; then
+    DEAD=1
+    echo "=== $name SKIPPED $(date +%H:%M:%S): tunnel probe failed ==="
+    echo "skipped: tunnel down at $(date +%H:%M:%S)" >"$OUT/$name.err"
+    return 1
+  fi
   echo "=== $name (timeout ${t}s) $(date +%H:%M:%S) ==="
   timeout "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
-  echo "rc=$? ($name)"
+  PREV_RC=$?
+  echo "rc=$PREV_RC ($name)"
   tail -c 2000 "$OUT/$name.out"
   echo
 }
@@ -45,11 +90,14 @@ step scale_devtok      1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=
                             MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
                             $PY bench.py --scale
 if ! grep -q '"metric"' "$OUT/scale_devtok.out" 2>/dev/null; then
-  echo "scale_devtok failed; sleeping 90s then resuming from checkpoint"
-  sleep 90
-  step scale_devtok_resume 1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
-                              MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
-                              $PY bench.py --scale
+  echo "scale_devtok incomplete; attempting worker recovery before resume"
+  if recover 3 60; then
+    step scale_devtok_resume 1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
+                                MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
+                                $PY bench.py --scale
+  else
+    echo "worker did not recover after 3 probes; resume skipped"
+  fi
 fi
 
 # Stream-engine stage attribution at the r3 virtual-revalidation size

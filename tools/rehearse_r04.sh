@@ -27,17 +27,18 @@ step scale_realtext 400 env MRI_TPU_SCALE_PLATFORM=cpu MRI_TPU_SCALE_REALTEXT=1 
 # the 1M-doc step's CRASH + RESUME path (the r3 worker-crash recovery):
 # first run dies at window 2 by injection, second resumes from the
 # checkpoint — rc of the first is EXPECTED nonzero
-DEVTOK_ENV="MRI_TPU_SCALE_PLATFORM=cpu MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1
-    MRI_TPU_SCALE_DOCS=8000 MRI_TPU_SCALE_VOCAB=2000 MRI_TPU_SCALE_CHUNK=2000
-    MRI_TPU_SCALE_CKPT=$OUT/devtok.ckpt.npz"
-timeout 400 env $DEVTOK_ENV MRI_TPU_STREAM_CRASH_AFTER_WINDOWS=2 $PY bench.py --scale \
+DEVTOK_ENV=(MRI_TPU_SCALE_PLATFORM=cpu MRI_TPU_SCALE_DEVTOK=1
+    MRI_TPU_SCALE_CROSSCHECK=1 MRI_TPU_SCALE_DOCS=8000
+    MRI_TPU_SCALE_VOCAB=2000 MRI_TPU_SCALE_CHUNK=2000
+    MRI_TPU_SCALE_CKPT="$OUT/devtok.ckpt.npz")
+timeout 400 env "${DEVTOK_ENV[@]}" MRI_TPU_STREAM_CRASH_AFTER_WINDOWS=2 $PY bench.py --scale \
     >"$OUT/scale_devtok_crash.out" 2>&1
 if [ ! -f "$OUT/devtok.ckpt.npz" ]; then
   echo "rc=1 (scale_devtok_crash: no checkpoint written)"; fail=$((fail+1))
 else
   echo "rc=0 (scale_devtok_crash: checkpoint written)"
 fi
-step scale_devtok 400 env $DEVTOK_ENV $PY bench.py --scale
+step scale_devtok 400 env "${DEVTOK_ENV[@]}" $PY bench.py --scale
 grep -q '"resumed_from_window"' "$OUT/scale_devtok.out" \
   && echo "rc=0 (scale_devtok resumed from checkpoint)" \
   || { echo "rc=1 (scale_devtok did NOT resume)"; fail=$((fail+1)); }
