@@ -938,8 +938,8 @@ class InvertedIndexModel:
                     if snap is not None:
                         checkpoint.save_stream_state(
                             ckpt_path, snap, fed_tokens, win_i, stream_fp)
-                    ckpt_seconds += time.perf_counter() - t0
-                    ckpt_saves += 1
+                        ckpt_seconds += time.perf_counter() - t0
+                        ckpt_saves += 1
                 if crash_after and win_i >= crash_after:
                     raise RuntimeError(
                         "injected stream crash after window "

@@ -30,11 +30,17 @@ def manifest_fingerprint(manifest) -> str:
     """
     h = hashlib.md5()
     h.update(str(len(manifest)).encode())
-    # virtual manifests (corpus/synthetic.py) carry their generator
-    # parameters here — their path labels alone are not an identity
-    h.update(getattr(manifest, "fingerprint_extra", "").encode())
-    for p in manifest.paths:
-        h.update(b"\0" + p.encode("utf-8", "surrogateescape"))
+    # Virtual manifests (corpus/synthetic.py, corpus/realtext.py) carry
+    # their full identity here — generator parameters / source-corpus
+    # hash + doc count — and their path labels are constant-pattern
+    # placeholders, so hashing them would cost O(num_docs) string
+    # formats per run (seconds at the 1M-doc scale) for zero identity.
+    extra = getattr(manifest, "fingerprint_extra", "")
+    if extra:
+        h.update(extra.encode())
+    else:
+        for p in manifest.paths:
+            h.update(b"\0" + p.encode("utf-8", "surrogateescape"))
     return h.hexdigest()
 
 

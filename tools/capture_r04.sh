@@ -73,7 +73,12 @@ step() {  # step <name> <timeout_s> <cmd...>
 }
 
 step measure_tpu        900 $PY tools/measure_tpu.py
-step bench              900 $PY bench.py
+# bench's internal retry ladder must fit inside the step timeout, or
+# the outer kill destroys the salvaged fast-lane line the ladder
+# exists to protect: 75 s probe + 480 + 240 s attempts + cpu measure
+# fits 900 s only with the trimmed ladder below
+step bench              900 env MRI_TPU_BENCH_TIMEOUTS=480,240 MRI_TPU_BENCH_ATTEMPTS=2 \
+                            $PY bench.py
 step attribute          600 $PY tools/attribute_device_stages.py
 step scale_ab          1800 $PY tools/scale_ab.py --reps 3
 # Real-text config-5 regime on chip (VERDICT r3 #6): 107K paragraph
