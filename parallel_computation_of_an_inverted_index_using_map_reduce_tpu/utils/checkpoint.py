@@ -63,7 +63,12 @@ def save_pairs(path: str | Path, corpus, fingerprint: str = "") -> None:
     os.replace(tmp, path)
 
 
-_STREAM_FORMAT_VERSION = 1
+# v2: virtual-manifest fingerprints hash fingerprint_extra INSTEAD of
+# the O(num_docs) constant-pattern path labels — pre-v2 checkpoints of
+# virtual manifests carry a different fingerprint, so the version bump
+# makes the one-time invalidation an explicit version error rather
+# than a confusing "different manifest" rejection.
+_STREAM_FORMAT_VERSION = 2
 
 
 def stream_fingerprint(manifest, *, width: int, chunk_docs: int,
