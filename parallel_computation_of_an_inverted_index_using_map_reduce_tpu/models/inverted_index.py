@@ -23,7 +23,6 @@ import time
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from ..config import IndexConfig
 from ..parallel import dist_engine
