@@ -112,4 +112,9 @@ fi
 step stream_stages     1200 $PY tools/profile_stream_stages.py \
                             --docs 120000 --vocab 30000 --chunk 20000
 
+# Self-assemble: if this capture finishes after the builder session
+# ended, the artifacts must still land in the repo — the driver's
+# end-of-round snapshot commits uncommitted files.
+$PY tools/assemble_r04.py "$OUT" || echo "assembly failed (rc=$?)"
+
 echo "=== capture complete; outputs in $OUT ==="
