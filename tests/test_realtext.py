@@ -108,6 +108,85 @@ def test_engines_byte_identical_on_paragraph_manifest(src_dir, tmp_path):
         tmp_path / "golden")
 
 
+def test_salted_cycles_grow_vocabulary(src_dir):
+    """VERDICT r4 weak #1 / next #6: with salt_cycles the term space
+    keeps growing past one source cycle — cycle r re-contributes the
+    source vocabulary tagged with the cycle's letter suffix — instead
+    of freezing after the first ~P docs."""
+    P = ParagraphManifest(src_dir, repeats=1).source_paragraphs
+    m = ParagraphManifest(src_dir, repeats=3, salt_cycles=True)
+    # cycle 0 is the untouched real text
+    for i in range(P):
+        assert m.read_doc(i) == ParagraphManifest(src_dir,
+                                                  repeats=1).read_doc(i)
+    # later cycles: same word count, every word suffixed, distinct tags
+    assert m.read_doc(P) == b" ".join(
+        w + b"aa" for w in m.read_doc(0).split())
+    assert m.read_doc(2 * P) == b" ".join(
+        w + b"ab" for w in m.read_doc(0).split())
+
+    def vocab(docs):
+        return {w for d in docs for w in d.split()}
+
+    v1 = vocab(m.read_doc(i) for i in range(P))
+    v3 = vocab(m.read_doc(i) for i in range(3 * P))
+    # exactly 3x on this fixture because it is collision-free; real
+    # corpora can lose a few terms to raw-vs-salted collisions
+    # ("cab" == "c"+"ab") — see the class docstring
+    assert len(v3) == 3 * len(v1)
+    # unsalted comparison: vocabulary frozen after one cycle
+    u = ParagraphManifest(src_dir, repeats=3)
+    assert len(vocab(u.read_doc(i) for i in range(3 * P))) == len(v1)
+
+
+def test_salted_sizes_and_fingerprint(src_dir):
+    m = ParagraphManifest(src_dir, num_docs=13, salt_cycles=True)
+    for i in range(13):
+        assert m.sizes[i] == len(m.read_doc(i)), i
+    assert m.total_bytes == sum(m.sizes[i] for i in range(13))
+    # whole-cycle totals too (the closed-form full-cycle branch)
+    w = ParagraphManifest(src_dir, repeats=3, salt_cycles=True)
+    assert w.total_bytes == sum(w.sizes[i] for i in range(len(w)))
+    # a salted stream must not resume an unsalted checkpoint
+    assert (m.fingerprint_extra
+            != ParagraphManifest(src_dir, num_docs=13).fingerprint_extra)
+
+
+def test_cycle_tag_letters_only():
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.realtext import (
+        _cycle_tag,
+    )
+
+    tags = [_cycle_tag(r, 2) for r in range(1, 677)]
+    assert tags[:3] == [b"aa", b"ab", b"ac"]
+    assert len(set(tags)) == len(tags)  # unique per cycle
+    assert all(t.isalpha() and t.islower() and len(t) == 2 for t in tags)
+    with pytest.raises(ValueError, match="does not fit"):
+        _cycle_tag(677, 2)
+    # FIXED width is what makes word+tag unambiguous across cycles:
+    # with per-cycle widths, "web"+"a" == "we"+"ba" (review r5 finding)
+    assert b"web" + _cycle_tag(1, 2) != b"we" + _cycle_tag(28, 2)
+    salted = {w + t for w in (b"we", b"web") for t in tags}
+    assert len(salted) == 2 * len(tags)
+
+
+def test_salted_engines_byte_identical(src_dir, tmp_path):
+    """Salted docs are still plain text: every engine must agree with
+    the oracle on them (the tags survive cleaning verbatim)."""
+    m = ParagraphManifest(src_dir, repeats=3, salt_cycles=True)
+    oracle_index(m, tmp_path / "golden")
+    report = InvertedIndexModel(IndexConfig(
+        backend="tpu", output_dir=str(tmp_path / "stream"),
+        device_shards=1, stream_chunk_docs=4)).run(m)
+    assert read_letter_files(tmp_path / "stream") == read_letter_files(
+        tmp_path / "golden")
+    # the recorded vocab-growth curve keeps climbing in the salted
+    # cycles (window 1 covers cycle 0; windows 2-4 are cycles 1-2)
+    curve = report["vocab_curve"]
+    assert curve == sorted(curve) and curve[-1] > curve[0]
+    assert curve[-1] == report["unique_terms"]
+
+
 def test_empty_source_and_zero_docs_rejected(src_dir, tmp_path):
     empty = tmp_path / "empty"
     empty.mkdir()

@@ -1,10 +1,11 @@
-"""Assemble BENCH_TPU_r04.json from a capture_r04.sh output directory.
+"""Assemble BENCH_TPU_r{NN}.json from a capture.sh output directory.
 
-Run right after the capture finishes (the tunnel may die at any
-moment — artifact assembly must not require the chip):
+Round-parameterized (VERDICT r4 #7: one assembler + a round arg, not a
+per-round copy).  Run right after the capture finishes (the tunnel may
+die at any moment — artifact assembly must not require the chip):
 
-    python tools/assemble_r04.py /tmp/r04_capture
-    git add BENCH_TPU_r04.json SCALE_r04.json BENCH_ATTEST.json && git commit
+    python tools/assemble.py /tmp/r05_capture 5
+    git add BENCH_TPU_r05.json SCALE_r05.json BENCH_ATTEST.json && git commit
 
 Parses whatever steps completed — a partial capture still yields a
 partial artifact (same salvage discipline as bench.py's fast lane).
@@ -36,10 +37,12 @@ def read_json_lines(path: Path) -> list[dict]:
 
 
 def main() -> int:
-    cap = Path(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r04_capture")
-    # optional second arg: destination dir for the artifacts (the
+    cap = Path(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r05_capture")
+    rnd = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    # optional third arg: destination dir for the artifacts (the
     # rehearsal writes to a scratch dir instead of the repo's)
-    dest = Path(sys.argv[2]) if len(sys.argv) > 2 else REPO
+    dest = Path(sys.argv[3]) if len(sys.argv) > 3 else REPO
+    tag = f"r{rnd:02d}"
     art: dict = {
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "capture_dir": str(cap),
@@ -80,7 +83,8 @@ def main() -> int:
     if ss:
         art["stream_stage_attribution"] = ss[-1]
 
-    # 5. real-text config-5 on chip (last line carries skew + md5)
+    # 5. real-text config-5 on chip (last line carries skew + md5; from
+    # round 5 also salted vocab growth — the vocab_curve key)
     rt = read_json_lines(cap / "scale_realtext.out")
     if rt:
         art["scale_realtext"] = rt[-1]
@@ -95,7 +99,7 @@ def main() -> int:
         if err.exists() and err.stat().st_size and not lines:
             art[key + "_error"] = err.read_text()[-1500:]
 
-    out_path = dest / "BENCH_TPU_r04.json"
+    out_path = dest / f"BENCH_TPU_{tag}.json"
     out_path.write_text(json.dumps(art, indent=2) + "\n")
     done = [k for k in ("engines", "bench_line", "stage_attribution",
                         "stream_stage_attribution", "scale_ab",
@@ -103,11 +107,12 @@ def main() -> int:
             if k in art]
     print(f"wrote {out_path} with: {', '.join(done) or 'NOTHING (empty capture?)'}")
 
-    # merge the on-chip scale results into SCALE_r04.json next to the
+    # merge the on-chip scale results into SCALE_r{NN}.json next to any
     # virtual-platform section already committed there
-    scale_path = dest / "SCALE_r04.json"
-    if dest != REPO and (REPO / "SCALE_r04.json").exists() and not scale_path.exists():
-        scale_path.write_text((REPO / "SCALE_r04.json").read_text())
+    scale_path = dest / f"SCALE_{tag}.json"
+    if dest != REPO and (REPO / f"SCALE_{tag}.json").exists() \
+            and not scale_path.exists():
+        scale_path.write_text((REPO / f"SCALE_{tag}.json").read_text())
     try:
         scale = json.loads(scale_path.read_text()) if scale_path.exists() else {}
     except json.JSONDecodeError:

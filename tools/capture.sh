@@ -1,18 +1,32 @@
 #!/bin/bash
-# Round-4 on-chip capture sequence (run when the axon tunnel is up).
-# Value order = VERDICT r3 "Next round" list:
+# Round-parameterized on-chip capture sequence (run when the axon
+# tunnel is up).  VERDICT r4 #7: ONE script + a round arg, replacing
+# the capture_r03/r04 copies.  Usage:
+#
+#     bash tools/capture.sh [ROUND] [OUTDIR]
+#
+# Value order = the standing VERDICT "next round" list:
 #   1. measure_tpu       -> re-time the post-redesign device engines
 #      (group rows end-to-end, 61% fetch trim, 2-deep stream pipeline)
-#   2. bench             -> driver-format line; grid includes the
-#      pending overlap_window_split=0.75 probe (VERDICT #4)
+#   2. bench             -> driver-format line (self-writes
+#      BENCH_ATTEST.json on a genuine on-chip run); grid includes the
+#      overlap_window_split=0.75 probe
 #   3. attribute         -> dispatch-floor-cancelling stage splits for
 #      the redesigned device program
 #   4. scale_ab          -> >=3 interleaved host-stream reps with link
-#      RTT bracketing every rep (VERDICT #5)
-#   5. scale_devtok      -> the 1M-doc device-stream retry (VERDICT #3)
+#      RTT bracketing every rep
+#   5. scale_realtext    -> config-5 at magnitude, SALTED cycles (vocab
+#      keeps growing past one source pass), md5 cross-checked
+#   6. scale_devtok      -> the 1M-doc device-stream with crash-resume
+#      armed and the snapshot-tax budget active
+#   7. stream_stages     -> production-path stage attribution
 # Each step has its own timeout so one hung RPC cannot eat the window.
+# On completion the assembled artifacts are COMMITTED — a capture that
+# outlives the builder session must not depend on it to land results.
 set -u
-OUT=${1:-/tmp/r04_capture}
+R=${1:-5}
+TAG=$(printf 'r%02d' "$R")
+OUT=${2:-/tmp/${TAG}_capture}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR=/tmp/mri_tpu_xla_cache
@@ -81,16 +95,18 @@ step bench              900 env MRI_TPU_BENCH_TIMEOUTS=480,240 MRI_TPU_BENCH_ATT
                             $PY bench.py
 step attribute          600 $PY tools/attribute_device_stages.py
 step scale_ab          1800 $PY tools/scale_ab.py --reps 3
-# Real-text config-5 regime on chip (VERDICT r3 #6): 107K paragraph
-# docs through the host-stream engine, md5 cross-checked, with the
-# one-cycle skew probe
+# Real-text config-5 regime on chip: 107K paragraph docs through the
+# host-stream engine, md5 cross-checked, one-cycle skew probe, and —
+# round 5 on — SALTED repeat cycles so the vocabulary keeps growing
+# with real-text shape (bench.py records the per-window vocab_curve)
 step scale_realtext     900 env MRI_TPU_SCALE_REALTEXT=1 MRI_TPU_SCALE_CHUNK=20000 \
                             MRI_TPU_SCALE_SKEW=1 MRI_TPU_SCALE_CROSSCHECK=1 \
                             $PY bench.py --scale
-# Crash-hardened 1M-doc device-stream (VERDICT r3 #3): checkpoint
-# every 2 windows; on failure (the r3 run died to a TPU worker crash
-# ~9 min in) wait for the worker to come back and RESUME from the
-# checkpoint instead of restarting.
+# Crash-hardened 1M-doc device-stream: checkpoint every 2 windows
+# under the snapshot-tax budget (projected-too-expensive saves are
+# skipped and recorded); on failure (the r3 run died to a TPU worker
+# crash ~9 min in) wait for the worker to come back and RESUME from
+# the checkpoint instead of restarting.
 step scale_devtok      1800 env MRI_TPU_SCALE_DEVTOK=1 MRI_TPU_SCALE_CROSSCHECK=1 \
                             MRI_TPU_SCALE_CKPT="$OUT/devtok_stream.ckpt.npz" \
                             $PY bench.py --scale
@@ -107,14 +123,40 @@ fi
 
 # Stream-engine stage attribution at the r3 virtual-revalidation size
 # (120K docs, comparable to SCALE_r03's 3,696 docs/s virtual line):
-# serialized fetch-barrier splits vs the pipelined wall shows where
-# the on-chip stream time goes (upload vs window_rows vs merge).
+# production-path (stage_hook) fetch-barrier splits vs the pipelined
+# wall shows where the on-chip stream time goes.
 step stream_stages     1200 $PY tools/profile_stream_stages.py \
                             --docs 120000 --vocab 30000 --chunk 20000
 
-# Self-assemble: if this capture finishes after the builder session
-# ended, the artifacts must still land in the repo — the driver's
-# end-of-round snapshot commits uncommitted files.
-$PY tools/assemble_r04.py "$OUT" || echo "assembly failed (rc=$?)"
+# Self-assemble AND self-commit: if this capture finishes after the
+# builder session ended, the artifacts must still land in the repo —
+# and a commit is the only landing the driver is guaranteed to keep.
+$PY tools/assemble.py "$OUT" "$R" || echo "assembly failed (rc=$?)"
+ARTIFACTS=()
+for f in "BENCH_TPU_${TAG}.json" "SCALE_${TAG}.json" BENCH_ATTEST.json; do
+  [ -f "$f" ] || continue            # one missing file must not void
+  git add -- "$f" && ARTIFACTS+=("$f")  # the add of the survivors
+done
+if [ ${#ARTIFACTS[@]} -eq 0 ]; then
+  echo "capture commit: no artifacts to commit (empty capture?)"
+else
+  committed=0
+  for attempt in 1 2 3; do
+    # pathspec-limited commit: a concurrent builder session may have
+    # unrelated changes staged — they must not ride this commit
+    if git commit -m "Record on-chip capture artifacts (round $R)" \
+        -- "${ARTIFACTS[@]}"; then
+      committed=1
+      break
+    fi
+    sleep 5   # index.lock contention with a concurrent builder commit
+  done
+  if [ "$committed" -ne 1 ]; then
+    echo "capture commit FAILED after 3 attempts — artifacts" \
+         "(${ARTIFACTS[*]}) are written but UNCOMMITTED; commit them" \
+         "manually or let the driver's end-of-round snapshot pick" \
+         "them up" >&2
+  fi
+fi
 
 echo "=== capture complete; outputs in $OUT ==="

@@ -98,56 +98,30 @@ def main() -> int:
     print(json.dumps({"pipelined_cold_wall_s": round(cold_wall, 2),
                       "note": "includes XLA compile"}), flush=True)
 
-    # --- pass 2 (warm, serialized): fetch barrier after every stage
+    # --- pass 2 (warm, serialized): the PRODUCTION feed path with its
+    # stage_hook barriering + timing each stage (ops/device_streaming
+    # .feed — the hook also drains the merge pipeline per window, so
+    # this pass is exactly "production minus pipelining"; advisor r4:
+    # no stage-by-stage re-implementation to desynchronize)
     stage = {"host_prep_s": 0.0, "upload_s": 0.0, "window_rows_s": 0.0,
              "merge_s": 0.0}
+    clock = [0.0]
+
+    def stage_hook(name, val):
+        fetch_barrier(val)
+        now = time.perf_counter()
+        stage[name + "_s"] += now - clock[0]
+        clock[0] = now
+
     eng = DS.DeviceStreamEngine(width=width)
     t_all = time.perf_counter()
     t0 = time.perf_counter()
     for buf, ends, ids, cnt, ml in windows():
         stage["host_prep_s"] += time.perf_counter() - t0
-        if cnt == 0:
-            t0 = time.perf_counter()
-            continue
-        # replicate DeviceStreamEngine.feed stage by stage
-        eng.max_word_len = max(eng.max_word_len, ml)
-        sort_cols = -(-max(eng.max_word_len, 1) // 4)
-        eng._live_groups = max(eng._live_groups,
-                               DT.live_groups_for(sort_cols, width))
-        tok_cap = _round_up(cnt + 1, eng._window_pad)
-        out_cap = _round_up(min(cnt, tok_cap), eng._window_pad)
-
-        t0 = time.perf_counter()
-        d_buf = jax.device_put(buf)
-        d_ends = jax.device_put(ends)
-        d_ids = jax.device_put(ids)
-        fetch_barrier(d_buf)
-        stage["upload_s"] += time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        rows, counts = DS.window_rows(
-            d_buf, d_ends, d_ids, width=width, tok_cap=tok_cap,
-            num_docs=ends.shape[0], sort_cols=sort_cols,
-            num_groups=eng._num_groups, out_cap=out_cap)
-        fetch_barrier(counts)
-        stage["window_rows_s"] += time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        eng._ensure_capacity(cnt)
-        if eng._acc is None:
-            pad = np.full(eng._cap, DT.INT32_MAX, np.int32)
-            eng._acc = tuple(jax.device_put(pad)
-                             for _ in range(2 * eng._num_groups + 1))
-        eng._acc, cnt_dev = DS._merge_unique_rows(
-            eng._acc, rows, cap=eng._cap, live_groups=eng._live_groups)
-        fetch_barrier(cnt_dev)
-        # production tightens the bound from resolved merge counts;
-        # serialized mode has every count in hand — without this the
-        # bound grows as the raw token sum, the cap overshoots
-        # production's, and the 'warm' pass recompiles mid-measurement
-        eng._unique_bound = int(np.asarray(cnt_dev))
-        eng.windows_fed += 1
-        stage["merge_s"] += time.perf_counter() - t0
+        if cnt:
+            clock[0] = time.perf_counter()
+            eng.feed(buf, ends, ids, tok_count=cnt, max_len=ml,
+                     stage_hook=stage_hook)
         t0 = time.perf_counter()
     serialized_wall = time.perf_counter() - t_all
     out = {

@@ -1,11 +1,17 @@
 #!/bin/bash
-# CPU rehearsal of every capture_r04.sh step at tiny sizes: validates
+# CPU rehearsal of every capture.sh step at tiny sizes: validates
 # plumbing (commands, env, output files, checkpoint RESUME, assembler)
-# without the chip.  Unlike the capture (salvage-what-you-can), a
-# rehearsal is a VALIDATION: any failing step fails the script.
+# without the chip.  Round-parameterized like the capture (VERDICT r4
+# #7).  Usage:  bash tools/rehearse.sh [ROUND] [OUTDIR]
+# Unlike the capture (salvage-what-you-can), a rehearsal is a
+# VALIDATION: any failing step fails the script.  It never writes repo
+# artifacts and never commits — the assembler is pointed at the
+# scratch dir.
 set -u
 PY=${PY:-python}
-OUT=${1:-/tmp/r04_rehearsal}
+R=${1:-5}
+TAG=$(printf 'r%02d' "$R")
+OUT=${2:-/tmp/${TAG}_rehearsal}
 rm -rf "$OUT"; mkdir -p "$OUT"
 OUT=$(cd "$OUT" && pwd)          # absolute BEFORE we cd to the repo
 cd "$(dirname "$0")/.."
@@ -21,9 +27,22 @@ step measure_tpu 400 $PY tools/measure_tpu.py --platform cpu --quick --corpus $S
 step bench       500 env MRI_TPU_BENCH_PLATFORM=cpu MRI_TPU_BENCH_CORPUS=$SMOKE $PY bench.py
 step attribute   400 $PY tools/attribute_device_stages.py --platform cpu --corpus $SMOKE --reps 2
 step scale_ab    400 $PY tools/scale_ab.py --platform cpu --reps 2 --docs 4000 --vocab 800 --chunk 1000
+# two source cycles so the SALTED vocab-growth path is rehearsed (the
+# vocab_curve must keep climbing in cycle 2)
 step scale_realtext 400 env MRI_TPU_SCALE_PLATFORM=cpu MRI_TPU_SCALE_REALTEXT=1 \
-    MRI_TPU_SCALE_DOCS=13397 MRI_TPU_SCALE_CHUNK=8000 MRI_TPU_SCALE_SKEW=1 \
+    MRI_TPU_SCALE_DOCS=26794 MRI_TPU_SCALE_CHUNK=8000 MRI_TPU_SCALE_SKEW=1 \
     MRI_TPU_SCALE_CROSSCHECK=1 $PY bench.py --scale
+$PY - "$OUT/scale_realtext.out" <<'EOF'
+import json, sys
+lines = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+line = lines[-1]
+curve = line.get("vocab_curve")
+assert line.get("salt_cycles"), "salting not active"
+assert curve and curve[-1] > curve[0] >= 1, f"flat vocab curve: {curve}"
+assert line["unique_terms"] > 33262, line["unique_terms"]
+print("salted vocab growth ok:", curve[0], "->", curve[-1])
+EOF
+[ $? -eq 0 ] || { echo "rc=1 (scale_realtext vocab growth)"; fail=$((fail+1)); }
 # the 1M-doc step's CRASH + RESUME path (the r3 worker-crash recovery):
 # first run dies at window 2 by injection, second resumes from the
 # checkpoint — rc of the first is EXPECTED nonzero
@@ -45,8 +64,8 @@ grep -q '"resumed_from_window"' "$OUT/scale_devtok.out" \
 step stream_stages 400 $PY tools/profile_stream_stages.py --platform cpu --docs 8000 --vocab 2000 --chunk 2000
 # assembler is the step that must work after the tunnel dies — always
 # rehearse it, into the scratch dir so repo artifacts stay untouched
-step assemble 60 $PY tools/assemble_r04.py "$OUT" "$OUT"
-grep -q '"engines"' "$OUT/BENCH_TPU_r04.json" 2>/dev/null \
+step assemble 60 $PY tools/assemble.py "$OUT" "$R" "$OUT"
+grep -q '"engines"' "$OUT/BENCH_TPU_${TAG}.json" 2>/dev/null \
   || { echo "rc=1 (assembled artifact missing engines)"; fail=$((fail+1)); }
 echo "rehearsal failures: $fail"
 exit $fail
