@@ -979,12 +979,15 @@ class InvertedIndexModel:
                             ckpt_seconds += dt
                             ckpt_saves += 1
                             ckpt_ms_per_save.append(round(dt * 1e3, 2))
-                            if dt > 1e-3 and nbytes:
+                            moved = snap.get("fetched_nbytes", nbytes)
+                            if dt > 1e-3 and moved:
                                 # measured whole-save rate (drain +
-                                # fetch + write), floored so one outlier
-                                # can't lock out every later save
+                                # fetch + write) over the bytes the
+                                # fetch ACTUALLY moved, floored so one
+                                # outlier can't lock out every later
+                                # save
                                 ckpt_rate_mbps = max(
-                                    nbytes / dt / 1e6, 0.5)
+                                    moved / dt / 1e6, 0.5)
                 if crash_after and win_i >= crash_after:
                     raise RuntimeError(
                         "injected stream crash after window "

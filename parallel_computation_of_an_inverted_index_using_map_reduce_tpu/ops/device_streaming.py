@@ -137,6 +137,17 @@ def _regrow_rows(acc, *, cap: int):
     return tuple(one(a) for a in acc)
 
 
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _head_rows(acc, *, pad: int):
+    """Static-size prefix of every accumulator column — the snapshot
+    fetch moves only this instead of the full capacity (the cap can
+    sit at ~2x the live count right after a doubling; at 1M-doc scale
+    that slack is >100 MB over the tunnel).  ``pad`` is granule-
+    rounded by the caller so the program count stays O(high-water /
+    granule), not one per distinct live count."""
+    return tuple(lax.slice(a, (0,), (pad,)) for a in acc)
+
+
 def finalize_rows_body(acc, *, num_groups: int):
     """Traceable core of :func:`_finalize_rows` — also runs per shard
     inside the mesh streaming engine's ``shard_map`` finalize
@@ -218,6 +229,10 @@ class DeviceStreamEngine:
         self.windows_fed = 0
         self.max_word_len = 0
         self._window_checks = []   # (counts_dev, tok_cap, host_max_len)
+        # snapshot prefix-fetch rounding: bounds the number of distinct
+        # _head_rows programs at high-water/granule while keeping the
+        # over-fetch under one granule of rows per column
+        self._snapshot_granule = 1 << 16
 
     @property
     def capacity(self) -> int:
@@ -226,14 +241,16 @@ class DeviceStreamEngine:
     @property
     def snapshot_nbytes(self) -> int:
         """Bytes a :meth:`snapshot` would fetch over the link right
-        now: ``device_get`` moves every FULL-capacity int32 column
-        (the valid-prefix cut happens host-side).  Callers use this to
-        project the snapshot tax before paying it — at 1M-doc scale an
-        accumulator snapshot is hundreds of MB over a ~8 MB/s tunnel
-        (VERDICT r4 weak #3)."""
+        now: a granule-padded valid-prefix of every int32 column (the
+        host bound on unique rows stands in for the drained count).
+        Callers use this to project the snapshot tax before paying it
+        — at 1M-doc scale an accumulator snapshot is hundreds of MB
+        over a ~8 MB/s tunnel (VERDICT r4 weak #3)."""
         if self._acc is None:
             return 0
-        return (2 * self._num_groups + 1) * self._cap * 4
+        pad = min(round_up(max(self._unique_bound, 1),
+                           self._snapshot_granule), self._cap)
+        return (2 * self._num_groups + 1) * pad * 4
 
     def _ensure_capacity(self, extra: int) -> None:
         self._unique_bound += extra
@@ -347,9 +364,22 @@ class DeviceStreamEngine:
             self._unique_bound = int(np.asarray(handle))
         self._verify_window_checks()
         count = self._unique_bound
-        cols = jax.device_get(self._acc)
+        # fetch only a granule-padded prefix: every valid row sits in
+        # acc[:count] (merges compact valid rows first), and the cap
+        # can be ~2x count right after a doubling — slack worth >100 MB
+        # at 1M-doc scale over the tunnel
+        pad = min(round_up(max(count, 1), self._snapshot_granule),
+                  self._cap)
+        heads = (_head_rows(self._acc, pad=pad) if pad < self._cap
+                 else self._acc)
+        cols = jax.device_get(heads)
         return {
             "width": self._width,
+            # bytes this fetch actually moved — the budget loop
+            # calibrates its link rate from this, NOT from the pre-
+            # drain snapshot_nbytes projection (whose pending-inflated
+            # bound can overstate the transfer and inflate the rate)
+            "fetched_nbytes": (2 * self._num_groups + 1) * pad * 4,
             "count": count,
             "cap": self._cap,
             "live_groups": self._live_groups,

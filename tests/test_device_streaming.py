@@ -382,6 +382,28 @@ def test_checkpoint_budget_stretches_cadence(tmp_path, monkeypatch):
         tmp_path / "golden")
 
 
+def test_snapshot_prefix_fetch_matches_full_fetch():
+    """The granule-padded prefix fetch (snapshot cost trim) must hand
+    back exactly the rows the full-capacity fetch would: every valid
+    row lives in acc[:count], so a pad >= count loses nothing."""
+    eng = _fed_engine()
+    eng._snapshot_granule = 8   # force pad < cap (cap is 1 << 16)
+    assert eng.snapshot_nbytes < (2 * eng._num_groups + 1) * eng._cap * 4
+    trimmed = eng.snapshot()
+
+    full = _fed_engine()
+    full._snapshot_granule = full._cap   # pad == cap -> full device_get
+    reference = full.snapshot()
+
+    assert trimmed["count"] == reference["count"] > 0
+    for a, b in zip(trimmed["columns"], reference["columns"]):
+        np.testing.assert_array_equal(a, b)
+    # and the trimmed snapshot still restores into a working engine
+    eng2 = DS.DeviceStreamEngine(width=12)
+    eng2.restore(trimmed)
+    assert eng2.windows_fed == trimmed["windows_fed"]
+
+
 def test_restore_rejects_truncated_checkpoint():
     """A truncated/corrupt snapshot must fail with the same clear
     ValueError diagnostics as the width/column-count checks, not an
