@@ -429,6 +429,8 @@ def _bench_scale() -> int:
         # per-window unique-term counts: the vocabulary GROWTH curve
         # (must keep climbing past one source cycle when salted)
         line["vocab_curve"] = stats["vocab_curve"]
+    if "unique_rows_curve" in stats:
+        line["unique_rows_curve"] = stats["unique_rows_curve"]
     if realtext:
         line["source_paragraphs"] = manifest.source_paragraphs
         line["corpus_bytes"] = manifest.total_bytes

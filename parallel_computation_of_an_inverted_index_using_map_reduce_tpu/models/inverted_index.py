@@ -1008,6 +1008,11 @@ class InvertedIndexModel:
             timer.count("checkpoint_budget_s", ckpt_budget_s)
         timer.count("stream_windows", engine_s.windows_fed)
         timer.count("accumulator_capacity", engine_s.capacity)
+        if engine_s.rows_curve:
+            # resolved unique-row counts per merge — the device-stream
+            # analogue of the host engines' vocab_curve (trails the
+            # window count by the still-in-flight merges)
+            timer.count("unique_rows_curve", engine_s.rows_curve)
         if engine_s.windows_fed == 0:
             with timer.phase("emit"):
                 formatter.emit_grouped(out_dir, {})

@@ -233,6 +233,12 @@ class DeviceStreamEngine:
         # _head_rows programs at high-water/granule while keeping the
         # over-fetch under one granule of rows per column
         self._snapshot_granule = 1 << 16
+        # resolved unique-row counts in resolution order — the
+        # accumulator GROWTH curve (trails windows_fed by the in-flight
+        # merges; snapshot drains those, finalize leaves them): free
+        # observability for scale artifacts, mirroring the host-stream
+        # engines' vocab_curve
+        self.rows_curve: list[int] = []
 
     @property
     def capacity(self) -> int:
@@ -309,7 +315,9 @@ class DeviceStreamEngine:
         # module's bounded-memory claim).
         while len(self._pending) >= self._max_inflight:
             handle, _ = self._pending.pop(0)
-            self._unique_bound = (int(np.asarray(handle))
+            resolved = int(np.asarray(handle))
+            self.rows_curve.append(resolved)
+            self._unique_bound = (resolved
                                   + sum(tc for _, tc in self._pending))
         self._ensure_capacity(tok_count)
         if self._acc is None:
@@ -326,6 +334,7 @@ class DeviceStreamEngine:
             while self._pending:
                 handle, _ = self._pending.pop(0)
                 self._unique_bound = int(np.asarray(handle))
+                self.rows_curve.append(self._unique_bound)
 
     def _verify_window_checks(self) -> None:
         """Fetch + verify the accumulated per-window device stats
@@ -362,6 +371,7 @@ class DeviceStreamEngine:
         while self._pending:
             handle, _ = self._pending.pop(0)
             self._unique_bound = int(np.asarray(handle))
+            self.rows_curve.append(self._unique_bound)
         self._verify_window_checks()
         count = self._unique_bound
         # fetch only a granule-padded prefix: every valid row sits in
@@ -385,6 +395,7 @@ class DeviceStreamEngine:
             "live_groups": self._live_groups,
             "max_word_len": self.max_word_len,
             "windows_fed": self.windows_fed,
+            "rows_curve": list(self.rows_curve),
             "columns": [np.asarray(c[:count]) for c in cols],
         }
 
@@ -424,6 +435,10 @@ class DeviceStreamEngine:
         self._live_groups = int(state["live_groups"])
         self.max_word_len = int(state["max_word_len"])
         self.windows_fed = int(state["windows_fed"])
+        # pre-crash growth history, so a resumed run's reported curve
+        # covers the WHOLE stream (absent in checkpoints written
+        # before the key existed)
+        self.rows_curve = [int(v) for v in state.get("rows_curve", [])]
         self._pending = []
         self._window_checks = []
 

@@ -108,6 +108,9 @@ def save_stream_state(path: str | Path, state: dict, fed_tokens: int,
             # windows_fed, which skips empty (tok_count == 0) windows
             window_pos=np.int64(window_pos),
             fed_tokens=np.int64(fed_tokens),
+            # resolved accumulator-growth history (may be absent in
+            # snapshots from engines that predate the key)
+            rows_curve=np.asarray(state.get("rows_curve", []), np.int64),
             num_columns=np.int64(len(state["columns"])),
             **cols,
         )
@@ -139,6 +142,8 @@ def load_stream_state(path: str | Path,
             "windows_fed": int(z["windows_fed"]),
             "window_pos": int(z["window_pos"]),
             "fed_tokens": int(z["fed_tokens"]),
+            "rows_curve": (z["rows_curve"].tolist()
+                           if "rows_curve" in z.files else []),
             "columns": [z[f"col_{i}"]
                         for i in range(int(z["num_columns"]))],
         }

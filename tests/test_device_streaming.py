@@ -382,6 +382,50 @@ def test_checkpoint_budget_stretches_cadence(tmp_path, monkeypatch):
         tmp_path / "golden")
 
 
+def test_rows_curve_tracks_resolved_merge_counts(tmp_path):
+    """unique_rows_curve is the resolved per-merge accumulator count,
+    monotone nondecreasing, ending at the true unique-pair total."""
+    docs = zipf_corpus(num_docs=20, vocab_size=60, tokens_per_doc=8, seed=4)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    report = InvertedIndexModel(_cfg(stream_chunk_docs=4)).run(
+        m, output_dir=tmp_path / "out")
+    curve = report["unique_rows_curve"]
+    # 5 windows, 2-deep pipeline: at least 3 counts resolve in feed
+    assert len(curve) >= 3
+    assert curve == sorted(curve)
+    assert curve[-1] <= report["unique_pairs"]
+
+
+def test_rows_curve_survives_crash_resume(tmp_path, monkeypatch):
+    """A resumed run's curve must cover the WHOLE stream: the pre-crash
+    history rides the checkpoint (review r5 — without it the scale
+    artifact's growth curve starts mid-stream on exactly the long runs
+    it exists to observe)."""
+    docs = zipf_corpus(num_docs=32, vocab_size=90, tokens_per_doc=9, seed=2)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    m = read_manifest(tmp_path / "list.txt")
+    ckpt = tmp_path / "s.npz"
+    cfg = _cfg(stream_chunk_docs=4, stream_checkpoint=str(ckpt),
+               stream_checkpoint_every=2)
+    monkeypatch.setenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", "5")
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+    monkeypatch.delenv("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS")
+    resumed = InvertedIndexModel(cfg).run(m, output_dir=tmp_path / "out")
+
+    whole = InvertedIndexModel(_cfg(stream_chunk_docs=4)).run(
+        m, output_dir=tmp_path / "out2")
+    rc, wc = resumed["unique_rows_curve"], whole["unique_rows_curve"]
+    # the checkpoint (window 4) drained every in-flight merge, so the
+    # resumed curve's prefix is the uninterrupted run's first 4 counts
+    assert rc[:4] == wc[:4]
+    assert rc == sorted(rc) and len(rc) >= len(wc)
+    assert rc[-1] <= resumed["unique_pairs"]
+
+
 def test_snapshot_prefix_fetch_matches_full_fetch():
     """The granule-padded prefix fetch (snapshot cost trim) must hand
     back exactly the rows the full-capacity fetch would: every valid
