@@ -15,8 +15,12 @@ build:
 test:
 	$(PY) -m pytest tests/ -q
 
+# Tier-1 selection (-m "not slow"), parallelized over workers when
+# pytest-xdist is installed (falls back to a serial run when not —
+# the verify pipeline's own serial invocation is untouched)
 test-fast:
-	$(PY) -m pytest tests/ -q -m "not slow"
+	$(PY) -m pytest tests/ -q -m "not slow" \
+	  $$($(PY) -c "import importlib.util as u; print('-n auto' if u.find_spec('xdist') else '')")
 
 bench:
 	$(PY) bench.py

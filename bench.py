@@ -65,6 +65,32 @@ TPU_TIMEOUTS_S = tuple(
 CACHE_DIR = Path(tempfile.gettempdir()) / "mri_tpu_xla_cache"
 
 
+def _scratch_mkdtemp(prefix: str) -> str:
+    """Temp dir for bench scratch (corpus + per-round letter files),
+    RAM-backed when the host offers it.
+
+    The e2e rounds rewrite ~4 MB of letter files 15+ times per run; on
+    this VM's network-backed /tmp that makes the emit stage hostage to
+    the kernel's dirty-page writeback throttle, whose state drifts with
+    hours of unrelated disk traffic (observed: the same binary's emit
+    stage 1.8 ms vs 8.6 ms depending on when it ran).  /dev/shm takes
+    the storage weather out of a metric that exists to track code, not
+    the shared disk.  The `scratch` field in the JSON line records
+    which backing a run got, so numbers are never compared across
+    backings unknowingly."""
+    root = "/dev/shm"
+    if os.path.isdir(root) and os.access(root, os.W_OK):
+        return tempfile.mkdtemp(prefix=prefix, dir=root)
+    return tempfile.mkdtemp(prefix=prefix)
+
+
+def _scratch_backing() -> str:
+    root = "/dev/shm"
+    if os.path.isdir(root) and os.access(root, os.W_OK):
+        return "tmpfs"
+    return "default-tmp"
+
+
 @functools.lru_cache(maxsize=1)
 def _manifest():
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
@@ -79,7 +105,7 @@ def _manifest():
         return manifest_from_dir(override), "custom_corpus_e2e_wall_ms"
     if REFERENCE_CORPUS.is_dir():
         return manifest_from_dir(REFERENCE_CORPUS), "test_in_e2e_wall_ms"
-    tmp = Path(tempfile.mkdtemp(prefix="bench_corpus_"))
+    tmp = Path(_scratch_mkdtemp("bench_corpus_"))
     docs = zipf_corpus(num_docs=355, vocab_size=33_000, tokens_per_doc=2900, seed=7)
     paths = write_corpus(tmp / "docs", docs)
     write_manifest(tmp / "list.txt", paths)
@@ -100,7 +126,7 @@ def _measure(backend: str, plans: list[dict], rounds: int = 5) -> dict:
     manifest, _ = _manifest()
     models = []
     for plan in plans:
-        out_dir = tempfile.mkdtemp(prefix="bench_out_")
+        out_dir = _scratch_mkdtemp("bench_out_")
         models.append(InvertedIndexModel(
             IndexConfig(backend=backend, output_dir=out_dir, **plan)))
         models[-1].run(manifest)  # warmup: XLA compile + numpy/jit caches
@@ -117,6 +143,7 @@ def _measure(backend: str, plans: list[dict], rounds: int = 5) -> dict:
         "best_plan": best_plan,
         "phases_ms": best_report.get("phases_ms", {}),
         "host_threads": best_report.get("host_threads"),
+        "report": best_report,
     }
 
 
@@ -534,10 +561,34 @@ def _write_attestation(line: dict) -> None:
         print(f"bench: could not write attestation: {e}", file=sys.stderr)
 
 
+def _host_stage_split(report: dict) -> dict:
+    """read/tokenize/emit ms for the best cpu run.
+
+    The pipelined host path surfaces native ns-level timers as
+    ``stage_*_ms`` counters; the one-shot fallback only knows its two
+    coarse phases (load ≈ read, index_emit ≈ tokenize+emit fused)."""
+    if "stage_read_ms" in report:
+        return {k: round(float(report[f"stage_{k}_ms"]), 2)
+                for k in ("read", "tokenize", "emit")}
+    phases = report.get("phases_ms", {})
+    split = {}
+    if "load" in phases:
+        split["read"] = round(float(phases["load"]), 2)
+    if "index_emit" in phases:
+        split["tokenize_emit_fused"] = round(float(phases["index_emit"]), 2)
+    elif "oracle" in phases:
+        split["oracle"] = round(float(phases["oracle"]), 2)
+    return split
+
+
 def main() -> int:
     _, metric = _manifest()
     tpu, tpu_log = _run_tpu_attempts()
-    cpu = _measure("cpu", [{}])
+    # best-of-15: the host path's run-to-run spread on the shared
+    # 1-core VM (±2-5 ms) is the same order as the stage costs being
+    # tracked, and cpu rounds are ~50 ms each — sample enough that the
+    # floor, not the scheduler, is what gets reported
+    cpu = _measure("cpu", [{}], rounds=15)
 
     if tpu is not None:
         value_ms, measured_backend = tpu["best_ms"], "tpu"
@@ -561,6 +612,8 @@ def main() -> int:
         "measured_backend": measured_backend,
         "cpu_ms": round(cpu["best_ms"], 2),
         "cpu_host_threads": cpu.get("host_threads"),
+        "host_stage_split": _host_stage_split(cpu.get("report", {})),
+        "scratch": _scratch_backing(),
     }
     if tpu is not None:
         line["tpu_platform"] = tpu.get("platform")

@@ -87,6 +87,15 @@ def make_parser() -> argparse.ArgumentParser:
                    help="merged: one host writes all 26 files; letter: "
                         "multi-chip owners emit their own letter ranges "
                         "(the reference's reducer ownership, multi-host mode)")
+    p.add_argument("--emit-backend", choices=("auto", "native", "python"),
+                   default="auto",
+                   help="letter-file writer: auto = native vectorized emit "
+                        "when available, python = the pure-Python parity "
+                        "oracle; byte-identical either way")
+    p.add_argument("--io-prefetch", type=int, default=2,
+                   help="backend=cpu read-ahead depth: window arenas the "
+                        "reader thread keeps filled while the native scan "
+                        "runs (0 = one-shot load, no pipeline)")
     return p
 
 
@@ -115,6 +124,8 @@ def main(argv: list[str] | None = None) -> int:
             stream_checkpoint_every=args.stream_checkpoint_every,
             host_threads=args.host_threads,
             emit_ownership=args.emit_ownership,
+            emit_backend=args.emit_backend,
+            io_prefetch=args.io_prefetch,
         )
         stats = build_index(manifest, config)
     except (OSError, ValueError) as e:

@@ -124,6 +124,24 @@ class IndexConfig:
     # crash (SCALE_r03.json device_stream_real_tpu).
     stream_checkpoint: str | None = None
     stream_checkpoint_every: int = 2
+    # Letter-file writer:
+    #   "auto"   — native vectorized emit (tokenizer.cc EmitLettersRuns:
+    #              pre-rendered id strings, single-allocation render,
+    #              atomic tmp+rename per letter) when the library is
+    #              loadable, else the pure-Python formatter
+    #   "native" — require the native path (error if unavailable)
+    #   "python" — force the pure-Python formatter (the byte-parity
+    #              oracle; same atomic write contract)
+    # Output is byte-identical across all three.  backend="cpu" fuses
+    # scan and emit inside one native call, so this knob governs the
+    # device engines' emit tail; an all-Python cpu run is use_native=False
+    # (the oracle).
+    emit_backend: str = "auto"
+    # Read-ahead depth for the host pipeline (backend="cpu"): the reader
+    # thread keeps up to this many ~2 MB window arenas filled while the
+    # native scan (GIL released) chews the current one.  0 disables the
+    # pipelined ingest path (one-shot load + native call).
+    io_prefetch: int = 2
     # Emit-side ownership for the multi-chip pipelined path:
     #   "merged" — one host assembles and writes all 26 files (default)
     #   "letter" — pairs are exchanged by *letter owner*
@@ -231,6 +249,14 @@ class IndexConfig:
         if self.host_threads is not None and self.host_threads < 1:
             raise ValueError(
                 f"host_threads must be >= 1 or None (auto), got {self.host_threads}")
+        if self.emit_backend not in ("auto", "native", "python"):
+            raise ValueError(
+                f"emit_backend must be 'auto', 'native' or 'python', "
+                f"got {self.emit_backend!r}")
+        if self.io_prefetch < 0:
+            raise ValueError(
+                f"io_prefetch must be >= 0 (0 disables read-ahead), "
+                f"got {self.io_prefetch}")
         if self.emit_ownership not in ("merged", "letter"):
             raise ValueError(
                 f"emit_ownership must be 'merged' or 'letter', got {self.emit_ownership!r}")

@@ -75,6 +75,16 @@ class ParagraphManifest:
     eliminated by the fixed tag width (see :func:`_cycle_tag`).  Both
     residuals are noise at corpus scale; the recorded ``vocab_curve``
     is the measured truth either way.
+
+    Salting rebuilds each document as ``b" ".join(w + tag for w in
+    para.split())``, so every whitespace RUN (newlines, tabs, multiple
+    spaces) collapses to one space in cycles >= 1 — salted cycles are
+    a few bytes smaller per paragraph than ``raw + tags`` and their
+    byte layout differs from cycle 0's.  Token content is unaffected
+    (the tokenizer treats any whitespace run as one separator,
+    mirroring the reference's strtok at main.c:97-103), and the size
+    accounting below already uses the collapsed formula — but don't
+    expect cycle bytes to be comparable across the raw/salted boundary.
     """
 
     def __init__(self, src_dir: str | Path, num_docs: int | None = None,

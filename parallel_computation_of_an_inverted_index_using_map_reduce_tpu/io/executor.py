@@ -1,0 +1,107 @@
+"""Pipelined window executor: overlap read, tokenize, and emit.
+
+The reference interleaves read and scan serially inside each mapper
+(main.c:97-116).  Here a dedicated reader thread fills window arenas
+from a recycling ring while the consumer runs the GIL-releasing native
+scan on the previous window, and the final emit happens once at the
+end — a read → tokenize → emit pipeline across windows instead of
+serial whole-corpus phases.  On a single core the win is the removed
+copies; with spare cores the read genuinely hides behind the scan.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from .arena import WindowArena
+from .reader import read_window_into
+
+
+class PipelinedWindowReader:
+    """Iterate filled :class:`WindowArena` s, reading ahead on a thread.
+
+    ``depth`` is the prefetch distance (arena ring holds ``depth + 1``
+    buffers: up to ``depth`` filled ahead plus the one being consumed).
+    The consumer MUST hand each arena back via :meth:`recycle` once the
+    scan is done with its views — that is what bounds memory and what
+    the reader blocks on.  Reader exceptions re-raise in the consumer;
+    abandoning the iterator mid-loop unblocks and stops the reader
+    (same stop-event contract as corpus.manifest.prefetch_document_ranges).
+
+    ``read_wait_s`` / ``consume_wait_s`` accumulate the time the reader
+    sat blocked on a free arena and the consumer sat blocked on a filled
+    one — the pipeline-bubble split the bench stage report uses.
+    """
+
+    def __init__(self, manifest, windows, depth: int = 2,
+                 byte_capacity: int = 1 << 21, doc_capacity: int = 256,
+                 arenas: list[WindowArena] | None = None):
+        self._manifest = manifest
+        self._windows = list(windows)
+        self._depth = max(int(depth), 1)
+        self._ready: queue.Queue = queue.Queue()
+        self._free: queue.Queue = queue.Queue()
+        if arenas is None:
+            arenas = [WindowArena(byte_capacity=byte_capacity,
+                                  doc_capacity=doc_capacity)
+                      for _ in range(self._depth + 1)]
+        self.arenas = arenas  # caller may recycle the ring across runs
+        for a in arenas:
+            self._free.put(a)
+        self._done = object()
+        self._stop = threading.Event()
+        self.read_wait_s = 0.0
+        self.read_busy_s = 0.0
+        self.consume_wait_s = 0.0
+        # Reading starts NOW, not at first iteration: the first window
+        # has nothing to hide behind once consumption starts, so let it
+        # fill while the caller sets up its scan state.
+        self._thread = threading.Thread(target=self._reader, daemon=True)
+        self._thread.start()
+
+    def _get(self, q: queue.Queue):
+        # bounded get that gives up when the other side is gone, so
+        # neither thread can deadlock holding ring buffers
+        while not self._stop.is_set():
+            try:
+                return q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+        return None
+
+    def _reader(self) -> None:
+        try:
+            for lo, hi in self._windows:
+                t0 = time.perf_counter()
+                arena = self._get(self._free)
+                self.read_wait_s += time.perf_counter() - t0
+                if arena is None:
+                    return
+                t0 = time.perf_counter()
+                read_window_into(self._manifest, lo, hi, arena)
+                self.read_busy_s += time.perf_counter() - t0
+                self._ready.put(arena)
+            self._ready.put(self._done)
+        except BaseException as e:  # surfaced on the consumer side
+            self._ready.put(e)
+
+    def recycle(self, arena: WindowArena) -> None:
+        """Return a consumed arena to the ring (MUST be called once per
+        yielded arena, after the native scan no longer reads its views)."""
+        self._free.put(arena)
+
+    def __iter__(self):
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = self._ready.get()
+                self.consume_wait_s += time.perf_counter() - t0
+                if item is self._done:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self._stop.set()

@@ -1,0 +1,78 @@
+"""Zero-copy manifest readers.
+
+``read_doc_into`` is the single dispatch point between real-file
+manifests (which gain a ``readinto`` fast path writing straight into an
+arena) and the virtual corpus manifests (`corpus.synthetic`,
+`corpus.realtext`), which are duck types whose ``read_doc`` generates
+bytes — those fall back to one copy into the arena, still skipping the
+join/marshal copies downstream.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .arena import WindowArena
+
+
+def read_doc_into(manifest, index: int, dest: memoryview) -> int:
+    """Read document ``index`` into ``dest``; bytes actually written.
+
+    Dispatches to ``manifest.read_doc_into`` when the manifest offers
+    one (real files, ``readinto``), else copies ``read_doc()`` output.
+    ``dest`` is sized from the manifest's recorded document size; a
+    document that shrank since the manifest was written yields a short
+    count, one that grew is truncated to the recorded size (manifest
+    sizes are authoritative for window planning).
+    """
+    fast = getattr(manifest, "read_doc_into", None)
+    if fast is not None:
+        return fast(index, dest)
+    data = manifest.read_doc(index)
+    n = min(len(data), len(dest))
+    dest[:n] = data[:n]
+    return n
+
+
+def plan_byte_windows(manifest, target_bytes: int) -> list[tuple[int, int]]:
+    """Contiguous ``[lo, hi)`` document ranges of ~``target_bytes`` each.
+
+    Mirrors the byte-balanced window planning of the device paths: every
+    window holds whole documents, at least one per window, split when
+    the running size reaches the target.
+    """
+    n = len(manifest)
+    windows: list[tuple[int, int]] = []
+    lo = 0
+    acc = 0
+    for i in range(n):
+        acc += int(manifest.sizes[i])
+        if acc >= target_bytes:
+            windows.append((lo, i + 1))
+            lo = i + 1
+            acc = 0
+    if lo < n:
+        windows.append((lo, n))
+    return windows
+
+
+def read_window_into(manifest, lo: int, hi: int,
+                     arena: WindowArena) -> WindowArena:
+    """Fill ``arena`` with documents ``[lo, hi)`` (arena is reset first).
+
+    Unreadable documents are skipped with a warning — the same contract
+    as corpus.manifest.iter_document_ranges, so a vanished file degrades
+    the index instead of killing the run.
+    """
+    arena.reset()
+    for i in range(lo, hi):
+        size = int(manifest.sizes[i])
+        try:
+            dest = arena.view(size)
+            n = read_doc_into(manifest, i, dest)
+        except OSError as e:
+            print(f"warning: skipping unreadable document "
+                  f"{manifest.paths[i]}: {e}", file=sys.stderr)
+            continue
+        arena.commit(manifest.doc_id(i), n)
+    return arena

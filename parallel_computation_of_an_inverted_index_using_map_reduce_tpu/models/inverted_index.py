@@ -45,22 +45,34 @@ def _profile_ctx(profile_dir):
             else contextlib.nullcontext())
 
 
-def _pack_window(contents, ids, shard_len: int, docs_cap: int):
+def _pack_window(contents, ids, shard_len: int, docs_cap: int, arena=None):
     """Pack one doc window into the device byte-feed layout:
     ``(buf[shard_len] space-padded, ends[docs_cap], ids[docs_cap])``.
     One join + one copy — no per-doc python loop (the loop was ~1 us a
     doc, real money at 1M-doc streaming scale).  Padded ``ends``
     entries stay at ``shard_len``: the pad region is all spaces, so
-    those "docs" emit nothing."""
+    those "docs" emit nothing.
+
+    ``arena`` recycles a previous window's ``(buf, ends, idv)`` triple
+    in place of fresh zero-filled allocations — window loops keep a
+    2-deep ring so the buffer being refilled is never the one a still
+    in-flight ``device_put`` reads from."""
     joined = b"".join(contents)
-    buf = np.full(shard_len, 0x20, np.uint8)
+    if (arena is not None and arena[0].shape[0] == shard_len
+            and arena[1].shape[0] == docs_cap):
+        buf, ends, idv = arena
+        buf[len(joined):] = 0x20
+        ends[len(contents):] = shard_len
+        idv[len(ids):] = 1
+    else:
+        buf = np.full(shard_len, 0x20, np.uint8)
+        ends = np.full(docs_cap, shard_len, np.int32)
+        idv = np.full(docs_cap, 1, np.int32)
     buf[: len(joined)] = np.frombuffer(joined, np.uint8)
-    ends = np.full(docs_cap, shard_len, np.int32)
     if contents:
         lens = np.fromiter((len(c) for c in contents), np.int64,
                            len(contents))
         ends[: len(contents)] = np.cumsum(lens).astype(np.int32)
-    idv = np.full(docs_cap, 1, np.int32)
     idv[: len(ids)] = np.asarray(ids, np.int32)
     return buf, ends, idv
 
@@ -97,12 +109,18 @@ class InvertedIndexModel:
     # -- CPU backend ---------------------------------------------------
 
     def _run_cpu(self, manifest: Manifest, out_dir: str, timer: PhaseTimer) -> dict:
-        """All-on-host engine: one native call (native.host_index_native).
+        """All-on-host engine, pipelined read → tokenize → emit.
 
         The reference's regime — CPU only — re-architected: no spill
-        files, no locks, no token-scale sorts.  Falls back to the
-        Python oracle when no C++ toolchain is available, keeping the
-        backend usable everywhere.
+        files, no locks, no token-scale sorts.  Default path: a reader
+        thread fills reusable window arenas (io.executor) while the
+        GIL-releasing incremental scan (native.HostIndexStream) chews
+        the previous window — zero join/marshal copies end to end.
+        ``--io-prefetch 0`` or multi-threaded scans take the one-shot
+        fork-join call instead (its byte-balanced worker split needs
+        the whole corpus resident).  Falls back to the Python oracle
+        when no C++ toolchain is available, keeping the backend usable
+        everywhere.
         """
         from .. import native
 
@@ -113,6 +131,8 @@ class InvertedIndexModel:
             return {**stats, **timer.report()}
         threads = self.config.resolved_host_threads()
         timer.count("host_threads", threads)
+        if self.config.io_prefetch > 0 and threads == 1:
+            return self._run_cpu_pipelined(manifest, out_dir, timer)
         with timer.phase("load"):
             contents, doc_ids = load_documents(manifest)
         with timer.phase("index_emit"):
@@ -120,6 +140,61 @@ class InvertedIndexModel:
                 contents, doc_ids, out_dir, num_threads=threads)
         for key, value in stats.items():
             timer.count(key, value)
+        return timer.report()
+
+    # ~2 MB windows: several windows even for small corpora (so the
+    # read-ahead has something to hide behind) while staying resident in
+    # L2/L3 for the scan that immediately follows the fill.
+    _CPU_WINDOW_BYTES = 2 << 20
+
+    def _run_cpu_pipelined(self, manifest: Manifest, out_dir: str,
+                           timer: PhaseTimer) -> dict:
+        """Arena-fed incremental host index (the io subsystem path).
+
+        Stage attribution lands in the ``stage_*_ms`` counters: read is
+        the reader thread's busy time, tokenize the native scan +
+        postings finalize, emit the letter-file render + write — the
+        split bench.py reports as ``host_stage_split``.
+        """
+        from .. import native
+        from ..io.executor import PipelinedWindowReader
+        from ..io.reader import plan_byte_windows
+
+        windows = plan_byte_windows(manifest, self._CPU_WINDOW_BYTES)
+        max_docs = max((hi - lo for lo, hi in windows), default=1)
+        # The arena ring is reused across run() calls (steady-state: no
+        # page faults from fresh buffers); construct the reader FIRST —
+        # its thread starts filling window 0 while HostIndexStream
+        # allocates its vocab table below.
+        arenas = getattr(self, "_cpu_arenas", None)
+        if arenas is not None and len(arenas) != self.config.io_prefetch + 1:
+            arenas = None
+        reader = PipelinedWindowReader(
+            manifest, windows, depth=self.config.io_prefetch,
+            byte_capacity=self._CPU_WINDOW_BYTES + (self._CPU_WINDOW_BYTES >> 2),
+            doc_capacity=max_docs, arenas=arenas)
+        self._cpu_arenas = reader.arenas
+        stream = native.HostIndexStream()
+        try:
+            with timer.phase("ingest_scan"):
+                for arena in reader:
+                    buf, ends, ids = arena.feed_views()
+                    stream.feed_arrays(buf, ends, ids)
+                    reader.recycle(arena)
+            with timer.phase("finalize_emit"):
+                stats = stream.finalize_emit(out_dir)
+        finally:
+            stream.close()
+        for key, value in stats.items():
+            timer.count(key, value)
+        timer.count("io_windows", len(windows))
+        timer.count("io_prefetch", self.config.io_prefetch)
+        timer.count("stage_read_ms", round(reader.read_busy_s * 1e3, 3))
+        timer.count("stage_tokenize_ms",
+                    round(stats["scan_ms"] + stats["finalize_ms"], 3))
+        timer.count("stage_emit_ms", round(stats["emit_ms"], 3))
+        timer.count("read_wait_ms", round(reader.read_wait_s * 1e3, 3))
+        timer.count("consume_wait_ms", round(reader.consume_wait_s * 1e3, 3))
         return timer.report()
 
     # -- TPU backend ---------------------------------------------------
@@ -536,7 +611,7 @@ class InvertedIndexModel:
                     order=order, df=df_rank,
                     offsets=offsets_local[prov_of_rank],
                     postings=postings_o, max_doc_id=max_doc_id,
-                    letter_range=ranges[o])
+                    letter_range=ranges[o], backend=self._emit_backend())
                 lines += stats_o["lines_written"]
         timer.count("emit_ownership", "letter")
         timer.count("letter_owners", n)
@@ -853,18 +928,11 @@ class InvertedIndexModel:
             df64 = df.astype(np.int64)
             order, offsets = engine.host_order_offsets(letters, df64)
         with timer.phase("emit"):
-            from .. import native
-
-            if cfg.use_native and native.available():
-                bytes_written = native.emit_native(
-                    out_dir, vocab, order, df64, offsets, postings)
-                emit_stats = {"lines_written": num_words,
-                              "bytes_written": bytes_written}
-            else:
-                emit_stats = formatter.emit_index(
-                    out_dir, vocab=vocab, letter_of_term=letters,
-                    order=order, df=df64, offsets=offsets,
-                    postings=postings, max_doc_id=max_doc_id)
+            emit_stats = formatter.emit_index(
+                out_dir, vocab=vocab, letter_of_term=letters,
+                order=order, df=df64, offsets=offsets,
+                postings=postings, max_doc_id=max_doc_id,
+                backend=self._emit_backend())
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()
 
@@ -936,6 +1004,9 @@ class InvertedIndexModel:
         ckpt_consec_skips = 0
 
         profile = _profile_ctx(cfg.profile_dir)
+        # 2-deep pack ring: window N+1 refills the buffer window N-1
+        # used, never the one the in-flight upload of window N reads
+        pack_ring: list = [None, None]
         with profile, timer.phase("stream_feed"):
             for win_i, (contents, ids) in enumerate(
                     iter_document_chunks(manifest, cfg.stream_chunk_docs),
@@ -944,8 +1015,11 @@ class InvertedIndexModel:
                     continue
                 total = sum(len(c) for c in contents)
                 padded = _round_up(max(total, 1), cfg.pad_multiple)
-                buf, ends, _ = _pack_window(
-                    contents, ids, padded, max(len(contents), 1))
+                slot = win_i & 1
+                pack_ring[slot] = _pack_window(
+                    contents, ids, padded, max(len(contents), 1),
+                    arena=pack_ring[slot])
+                buf, ends, _ = pack_ring[slot]
                 ends = ends[: len(contents)]
                 cnt, ml = DT.host_token_stats(buf, ends)
                 if ml > width:
@@ -1152,7 +1226,8 @@ class InvertedIndexModel:
                         order=order_o, df=df_o,
                         offsets=np.cumsum(df_o) - df_o,
                         postings=ow["postings"].astype(np.int32),
-                        max_doc_id=max_doc_id, letter_range=ranges[o])
+                        max_doc_id=max_doc_id, letter_range=ranges[o],
+                        backend=self._emit_backend())
                     lines += stats_o["lines_written"]
             timer.count("letter_owners", n)
             timer.count("unique_terms",
@@ -1208,18 +1283,11 @@ class InvertedIndexModel:
             order = np.lexsort((vocab, -df64, letters))
 
         with timer.phase("emit"):
-            from .. import native
-
-            if cfg.use_native and native.available():
-                bytes_written = native.emit_native(
-                    out_dir, vocab, order, df64, offsets, postings)
-                emit_stats = {"lines_written": num_words,
-                              "bytes_written": bytes_written}
-            else:
-                emit_stats = formatter.emit_index(
-                    out_dir, vocab=vocab, letter_of_term=letters,
-                    order=order, df=df64, offsets=offsets,
-                    postings=postings, max_doc_id=max_doc_id)
+            emit_stats = formatter.emit_index(
+                out_dir, vocab=vocab, letter_of_term=letters,
+                order=order, df=df64, offsets=offsets,
+                postings=postings, max_doc_id=max_doc_id,
+                backend=self._emit_backend())
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()
 
@@ -1244,11 +1312,14 @@ class InvertedIndexModel:
         timer.count("documents", len(manifest))
         engine_s = DDS.DistDeviceStreamEngine(width=width, mesh=mesh)
         profile = _profile_ctx(cfg.profile_dir)
+        # 2-deep per-shard pack rings (same reuse discipline as the
+        # single-chip stream loop above)
+        pack_rings: list = [[None] * n, [None] * n]
         with profile, timer.phase("stream_feed"):
             from ..corpus.scheduler import plan_contiguous_ranges
 
-            for contents, ids in iter_document_chunks(
-                    manifest, cfg.stream_chunk_docs):
+            for win_i, (contents, ids) in enumerate(iter_document_chunks(
+                    manifest, cfg.stream_chunk_docs)):
                 # byte-balanced contiguous doc split of this chunk —
                 # the scheduler's one greedy-cut policy
                 ranges_c = plan_contiguous_ranges(
@@ -1260,11 +1331,14 @@ class InvertedIndexModel:
                         default=1), 1)
                 shard_len = _round_up(shard_len, cfg.pad_multiple)
                 docs_cap = max(max(len(c) for c, _ in parts), 1)
+                ring = pack_rings[win_i & 1]
                 bufs, ends_l, ids_l = [], [], []
                 tok_count = max_len = 0
-                for contents_s, ids_s in parts:
-                    buf, ends, idv = _pack_window(
-                        contents_s, ids_s, shard_len, docs_cap)
+                for si, (contents_s, ids_s) in enumerate(parts):
+                    ring[si] = _pack_window(
+                        contents_s, ids_s, shard_len, docs_cap,
+                        arena=ring[si])
+                    buf, ends, idv = ring[si]
                     cnt, ml = DT.host_token_stats(buf, ends)
                     tok_count = max(tok_count, cnt)
                     max_len = max(max_len, ml)
@@ -1523,26 +1597,27 @@ class InvertedIndexModel:
 
         return self._emit_and_report(corpus, host, out_dir, timer, vocab_size, max_doc_id)
 
+    def _emit_backend(self) -> str:
+        """Resolve ``config.emit_backend`` for the formatter dispatch:
+        ``auto`` respects ``use_native`` (the scan path's native kill
+        switch) so one knob still forces an all-Python run."""
+        if self.config.emit_backend == "auto" and not self.config.use_native:
+            return "python"
+        return self.config.emit_backend
+
     def _emit_and_report(self, corpus, host, out_dir, timer, vocab_size, max_doc_id) -> dict:
         with timer.phase("emit"):
-            from .. import native
-
-            if self.config.use_native and native.available():
-                bytes_written = native.emit_native(
-                    out_dir, corpus.vocab, host["order"], host["df"],
-                    host["offsets"], host["postings"])
-                emit_stats = {"lines_written": vocab_size, "bytes_written": bytes_written}
-            else:
-                emit_stats = formatter.emit_index(
-                    out_dir,
-                    vocab=corpus.vocab,
-                    letter_of_term=corpus.letter_of_term,
-                    order=host["order"],
-                    df=host["df"],
-                    offsets=host["offsets"],
-                    postings=host["postings"],
-                    max_doc_id=max_doc_id,
-                )
+            emit_stats = formatter.emit_index(
+                out_dir,
+                vocab=corpus.vocab,
+                letter_of_term=corpus.letter_of_term,
+                order=host["order"],
+                df=host["df"],
+                offsets=host["offsets"],
+                postings=host["postings"],
+                max_doc_id=max_doc_id,
+                backend=self._emit_backend(),
+            )
         timer.count("unique_pairs", int(host["num_unique"]))
         timer.count("lines_written", emit_stats["lines_written"])
         return timer.report()

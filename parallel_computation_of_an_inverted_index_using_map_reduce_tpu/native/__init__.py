@@ -65,6 +65,19 @@ class _HostIndexStats(ctypes.Structure):
     ]
 
 
+class _HostStreamStats(ctypes.Structure):
+    _fields_ = [
+        ("raw_tokens", ctypes.c_int64),
+        ("num_pairs", ctypes.c_int64),
+        ("vocab_size", ctypes.c_int32),
+        ("reserved", ctypes.c_int32),
+        ("bytes_written", ctypes.c_int64),
+        ("scan_ns", ctypes.c_int64),
+        ("finalize_ns", ctypes.c_int64),
+        ("emit_ns", ctypes.c_int64),
+    ]
+
+
 class _StreamFinalResult(ctypes.Structure):
     _fields_ = [
         ("vocab_size", ctypes.c_int32),
@@ -110,6 +123,11 @@ def _compile() -> Path:
         except (OSError, subprocess.SubprocessError) as e:
             last_err = e
     raise RuntimeError(f"native build failed: {last_err}")
+
+
+def load_error() -> str | None:
+    """Why :func:`load` returned None, if it did."""
+    return _lib_error
 
 
 def load():
@@ -163,6 +181,22 @@ def load():
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int32, ctypes.c_char_p, ctypes.POINTER(_HostIndexStats),
             ctypes.c_int32,
+        ]
+        lib.mri_hidx_new.restype = ctypes.c_void_p
+        lib.mri_hidx_new.argtypes = []
+        lib.mri_hidx_free.restype = None
+        lib.mri_hidx_free.argtypes = [ctypes.c_void_p]
+        lib.mri_hidx_feed.restype = ctypes.c_int32
+        lib.mri_hidx_feed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mri_hidx_finalize_emit.restype = ctypes.c_int32
+        lib.mri_hidx_finalize_emit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(_HostStreamStats),
         ]
         lib.mri_token_stats.restype = ctypes.c_int32
         lib.mri_token_stats.argtypes = [
@@ -472,6 +506,107 @@ def host_index_native(contents: list[bytes], doc_ids: list[int],
         "lines_written": int(stats.vocab_size),
         "bytes_written": int(stats.bytes_written),
     }
+
+
+class HostIndexStream:
+    """Incremental ``backend="cpu"`` pipeline: feed windows, emit once.
+
+    The zero-copy counterpart of :func:`host_index_native` — each
+    :meth:`feed_arrays` call hands the scan a window straight out of a
+    reusable io.arena buffer (no ``b"".join``, no marshalling copies),
+    and ctypes releases the GIL for the call's duration, so a Python
+    reader thread can fill the next arena while C++ scans this one.
+    :meth:`finalize_emit` flattens postings, sorts, and writes the 26
+    letter files, returning a stats dict that includes the native-side
+    ``scan_ms`` / ``finalize_ms`` / ``emit_ms`` stage split.
+    """
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native host index unavailable: {_lib_error}")
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.mri_hidx_new())
+        if not self._handle:
+            raise MemoryError("native host index allocation failure")
+        self._documents = 0
+
+    def feed_arrays(self, buf: np.ndarray, ends: np.ndarray,
+                    ids: np.ndarray, num_docs: int | None = None,
+                    used_bytes: int | None = None) -> None:
+        """Scan one window of whole documents, zero-copy.
+
+        ``buf`` is the concatenated uint8 document bytes, ``ends`` the
+        int64 cumulative end offsets, ``ids`` the int32 doc ids.  Pass
+        ``num_docs`` / ``used_bytes`` to scan a prefix of oversized
+        arena arrays without slicing (slices of C-contiguous prefixes
+        are fine too — the pointers are taken as-is).
+        """
+        n = int(num_docs if num_docs is not None else ends.shape[0])
+        if n == 0:
+            return
+        nbytes = int(used_bytes if used_bytes is not None else buf.shape[0])
+        if buf.dtype != np.uint8 or ends.dtype != np.int64 \
+                or ids.dtype != np.int32:
+            raise TypeError("feed_arrays requires uint8/int64/int32 arrays")
+        rc = self._lib.mri_hidx_feed(
+            self._handle,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(nbytes),
+            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(n))
+        if rc != 0:
+            raise MemoryError("native host index feed allocation failure")
+        self._documents += n
+
+    def feed(self, contents: list[bytes], doc_ids: list[int]) -> None:
+        """Convenience wrapper for list-of-bytes callers (tests)."""
+        args, keepalive = _marshal_docs(contents, doc_ids)
+        rc = self._lib.mri_hidx_feed(self._handle, *args)
+        del keepalive
+        if rc != 0:
+            raise MemoryError("native host index feed allocation failure")
+        self._documents += len(contents)
+
+    def finalize_emit(self, out_dir) -> dict:
+        """Flatten + sort + write the 26 letter files; the stats dict."""
+        os.makedirs(out_dir, exist_ok=True)
+        stats = _HostStreamStats()
+        rc = self._lib.mri_hidx_finalize_emit(
+            self._handle, str(out_dir).encode(), ctypes.byref(stats))
+        if rc == -2:
+            raise MemoryError("native host index allocation failure")
+        if rc != 0:
+            raise OSError(f"native host index failed writing to {out_dir!r}")
+        return {
+            "documents": self._documents,
+            "tokens": int(stats.raw_tokens),
+            "unique_terms": int(stats.vocab_size),
+            "unique_pairs": int(stats.num_pairs),
+            "lines_written": int(stats.vocab_size),
+            "bytes_written": int(stats.bytes_written),
+            "scan_ms": stats.scan_ns / 1e6,
+            "finalize_ms": stats.finalize_ns / 1e6,
+            "emit_ms": stats.emit_ns / 1e6,
+        }
+
+    def close(self):
+        if self._handle:
+            self._lib.mri_hidx_free(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def emit_native_runs(out_dir, vocab: np.ndarray, order, runs) -> int:

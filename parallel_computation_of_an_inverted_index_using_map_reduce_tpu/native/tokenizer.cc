@@ -49,10 +49,12 @@
 // Build: g++ -O3 -shared -fPIC -o libmri_tokenizer.so tokenizer.cc
 
 #include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <cstdlib>
+#include <csignal>
 #include <exception>
 #include <new>
 #include <system_error>
@@ -1316,65 +1318,157 @@ struct EmitRun {
   const int64_t* counts;   // rank space
 };
 
+// Pre-rendered doc-id strings: ids repeat constantly across postings
+// lists, and the per-digit division chain in PutU32 is the emit loop's
+// hot op — one fixed 8-byte copy per posting halves it.  `s` holds the
+// digits left-justified; `len` the digit count (<= 7 under kIdTableMax).
+struct IdStr {
+  char s[7];
+  uint8_t len;
+};
+// Table ceiling: 1 << 17 entries = 1 MB, still cache/TLB-friendly;
+// larger id spaces fall back to PutU32 per posting.
+constexpr uint32_t kIdTableMax = 1u << 17;
+
+// Largest doc id across every run segment (full pass — postings are
+// ascending per term on every current caller, but a bounds-critical
+// table must not trust that).  Returns kIdTableMax early when the ids
+// outgrow the table.
+uint32_t MaxDocId(const EmitRun* runs, int32_t n_runs, int32_t vocab_size) {
+  uint32_t maxid = 0;
+  for (int32_t r = 0; r < n_runs; ++r) {
+    const EmitRun& run = runs[r];
+    for (int32_t t = 0; t < vocab_size; ++t) {
+      const int64_t start = run.offsets[t], n = run.counts[t];
+      for (int64_t k = 0; k < n; ++k) {
+        const uint32_t v = run.p16 ? run.p16[start + k]
+                                   : static_cast<uint32_t>(run.p32[start + k]);
+        if (v > maxid) {
+          maxid = v;
+          if (maxid >= kIdTableMax) return kIdTableMax;
+        }
+      }
+    }
+  }
+  return maxid;
+}
+
 // Shared emit core: one letter-file set from rank-space order and
 // `n_runs` postings runs, concatenated per term in run order.
+//
+// Writes are ATOMIC per letter file: each file is rendered fully in
+// memory, written to `<letter>.txt.tmp`, then renamed over the final
+// name — a crash mid-emit leaves earlier letters complete, the
+// in-flight letter only as a `.tmp`, and never a truncated-but-
+// plausible `<letter>.txt` (the reference's partial_<letter>.txt spill
+// files have the same never-half-a-file property, main.c:332-341).
 int64_t EmitLettersRuns(const uint8_t* vocab_packed, int32_t vocab_size,
                         int32_t width, const int64_t* order,
                         const EmitRun* runs, int32_t n_runs,
-                        const char* out_dir) {
-  std::vector<char> buf;
-  buf.reserve(1 << 22);
+                        const char* out_dir,
+                        const uint32_t* lens = nullptr,
+                        int64_t maxid_hint = -1) {
   std::string dir(out_dir);
   if (!dir.empty() && dir.back() != '/') dir += '/';
+  // Vectorized id formatting: render each id once, copy 8 bytes per
+  // posting.  The table pays for itself whenever postings outnumber
+  // distinct ids (always, past trivial corpora).  Callers that track
+  // the max doc id pass it as ``maxid_hint`` and skip the full pass.
+  std::vector<IdStr> id_table;
+  const uint32_t maxid =
+      maxid_hint >= 0 ? static_cast<uint32_t>(std::min<int64_t>(
+                            maxid_hint, kIdTableMax))
+                      : MaxDocId(runs, n_runs, vocab_size);
+  if (vocab_size && maxid < kIdTableMax) {
+    id_table.resize(static_cast<size_t>(maxid) + 1);
+    for (uint32_t v = 0; v <= maxid; ++v) {
+      char* p = id_table[v].s;
+      id_table[v].len = static_cast<uint8_t>(PutU32(p, v) - p);
+    }
+  }
+  const IdStr* tab = id_table.empty() ? nullptr : id_table.data();
+  // One upper-bound allocation for the render buffer: per-term resize
+  // calls zero-fill their growth, which costs more than the formatting
+  // itself.  Bound: word row + ":[]\n" per term, <= 11 bytes per
+  // posting (space + 10 digits), + 8 bytes table-copy overhang slack.
+  int64_t total_df = 0;
+  for (int32_t r = 0; r < n_runs; ++r)
+    for (int32_t t = 0; t < vocab_size; ++t) total_df += runs[r].counts[t];
+  std::vector<char> buf(static_cast<size_t>(vocab_size) * (width + 4) +
+                        11ull * total_df + 8);
   int64_t total = 0;
   int32_t idx = 0;
   for (int letter = 0; letter < 26; ++letter) {
-    buf.clear();
+    char* p = buf.data();
     for (; idx < vocab_size; ++idx) {
       const int64_t t = order[idx];
       const uint8_t* w = vocab_packed + static_cast<int64_t>(t) * width;
       if (w[0] - 'a' != letter) break;
-      // word (NUL-padded row)
-      int wl = 0;
-      while (wl < width && w[wl]) ++wl;
-      int64_t df = 0;
-      for (int32_t r = 0; r < n_runs; ++r) df += runs[r].counts[t];
-      const size_t need = buf.size() + wl + 2 + 11ull * df + 2;
-      if (buf.capacity() < need) buf.reserve(need * 2);
-      const size_t old = buf.size();
-      buf.resize(old + wl + 2);
-      std::memcpy(buf.data() + old, w, wl);
-      buf[old + wl] = ':';
-      buf[old + wl + 1] = '[';
-      buf.resize(buf.size() + 11ull * df + 2);
-      char* p = buf.data() + old + wl + 2;
-      bool first = true;
+      // word length: caller-supplied, or walk the NUL-padded row
+      int wl;
+      if (lens) {
+        wl = static_cast<int>(lens[t]);
+      } else {
+        wl = 0;
+        while (wl < width && w[wl]) ++wl;
+      }
+      std::memcpy(p, w, wl);
+      // Branch-free separators: every posting renders as " id" starting
+      // one byte past the ':' slot, then ':' and '[' are patched in —
+      // the '[' lands exactly on the first posting's leading space.
+      char* mark = p + wl;
+      p = mark + 1;
       for (int32_t r = 0; r < n_runs; ++r) {
         const EmitRun& run = runs[r];
         const int64_t start = run.offsets[t], n = run.counts[t];
-        for (int64_t k = 0; k < n; ++k) {
-          if (!first) *p++ = ' ';
-          first = false;
-          const uint32_t v = run.p16 ? run.p16[start + k]
-                                     : static_cast<uint32_t>(run.p32[start + k]);
-          p = PutU32(p, v);
+        if (tab) {
+          for (int64_t k = 0; k < n; ++k) {
+            *p++ = ' ';
+            const uint32_t v = run.p16
+                ? run.p16[start + k]
+                : static_cast<uint32_t>(run.p32[start + k]);
+            std::memcpy(p, tab[v].s, 8);  // IdStr is 8 bytes, len <= 7
+            p += tab[v].len;
+          }
+        } else {
+          for (int64_t k = 0; k < n; ++k) {
+            *p++ = ' ';
+            const uint32_t v = run.p16
+                ? run.p16[start + k]
+                : static_cast<uint32_t>(run.p32[start + k]);
+            p = PutU32(p, v);
+          }
         }
       }
+      mark[0] = ':';
+      mark[1] = '[';
+      if (p == mark + 1) p = mark + 2;  // df == 0: keep the '[' written
       *p++ = ']';
       *p++ = '\n';
-      buf.resize(p - buf.data());
     }
+    const size_t nbytes = p - buf.data();
     std::string path = dir;
     path += static_cast<char>('a' + letter);
     path += ".txt";
-    FILE* f = std::fopen(path.c_str(), "wb");
+    const std::string tmp = path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
     if (!f) return -1;
-    if (!buf.empty() && std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+    if (nbytes && std::fwrite(buf.data(), 1, nbytes, f) != nbytes) {
       std::fclose(f);
+      std::remove(tmp.c_str());
       return -1;
     }
-    std::fclose(f);
-    total += static_cast<int64_t>(buf.size());
+    if (std::fclose(f) != 0 || std::rename(tmp.c_str(), path.c_str()) != 0) {
+      std::remove(tmp.c_str());
+      return -1;
+    }
+    total += static_cast<int64_t>(nbytes);
+    // Crash-injection hook shared with text/formatter.py: after N
+    // complete letters, die without unwinding so the durability test
+    // observes exactly what a hard crash leaves on disk.
+    if (const char* kill_after = std::getenv("MRI_EMIT_KILL_AFTER_LETTERS")) {
+      if (letter + 1 == std::atoi(kill_after)) raise(SIGKILL);
+    }
   }
   return total;
 }
@@ -1382,10 +1476,12 @@ int64_t EmitLettersRuns(const uint8_t* vocab_packed, int32_t vocab_size,
 int64_t EmitLetters(const uint8_t* vocab_packed, int32_t vocab_size,
                     int32_t width, const int64_t* order, const int64_t* df,
                     const int64_t* offsets, const uint16_t* postings16,
-                    const int32_t* postings32, const char* out_dir) {
+                    const int32_t* postings32, const char* out_dir,
+                    const uint32_t* lens = nullptr,
+                    int64_t maxid_hint = -1) {
   const EmitRun run{postings16, postings32, offsets, df};
   return EmitLettersRuns(vocab_packed, vocab_size, width, order, &run, 1,
-                         out_dir);
+                         out_dir, lens, maxid_hint);
 }
 
 }  // namespace
@@ -1553,6 +1649,195 @@ int32_t mri_host_index(const uint8_t* data, int64_t len,
   stats->bytes_written = EmitLetters(
       vocab_packed.data(), vocab, width, emit_rank.data(), df_rank.data(),
       offsets_rank.data(), nullptr, flat.data(), out_dir);
+  return stats->bytes_written < 0 ? -1 : 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+// ---------------------------------------------------------------------------
+// Incremental host index: same pipeline as mri_host_index but fed one
+// window at a time so the caller can overlap file reads with the scan
+// (the ctypes layer releases the GIL for the feed call's duration).
+// Single scan state — windows arrive in manifest order, so postings
+// stay doc-ascending for free, exactly like the T == 1 one-shot path.
+// Stage nanoseconds are accumulated so the Python side can report a
+// read/tokenize/emit split without host-side clock instrumentation
+// around every call.
+// ---------------------------------------------------------------------------
+
+struct HostStreamStats {
+  int64_t raw_tokens;
+  int64_t num_pairs;
+  int32_t vocab_size;
+  int32_t reserved;
+  int64_t bytes_written;  // -1 = IO error
+  int64_t scan_ns;        // cumulative mri_hidx_feed time
+  int64_t finalize_ns;    // postings flatten + sorts
+  int64_t emit_ns;        // letter-file render + write
+};
+
+struct HostStreamState {
+  StreamState st;
+  // First (term, doc) occurrences in scan order — term ids flat (ONE
+  // push in the scan's hot loop), with the doc id recovered from
+  // doc_marks: docs are scanned in order, so each mark says "pairs
+  // from this index on belong to this doc" (document-count scale).
+  // The finalize pass scatters by the combiner's df counts.
+  std::vector<int32_t> pair_ids;
+  struct DocMark { int64_t start; int32_t doc; };
+  std::vector<DocMark> doc_marks;
+  int32_t max_doc_id = 0;
+  int64_t scan_ns = 0;
+};
+
+void* mri_hidx_new() try {
+  return new HostStreamState();
+} catch (const std::bad_alloc&) {
+  return nullptr;
+}
+
+void mri_hidx_free(void* handle) {
+  delete static_cast<HostStreamState*>(handle);
+}
+
+int32_t mri_hidx_feed(void* handle, const uint8_t* data, int64_t len,
+                      const int64_t* doc_ends, const int32_t* doc_id_values,
+                      int32_t num_docs) try {
+  HostStreamState& h = *static_cast<HostStreamState*>(handle);
+  const auto t0 = std::chrono::steady_clock::now();
+  if (h.pair_ids.capacity() == h.pair_ids.size())
+    h.pair_ids.reserve(std::max<size_t>(h.pair_ids.size() * 2, 1 << 16));
+  for (int32_t d = 0; d < num_docs; ++d)
+    h.max_doc_id = std::max(h.max_doc_id, doc_id_values[d]);
+  int32_t cur_doc = h.doc_marks.empty() ? -1 : h.doc_marks.back().doc;
+  ScanChunk(h.st, data, len, 0, doc_ends, doc_id_values, 0, num_docs,
+            /*dedup=*/true, [&](int32_t id, int32_t doc) {
+              if (doc != cur_doc) {
+                cur_doc = doc;
+                h.doc_marks.push_back(
+                    {static_cast<int64_t>(h.pair_ids.size()), doc});
+              }
+              h.pair_ids.push_back(id);
+            });
+  h.scan_ns += std::chrono::duration_cast<std::chrono::nanoseconds>(
+                   std::chrono::steady_clock::now() - t0)
+                   .count();
+  return 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+int32_t mri_hidx_finalize_emit(void* handle, const char* out_dir,
+                               HostStreamStats* stats) try {
+  HostStreamState& h = *static_cast<HostStreamState*>(handle);
+  StreamState& st = h.st;
+  const auto t0 = std::chrono::steady_clock::now();
+
+  const int32_t vocab = st.next_id;
+  // The combiner already holds every term's deduped document frequency;
+  // scatter the flat scan-order pairs into per-term runs (scan order is
+  // doc-ascending within a term, so the runs arrive sorted).  Doc ids
+  // come from the doc_marks segments, not a parallel per-pair array.
+  std::vector<int64_t> df_prov(std::max(vocab, 1), 0);
+  std::vector<int64_t> offsets_prov(std::max(vocab, 1));
+  int64_t total_pairs = 0;
+  for (int32_t p = 0; p < vocab; ++p) {
+    df_prov[p] = st.combiner[p].df;
+    offsets_prov[p] = total_pairs;
+    total_pairs += df_prov[p];
+  }
+  std::vector<int32_t> flat(std::max<int64_t>(total_pairs, 1));
+  {
+    std::vector<int64_t> cursor(offsets_prov.begin(), offsets_prov.end());
+    const size_t n_marks = h.doc_marks.size();
+    for (size_t s = 0; s < n_marks; ++s) {
+      const int64_t seg_end = (s + 1 < n_marks) ? h.doc_marks[s + 1].start
+                                                : static_cast<int64_t>(
+                                                      h.pair_ids.size());
+      const int32_t doc = h.doc_marks[s].doc;
+      for (int64_t k = h.doc_marks[s].start; k < seg_end; ++k)
+        flat[cursor[h.pair_ids[k]]++] = doc;
+    }
+  }
+
+  int32_t width = 1;
+  for (int32_t i = 0; i < vocab; ++i)
+    width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
+
+  // One sort straight to emit order — (letter asc, df desc, word asc)
+  // — instead of SortedOrder + rank views + a second stable sort.  A
+  // counting pre-partition on the letter (the bswapped prefix's top
+  // byte) turns it into 26 smaller sorts whose comparator never has to
+  // look at the letter again.  Ties past the 8-byte prefix fall back
+  // to the padded tail, which is NUL-filled so prefix words sort first
+  // (main.c:55-64 semantics).
+  struct EmitKey {
+    uint64_t prefix;
+    int32_t df;
+    int32_t id;
+  };
+  const uint8_t* base = st.arena.data();
+  std::vector<EmitKey> keyed(std::max(vocab, 1));
+  int32_t letter_count[27] = {0};
+  for (int32_t i = 0; i < vocab; ++i) {
+    const uint64_t prefix = __builtin_bswap64(Load64(base + st.word_offsets[i]));
+    ++letter_count[(prefix >> 56) - 'a' + 1];
+    keyed[i] = {prefix, static_cast<int32_t>(df_prov[i]), i};
+  }
+  int32_t letter_off[27];
+  letter_off[0] = 0;
+  for (int i = 1; i < 27; ++i)
+    letter_off[i] = letter_off[i - 1] + letter_count[i];
+  std::vector<EmitKey> part(std::max(vocab, 1));
+  {
+    int32_t cur[26];
+    std::memcpy(cur, letter_off, sizeof(cur));
+    for (int32_t i = 0; i < vocab; ++i)
+      part[cur[(keyed[i].prefix >> 56) - 'a']++] = keyed[i];
+  }
+  const auto by_df_word = [&](const EmitKey& a, const EmitKey& b) {
+    if (a.df != b.df) return a.df > b.df;
+    if (a.prefix != b.prefix) return a.prefix < b.prefix;
+    const uint8_t* pa = base + st.word_offsets[a.id];
+    const uint8_t* pb = base + st.word_offsets[b.id];
+    const uint32_t pla = (st.word_lens[a.id] + 7) & ~7u;
+    const uint32_t plb = (st.word_lens[b.id] + 7) & ~7u;
+    const uint32_t lim = pla > plb ? pla : plb;
+    for (uint32_t i = 8; i < lim; i += 8) {
+      const uint64_t ka = i < pla ? __builtin_bswap64(Load64(pa + i)) : 0;
+      const uint64_t kb = i < plb ? __builtin_bswap64(Load64(pb + i)) : 0;
+      if (ka != kb) return ka < kb;
+    }
+    return false;  // identical words cannot occur (unique vocab)
+  };
+  for (int l = 0; l < 26; ++l)
+    std::sort(part.begin() + letter_off[l], part.begin() + letter_off[l + 1],
+              by_df_word);
+  std::vector<int64_t> emit_order(std::max(vocab, 1));
+  for (int32_t i = 0; i < vocab; ++i) emit_order[i] = part[i].id;
+
+  // Fixed-width NUL-padded rows for the shared emit core, prov space.
+  std::vector<uint8_t> vocab_packed(
+      std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 0);
+  for (int32_t p = 0; p < vocab; ++p)
+    std::memcpy(vocab_packed.data() + static_cast<int64_t>(p) * width,
+                base + st.word_offsets[p], st.word_lens[p]);
+  const auto t1 = std::chrono::steady_clock::now();
+
+  stats->raw_tokens = st.raw_tokens;
+  stats->num_pairs = st.num_pairs;
+  stats->vocab_size = vocab;
+  stats->reserved = 0;
+  stats->bytes_written = EmitLetters(
+      vocab_packed.data(), vocab, width, emit_order.data(), df_prov.data(),
+      offsets_prov.data(), nullptr, flat.data(), out_dir,
+      st.word_lens.data(), h.max_doc_id);
+  const auto t2 = std::chrono::steady_clock::now();
+  stats->scan_ns = h.scan_ns;
+  stats->finalize_ns =
+      std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0).count();
+  stats->emit_ns =
+      std::chrono::duration_cast<std::chrono::nanoseconds>(t2 - t1).count();
   return stats->bytes_written < 0 ? -1 : 0;
 } catch (const std::bad_alloc&) {
   return -2;

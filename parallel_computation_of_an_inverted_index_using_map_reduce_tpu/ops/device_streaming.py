@@ -254,7 +254,15 @@ class DeviceStreamEngine:
         over a ~8 MB/s tunnel (VERDICT r4 weak #3)."""
         if self._acc is None:
             return 0
-        pad = min(round_up(max(self._unique_bound, 1),
+        # snapshot() drains the in-flight merges BEFORE fetching, so
+        # project from the last resolved true count, not the pending-
+        # inflated capacity bound: _unique_bound carries every pending
+        # window's whole token count (worst case all-unique), which at
+        # streaming scale overstates the fetch by windows' worth of
+        # tokens and makes the budget loop skip affordable snapshots.
+        drained_bound = self._unique_bound - sum(
+            tc for _, tc in self._pending)
+        pad = min(round_up(max(drained_bound, 1),
                            self._snapshot_granule), self._cap)
         return (2 * self._num_groups + 1) * pad * 4
 
@@ -294,7 +302,9 @@ class DeviceStreamEngine:
         d_ends = jax.device_put(ends)
         d_ids = jax.device_put(ids)
         if stage_hook is not None:
-            stage_hook("upload", d_buf)
+            # all three uploads: barriering d_buf alone lets the ends /
+            # ids transfers leak into the next stage's measured time
+            stage_hook("upload", (d_buf, d_ends, d_ids))
         rows, counts = window_rows(
             d_buf, d_ends, d_ids,
             width=self._width, tok_cap=tok_cap, num_docs=ends.shape[0],

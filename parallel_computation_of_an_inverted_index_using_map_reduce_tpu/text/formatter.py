@@ -28,6 +28,28 @@ def _doc_id_str_table(max_doc_id: int) -> np.ndarray:
     return np.array([str(i).encode("ascii") for i in range(max_doc_id + 1)], dtype=object)
 
 
+def _write_letter_atomic(path: Path, payload: bytes) -> None:
+    """tmp + rename so a crash mid-emit never leaves a truncated letter
+    file that parses as a smaller-but-plausible index (matches the
+    native emit core's write discipline)."""
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def _maybe_kill_after(letters_done: int) -> None:
+    # Crash-injection hook for the kill-mid-emit durability test: after
+    # N complete letter files, die without unwinding (SIGKILL — no
+    # flush, no atexit), so the test observes exactly what a hard crash
+    # leaves on disk.
+    target = os.environ.get("MRI_EMIT_KILL_AFTER_LETTERS")
+    if target is not None and letters_done == int(target):
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 def emit_index(
     output_dir: str | Path,
     vocab: np.ndarray,            # (V,) numpy 'S' array, sorted
@@ -38,6 +60,7 @@ def emit_index(
     postings: np.ndarray,         # (>=num pairs,) compacted ascending doc ids
     max_doc_id: int,
     letter_range: tuple[int, int] = (0, ALPHABET_SIZE),
+    backend: str = "python",
 ) -> dict:
     """Write letter files from the device engine's output arrays.
 
@@ -45,9 +68,31 @@ def emit_index(
     emit of the multi-host regime (the reference's reducer letter
     ownership, main.c:129-150): each owner writes only its own files,
     so no host ever assembles the global index.
+
+    ``backend`` selects the writer: ``"native"`` requires the C++
+    vectorized emit, ``"auto"`` uses it when available (full letter
+    range only — the native core always writes all 26 files), and
+    ``"python"`` is this module's pure-Python oracle.  All three are
+    byte-identical; the pure-Python path stays authoritative.
     """
     output_dir = Path(output_dir)
     os.makedirs(output_dir, exist_ok=True)
+    if backend not in ("python", "auto", "native"):
+        raise ValueError(f"unknown emit backend {backend!r}")
+    if backend in ("auto", "native") and tuple(letter_range) == (0, ALPHABET_SIZE):
+        from .. import native
+
+        if native.load() is not None:
+            bytes_written = native.emit_native(
+                output_dir, np.asarray(vocab), order, df, offsets, postings)
+            return {"lines_written": int(np.asarray(order).shape[0]),
+                    "letters": ALPHABET_SIZE,
+                    "bytes_written": int(bytes_written),
+                    "emit_backend": "native"}
+        if backend == "native":
+            raise RuntimeError(
+                f"emit_backend='native' but the native library is "
+                f"unavailable: {native.load_error()}")
     id_strs = _doc_id_str_table(max_doc_id)
     vocab_py = vocab.tolist()  # list[bytes]; plain indexing is faster than np scalar access
     df = np.asarray(df)
@@ -57,6 +102,7 @@ def emit_index(
     letters_in_order = np.asarray(letter_of_term)[order]
     bounds = np.searchsorted(letters_in_order, np.arange(ALPHABET_SIZE + 1))
     lines_written = 0
+    letters_done = 0
     for letter in range(*letter_range):
         lo, hi = int(bounds[letter]), int(bounds[letter + 1])
         out = bytearray()
@@ -67,11 +113,13 @@ def emit_index(
             out += b":["
             out += b" ".join(id_strs[postings[start : start + n]])
             out += b"]\n"
-        with open(output_dir / letter_filename(letter), "wb") as f:
-            f.write(out)
+        _write_letter_atomic(output_dir / letter_filename(letter), bytes(out))
         lines_written += hi - lo
+        letters_done += 1
+        _maybe_kill_after(letters_done)
     return {"lines_written": lines_written,
-            "letters": letter_range[1] - letter_range[0]}
+            "letters": letter_range[1] - letter_range[0],
+            "emit_backend": "python"}
 
 
 def letters_md5(output_dir: str | Path) -> str:
@@ -93,6 +141,8 @@ def emit_grouped(output_dir: str | Path,
     os.makedirs(output_dir, exist_ok=True)
     for letter in range(ALPHABET_SIZE):
         entries = per_letter.get(letter, [])
-        with open(output_dir / letter_filename(letter), "wb") as f:
-            for word, ids in entries:
-                f.write(word + b":[" + " ".join(map(str, ids)).encode("ascii") + b"]\n")
+        out = bytearray()
+        for word, ids in entries:
+            out += word + b":[" + " ".join(map(str, ids)).encode("ascii") + b"]\n"
+        _write_letter_atomic(output_dir / letter_filename(letter), bytes(out))
+        _maybe_kill_after(letter + 1)

@@ -1,0 +1,220 @@
+"""Emit backend parity + crash durability.
+
+The native vectorized emit (tokenizer.cc EmitLettersRuns) and the
+pure-Python formatter are byte-identical by contract — the Python path
+is the oracle the native one is judged against.  Both write each letter
+file atomically (tmp + rename), so a crash mid-emit can leave a letter
+missing but never truncated-but-plausible; the kill-mid-emit test
+proves exactly that with a real SIGKILL.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT, read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    InvertedIndexModel,
+    native,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text import (
+    formatter,
+)
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+
+def _emit_arrays(rng, n_terms, max_doc_id, letters="abcdefghijklmnopqrstuvwxyz"):
+    """Random but well-formed device-engine output arrays: sorted 'S'
+    vocab, (letter asc, df desc, word asc) order, ascending per-term
+    postings."""
+    alphabet = np.frombuffer(letters.encode(), np.uint8)
+    words = set()
+    while len(words) < n_terms:
+        n = int(rng.integers(1, 18))
+        words.add(bytes(rng.choice(alphabet, size=n)))
+    vocab_list = sorted(words)
+    width = max((len(w) for w in vocab_list), default=1)
+    vocab = np.array(vocab_list, dtype=f"S{width}")
+    letters_of = np.array([w[0] - ord("a") for w in vocab_list], np.int64)
+    df = rng.integers(1, min(max_doc_id + 1, 7) + 1, size=n_terms).astype(np.int64)
+    offsets = np.cumsum(df) - df
+    postings = np.concatenate([
+        np.sort(rng.choice(max_doc_id + 1, size=int(d), replace=False))
+        for d in df]).astype(np.int32) if n_terms else np.empty(0, np.int32)
+    order = np.lexsort((vocab, -df, letters_of))
+    return vocab, letters_of, order, df, offsets, postings
+
+
+def _emit_both(tmp_path, arrays, max_doc_id):
+    vocab, letters_of, order, df, offsets, postings = arrays
+    for backend in ("python", "native"):
+        formatter.emit_index(
+            tmp_path / backend, vocab=vocab, letter_of_term=letters_of,
+            order=order, df=df, offsets=offsets, postings=postings,
+            max_doc_id=max_doc_id, backend=backend)
+    assert read_letter_files(tmp_path / "native") == \
+        read_letter_files(tmp_path / "python")
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_native_emit_matches_python_random(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    arrays = _emit_arrays(rng, n_terms=120, max_doc_id=400)
+    _emit_both(tmp_path, arrays, max_doc_id=400)
+
+
+@needs_native
+def test_native_emit_empty_letters(tmp_path):
+    # only two first letters in play: 24 letter files must come out
+    # empty (and still exist) from both writers
+    rng = np.random.default_rng(7)
+    arrays = _emit_arrays(rng, n_terms=30, max_doc_id=50, letters="qx")
+    _emit_both(tmp_path, arrays, max_doc_id=50)
+    content = (tmp_path / "native" / "a.txt").read_bytes()
+    assert content == b""
+
+
+@needs_native
+def test_native_emit_empty_vocab(tmp_path):
+    rng = np.random.default_rng(0)
+    arrays = _emit_arrays(rng, n_terms=0, max_doc_id=0)
+    _emit_both(tmp_path, arrays, max_doc_id=0)
+    assert read_letter_files(tmp_path / "native") == b""
+
+
+@needs_native
+def test_native_emit_single_doc_postings(tmp_path):
+    # every posting list is exactly one doc — the df==1 render edge
+    # (separator patching must produce "w:[0]\n", never "w:[]\n")
+    rng = np.random.default_rng(5)
+    vocab, letters_of, order, df, offsets, postings = _emit_arrays(
+        rng, n_terms=40, max_doc_id=0)
+    assert df.tolist() == [1] * 40 and set(postings.tolist()) == {0}
+    _emit_both(tmp_path, (vocab, letters_of, order, df, offsets, postings),
+               max_doc_id=0)
+    first_letter_file = tmp_path / "native" / (vocab[0][:1].decode() + ".txt")
+    for line in first_letter_file.read_bytes().splitlines():
+        assert line.endswith(b":[0]")
+
+
+def test_emit_backend_python_forced(tmp_path):
+    rng = np.random.default_rng(9)
+    arrays = _emit_arrays(rng, n_terms=10, max_doc_id=5)
+    vocab, letters_of, order, df, offsets, postings = arrays
+    stats = formatter.emit_index(
+        tmp_path, vocab=vocab, letter_of_term=letters_of, order=order,
+        df=df, offsets=offsets, postings=postings, max_doc_id=5,
+        backend="python")
+    assert stats["emit_backend"] == "python"
+
+
+def test_emit_backend_unknown_rejected(tmp_path):
+    with pytest.raises(ValueError, match="emit backend"):
+        formatter.emit_index(
+            tmp_path, vocab=np.empty(0, "S1"),
+            letter_of_term=np.empty(0, np.int64),
+            order=np.empty(0, np.int64), df=np.empty(0, np.int64),
+            offsets=np.empty(0, np.int64), postings=np.empty(0, np.int32),
+            max_doc_id=0, backend="fortran")
+
+
+def test_emit_backend_native_errors_when_unavailable(tmp_path, monkeypatch):
+    monkeypatch.setattr(native, "load", lambda: None)
+    with pytest.raises(RuntimeError, match="native"):
+        formatter.emit_index(
+            tmp_path, vocab=np.empty(0, "S1"),
+            letter_of_term=np.empty(0, np.int64),
+            order=np.empty(0, np.int64), df=np.empty(0, np.int64),
+            offsets=np.empty(0, np.int64), postings=np.empty(0, np.int32),
+            max_doc_id=0, backend="native")
+
+
+# -- degenerate reference configs -------------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("mappers,reducers", [(400, 1), (4, 30), (400, 30)])
+def test_degenerate_configs_backend_parity(smoke_fixture, tmp_path,
+                                           mappers, reducers):
+    """The reference's degenerate thread configs (more mappers than
+    docs, more reducers than letters) must not disturb emit parity:
+    python and native writers agree byte-for-byte and match the
+    goldens."""
+    m = read_manifest(smoke_fixture / "manifest.txt",
+                      base_dir=smoke_fixture)
+    golden = read_letter_files(smoke_fixture / "golden")
+    for backend in ("python", "native"):
+        out = tmp_path / backend
+        # pipeline_chunk_docs=0: the one-shot engine (the multichip
+        # fast path needs jax.shard_map, deprecated on this jax)
+        InvertedIndexModel(IndexConfig(
+            backend="tpu", num_mappers=mappers, num_reducers=reducers,
+            emit_backend=backend, pad_multiple=64, device_shards=1,
+            pipeline_chunk_docs=0)).run(m, output_dir=out)
+        assert read_letter_files(out) == golden
+
+
+# -- kill-mid-emit durability -----------------------------------------
+
+_CHILD = """\
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text import formatter
+
+vocab = np.array([b"ant", b"bee", b"cat", b"dog", b"eel"], dtype="S3")
+letters = np.arange(5, dtype=np.int64)
+df = np.array([2, 1, 3, 1, 2], dtype=np.int64)
+offsets = np.cumsum(df) - df
+postings = np.array([0, 1, 2, 0, 1, 2, 1, 0, 2], dtype=np.int32)
+order = np.arange(5, dtype=np.int64)
+formatter.emit_index(sys.argv[1], vocab=vocab, letter_of_term=letters,
+                     order=order, df=df, offsets=offsets,
+                     postings=postings, max_doc_id=2,
+                     backend=sys.argv[2])
+"""
+
+
+@pytest.mark.parametrize("backend", [
+    "python", pytest.param("native", marks=needs_native)])
+def test_kill_mid_emit_leaves_no_truncated_file(tmp_path, backend):
+    """SIGKILL after the 3rd letter: completed letters are byte-exact,
+    later letters are absent or `.tmp` residue — NEVER a truncated
+    `<letter>.txt` that would parse as a smaller-but-plausible index."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(repo=str(REPO_ROOT)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    ref_dir = tmp_path / "ref"
+    subprocess.run([sys.executable, str(script), str(ref_dir), backend],
+                   env=env, check=True, timeout=300)
+
+    kill_dir = tmp_path / "killed"
+    proc = subprocess.run(
+        [sys.executable, str(script), str(kill_dir), backend],
+        env={**env, "MRI_EMIT_KILL_AFTER_LETTERS": "3"}, timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+
+    survivors = 0
+    for i in range(26):
+        name = f"{chr(ord('a') + i)}.txt"
+        final = kill_dir / name
+        if final.exists():
+            # anything that looks complete must BE complete
+            assert final.read_bytes() == (ref_dir / name).read_bytes()
+            survivors += 1
+        else:
+            leftovers = list(kill_dir.glob(name + "*"))
+            assert [p.suffix for p in leftovers] in ([], [".tmp"])
+    assert survivors == 3  # killed right after the 3rd rename

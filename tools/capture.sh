@@ -139,6 +139,12 @@ for f in "BENCH_TPU_${TAG}.json" "SCALE_${TAG}.json" BENCH_ATTEST.json; do
 done
 if [ ${#ARTIFACTS[@]} -eq 0 ]; then
   echo "capture commit: no artifacts to commit (empty capture?)"
+elif [ -z "$(git status --porcelain -- "${ARTIFACTS[@]}")" ]; then
+  # `git commit` exits non-zero when the artifacts are byte-identical
+  # to HEAD (a re-run after an already-landed capture) — that is not
+  # lock contention, so don't spin the retry loop or scare the log
+  echo "capture commit: artifacts (${ARTIFACTS[*]}) unchanged since" \
+       "HEAD; nothing to commit"
 else
   committed=0
   for attempt in 1 2 3; do

@@ -79,10 +79,12 @@ def main() -> int:
 
     def fetch_barrier(x):
         """Real host fetch of a tiny slice — block_until_ready returns
-        at dispatch-ACK on the tunneled platform (measurement lore)."""
-        if isinstance(x, tuple):
-            x = x[0]
-        np.asarray(x if getattr(x, "ndim", 0) == 0 else x[:1])
+        at dispatch-ACK on the tunneled platform (measurement lore).
+        Tuples barrier EVERY element: the upload hook hands all three
+        window transfers (d_buf, d_ends, d_ids), and skipping two would
+        credit their copy time to the next stage."""
+        for a in (x if isinstance(x, tuple) else (x,)):
+            np.asarray(a if getattr(a, "ndim", 0) == 0 else a[:1])
 
     # --- pass 1 (cold, pipelined): pays every XLA compile so the two
     # timed passes below compare warm programs; its wall is reported
