@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast bench bench-scale capture rehearse clean
+.PHONY: build test test-fast test-faults bench bench-scale capture rehearse clean
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -21,6 +21,11 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
 	  $$($(PY) -c "import importlib.util as u; print('-n auto' if u.find_spec('xdist') else '')")
+
+# failure-semantics suite only: fault injection, retry/skip policy,
+# crash-safe resume (tests marked `faults`)
+test-faults:
+	$(PY) -m pytest tests/ -q -m faults
 
 bench:
 	$(PY) bench.py

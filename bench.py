@@ -614,6 +614,10 @@ def main() -> int:
         "cpu_host_threads": cpu.get("host_threads"),
         "host_stage_split": _host_stage_split(cpu.get("report", {})),
         "scratch": _scratch_backing(),
+        # failure-handling outcome of the best cpu run (faults.py):
+        # non-empty skipped_docs means the measurement itself is suspect
+        "degradation": cpu.get("report", {}).get(
+            "degradation", {"read_retries": 0, "skipped_docs": []}),
     }
     if tpu is not None:
         line["tpu_platform"] = tpu.get("platform")

@@ -13,11 +13,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
+from . import faults
 from .config import IndexConfig
 from .corpus.manifest import read_manifest
 from .models.inverted_index import build_index
+from .utils.checkpoint import CheckpointCorrupt
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -96,11 +99,42 @@ def make_parser() -> argparse.ArgumentParser:
                    help="backend=cpu read-ahead depth: window arenas the "
                         "reader thread keeps filled while the native scan "
                         "runs (0 = one-shot load, no pipeline)")
+    p.add_argument("--resume", choices=("strict", "auto"), default="strict",
+                   help="checkpoint-trust policy: strict = a corrupt "
+                        "checkpoint is a hard error; auto = quarantine it "
+                        "to <path>.corrupt and restart fresh (crash-safe "
+                        "rerun after SIGKILL mid-save)")
+    p.add_argument("--fault-spec", default=None,
+                   help="arm the deterministic fault injector (faults.py "
+                        "grammar, e.g. 'read-error:doc=2:times=2'; also "
+                        f"readable from ${faults.ENV_VAR}) — test/bench "
+                        "only, never needed for production runs")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
     args = make_parser().parse_args(argv)
+    # Satellite: validate the reference positionals up front with ONE
+    # clear line on stderr — not an IndexConfig traceback, not a
+    # confusing manifest parse error three layers down.
+    if args.num_mappers < 1:
+        print(f"error: num_mappers must be >= 1, got {args.num_mappers}",
+              file=sys.stderr)
+        return 2
+    if args.num_reducers < 1:
+        print(f"error: num_reducers must be >= 1, got {args.num_reducers}",
+              file=sys.stderr)
+        return 2
+    if not os.path.exists(args.file_list):
+        print(f"error: input list {args.file_list!r} does not exist",
+              file=sys.stderr)
+        return 2
+    if args.fault_spec is not None:
+        try:
+            faults.install(args.fault_spec)
+        except faults.FaultSpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     try:
         manifest = read_manifest(args.file_list)
         config = IndexConfig(
@@ -126,13 +160,21 @@ def main(argv: list[str] | None = None) -> int:
             emit_ownership=args.emit_ownership,
             emit_backend=args.emit_backend,
             io_prefetch=args.io_prefetch,
+            resume=args.resume,
         )
         stats = build_index(manifest, config)
-    except (OSError, ValueError) as e:
+    except (OSError, ValueError, CheckpointCorrupt) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.stats:
         print(json.dumps(stats, sort_keys=True))
+    degradation = stats.get("degradation") or {}
+    skipped = degradation.get("skipped_docs") or []
+    if skipped:
+        print(f"warning: completed DEGRADED — skipped {len(skipped)} "
+              f"unreadable document(s) (doc ids {sorted(skipped)}); "
+              f"exit {faults.EXIT_DEGRADED}", file=sys.stderr)
+        return faults.EXIT_DEGRADED
     return 0
 
 

@@ -124,6 +124,14 @@ class IndexConfig:
     # crash (SCALE_r03.json device_stream_real_tpu).
     stream_checkpoint: str | None = None
     stream_checkpoint_every: int = 2
+    # Checkpoint-trust policy at resume time:
+    #   "strict" — a corrupt checkpoint file is a hard error
+    #              (utils/checkpoint.CheckpointCorrupt); mismatched
+    #              version/fingerprint stays a hard error in both modes
+    #   "auto"   — a corrupt file is quarantined to ``<path>.corrupt``
+    #              and the run restarts fresh (crash-safe auto-resume:
+    #              a SIGKILL mid-save must never wedge the rerun)
+    resume: str = "strict"
     # Letter-file writer:
     #   "auto"   — native vectorized emit (tokenizer.cc EmitLettersRuns:
     #              pre-rendered id strings, single-allocation render,
@@ -273,6 +281,9 @@ class IndexConfig:
                 raise ValueError(
                     "emit_ownership='letter' requires the pipelined multi-chip "
                     "path (pipeline_chunk_docs=0 disables it)")
+        if self.resume not in ("strict", "auto"):
+            raise ValueError(
+                f"resume must be 'strict' or 'auto', got {self.resume!r}")
         if self.stream_checkpoint_every < 1:
             raise ValueError(
                 f"stream_checkpoint_every must be >= 1, "

@@ -16,9 +16,13 @@ Reference behavior being reproduced (main.c:257-298):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
-import sys
 from pathlib import Path
+
+from .. import faults
+
+log = logging.getLogger("mri_tpu.corpus")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,12 +69,24 @@ class Manifest:
         return total
 
 
-def _stat_size(path: str) -> int:
-    try:
-        return os.stat(path).st_size
-    except OSError:
-        print(f"warning: cannot stat {path!r}; keeping it with size 0", file=sys.stderr)
-        return 0
+def _stat_sizes(paths) -> tuple[int, ...]:
+    """Sizes for a path list; unstat-able files keep size 0 (reference
+    main.c:289-296 keeps them in the manifest).  Repeated per-file
+    warnings are deduplicated into ONE counted summary line."""
+    sizes = []
+    missing: list[str] = []
+    for p in paths:
+        try:
+            sizes.append(os.stat(p).st_size)
+        except OSError:
+            missing.append(p)
+            sizes.append(0)
+    if missing:
+        shown = ", ".join(repr(p) for p in missing[:3])
+        more = f" (+{len(missing) - 3} more)" if len(missing) > 3 else ""
+        log.warning("cannot stat %d file(s); keeping them with size 0: "
+                    "%s%s", len(missing), shown, more)
+    return tuple(sizes)
 
 
 def read_manifest(list_path: str | Path, base_dir: str | Path | None = None) -> Manifest:
@@ -94,8 +110,7 @@ def read_manifest(list_path: str | Path, base_dir: str | Path | None = None) -> 
             f"manifest {list_path!r} declares {count} files but lists {len(names)}"
         )
     paths = tuple(str(p) if os.path.isabs(p) else str(base / p) for p in names)
-    sizes = tuple(_stat_size(p) for p in paths)
-    return Manifest(paths=paths, sizes=sizes)
+    return Manifest(paths=paths, sizes=_stat_sizes(paths))
 
 
 def write_manifest(manifest_path: str | Path, paths: list[str]) -> None:
@@ -118,26 +133,59 @@ def manifest_from_dir(corpus_dir: str | Path, pattern: str = "**/*.txt") -> Mani
     paths = sorted(str(p) for p in root.glob(pattern) if p.is_file())
     if not paths:
         raise ValueError(f"no files matching {pattern!r} under {corpus_dir!r}")
-    sizes = tuple(_stat_size(p) for p in paths)
-    return Manifest(paths=tuple(paths), sizes=sizes)
+    return Manifest(paths=tuple(paths), sizes=_stat_sizes(paths))
 
 
-def iter_document_ranges(manifest: Manifest, ranges):
+def _read_doc_resilient(manifest: Manifest, i: int, policy, report):
+    """One document read under the pipeline retry policy, honouring
+    any armed fault injector (faults.py).  Returns bytes, or None when
+    the document stays unreadable (recorded as a skip in ``report``)."""
+
+    def attempt() -> bytes:
+        inj = faults.active()
+        cap = None
+        if inj is not None:
+            cap = inj.on_read(i, manifest.paths[i])
+        data = manifest.read_doc(i)
+        return data if cap is None else data[:cap]
+
+    try:
+        return policy.run(attempt, doc_id=manifest.doc_id(i),
+                          path=manifest.paths[i], report=report)
+    except OSError as e:
+        report.record_skip(doc_id=manifest.doc_id(i),
+                           path=manifest.paths[i], reason=str(e))
+        return None
+
+
+def iter_document_ranges(manifest: Manifest, ranges, *,
+                         policy=None, report=None):
     """Yield ``(contents, doc_ids)`` for each ``[lo, hi)`` doc range —
     the loader behind both doc-count windows and the scheduler's
     byte-balanced plans (corpus/scheduler.plan_contiguous_windows).
-    Unreadable files are warned about and skipped inside their window
-    (reference main.c:97-100)."""
+    Each read retries per ``policy`` (default: the env-tuned pipeline
+    policy, faults.RetryPolicy); persistently unreadable files are
+    skipped inside their window (reference main.c:97-100) and recorded
+    in ``report`` — one counted warning line per window, not one per
+    document."""
+    if policy is None:
+        policy = faults.default_policy()
+    if report is None:
+        report = faults.current_report()
     for lo, hi in ranges:
         contents: list[bytes] = []
         doc_ids: list[int] = []
+        window_skips = 0
         for i in range(lo, hi):
-            try:
-                contents.append(manifest.read_doc(i))
-                doc_ids.append(manifest.doc_id(i))
-            except OSError:
-                print(f"warning: cannot open {manifest.paths[i]!r}; skipping",
-                      file=sys.stderr)
+            data = _read_doc_resilient(manifest, i, policy, report)
+            if data is None:
+                window_skips += 1
+                continue
+            contents.append(data)
+            doc_ids.append(manifest.doc_id(i))
+        if window_skips:
+            log.warning("skipped %d unreadable document(s) in window "
+                        "[%d, %d) after retries", window_skips, lo, hi)
         yield contents, doc_ids
 
 

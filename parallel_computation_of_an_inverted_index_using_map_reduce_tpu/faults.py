@@ -1,0 +1,419 @@
+"""Deterministic fault injection + the pipeline's resilience policy.
+
+The reference dies on any I/O hiccup (a failed ``fopen`` merely warns,
+main.c:97-100, but nothing retries, nothing reports, and a crash loses
+the whole run).  This module makes failure handling a *tested
+subsystem*: every failure mode the pipeline claims to survive can be
+armed deterministically and proven in a test, the same way DrJAX
+(arXiv:2403.07128) treats MapReduce structure as an explicit primitive
+rather than emergent behavior.
+
+Three layers live here:
+
+``FaultInjector``
+    Seedable, deterministic injection hooks.  Armed via
+    :func:`install` (the CLI's ``--fault-spec``) or the ``MRI_FAULTS``
+    env var (so subprocess e2e tests can arm a child they then
+    SIGKILL).  Spec grammar — clauses joined by ``;``, fields by ``:``::
+
+        read-error:doc=2:times=2     transient OSError, first 2 attempts
+        read-error:all:times=-1      permanent OSError on every doc
+        read-error:every=3:times=1   every 3rd manifest index
+        read-error:all:p=0.5:times=1 probabilistic (seed=N clause)
+        slow-read:doc=1:ms=50        sleep before the read
+        truncate:doc=4:bytes=10      document bytes cut short
+        reader-death:window=1        silent reader-thread death
+        sigkill:window=2             SIGKILL at stream window boundary
+        stream-crash:window=2        RuntimeError from the stream engine
+        ckpt-corrupt:save=1          corrupt checkpoint bytes post-save
+        seed=7                       RNG seed for ``p=`` rules
+
+    ``doc`` / ``every`` match the 0-based manifest index; ``window``
+    and ``save`` are 1-based ordinals (matching ``win_i`` in the
+    stream loop and "the Nth save").
+
+``RetryPolicy``
+    Bounded retries with exponential backoff and a per-document
+    deadline — replaces the single-shot warn-and-skip on the read
+    paths (io/reader.py, corpus/manifest.iter_document_ranges).
+
+``DegradationReport``
+    The structured outcome of a run's failure handling: retry counts
+    and exactly which doc ids were skipped, with reasons.  The model
+    attaches it to run stats; the CLI turns a non-empty skip list into
+    the documented degraded exit code (:data:`EXIT_DEGRADED`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import logging
+import os
+import random
+import signal
+import threading
+import time
+
+log = logging.getLogger("mri_tpu.faults")
+
+ENV_VAR = "MRI_FAULTS"
+
+#: CLI exit code for a run that completed but skipped documents after
+#: exhausting its retry budget (0 = clean, 2 = error, 3 = degraded).
+EXIT_DEGRADED = 3
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``--fault-spec`` / ``MRI_FAULTS`` string."""
+
+
+class InjectedReadError(OSError):
+    """The injected transient/permanent read failure (an OSError, so
+    the production retry/skip machinery handles it like a real one)."""
+
+
+class ReaderThreadDeath(BaseException):
+    """Injected *silent* reader-thread death.
+
+    Deliberately a BaseException: the executor's reader loop catches it
+    specially and exits without posting anything to the consumer — the
+    fire-and-forget daemon-thread failure mode the consumer-side
+    watchdog exists to detect.
+    """
+
+
+# -- injector ---------------------------------------------------------
+
+_READ_KINDS = ("read-error", "slow-read", "truncate")
+
+
+@dataclasses.dataclass
+class _Rule:
+    kind: str
+    doc: int | None = None      # manifest index; None = all (read kinds)
+    every: int | None = None
+    p: float | None = None
+    times: int = 1              # -1 = permanent (read-error)
+    ms: float = 0.0             # slow-read
+    bytes: int = 0              # truncate
+    window: int = 0             # reader-death / sigkill / stream-crash
+    save: int = 0               # ckpt-corrupt
+
+
+def _parse_int(kind: str, key: str, value: str) -> int:
+    try:
+        return int(value)
+    except ValueError:
+        raise FaultSpecError(
+            f"{kind}: {key}={value!r} is not an integer") from None
+
+
+def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
+    parts = [p for p in clause.strip().split(":") if p]
+    if not parts:
+        return None
+    head = parts[0]
+    if "=" in head:  # bare global assignment, e.g. seed=7
+        k, v = head.split("=", 1)
+        if k != "seed":
+            raise FaultSpecError(f"unknown global fault key {k!r}")
+        kv_global["seed"] = _parse_int("seed", "seed", v)
+        if len(parts) > 1:
+            raise FaultSpecError("seed=N must be a clause of its own")
+        return None
+    rule = _Rule(kind=head)
+    if head not in _READ_KINDS + ("reader-death", "sigkill",
+                                  "stream-crash", "ckpt-corrupt"):
+        raise FaultSpecError(f"unknown fault kind {head!r}")
+    for field in parts[1:]:
+        if field == "all":
+            rule.doc = None
+            continue
+        if "=" not in field:
+            raise FaultSpecError(
+                f"{head}: expected key=value, got {field!r}")
+        k, v = field.split("=", 1)
+        if k == "doc":
+            rule.doc = _parse_int(head, k, v)
+        elif k == "every":
+            rule.every = _parse_int(head, k, v)
+        elif k == "times":
+            rule.times = _parse_int(head, k, v)
+        elif k == "p":
+            try:
+                rule.p = float(v)
+            except ValueError:
+                raise FaultSpecError(
+                    f"{head}: p={v!r} is not a float") from None
+        elif k == "ms":
+            rule.ms = float(_parse_int(head, k, v))
+        elif k == "bytes":
+            rule.bytes = _parse_int(head, k, v)
+        elif k == "window":
+            rule.window = _parse_int(head, k, v)
+        elif k == "save":
+            rule.save = _parse_int(head, k, v)
+        else:
+            raise FaultSpecError(f"{head}: unknown key {k!r}")
+    if rule.kind in ("reader-death", "sigkill", "stream-crash") \
+            and rule.window < 1:
+        raise FaultSpecError(f"{head} needs window=N (1-based)")
+    if rule.kind == "ckpt-corrupt" and rule.save < 1:
+        raise FaultSpecError("ckpt-corrupt needs save=N (1-based)")
+    return rule
+
+
+class FaultInjector:
+    """Parsed fault spec + per-rule firing state.  Thread-safe: the
+    read hooks fire from reader threads concurrently with the main
+    thread's checkpoint/window hooks."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        kv_global: dict = {}
+        self.rules: list[_Rule] = []
+        for clause in spec.split(";"):
+            rule = _parse_clause(clause, kv_global)
+            if rule is not None:
+                self.rules.append(rule)
+        if not self.rules and "seed" not in kv_global:
+            raise FaultSpecError(f"empty fault spec {spec!r}")
+        self._rng = random.Random(kv_global.get("seed", 0))
+        self._lock = threading.Lock()
+        self._fired: dict[tuple[int, int], int] = {}
+        self._saves = 0
+
+    def _matches(self, rule: _Rule, index: int) -> bool:
+        if rule.doc is not None and index != rule.doc:
+            return False
+        if rule.every is not None and index % rule.every != 0:
+            return False
+        if rule.p is not None and self._rng.random() >= rule.p:
+            return False
+        return True
+
+    # -- hooks (each a no-op unless a matching rule is armed) ---------
+
+    def on_read(self, index: int, path: str) -> int | None:
+        """Per-attempt read hook.  May raise :class:`InjectedReadError`
+        or sleep; returns a byte cap to truncate the document to, or
+        None.  ``times=N`` counts *per document*, so a retrying caller
+        sees N failures then success — the transient-fault contract."""
+        cap = None
+        delay = 0.0
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind not in _READ_KINDS \
+                        or not self._matches(rule, index):
+                    continue
+                if rule.kind == "slow-read":
+                    delay = max(delay, rule.ms / 1e3)
+                elif rule.kind == "truncate":
+                    cap = rule.bytes if cap is None \
+                        else min(cap, rule.bytes)
+                else:  # read-error
+                    key = (ri, index)
+                    n = self._fired.get(key, 0)
+                    if rule.times < 0 or n < rule.times:
+                        self._fired[key] = n + 1
+                        raise InjectedReadError(
+                            errno.EIO, "injected read failure "
+                            f"(attempt {n + 1})", path)
+        if delay:
+            time.sleep(delay)
+        return cap
+
+    def on_reader_window(self, window: int) -> None:
+        """Fires in the executor's reader thread before window
+        ``window`` (1-based) is read; may raise ReaderThreadDeath."""
+        for rule in self.rules:
+            if rule.kind == "reader-death" and rule.window == window:
+                raise ReaderThreadDeath()
+
+    def on_window_boundary(self, window: int) -> None:
+        """Fires on the stream loop's main thread after window
+        ``window`` completes (post-checkpoint); may SIGKILL."""
+        for rule in self.rules:
+            if rule.kind == "sigkill" and rule.window == window:
+                log.warning("fault injection: SIGKILL at stream "
+                            "window boundary %d", window)
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_stream_window(self, window: int) -> None:
+        """Fires inside the device stream engine after it folds window
+        ``window``; may raise (the round-3 TPU worker crash, as a
+        first-class fault instead of an ad-hoc env hook)."""
+        for rule in self.rules:
+            if rule.kind == "stream-crash" and rule.window == window:
+                raise RuntimeError(
+                    f"injected stream crash after window {window} "
+                    "(fault spec)")
+
+    def on_checkpoint_saved(self, path: str) -> None:
+        """Fires after every atomic checkpoint save; the Nth save may
+        be corrupted in place (truncated to a third), simulating the
+        torn/bit-rotted file ``--resume=auto`` must survive."""
+        with self._lock:
+            self._saves += 1
+            saves = self._saves
+        for rule in self.rules:
+            if rule.kind == "ckpt-corrupt" and rule.save == saves:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(max(size // 3, 1))
+                log.warning("fault injection: corrupted checkpoint "
+                            "%s (save #%d)", path, saves)
+
+
+# -- arming -----------------------------------------------------------
+
+_UNSET = object()
+_active: FaultInjector | None | object = _UNSET
+_active_lock = threading.Lock()
+
+
+def install(spec: str | None) -> FaultInjector | None:
+    """Arm the injector from a spec string (None/empty disarms)."""
+    global _active
+    with _active_lock:
+        _active = FaultInjector(spec) if spec else None
+        return _active  # type: ignore[return-value]
+
+
+def active() -> FaultInjector | None:
+    """The armed injector, or None.  First call parses ``MRI_FAULTS``
+    if :func:`install` was never called (subprocess arming)."""
+    global _active
+    if _active is _UNSET:
+        with _active_lock:
+            if _active is _UNSET:
+                _active = (FaultInjector(os.environ[ENV_VAR])
+                           if os.environ.get(ENV_VAR) else None)
+    return _active  # type: ignore[return-value]
+
+
+# -- retry policy -----------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retries with exponential backoff and a per-document
+    deadline.  ``max_attempts`` counts the first try: 3 attempts = up
+    to 2 retries.  The deadline bounds the *total* time (including the
+    upcoming sleep) one document may consume before its error is
+    final — a pathological device can't stall the whole window."""
+
+    max_attempts: int = 3
+    backoff_s: float = 0.005
+    backoff_mult: float = 2.0
+    deadline_s: float = 1.0
+    sleep: object = time.sleep  # injectable for tests
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        """Knobs: MRI_READ_RETRIES (attempts), MRI_READ_BACKOFF_MS,
+        MRI_READ_DEADLINE_S."""
+        return cls(
+            max_attempts=int(os.environ.get("MRI_READ_RETRIES", 3)),
+            backoff_s=float(os.environ.get("MRI_READ_BACKOFF_MS", 5)) / 1e3,
+            deadline_s=float(os.environ.get("MRI_READ_DEADLINE_S", 1.0)),
+        )
+
+    def run(self, fn, *, doc_id: int | None = None, path: str = "",
+            report: "DegradationReport | None" = None):
+        """Call ``fn`` retrying OSError; the final error re-raises."""
+        delay = self.backoff_s
+        deadline = time.monotonic() + self.deadline_s
+        attempt = 1
+        while True:
+            try:
+                return fn()
+            except OSError:
+                if (attempt >= self.max_attempts
+                        or time.monotonic() + delay > deadline):
+                    raise
+                if report is not None:
+                    report.record_retry(doc_id=doc_id, path=path)
+                self.sleep(delay)
+                delay *= self.backoff_mult
+                attempt += 1
+
+
+def default_policy() -> RetryPolicy:
+    """The pipeline-wide read policy (env-tunable, see
+    :meth:`RetryPolicy.from_env`)."""
+    return RetryPolicy.from_env()
+
+
+# -- degradation report -----------------------------------------------
+
+class DegradationReport:
+    """Thread-safe tally of what failure handling did in one run."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.read_retries = 0
+        self.skips: list[dict] = []  # {"doc_id", "path", "reason"}
+
+    def record_retry(self, *, doc_id: int | None = None,
+                     path: str = "") -> None:
+        with self._lock:
+            self.read_retries += 1
+
+    def record_skip(self, *, doc_id: int, path: str,
+                    reason: str) -> None:
+        log.debug("skipping unreadable document %s (doc id %d): %s",
+                  path, doc_id, reason)
+        with self._lock:
+            self.skips.append(
+                {"doc_id": doc_id, "path": path, "reason": reason})
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.skips)
+
+    def skipped_doc_ids(self) -> list[int]:
+        with self._lock:
+            return [s["doc_id"] for s in self.skips]
+
+    def summary(self) -> dict:
+        """The stats-dict form (bench JSON / ``--stats`` fields)."""
+        with self._lock:
+            return {
+                "read_retries": self.read_retries,
+                "skipped_docs": [s["doc_id"] for s in self.skips],
+                "skip_reasons": {
+                    str(s["doc_id"]): s["reason"] for s in self.skips},
+            }
+
+    def log_summary(self, logger: logging.Logger = log) -> None:
+        """ONE counted line for the whole run — per-document warnings
+        are deduplicated here (each skip is DEBUG-logged at the site)."""
+        if not self.degraded:
+            return
+        with self._lock:
+            ids = [s["doc_id"] for s in self.skips]
+            first = self.skips[0]
+        logger.warning(
+            "degraded run: skipped %d unreadable document(s) "
+            "(doc ids %s) after %d retr%s; first reason: %s",
+            len(ids), ids, self.read_retries,
+            "y" if self.read_retries == 1 else "ies", first["reason"])
+
+
+_report_lock = threading.Lock()
+_current_report = DegradationReport()
+
+
+def current_report() -> DegradationReport:
+    """The run-scoped report the read paths record into by default."""
+    with _report_lock:
+        return _current_report
+
+
+def begin_run() -> DegradationReport:
+    """Start a fresh report (the model calls this at run() entry)."""
+    global _current_report
+    with _report_lock:
+        _current_report = DegradationReport()
+        return _current_report

@@ -11,12 +11,14 @@ the GIL-releasing native scan.
 """
 
 from .arena import WindowArena
-from .executor import PipelinedWindowReader
+from .executor import PipelinedWindowReader, ReaderDied, ReaderHang
 from .reader import plan_byte_windows, read_doc_into, read_window_into
 
 __all__ = [
     "WindowArena",
     "PipelinedWindowReader",
+    "ReaderDied",
+    "ReaderHang",
     "plan_byte_windows",
     "read_doc_into",
     "read_window_into",

@@ -7,6 +7,15 @@ scan on the previous window, and the final emit happens once at the
 end — a read → tokenize → emit pipeline across windows instead of
 serial whole-corpus phases.  On a single core the win is the removed
 copies; with spare cores the read genuinely hides behind the scan.
+
+Failure semantics (faults.py): the reader thread has an explicit
+lifecycle — :meth:`PipelinedWindowReader.close` joins it with a
+timeout (context-manager exit does the same), and the consumer side
+runs a watchdog so a reader that dies silently raises
+:class:`ReaderDied` and one that hangs raises :class:`ReaderHang`
+instead of deadlocking the scan forever.  Documents the reader skips
+after exhausting their retry budget land in the reader's
+:attr:`~PipelinedWindowReader.report`.
 """
 
 from __future__ import annotations
@@ -15,8 +24,19 @@ import queue
 import threading
 import time
 
+from .. import faults
 from .arena import WindowArena
 from .reader import read_window_into
+
+
+class ReaderDied(RuntimeError):
+    """The reader thread exited without delivering a result or an
+    exception — the fire-and-forget daemon failure mode."""
+
+
+class ReaderHang(RuntimeError):
+    """The reader thread is alive but made no progress within the
+    watchdog window (hung filesystem / device)."""
 
 
 class PipelinedWindowReader:
@@ -28,7 +48,14 @@ class PipelinedWindowReader:
     scan is done with its views — that is what bounds memory and what
     the reader blocks on.  Reader exceptions re-raise in the consumer;
     abandoning the iterator mid-loop unblocks and stops the reader
-    (same stop-event contract as corpus.manifest.prefetch_document_ranges).
+    (same stop-event contract as corpus.manifest.prefetch_document_ranges),
+    and :meth:`close` — also the context-manager exit — joins the
+    thread so no daemon leaks past the loop's lifetime.
+
+    ``watchdog_s`` bounds how long the consumer waits for the next
+    window with the reader thread still alive before raising
+    :class:`ReaderHang` (None disables); a reader thread that died
+    without posting anything raises :class:`ReaderDied` immediately.
 
     ``read_wait_s`` / ``consume_wait_s`` accumulate the time the reader
     sat blocked on a free arena and the consumer sat blocked on a filled
@@ -37,10 +64,16 @@ class PipelinedWindowReader:
 
     def __init__(self, manifest, windows, depth: int = 2,
                  byte_capacity: int = 1 << 21, doc_capacity: int = 256,
-                 arenas: list[WindowArena] | None = None):
+                 arenas: list[WindowArena] | None = None,
+                 watchdog_s: float | None = 30.0,
+                 policy: "faults.RetryPolicy | None" = None,
+                 report: "faults.DegradationReport | None" = None):
         self._manifest = manifest
         self._windows = list(windows)
         self._depth = max(int(depth), 1)
+        self._watchdog_s = watchdog_s
+        self.policy = policy if policy is not None else faults.default_policy()
+        self.report = report if report is not None else faults.current_report()
         self._ready: queue.Queue = queue.Queue()
         self._free: queue.Queue = queue.Queue()
         if arenas is None:
@@ -73,17 +106,25 @@ class PipelinedWindowReader:
 
     def _reader(self) -> None:
         try:
-            for lo, hi in self._windows:
+            for wi, (lo, hi) in enumerate(self._windows, start=1):
+                inj = faults.active()
+                if inj is not None:
+                    inj.on_reader_window(wi)
                 t0 = time.perf_counter()
                 arena = self._get(self._free)
                 self.read_wait_s += time.perf_counter() - t0
                 if arena is None:
                     return
                 t0 = time.perf_counter()
-                read_window_into(self._manifest, lo, hi, arena)
+                read_window_into(self._manifest, lo, hi, arena,
+                                 policy=self.policy, report=self.report)
                 self.read_busy_s += time.perf_counter() - t0
                 self._ready.put(arena)
             self._ready.put(self._done)
+        except faults.ReaderThreadDeath:
+            # injected silent death: exit WITHOUT posting, so the
+            # consumer watchdog — not this handler — must catch it
+            return
         except BaseException as e:  # surfaced on the consumer side
             self._ready.put(e)
 
@@ -92,12 +133,53 @@ class PipelinedWindowReader:
         yielded arena, after the native scan no longer reads its views)."""
         self._free.put(arena)
 
+    def close(self, timeout: float = 5.0) -> bool:
+        """Stop the reader and join its thread (idempotent).
+
+        Returns True when the thread exited within ``timeout``.  The
+        stop event unblocks a reader waiting on a free arena; a reader
+        stuck inside a hung read() can outlive the join — the False
+        return (plus the daemon flag) means it can never block process
+        exit, only linger.
+        """
+        self._stop.set()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def __enter__(self) -> "PipelinedWindowReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _next_item(self):
+        """Watchdog get: poll the ready queue, noticing a dead or hung
+        reader instead of blocking forever."""
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._ready.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise ReaderDied(
+                        "reader thread exited without delivering a "
+                        "window or an error (see faults.py "
+                        "reader-death)") from None
+                waited = time.perf_counter() - t0
+                if (self._watchdog_s is not None
+                        and waited > self._watchdog_s):
+                    raise ReaderHang(
+                        f"reader made no progress in {waited:.1f}s "
+                        "(watchdog_s exceeded); a hung filesystem "
+                        "would otherwise deadlock the scan") from None
+        self.consume_wait_s += time.perf_counter() - t0
+        return item
+
     def __iter__(self):
         try:
             while True:
-                t0 = time.perf_counter()
-                item = self._ready.get()
-                self.consume_wait_s += time.perf_counter() - t0
+                item = self._next_item()
                 if item is self._done:
                     return
                 if isinstance(item, BaseException):

@@ -6,13 +6,24 @@ arena) and the virtual corpus manifests (`corpus.synthetic`,
 `corpus.realtext`), which are duck types whose ``read_doc`` generates
 bytes — those fall back to one copy into the arena, still skipping the
 join/marshal copies downstream.
+
+Failure semantics (faults.py): every document read runs under the
+pipeline :class:`~..faults.RetryPolicy` — transient OSErrors are
+retried with backoff inside a per-document deadline, and only a
+*persistent* failure degrades the run by skipping the document, which
+is recorded (doc id, path, reason) in the active
+:class:`~..faults.DegradationReport` instead of being a lone stderr
+line the caller can't act on.
 """
 
 from __future__ import annotations
 
-import sys
+import logging
 
+from .. import faults
 from .arena import WindowArena
+
+log = logging.getLogger("mri_tpu.io")
 
 
 def read_doc_into(manifest, index: int, dest: memoryview) -> int:
@@ -25,12 +36,19 @@ def read_doc_into(manifest, index: int, dest: memoryview) -> int:
     count, one that grew is truncated to the recorded size (manifest
     sizes are authoritative for window planning).
     """
+    inj = faults.active()
+    cap = None
+    if inj is not None:
+        cap = inj.on_read(index, manifest.paths[index])
     fast = getattr(manifest, "read_doc_into", None)
     if fast is not None:
-        return fast(index, dest)
-    data = manifest.read_doc(index)
-    n = min(len(data), len(dest))
-    dest[:n] = data[:n]
+        n = fast(index, dest)
+    else:
+        data = manifest.read_doc(index)
+        n = min(len(data), len(dest))
+        dest[:n] = data[:n]
+    if cap is not None:
+        n = min(n, cap)
     return n
 
 
@@ -56,23 +74,41 @@ def plan_byte_windows(manifest, target_bytes: int) -> list[tuple[int, int]]:
     return windows
 
 
-def read_window_into(manifest, lo: int, hi: int,
-                     arena: WindowArena) -> WindowArena:
+def read_window_into(manifest, lo: int, hi: int, arena: WindowArena,
+                     policy: "faults.RetryPolicy | None" = None,
+                     report: "faults.DegradationReport | None" = None,
+                     ) -> WindowArena:
     """Fill ``arena`` with documents ``[lo, hi)`` (arena is reset first).
 
-    Unreadable documents are skipped with a warning — the same contract
-    as corpus.manifest.iter_document_ranges, so a vanished file degrades
-    the index instead of killing the run.
+    Each document read is retried per ``policy`` (default: the
+    env-tuned pipeline policy); a document that stays unreadable is
+    skipped and recorded in ``report`` (default: the run's active
+    report) — the same degrade-don't-die contract as
+    corpus.manifest.iter_document_ranges, now with the outcome
+    *reported* instead of merely printed.  One counted warning line per
+    window covers every skip in it.
     """
+    if policy is None:
+        policy = faults.default_policy()
+    if report is None:
+        report = faults.current_report()
     arena.reset()
+    window_skips = 0
     for i in range(lo, hi):
         size = int(manifest.sizes[i])
+        dest = arena.view(size)
         try:
-            dest = arena.view(size)
-            n = read_doc_into(manifest, i, dest)
+            n = policy.run(
+                lambda: read_doc_into(manifest, i, dest),
+                doc_id=manifest.doc_id(i), path=manifest.paths[i],
+                report=report)
         except OSError as e:
-            print(f"warning: skipping unreadable document "
-                  f"{manifest.paths[i]}: {e}", file=sys.stderr)
+            report.record_skip(doc_id=manifest.doc_id(i),
+                               path=manifest.paths[i], reason=str(e))
+            window_skips += 1
             continue
         arena.commit(manifest.doc_id(i), n)
+    if window_skips:
+        log.warning("skipped %d unreadable document(s) in window "
+                    "[%d, %d) after retries", window_skips, lo, hi)
     return arena

@@ -24,6 +24,7 @@ import numpy as np
 
 import jax
 
+from .. import faults
 from ..config import IndexConfig
 from ..parallel import dist_engine
 from ..parallel.mesh import make_mesh, replicated_spec, shard_spec, sharding
@@ -89,6 +90,19 @@ class InvertedIndexModel:
         self.timer = PhaseTimer()
 
     def run(self, manifest: Manifest, output_dir: str | None = None) -> dict:
+        # One degradation report per run: every read path below records
+        # retries and skipped documents into it, and the summary rides
+        # the stats dict into the CLI (exit faults.EXIT_DEGRADED when
+        # documents were skipped) and the bench JSON.
+        report = faults.begin_run()
+        stats = self._run_dispatch(manifest, output_dir)
+        stats["degradation"] = report.summary()
+        if report.degraded:
+            report.log_summary()
+        return stats
+
+    def _run_dispatch(self, manifest: Manifest,
+                      output_dir: str | None = None) -> dict:
         cfg = self.config
         self.timer = timer = PhaseTimer()
         # Reference-CLI knobs, recorded as config.py promises (the
@@ -176,7 +190,7 @@ class InvertedIndexModel:
         self._cpu_arenas = reader.arenas
         stream = native.HostIndexStream()
         try:
-            with timer.phase("ingest_scan"):
+            with reader, timer.phase("ingest_scan"):
                 for arena in reader:
                     buf, ends, ids = arena.feed_views()
                     stream.feed_arrays(buf, ends, ids)
@@ -185,6 +199,7 @@ class InvertedIndexModel:
                 stats = stream.finalize_emit(out_dir)
         finally:
             stream.close()
+            reader.close()
         for key, value in stats.items():
             timer.count(key, value)
         timer.count("io_windows", len(windows))
@@ -203,10 +218,20 @@ class InvertedIndexModel:
         ckpt = self.config.checkpoint_path
         fp = checkpoint.manifest_fingerprint(manifest) if ckpt is not None else ""
         if ckpt is not None and os.path.exists(ckpt):
-            with timer.phase("resume"):
-                corpus = checkpoint.load_pairs(ckpt, expect_fingerprint=fp)
-            timer.count("resumed_from", ckpt)
-            return corpus, 0
+            try:
+                with timer.phase("resume"):
+                    corpus = checkpoint.load_pairs(ckpt, expect_fingerprint=fp)
+                timer.count("resumed_from", ckpt)
+                return corpus, 0
+            except checkpoint.CheckpointCorrupt:
+                # resume='auto': a torn/garbage checkpoint must not wedge
+                # the rerun — quarantine it and tokenize fresh.  Version
+                # and fingerprint mismatches stay hard ValueErrors in
+                # both modes (a WRONG checkpoint is not a damaged one).
+                if self.config.resume != "auto":
+                    raise
+                timer.count("quarantined_checkpoint",
+                            checkpoint.quarantine(ckpt))
         threads = self.config.resolved_host_threads()
         timer.count("host_threads", threads)
         with timer.phase("load"):
@@ -968,14 +993,26 @@ class InvertedIndexModel:
                 manifest, width=width, chunk_docs=cfg.stream_chunk_docs,
                 pad_multiple=cfg.pad_multiple)
             if os.path.exists(ckpt_path):
-                state = checkpoint.load_stream_state(ckpt_path, stream_fp)
-                engine_s.restore(state)
-                fed_tokens = state["fed_tokens"]
-                # loop position, NOT engine windows_fed: the engine
-                # skips empty (tok_count == 0) windows, so its count
-                # can run behind the iteration index
-                resume_from = state["window_pos"]
-                timer.count("resumed_from_window", resume_from)
+                try:
+                    state = checkpoint.load_stream_state(ckpt_path,
+                                                         stream_fp)
+                except checkpoint.CheckpointCorrupt:
+                    # resume='auto': a SIGKILL can land mid-save; the
+                    # write is atomic (tmp + rename) so this normally
+                    # never fires, but disk corruption or a foreign
+                    # file at the path must not wedge the rerun
+                    if cfg.resume != "auto":
+                        raise
+                    timer.count("quarantined_checkpoint",
+                                checkpoint.quarantine(ckpt_path))
+                else:
+                    engine_s.restore(state)
+                    fed_tokens = state["fed_tokens"]
+                    # loop position, NOT engine windows_fed: the engine
+                    # skips empty (tok_count == 0) windows, so its count
+                    # can run behind the iteration index
+                    resume_from = state["window_pos"]
+                    timer.count("resumed_from_window", resume_from)
         # test hook: simulate the round-3 on-chip TPU worker crash
         # (SCALE_r03.json) at a deterministic stream position
         crash_after = int(os.environ.get(
@@ -1067,6 +1104,13 @@ class InvertedIndexModel:
                         "injected stream crash after window "
                         f"{win_i} "
                         "(MRI_TPU_STREAM_CRASH_AFTER_WINDOWS)")
+                # fault hook (faults.py sigkill:window=K): hard-kill
+                # THIS process at the window boundary, after any
+                # checkpoint save above — the crash-safety e2e proves
+                # a rerun with resume='auto' is byte-identical
+                inj = faults.active()
+                if inj is not None:
+                    inj.on_window_boundary(win_i)
         if ckpt_saves:
             # inside stream_feed's wall time — recorded separately so
             # checkpointed docs/s is comparable to uncheckpointed runs

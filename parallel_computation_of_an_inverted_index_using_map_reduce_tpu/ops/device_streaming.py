@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import faults
 from ..utils.rounding import round_up
 from .device_tokenizer import (
     INT32_MAX,
@@ -339,6 +340,12 @@ class DeviceStreamEngine:
         pending_count.copy_to_host_async()
         self._pending.append((pending_count, tok_count))
         self.windows_fed += 1
+        # fault hook (faults.py stream-crash:window=K): raise AFTER this
+        # window's merge is dispatched but before any later checkpoint —
+        # the worst-case crash position for the resume contract
+        inj = faults.active()
+        if inj is not None:
+            inj.on_stream_window(self.windows_fed)
         if stage_hook is not None:
             stage_hook("merge", pending_count)
             while self._pending:

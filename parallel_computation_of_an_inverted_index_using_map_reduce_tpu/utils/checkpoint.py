@@ -11,12 +11,51 @@ re-run without touching the corpus.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
+import struct
+import zipfile
 from pathlib import Path
 
 import numpy as np
 
+from .. import faults
+
+log = logging.getLogger("mri_tpu.checkpoint")
+
 _FORMAT_VERSION = 2
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file exists but cannot be read back (truncated
+    write, disk corruption, or a non-checkpoint file at the path).
+
+    Wraps the opaque ``zipfile.BadZipFile``/EOF errors a damaged npz
+    raises, naming the path and the remediation.
+    """
+
+    def __init__(self, path, cause):
+        self.path = str(path)
+        super().__init__(
+            f"checkpoint {self.path!r} is corrupt or truncated "
+            f"({cause.__class__.__name__}: {cause}); delete it, or move "
+            f"it aside and rerun — --resume=auto quarantines it to "
+            f"{self.path!r}.corrupt and restarts automatically")
+
+
+# error classes a torn/garbage npz surfaces from np.load + member reads
+_CORRUPT_ERRORS = (zipfile.BadZipFile, zipfile.LargeZipFile, EOFError,
+                   KeyError, struct.error, OSError)
+
+
+def quarantine(path: str | Path) -> str:
+    """Move a corrupt checkpoint aside to ``<path>.corrupt`` (atomic
+    rename; any previous quarantine at that name is replaced) so the
+    run can start fresh without destroying the forensic evidence."""
+    dest = str(path) + ".corrupt"
+    os.replace(path, dest)
+    log.warning("quarantined corrupt checkpoint to %s", dest)
+    return dest
 
 
 def manifest_fingerprint(manifest) -> str:
@@ -61,6 +100,9 @@ def save_pairs(path: str | Path, corpus, fingerprint: str = "") -> None:
             raw_tokens=np.int64(corpus.raw_tokens if corpus.raw_tokens is not None else -1),
         )
     os.replace(tmp, path)
+    inj = faults.active()
+    if inj is not None:
+        inj.on_checkpoint_saved(str(path))
 
 
 # v2: virtual-manifest fingerprints hash fingerprint_extra INSTEAD of
@@ -115,61 +157,79 @@ def save_stream_state(path: str | Path, state: dict, fed_tokens: int,
             **cols,
         )
     os.replace(tmp, path)
+    inj = faults.active()
+    if inj is not None:
+        inj.on_checkpoint_saved(str(path))
 
 
 def load_stream_state(path: str | Path,
                       expect_fingerprint: str) -> dict:
-    """Restore a stream snapshot; reject version/fingerprint mismatch."""
-    with np.load(path) as z:
-        version = int(z["version"])
-        if version != _STREAM_FORMAT_VERSION:
-            raise ValueError(
-                f"stream checkpoint {path!r} has version {version}, "
-                f"expected {_STREAM_FORMAT_VERSION}")
-        saved_fp = bytes(z["fingerprint"]).decode()
-        if saved_fp != expect_fingerprint:
-            raise ValueError(
-                f"stream checkpoint {path!r} was written for a different "
-                f"manifest or stream config (saved {saved_fp[:20]}…, "
-                f"current {expect_fingerprint[:20]}…); delete it or "
-                "restore the original run configuration")
-        return {
-            "width": int(z["width"]),
-            "count": int(z["count"]),
-            "cap": int(z["cap"]),
-            "live_groups": int(z["live_groups"]),
-            "max_word_len": int(z["max_word_len"]),
-            "windows_fed": int(z["windows_fed"]),
-            "window_pos": int(z["window_pos"]),
-            "fed_tokens": int(z["fed_tokens"]),
-            "rows_curve": (z["rows_curve"].tolist()
-                           if "rows_curve" in z.files else []),
-            "columns": [z[f"col_{i}"]
-                        for i in range(int(z["num_columns"]))],
-        }
+    """Restore a stream snapshot; reject version/fingerprint mismatch
+    (ValueError) and raise :class:`CheckpointCorrupt` — never a raw
+    zipfile error — for a damaged/truncated file."""
+    try:
+        with np.load(path) as z:
+            version = int(z["version"])
+            if version != _STREAM_FORMAT_VERSION:
+                raise ValueError(
+                    f"stream checkpoint {path!r} has version {version}, "
+                    f"expected {_STREAM_FORMAT_VERSION}")
+            saved_fp = bytes(z["fingerprint"]).decode()
+            if saved_fp != expect_fingerprint:
+                raise ValueError(
+                    f"stream checkpoint {path!r} was written for a different "
+                    f"manifest or stream config (saved {saved_fp[:20]}…, "
+                    f"current {expect_fingerprint[:20]}…); delete it or "
+                    "restore the original run configuration")
+            return {
+                "width": int(z["width"]),
+                "count": int(z["count"]),
+                "cap": int(z["cap"]),
+                "live_groups": int(z["live_groups"]),
+                "max_word_len": int(z["max_word_len"]),
+                "windows_fed": int(z["windows_fed"]),
+                "window_pos": int(z["window_pos"]),
+                "fed_tokens": int(z["fed_tokens"]),
+                "rows_curve": (z["rows_curve"].tolist()
+                               if "rows_curve" in z.files else []),
+                "columns": [z[f"col_{i}"]
+                            for i in range(int(z["num_columns"]))],
+            }
+    except FileNotFoundError:
+        raise
+    except _CORRUPT_ERRORS as e:
+        raise CheckpointCorrupt(path, e) from e
 
 
 def load_pairs(path: str | Path, expect_fingerprint: str | None = None):
-    """Restore a TokenizedCorpus; reject version or manifest mismatch."""
+    """Restore a TokenizedCorpus; reject version or manifest mismatch
+    (ValueError) and raise :class:`CheckpointCorrupt` for a damaged or
+    truncated file (satellite: a half-written npz used to surface as a
+    bare ``zipfile.BadZipFile`` with no path or remediation)."""
     from ..text.tokenizer import TokenizedCorpus
 
-    with np.load(path) as z:
-        version = int(z["version"])
-        if version != _FORMAT_VERSION:
-            raise ValueError(f"checkpoint {path!r} has version {version}, expected {_FORMAT_VERSION}")
-        saved_fp = bytes(z["fingerprint"]).decode()
-        if expect_fingerprint is not None and saved_fp != expect_fingerprint:
-            raise ValueError(
-                f"checkpoint {path!r} was written for a different manifest "
-                f"(saved {saved_fp[:12]}…, current {expect_fingerprint[:12]}…); "
-                "delete the checkpoint or restore the original file list"
+    try:
+        with np.load(path) as z:
+            version = int(z["version"])
+            if version != _FORMAT_VERSION:
+                raise ValueError(f"checkpoint {path!r} has version {version}, expected {_FORMAT_VERSION}")
+            saved_fp = bytes(z["fingerprint"]).decode()
+            if expect_fingerprint is not None and saved_fp != expect_fingerprint:
+                raise ValueError(
+                    f"checkpoint {path!r} was written for a different manifest "
+                    f"(saved {saved_fp[:12]}…, current {expect_fingerprint[:12]}…); "
+                    "delete the checkpoint or restore the original file list"
+                )
+            raw = int(z["raw_tokens"]) if "raw_tokens" in z.files else -1
+            return TokenizedCorpus(
+                term_ids=z["term_ids"],
+                doc_ids=z["doc_ids"],
+                vocab=z["vocab"],
+                letter_of_term=z["letter_of_term"],
+                pairs_deduped=bool(int(z["pairs_deduped"])) if "pairs_deduped" in z.files else False,
+                raw_tokens=raw if raw >= 0 else None,
             )
-        raw = int(z["raw_tokens"]) if "raw_tokens" in z.files else -1
-        return TokenizedCorpus(
-            term_ids=z["term_ids"],
-            doc_ids=z["doc_ids"],
-            vocab=z["vocab"],
-            letter_of_term=z["letter_of_term"],
-            pairs_deduped=bool(int(z["pairs_deduped"])) if "pairs_deduped" in z.files else False,
-            raw_tokens=raw if raw >= 0 else None,
-        )
+    except FileNotFoundError:
+        raise
+    except _CORRUPT_ERRORS as e:
+        raise CheckpointCorrupt(path, e) from e

@@ -47,7 +47,26 @@ def test_cli_invalid_mapper_count(tmp_path, capsys):
     listfile = _mk_corpus(tmp_path)
     rc = main(["0", "1", str(listfile)])
     assert rc == 2
-    assert "num_mappers" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "num_mappers" in err
+    assert err.count("\n") == 1  # ONE line, not a traceback
+
+
+def test_cli_invalid_reducer_count(tmp_path, capsys):
+    listfile = _mk_corpus(tmp_path)
+    rc = main(["1", "-3", str(listfile)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "num_reducers" in err
+    assert err.count("\n") == 1
+
+
+def test_cli_missing_list_is_one_line(tmp_path, capsys):
+    rc = main(["1", "1", str(tmp_path / "absent.txt")])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "does not exist" in err and "absent.txt" in err
+    assert err.count("\n") == 1
 
 
 def test_cli_checkpoint_resume(tmp_path):

@@ -1,0 +1,482 @@
+"""Failure semantics, proven by deterministic fault injection.
+
+Every failure mode the pipeline claims to survive (README "Failure
+semantics") is armed here via faults.py and asserted end to end:
+
+- transient read errors retry to success — zero skips, output
+  byte-identical to the oracle, with the retries *reported*
+- permanent read errors degrade, not die — the run completes, the
+  exact skipped doc ids ride the stats into CLI exit 3
+- a silently dying reader thread raises ReaderDied, a hung one
+  ReaderHang — never a deadlocked scan
+- a corrupt/truncated checkpoint is a named CheckpointCorrupt;
+  --resume=auto quarantines it and restarts fresh
+- SIGKILL at an arbitrary stream-window boundary (a REAL kill, child
+  process) plus a rerun with --resume=auto yields byte-identical
+  a.txt..z.txt
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from conftest import REPO_ROOT, read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    faults,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import main
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    iter_document_ranges,
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.io import (
+    PipelinedWindowReader,
+    ReaderDied,
+    ReaderHang,
+    WindowArena,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.io.reader import (
+    read_window_into,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.utils import (
+    checkpoint,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with no injector armed and a fresh
+    degradation report (both are process-global by design)."""
+    faults.install(None)
+    faults.begin_run()
+    yield
+    faults.install(None)
+    faults.begin_run()
+
+
+def _corpus(tmp_path, texts=("alpha beta", "beta gamma", "delta alpha")):
+    paths = []
+    for i, text in enumerate(texts):
+        p = tmp_path / f"doc{i}.txt"
+        p.write_text(text)
+        paths.append(str(p))
+    write_manifest(tmp_path / "list.txt", paths)
+    return read_manifest(tmp_path / "list.txt")
+
+
+# -- spec parsing -----------------------------------------------------
+
+
+def test_spec_parses_every_kind():
+    inj = faults.FaultInjector(
+        "read-error:doc=2:times=2; slow-read:all:ms=1; "
+        "truncate:doc=0:bytes=4; reader-death:window=1; "
+        "sigkill:window=2; stream-crash:window=3; "
+        "ckpt-corrupt:save=1; seed=7")
+    kinds = [r.kind for r in inj.rules]
+    assert kinds == ["read-error", "slow-read", "truncate",
+                     "reader-death", "sigkill", "stream-crash",
+                     "ckpt-corrupt"]
+
+
+@pytest.mark.parametrize("bad", [
+    "", "bogus:doc=1", "read-error:doc=x", "read-error:nope=1",
+    "reader-death", "sigkill:window=0", "ckpt-corrupt",
+    "seed=7:doc=1", "speed=9",
+])
+def test_spec_rejects_malformed(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultInjector(bad)
+
+
+def test_install_and_env_arming(monkeypatch):
+    assert faults.install("read-error:doc=0").spec == "read-error:doc=0"
+    assert faults.install(None) is None
+    # env arming happens on the first active() after an unset state
+    monkeypatch.setenv(faults.ENV_VAR, "slow-read:all:ms=1")
+    monkeypatch.setattr(faults, "_active", faults._UNSET)
+    inj = faults.active()
+    assert inj is not None and inj.rules[0].kind == "slow-read"
+
+
+# -- RetryPolicy ------------------------------------------------------
+
+
+def test_retry_policy_transient_then_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    report = faults.DegradationReport()
+    policy = faults.RetryPolicy(max_attempts=3, backoff_s=0.0,
+                                sleep=lambda s: None)
+    assert policy.run(flaky, doc_id=1, report=report) == "ok"
+    assert calls["n"] == 3 and report.read_retries == 2
+
+
+def test_retry_policy_exhausts_attempts():
+    policy = faults.RetryPolicy(max_attempts=2, backoff_s=0.0,
+                                sleep=lambda s: None)
+    with pytest.raises(OSError):
+        policy.run(lambda: (_ for _ in ()).throw(OSError("always")))
+
+
+def test_retry_policy_deadline_cuts_retries():
+    # backoff so large the FIRST retry would already blow the deadline
+    policy = faults.RetryPolicy(max_attempts=10, backoff_s=99.0,
+                                deadline_s=0.01, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.run(always)
+    assert calls["n"] == 1
+
+
+# -- read paths: retry, skip, truncate --------------------------------
+
+
+def test_read_window_transient_faults_no_skips(tmp_path):
+    m = _corpus(tmp_path)
+    faults.install("read-error:all:times=2")
+    report = faults.DegradationReport()
+    policy = faults.RetryPolicy(backoff_s=0.0, sleep=lambda s: None)
+    arena = read_window_into(m, 0, len(m), WindowArena(),
+                             policy=policy, report=report)
+    assert arena.contents() == [open(p, "rb").read() for p in m.paths]
+    assert report.read_retries == 2 * len(m)
+    assert not report.degraded
+
+
+def test_read_window_permanent_fault_records_exact_skip(tmp_path):
+    m = _corpus(tmp_path)
+    faults.install("read-error:doc=1:times=-1")
+    report = faults.DegradationReport()
+    policy = faults.RetryPolicy(backoff_s=0.0, sleep=lambda s: None)
+    arena = read_window_into(m, 0, len(m), WindowArena(),
+                             policy=policy, report=report)
+    _, _, ids = arena.feed_views()
+    assert ids.tolist() == [1, 3]  # doc id 2 (index 1) skipped
+    assert report.skipped_doc_ids() == [2]
+    assert "injected read failure" in report.summary()["skip_reasons"]["2"]
+
+
+def test_iter_document_ranges_resilience(tmp_path):
+    m = _corpus(tmp_path)
+    faults.install("read-error:doc=0:times=1; read-error:doc=2:times=-1")
+    report = faults.DegradationReport()
+    policy = faults.RetryPolicy(backoff_s=0.0, sleep=lambda s: None)
+    out = list(iter_document_ranges(m, [(0, len(m))],
+                                    policy=policy, report=report))
+    (contents, doc_ids), = out
+    assert doc_ids == [1, 2]           # doc id 3 (index 2) gone
+    assert report.skipped_doc_ids() == [3]
+    # doc 0's single transient + the 2 retries doc 2 burned before its
+    # error became final (3 attempts = 2 recorded retries)
+    assert report.read_retries == 3
+
+
+def test_truncate_fault_shortens_document(tmp_path):
+    m = _corpus(tmp_path, texts=("alpha beta", "gamma"))
+    faults.install("truncate:doc=0:bytes=5")
+    arena = read_window_into(m, 0, len(m), WindowArena(),
+                             report=faults.DegradationReport())
+    assert arena.contents()[0] == b"alpha"
+    assert arena.contents()[1] == b"gamma"
+
+
+def test_slow_read_fault_still_succeeds(tmp_path):
+    m = _corpus(tmp_path, texts=("alpha",))
+    faults.install("slow-read:doc=0:ms=1")
+    arena = read_window_into(m, 0, 1, WindowArena(),
+                             report=faults.DegradationReport())
+    assert arena.contents() == [b"alpha"]
+
+
+# -- executor lifecycle: death, hang ----------------------------------
+
+
+def test_reader_death_raises_not_deadlocks(tmp_path):
+    m = _corpus(tmp_path)
+    faults.install("reader-death:window=1")
+    reader = PipelinedWindowReader(m, [(0, len(m))], depth=1)
+    with pytest.raises(ReaderDied):
+        for arena in reader:
+            reader.recycle(arena)
+    assert reader.close()
+
+
+def test_reader_hang_watchdog(tmp_path):
+    m = _corpus(tmp_path)
+    # the reader thread sleeps 2s inside the injected slow read; a
+    # 0.2s watchdog must raise instead of waiting it out (one-doc
+    # window so the abandoned thread lingers one sleep, not three)
+    faults.install("slow-read:all:ms=2000")
+    reader = PipelinedWindowReader(m, [(0, 1)], depth=1,
+                                   watchdog_s=0.2)
+    with pytest.raises(ReaderHang):
+        for arena in reader:
+            reader.recycle(arena)
+    reader.close(timeout=0.01)  # thread still sleeping: don't wait here
+
+
+# -- whole-pipeline degradation ---------------------------------------
+
+
+def test_oracle_backend_transient_faults_byte_identical(tmp_path):
+    m = _corpus(tmp_path)
+    oracle_index(m, tmp_path / "clean")
+    faults.install("read-error:all:times=2")
+    stats = build_index(m, IndexConfig(backend="oracle"),
+                        output_dir=tmp_path / "faulted")
+    assert read_letter_files(tmp_path / "faulted") == \
+        read_letter_files(tmp_path / "clean")
+    deg = stats["degradation"]
+    assert deg["read_retries"] > 0 and deg["skipped_docs"] == []
+
+
+def test_device_stream_engine_transient_faults_byte_identical(tmp_path):
+    m = _corpus(tmp_path)
+    oracle_index(m, tmp_path / "clean")
+    faults.install("read-error:all:times=1")
+    stats = build_index(
+        m, IndexConfig(device_tokenize=True, stream_chunk_docs=1,
+                       device_shards=1, pad_multiple=64),
+        output_dir=tmp_path / "faulted")
+    assert read_letter_files(tmp_path / "faulted") == \
+        read_letter_files(tmp_path / "clean")
+    assert stats["degradation"]["read_retries"] >= len(m)
+    assert stats["degradation"]["skipped_docs"] == []
+
+
+def test_cli_degraded_exit_with_exact_doc_ids(tmp_path, capsys):
+    _corpus(tmp_path)
+    out = tmp_path / "out"
+    rc = main(["1", "1", str(tmp_path / "list.txt"), "--backend",
+               "oracle", "--output-dir", str(out), "--stats",
+               "--fault-spec", "read-error:doc=1:times=-1"])
+    assert rc == faults.EXIT_DEGRADED == 3
+    captured = capsys.readouterr()
+    assert "DEGRADED" in captured.err and "[2]" in captured.err
+    stats = json.loads(captured.out.strip())
+    assert stats["degradation"]["skipped_docs"] == [2]
+    # the readable documents were still fully indexed
+    assert b"alpha:[1 3]\n" in read_letter_files(out)
+
+
+def test_bad_fault_spec_is_cli_usage_error(tmp_path, capsys):
+    _corpus(tmp_path)
+    rc = main(["1", "1", str(tmp_path / "list.txt"),
+               "--fault-spec", "warp-core-breach"])
+    assert rc == 2
+    assert "unknown fault kind" in capsys.readouterr().err
+
+
+# -- checkpoint corruption + quarantine -------------------------------
+
+
+def _truncate(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 3, 1))
+
+
+def test_load_pairs_corrupt_is_named_error(tmp_path):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+        tokenize,
+    )
+
+    corpus = tokenize([b"alpha beta"], [1], use_native=False,
+                      dedup_pairs=True)
+    p = tmp_path / "pairs.npz"
+    checkpoint.save_pairs(p, corpus, fingerprint="fp")
+    _truncate(p)
+    with pytest.raises(checkpoint.CheckpointCorrupt) as ei:
+        checkpoint.load_pairs(p, expect_fingerprint="fp")
+    assert str(p) in str(ei.value) and "--resume=auto" in str(ei.value)
+
+
+def test_load_stream_state_corrupt_is_named_error(tmp_path):
+    p = tmp_path / "stream.npz"
+    p.write_bytes(b"PK\x03\x04 not actually a zip")
+    with pytest.raises(checkpoint.CheckpointCorrupt):
+        checkpoint.load_stream_state(p, "fp")
+
+
+def test_quarantine_moves_file_aside(tmp_path):
+    p = tmp_path / "c.npz"
+    p.write_bytes(b"junk")
+    dest = checkpoint.quarantine(p)
+    assert not p.exists()
+    assert dest == str(p) + ".corrupt"
+    assert open(dest, "rb").read() == b"junk"
+
+
+def test_resume_auto_quarantines_pairs_checkpoint(tmp_path, capsys):
+    _corpus(tmp_path)
+    listfile = str(tmp_path / "list.txt")
+    ckpt = tmp_path / "pairs.npz"
+    base = ["1", "1", listfile, "--checkpoint", str(ckpt),
+            "--pad-multiple", "64", "--device-shards", "1",
+            "--pipeline-chunk-docs", "0"]
+    assert main(base + ["--output-dir", str(tmp_path / "o1")]) == 0
+    _truncate(ckpt)
+    # strict (default): hard error naming the file
+    rc = main(base + ["--output-dir", str(tmp_path / "o2")])
+    assert rc == 2
+    assert "corrupt" in capsys.readouterr().err
+    # auto: quarantine + fresh run, byte-identical output
+    assert main(base + ["--output-dir", str(tmp_path / "o3"),
+                        "--resume", "auto"]) == 0
+    assert (tmp_path / "pairs.npz.corrupt").exists()
+    assert read_letter_files(tmp_path / "o3") == \
+        read_letter_files(tmp_path / "o1")
+
+
+def test_resume_auto_survives_corrupted_stream_checkpoint(tmp_path):
+    """ckpt-corrupt + stream-crash armed together: the crash leaves a
+    TORN stream checkpoint behind; --resume=auto must quarantine it and
+    still produce byte-identical output from a fresh start."""
+    m = _corpus(tmp_path)
+    oracle_index(m, tmp_path / "clean")
+    ckpt = tmp_path / "run.ckpt.npz"
+    argv = ["1", "1", str(tmp_path / "list.txt"),
+            "--device-tokenize", "--stream-chunk-docs", "1",
+            "--device-shards", "1", "--pad-multiple", "64",
+            "--stream-checkpoint", str(ckpt),
+            "--stream-checkpoint-every", "1"]
+    faults.install("ckpt-corrupt:save=1; stream-crash:window=2")
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        main(argv + ["--output-dir", str(tmp_path / "out")])
+    assert ckpt.exists()
+    faults.install(None)
+    # strict rerun refuses the torn file
+    rc = main(argv + ["--output-dir", str(tmp_path / "out")])
+    assert rc == 2
+    # auto rerun quarantines and completes identically
+    assert main(argv + ["--output-dir", str(tmp_path / "out"),
+                        "--resume", "auto"]) == 0
+    assert (tmp_path / "run.ckpt.npz.corrupt").exists()
+    assert read_letter_files(tmp_path / "out") == \
+        read_letter_files(tmp_path / "clean")
+
+
+def test_stream_crash_resume_valid_checkpoint(tmp_path, capsys):
+    """stream-crash via the fault spec (first-class replacement for the
+    MRI_TPU_STREAM_CRASH_AFTER_WINDOWS env hook): the engine dies
+    folding window 2 — AFTER window 1's save, BEFORE window 2's — and
+    the rerun resumes at the window-1 checkpoint, not from scratch."""
+    m = _corpus(tmp_path)
+    oracle_index(m, tmp_path / "clean")
+    ckpt = tmp_path / "run.ckpt.npz"
+    argv = ["1", "1", str(tmp_path / "list.txt"),
+            "--output-dir", str(tmp_path / "out"),
+            "--device-tokenize", "--stream-chunk-docs", "1",
+            "--device-shards", "1", "--pad-multiple", "64",
+            "--stream-checkpoint", str(ckpt),
+            "--stream-checkpoint-every", "1", "--stats"]
+    faults.install("stream-crash:window=2")
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        main(argv)
+    assert ckpt.exists()
+    faults.install(None)
+    capsys.readouterr()
+    assert main(argv) == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["resumed_from_window"] == 1
+    assert not ckpt.exists()
+    assert read_letter_files(tmp_path / "out") == \
+        read_letter_files(tmp_path / "clean")
+
+
+# -- SIGKILL e2e: crash-safe auto-resume ------------------------------
+
+_KILL_TEXTS = ("alpha beta", "beta gamma", "delta alpha",
+               "epsilon beta", "zeta eta alpha")
+
+
+def _kill_argv(tmp_path):
+    return ["1", "1", str(tmp_path / "list.txt"),
+            "--output-dir", str(tmp_path / "out"),
+            "--device-tokenize", "--stream-chunk-docs", "1",
+            "--device-shards", "1", "--pad-multiple", "64",
+            "--stream-checkpoint", str(tmp_path / "run.ckpt.npz"),
+            "--stream-checkpoint-every", "1", "--resume", "auto"]
+
+
+def _run_killed_child(tmp_path, window):
+    """Run the CLI in a REAL child process armed to SIGKILL itself at
+    the given stream-window boundary; assert it died by SIGKILL."""
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
+        + _kill_argv(tmp_path),
+        cwd=str(REPO_ROOT),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             faults.ENV_VAR: f"sigkill:window={window}"},
+        timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+
+
+def _sigkill_resume_case(tmp_path, window):
+    m = _corpus(tmp_path, texts=_KILL_TEXTS)
+    oracle_index(m, tmp_path / "clean")
+    golden = read_letter_files(tmp_path / "clean")
+    _run_killed_child(tmp_path, window)
+    ckpt = tmp_path / "run.ckpt.npz"
+    assert ckpt.exists()  # the kill landed after a completed save
+    # rerun the SAME command in-process (jax already warm): must
+    # resume — or restart cleanly — and emit byte-identical letters
+    assert main(_kill_argv(tmp_path)) == 0
+    assert not ckpt.exists()
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+# Three distinct kill points across the 5-window stream: right after
+# the first save, mid-stream, and after the LAST possible save (the
+# final window's save is skipped by design, so window 4 is the latest
+# boundary with a checkpoint behind it).
+@pytest.mark.parametrize("window", [1, 2, 4])
+def test_sigkill_at_window_boundary_resume_byte_identical(
+        tmp_path, window):
+    _sigkill_resume_case(tmp_path, window)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("window", [3, 5])
+def test_sigkill_every_remaining_window(tmp_path, window):
+    """Exhaustive sweep tail (window 5 kills AFTER the stream finished
+    feeding — the checkpoint is already deleted by then only if
+    finalize ran; either way the rerun must converge)."""
+    m = _corpus(tmp_path, texts=_KILL_TEXTS)
+    oracle_index(m, tmp_path / "clean")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
+        + _kill_argv(tmp_path),
+        cwd=str(REPO_ROOT),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             faults.ENV_VAR: f"sigkill:window={window}"},
+        timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+    assert main(_kill_argv(tmp_path)) == 0
+    assert read_letter_files(tmp_path / "out") == \
+        read_letter_files(tmp_path / "clean")

@@ -108,6 +108,36 @@ def test_plan_byte_windows_single_window(tmp_path):
     assert plan_byte_windows(m, target_bytes=1 << 30) == [(0, len(m))]
 
 
+class _FakeManifest:
+    """Sizes-only duck manifest for planner edge cases."""
+
+    def __init__(self, sizes):
+        self.sizes = tuple(sizes)
+        self.paths = tuple(f"<doc{i}>" for i in range(len(sizes)))
+
+    def __len__(self):
+        return len(self.sizes)
+
+
+def test_plan_byte_windows_empty_manifest():
+    assert plan_byte_windows(_FakeManifest([]), target_bytes=1024) == []
+
+
+def test_plan_byte_windows_single_oversized_doc():
+    # one doc larger than the target: exactly one whole-doc window,
+    # never a split mid-document
+    assert plan_byte_windows(_FakeManifest([1 << 20]),
+                             target_bytes=4096) == [(0, 1)]
+
+
+def test_plan_byte_windows_all_zero_sizes():
+    # unstat-able files keep size 0 (manifest contract): the running
+    # total never reaches the target, so everything lands in one
+    # trailing window instead of producing per-doc degenerate windows
+    assert plan_byte_windows(_FakeManifest([0, 0, 0, 0]),
+                             target_bytes=1) == [(0, 4)]
+
+
 def test_read_window_into_matches_load_documents(tmp_path):
     m = _small_manifest(tmp_path)
     contents, doc_ids = load_documents(m)
@@ -181,6 +211,29 @@ def test_reader_propagates_source_exception():
     with pytest.raises(ValueError, match="corrupt source"):
         for arena in reader:
             reader.recycle(arena)
+
+
+def test_reader_close_joins_abandoned_thread(tmp_path):
+    """Regression: abandoning the iterator mid-loop used to leave the
+    daemon reader thread alive until process exit; close() must join
+    it (and stay idempotent)."""
+    m = _small_manifest(tmp_path)
+    windows = plan_byte_windows(m, target_bytes=256)
+    assert len(windows) > 2
+    reader = PipelinedWindowReader(m, windows, depth=1)
+    it = iter(reader)
+    reader.recycle(next(it))  # consume one window, then walk away
+    assert reader.close() is True
+    assert not reader._thread.is_alive()
+    assert reader.close() is True
+
+
+def test_reader_context_manager_joins(tmp_path):
+    m = _small_manifest(tmp_path)
+    windows = plan_byte_windows(m, target_bytes=256)
+    with PipelinedWindowReader(m, windows, depth=1) as reader:
+        next(iter(reader))  # not even recycled: close must still win
+    assert not reader._thread.is_alive()
 
 
 # -- zero-copy feed + whole-path equivalence --------------------------
