@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast test-faults bench bench-scale capture rehearse clean
+.PHONY: build test test-fast test-faults test-parallel bench bench-scale bench-sweep capture rehearse clean
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -15,7 +15,8 @@ build:
 test:
 	$(PY) -m pytest tests/ -q
 
-# Tier-1 selection (-m "not slow"), parallelized over workers when
+# Tier-1 selection (-m "not slow") — includes the fast `parallel_host`
+# multi-worker map/reduce tests — parallelized over workers when
 # pytest-xdist is installed (falls back to a serial run when not —
 # the verify pipeline's own serial invocation is untouched)
 test-fast:
@@ -27,6 +28,11 @@ test-fast:
 test-faults:
 	$(PY) -m pytest tests/ -q -m faults
 
+# multi-worker host map/reduce suite only (steal queue, (K, M)
+# byte-identity matrix, letter-partitioned reduce)
+test-parallel:
+	$(PY) -m pytest tests/ -q -m parallel_host
+
 bench:
 	$(PY) bench.py
 
@@ -34,6 +40,11 @@ bench:
 # MRI_TPU_SCALE_* knobs (REALTEXT=1 switches to the config-5 regime)
 bench-scale:
 	$(PY) bench.py --scale
+
+# host map-phase scaling curve: cpu e2e at 1/2/4 scan workers on the
+# same corpus, with the per-worker stage split (prints a JSON line)
+bench-sweep:
+	$(PY) bench.py --sweep
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

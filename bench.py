@@ -38,6 +38,10 @@ bytes.
 ``--scale`` runs the large-corpus streaming benchmark instead
 (BASELINE.json config 4 magnitude): Zipfian docs through the bounded
 streaming engine, reporting docs/s and the accumulator high-water mark.
+
+``--sweep`` runs only the host map-phase scaling curve (cpu e2e at
+1/2/4 scan workers with the per-worker stage split); the same block is
+embedded in the main line as ``host_threads_sweep``.
 """
 
 from __future__ import annotations
@@ -581,6 +585,61 @@ def _host_stage_split(report: dict) -> dict:
     return split
 
 
+SWEEP_WORKERS = tuple(
+    int(k) for k in os.environ.get("MRI_BENCH_SWEEP_WORKERS", "1,2,4").split(","))
+
+
+def _host_threads_sweep(rounds: int = 7) -> dict:
+    """cpu e2e at 1/2/4 scan workers on the same corpus: the host
+    map-phase scaling curve, tracked round over round.
+
+    Each worker count is its own plan (its own model + warmup) so the
+    steal-queue path and the single-worker pipelined path are measured
+    as the dispatcher actually routes them.  ``host_cores`` is recorded
+    because the curve is only meaningful relative to the physical
+    parallelism on offer — on a 1-core container the 4-worker point
+    measures coordination overhead, not speedup, and the number must
+    say so rather than look like a regression."""
+    sweep: dict = {"host_cores": os.cpu_count(), "rounds": rounds,
+                   "points": {}}
+    for k in SWEEP_WORKERS:
+        res = _measure("cpu", [{"host_threads": k}], rounds=rounds)
+        report = res.get("report", {})
+        point = {
+            "best_ms": round(res["best_ms"], 2),
+            "host_threads": report.get("host_threads"),
+            "stage_split_ms": _host_stage_split(report),
+        }
+        for key in ("stage_read_ms_per_worker",
+                    "stage_tokenize_ms_per_worker",
+                    "stage_emit_ms_per_reducer", "merge_ms",
+                    "read_wait_ms", "consume_wait_ms", "reduce_workers"):
+            if key in report:
+                point[key] = ([round(float(v), 2) for v in report[key]]
+                              if isinstance(report[key], list)
+                              else round(float(report[key]), 2))
+        sweep["points"][str(k)] = point
+    pts = sweep["points"]
+    if "1" in pts and "4" in pts:
+        sweep["speedup_4v1"] = round(
+            pts["1"]["best_ms"] / pts["4"]["best_ms"], 3)
+    return sweep
+
+
+def _bench_sweep() -> int:
+    """Standalone sweep mode (make bench-sweep): one JSON line, no TPU."""
+    _, metric = _manifest()
+    sweep = _host_threads_sweep()
+    print(json.dumps({
+        "metric": "host_threads_sweep",
+        "corpus_metric": metric,
+        "unit": "ms",
+        "scratch": _scratch_backing(),
+        **sweep,
+    }))
+    return 0
+
+
 def main() -> int:
     _, metric = _manifest()
     tpu, tpu_log = _run_tpu_attempts()
@@ -618,6 +677,10 @@ def main() -> int:
         # non-empty skipped_docs means the measurement itself is suspect
         "degradation": cpu.get("report", {}).get(
             "degradation", {"read_retries": 0, "skipped_docs": []}),
+        # host map-phase scaling curve (1/2/4 scan workers, same
+        # corpus) with the per-worker stage split — tracked round over
+        # round; host_cores qualifies what the curve can even show
+        "host_threads_sweep": _host_threads_sweep(),
     }
     if tpu is not None:
         line["tpu_platform"] = tpu.get("platform")
@@ -666,4 +729,6 @@ if __name__ == "__main__":
         sys.exit(_tpu_child())
     if "--scale" in sys.argv:
         sys.exit(_bench_scale())
+    if "--sweep" in sys.argv:
+        sys.exit(_bench_sweep())
     sys.exit(main())

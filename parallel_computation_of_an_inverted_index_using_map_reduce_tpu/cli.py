@@ -29,9 +29,12 @@ def make_parser() -> argparse.ArgumentParser:
         description="TPU-native inverted-index MapReduce",
     )
     p.add_argument("num_mappers", type=int,
-                   help="host shard count (reference mapper threads; output-invariant)")
+                   help="host shard count (reference mapper threads; "
+                        "backend=cpu scan workers; output-invariant)")
     p.add_argument("num_reducers", type=int,
-                   help="reduce partition count (reference reducer threads; output-invariant)")
+                   help="reduce partition count (reference reducer threads; "
+                        "backend=cpu letter-range reduce workers; "
+                        "output-invariant)")
     p.add_argument("file_list", help="manifest: count header then one path per line")
     p.add_argument("--backend", choices=("tpu", "cpu", "oracle"), default="tpu",
                    help="tpu: device engine; cpu: one native host call; "
@@ -83,8 +86,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stream-checkpoint-every", type=int, default=2,
                    help="windows between stream checkpoints")
     p.add_argument("--host-threads", type=int, default=None,
-                   help="host map-phase threads (default: num_mappers if > 1, "
-                        "else min(cores, 8)); output-invariant")
+                   help="host map-phase threads — backend=cpu scan workers "
+                        "pulling windows from a shared steal queue "
+                        "(default: num_mappers if > 1, else min(cores, 8)); "
+                        "output-invariant")
     p.add_argument("--emit-ownership", choices=("merged", "letter"),
                    default="merged",
                    help="merged: one host writes all 26 files; letter: "

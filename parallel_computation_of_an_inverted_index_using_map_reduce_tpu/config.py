@@ -32,9 +32,15 @@ class IndexConfig:
     # counts (SURVEY.md §2.3 determinism), and so is ours: ``num_mappers``
     # sets the host map-phase thread count when ``host_threads`` is unset
     # (the reference's mapper threads, main.c:348-365, re-expressed —
-    # byte-identical output at any count); ``num_reducers`` is recorded in
-    # run stats (device reduce is balanced by sort/hash regardless, so the
-    # reference's 1000x letter skew, SURVEY.md §2.3, cannot recur).
+    # byte-identical output at any count).  On ``backend="cpu"`` with
+    # read-ahead on, that count is K scan workers, each with its own
+    # arena ring + reader + incremental native handle, pulling byte
+    # windows from a shared steal queue; ``num_reducers`` is then M
+    # reducer threads owning contiguous letter ranges
+    # (corpus/scheduler.plan_letter_ranges — the reference's reducer
+    # ownership, main.c:129-130) over the merged vocabulary.  On device,
+    # reduce is balanced by sort/hash regardless, so the reference's
+    # 1000x letter skew (SURVEY.md §2.3) cannot recur.
     num_mappers: int = 1
     num_reducers: int = 1
     # "tpu"    — device engine (jit sort pipeline; pipelined/one-shot plans)
@@ -106,10 +112,11 @@ class IndexConfig:
     # token or the run falls back).  48 covers real text with margin
     # (reference corpus max: 38 letters).
     device_tokenize_width: int = 48
-    # Host map-phase threads for the native tokenizer (contiguous
-    # byte-balanced doc ranges, merged at vocab scale — output-identical
-    # at any count).  None = ``num_mappers`` if > 1, else auto
-    # (min(cores, 8)).
+    # Host map-phase threads: the native tokenizer's fork-join worker
+    # count AND the pipelined cpu path's scan-worker count (one arena
+    # ring + reader + native handle per worker, windows from a shared
+    # steal queue; merged at vocab scale — output-identical at any
+    # count).  None = ``num_mappers`` if > 1, else auto (min(cores, 8)).
     host_threads: int | None = None
     # Crash-resumable streaming for the single-chip all-device engine:
     # persist the bounded row accumulator's VERIFIED valid prefix plus

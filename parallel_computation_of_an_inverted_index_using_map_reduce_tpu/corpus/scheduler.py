@@ -16,6 +16,8 @@ letter skew measured in SURVEY.md §2.3.
 from __future__ import annotations
 
 import dataclasses
+import random
+import threading
 
 from ..config import ALPHABET_SIZE
 from .manifest import Manifest
@@ -119,6 +121,46 @@ def plan_fraction_windows(manifest: Manifest,
         cuts.append(d)
     cuts.append(n)
     return tuple((cuts[t], cuts[t + 1]) for t in range(len(fr)))
+
+
+class StealQueue:
+    """Steal-safe window queue shared by K scan workers.
+
+    The reference statically pre-assigns file ranges to mappers
+    (main.c:307-328), so one slow disk stripe idles every other thread
+    until the join.  Here the byte-window plan goes into one shared
+    queue and each worker's reader pulls the next window when its ring
+    has a free arena — dynamic self-scheduling, the degenerate-deque
+    form of work stealing (every pop is a "steal" from the shared pool),
+    which is all the structure K independent readers need.
+
+    Windows are handed out with their GLOBAL 1-based plan index so
+    fault hooks keyed on window numbers (``sigkill:window=N``) stay
+    deterministic under any worker interleaving, and ``shuffle_seed``
+    deliberately scrambles hand-out order — the output-invariance tests
+    use it to prove scheduling can never change the emitted bytes.
+    """
+
+    def __init__(self, windows, shuffle_seed: int | None = None):
+        items = list(enumerate(windows, start=1))
+        if shuffle_seed is not None:
+            random.Random(shuffle_seed).shuffle(items)
+        self._items = items
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def pop_window(self) -> tuple[int, tuple[int, int]] | None:
+        """Next ``(global_index, (lo, hi))``, or None when drained."""
+        with self._lock:
+            if self._pos >= len(self._items):
+                return None
+            item = self._items[self._pos]
+            self._pos += 1
+            return item
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items) - self._pos
 
 
 def plan_letter_ranges(num_reducers: int) -> tuple[tuple[int, int], ...]:

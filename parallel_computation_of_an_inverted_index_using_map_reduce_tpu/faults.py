@@ -232,8 +232,11 @@ class FaultInjector:
                 raise ReaderThreadDeath()
 
     def on_window_boundary(self, window: int) -> None:
-        """Fires on the stream loop's main thread after window
-        ``window`` completes (post-checkpoint); may SIGKILL."""
+        """Fires after window ``window`` completes — on the stream
+        loop's main thread (post-checkpoint) and, for the pipelined cpu
+        path, in each reader thread after the window is read and handed
+        downstream (the window index is the GLOBAL plan index, so specs
+        are worker-count-invariant); may SIGKILL."""
         for rule in self.rules:
             if rule.kind == "sigkill" and rule.window == window:
                 log.warning("fault injection: SIGKILL at stream "
@@ -367,6 +370,22 @@ class DegradationReport:
         with self._lock:
             self.skips.append(
                 {"doc_id": doc_id, "path": path, "reason": reason})
+
+    def merge(self, other: "DegradationReport") -> None:
+        """Fold ``other``'s tallies into this report (thread-safe on
+        both sides).  The multi-worker host path gives each scan worker
+        its own report — readers record without contending on the
+        run-scoped lock — and merges them at the join barrier, so a
+        degraded K-worker run still exits with the COMPLETE skipped-doc
+        list no matter which worker hit the bad stripe."""
+        if other is self:
+            return
+        with other._lock:
+            retries = other.read_retries
+            skips = list(other.skips)
+        with self._lock:
+            self.read_retries += retries
+            self.skips.extend(skips)
 
     @property
     def degraded(self) -> bool:

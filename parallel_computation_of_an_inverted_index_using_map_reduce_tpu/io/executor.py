@@ -59,7 +59,17 @@ class PipelinedWindowReader:
 
     ``read_wait_s`` / ``consume_wait_s`` accumulate the time the reader
     sat blocked on a free arena and the consumer sat blocked on a filled
-    one — the pipeline-bubble split the bench stage report uses.
+    one — the pipeline-bubble split the bench stage report uses.  Each
+    counter is written by exactly one thread (reader / consumer), so
+    per-reader instances are race-free and the multi-worker path merges
+    them by plain summation.
+
+    ``windows`` is either a concrete window list (read in plan order)
+    or a shared :class:`~..corpus.scheduler.StealQueue`: then each of K
+    readers pulls the next undrained window when it has a free arena —
+    the work-stealing schedule that keeps fast workers busy past a slow
+    disk stripe.  Fault hooks fire on the window's GLOBAL plan index in
+    both modes, so injection specs mean the same thing at any K.
     """
 
     def __init__(self, manifest, windows, depth: int = 2,
@@ -69,7 +79,9 @@ class PipelinedWindowReader:
                  policy: "faults.RetryPolicy | None" = None,
                  report: "faults.DegradationReport | None" = None):
         self._manifest = manifest
-        self._windows = list(windows)
+        # a shared StealQueue (duck-typed on pop_window) or a plan list
+        self._queue = windows if hasattr(windows, "pop_window") else None
+        self._windows = [] if self._queue is not None else list(windows)
         self._depth = max(int(depth), 1)
         self._watchdog_s = watchdog_s
         self.policy = policy if policy is not None else faults.default_policy()
@@ -104,9 +116,22 @@ class PipelinedWindowReader:
                 continue
         return None
 
+    def _iter_windows(self):
+        """(global_index, (lo, hi)) pairs from the plan list or, under
+        the multi-worker schedule, whatever the shared queue still
+        holds — each pop is this reader 'stealing' the next window."""
+        if self._queue is None:
+            yield from enumerate(self._windows, start=1)
+            return
+        while True:
+            item = self._queue.pop_window()
+            if item is None:
+                return
+            yield item
+
     def _reader(self) -> None:
         try:
-            for wi, (lo, hi) in enumerate(self._windows, start=1):
+            for wi, (lo, hi) in self._iter_windows():
                 inj = faults.active()
                 if inj is not None:
                     inj.on_reader_window(wi)
@@ -120,6 +145,11 @@ class PipelinedWindowReader:
                                  policy=self.policy, report=self.report)
                 self.read_busy_s += time.perf_counter() - t0
                 self._ready.put(arena)
+                # window wi is now fully read and handed downstream —
+                # the crash-injection boundary the SIGKILL e2e tests
+                # aim at (same global numbering at any worker count)
+                if inj is not None:
+                    inj.on_window_boundary(wi)
             self._ready.put(self._done)
         except faults.ReaderThreadDeath:
             # injected silent death: exit WITHOUT posting, so the

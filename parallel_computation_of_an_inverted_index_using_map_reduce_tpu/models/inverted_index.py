@@ -145,7 +145,14 @@ class InvertedIndexModel:
             return {**stats, **timer.report()}
         threads = self.config.resolved_host_threads()
         timer.count("host_threads", threads)
-        if self.config.io_prefetch > 0 and threads == 1:
+        if self.config.io_prefetch > 0:
+            # resolved_host_threads drives the pipelined path too (it
+            # used to fall off to the one-shot call for any K > 1,
+            # reporting host_threads=1 work): K scan workers + M letter
+            # reducers when either knob asks for parallelism.
+            if threads > 1 or self.config.num_reducers > 1:
+                return self._run_cpu_parallel(manifest, out_dir, timer,
+                                              threads)
             return self._run_cpu_pipelined(manifest, out_dir, timer)
         with timer.phase("load"):
             contents, doc_ids = load_documents(manifest)
@@ -161,6 +168,13 @@ class InvertedIndexModel:
     # L2/L3 for the scan that immediately follows the fill.
     _CPU_WINDOW_BYTES = 2 << 20
 
+    def _cpu_window_bytes(self) -> int:
+        # MRI_CPU_WINDOW_BYTES forces tiny windows from a subprocess —
+        # the SIGKILL-at-window-boundary e2e tests need a multi-window
+        # plan on a corpus small enough to kill deterministically.
+        return int(os.environ.get("MRI_CPU_WINDOW_BYTES",
+                                  self._CPU_WINDOW_BYTES))
+
     def _run_cpu_pipelined(self, manifest: Manifest, out_dir: str,
                            timer: PhaseTimer) -> dict:
         """Arena-fed incremental host index (the io subsystem path).
@@ -174,7 +188,8 @@ class InvertedIndexModel:
         from ..io.executor import PipelinedWindowReader
         from ..io.reader import plan_byte_windows
 
-        windows = plan_byte_windows(manifest, self._CPU_WINDOW_BYTES)
+        window_bytes = self._cpu_window_bytes()
+        windows = plan_byte_windows(manifest, window_bytes)
         max_docs = max((hi - lo for lo, hi in windows), default=1)
         # The arena ring is reused across run() calls (steady-state: no
         # page faults from fresh buffers); construct the reader FIRST —
@@ -185,7 +200,7 @@ class InvertedIndexModel:
             arenas = None
         reader = PipelinedWindowReader(
             manifest, windows, depth=self.config.io_prefetch,
-            byte_capacity=self._CPU_WINDOW_BYTES + (self._CPU_WINDOW_BYTES >> 2),
+            byte_capacity=window_bytes + (window_bytes >> 2),
             doc_capacity=max_docs, arenas=arenas)
         self._cpu_arenas = reader.arenas
         stream = native.HostIndexStream()
@@ -210,6 +225,163 @@ class InvertedIndexModel:
         timer.count("stage_emit_ms", round(stats["emit_ms"], 3))
         timer.count("read_wait_ms", round(reader.read_wait_s * 1e3, 3))
         timer.count("consume_wait_ms", round(reader.consume_wait_s * 1e3, 3))
+        return timer.report()
+
+    def _run_cpu_parallel(self, manifest: Manifest, out_dir: str,
+                          timer: PhaseTimer, num_workers: int) -> dict:
+        """K-worker map + M-reducer reduce on the pipelined host path.
+
+        The reference's fork-join topology (N mapper threads scanning
+        file shards, M reducer threads owning letter ranges,
+        main.c:85-242) rebuilt on the zero-copy pipeline: every scan
+        worker owns its own arena ring + reader thread + incremental
+        native handle and pulls byte windows from one shared
+        :class:`StealQueue`, so a slow stripe never idles the rest.
+        ctypes releases the GIL for the native scan, partial-flatten,
+        and emit calls — the Python threads are genuinely concurrent in
+        C++.  Reduce is letter-partitioned: ``plan_letter_ranges``
+        (``num_reducers``) splits the merged emit order and each
+        reducer renders its range through the shared vectorized emit.
+        Output is byte-identical to the single-worker path at every
+        (K, M) — scheduling can reorder work, never bytes.
+        """
+        import threading
+
+        from .. import native
+        from ..corpus.scheduler import StealQueue, plan_letter_ranges
+        from ..io.executor import PipelinedWindowReader
+        from ..io.reader import plan_byte_windows
+
+        cfg = self.config
+        window_bytes = self._cpu_window_bytes()
+        windows = plan_byte_windows(manifest, window_bytes)
+        max_docs = max((hi - lo for lo, hi in windows), default=1)
+        K = max(1, num_workers)
+        shuffle_env = os.environ.get("MRI_STEAL_SHUFFLE_SEED")
+        queue = StealQueue(
+            windows,
+            shuffle_seed=int(shuffle_env) if shuffle_env else None)
+
+        # Per-worker arena rings, recycled across run() calls like the
+        # single-worker path's ring (invalidated when K or depth moves).
+        rings = getattr(self, "_cpu_arena_rings", None)
+        if rings is not None and (
+                len(rings) != K
+                or any(len(r) != cfg.io_prefetch + 1 for r in rings)):
+            rings = None
+        if rings is None:
+            rings = [None] * K
+
+        # Private DegradationReport per worker (reader threads record
+        # without cross-worker lock contention), merged at the join so
+        # a degraded run still reports every skipped doc id.
+        run_report = faults.current_report()
+        policy = faults.default_policy()
+        reports = [faults.DegradationReport() for _ in range(K)]
+        readers = [
+            PipelinedWindowReader(
+                manifest, queue, depth=cfg.io_prefetch,
+                byte_capacity=window_bytes + (window_bytes >> 2),
+                doc_capacity=max_docs, arenas=rings[w],
+                policy=policy, report=reports[w])
+            for w in range(K)
+        ]
+        self._cpu_arena_rings = [r.arenas for r in readers]
+        streams = [native.HostIndexStream() for _ in range(K)]
+        partials: list[dict | None] = [None] * K
+        errors: list[BaseException | None] = [None] * K
+
+        def scan_worker(w: int) -> None:
+            reader, stream = readers[w], streams[w]
+            try:
+                for arena in reader:
+                    buf, ends, ids = arena.feed_views()
+                    stream.feed_arrays(buf, ends, ids)
+                    reader.recycle(arena)
+                # flatten this worker's postings runs here, inside the
+                # map phase's parallelism, not at the serial join
+                partials[w] = stream.partial()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors[w] = e
+
+        merge = None
+        try:
+            with timer.phase("ingest_scan"):
+                threads = [
+                    threading.Thread(target=scan_worker, args=(w,),
+                                     name=f"scan-worker-{w}")
+                    for w in range(1, K)
+                ]
+                for t in threads:
+                    t.start()
+                scan_worker(0)  # the caller's thread is worker 0
+                for t in threads:
+                    t.join()
+            for rep in reports:
+                run_report.merge(rep)
+            for err in errors:
+                if err is not None:
+                    raise err
+            with timer.phase("finalize_emit"):
+                merge = native.HostIndexMerge(streams)
+                ranges = plan_letter_ranges(cfg.num_reducers)
+                emit_ms = [0.0] * len(ranges)
+                emit_bytes = [0] * len(ranges)
+                emit_errors: list[BaseException | None] = [None] * len(ranges)
+
+                def reduce_worker(r: int, lo: int, hi: int) -> None:
+                    t0 = time.perf_counter()
+                    try:
+                        emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
+                    except BaseException as e:  # noqa: BLE001
+                        emit_errors[r] = e
+                    emit_ms[r] = (time.perf_counter() - t0) * 1e3
+
+                reducers = [
+                    threading.Thread(target=reduce_worker, args=(r, lo, hi),
+                                     name=f"reduce-worker-{r}")
+                    for r, (lo, hi) in list(enumerate(ranges))[1:]
+                ]
+                for t in reducers:
+                    t.start()
+                reduce_worker(0, *ranges[0])
+                for t in reducers:
+                    t.join()
+                for err in emit_errors:
+                    if err is not None:
+                        raise err
+                mstats = merge.stats()
+        finally:
+            for reader in readers:
+                reader.close()
+            if merge is not None:
+                merge.close()
+            for stream in streams:
+                stream.close()
+
+        for key, value in mstats.items():
+            if key != "merge_ms":
+                timer.count(key, value)
+        timer.count("bytes_written", int(sum(emit_bytes)))
+        timer.count("reduce_workers", len(ranges))
+        timer.count("io_windows", len(windows))
+        timer.count("io_prefetch", cfg.io_prefetch)
+        read_ms = [round(r.read_busy_s * 1e3, 3) for r in readers]
+        tok_ms = [round(p["scan_ms"] + p["partial_ms"], 3)
+                  for p in partials if p is not None]
+        timer.count("stage_read_ms", round(sum(read_ms), 3))
+        timer.count("stage_tokenize_ms",
+                    round(sum(tok_ms) + mstats["merge_ms"], 3))
+        timer.count("stage_emit_ms", round(sum(emit_ms), 3))
+        timer.count("stage_read_ms_per_worker", read_ms)
+        timer.count("stage_tokenize_ms_per_worker", tok_ms)
+        timer.count("stage_emit_ms_per_reducer",
+                    [round(ms, 3) for ms in emit_ms])
+        timer.count("merge_ms", round(mstats["merge_ms"], 3))
+        timer.count("read_wait_ms",
+                    round(sum(r.read_wait_s for r in readers) * 1e3, 3))
+        timer.count("consume_wait_ms",
+                    round(sum(r.consume_wait_s for r in readers) * 1e3, 3))
         return timer.report()
 
     # -- TPU backend ---------------------------------------------------

@@ -198,6 +198,22 @@ def load():
             ctypes.c_void_p, ctypes.c_char_p,
             ctypes.POINTER(_HostStreamStats),
         ]
+        lib.mri_hidx_partial.restype = ctypes.c_int32
+        lib.mri_hidx_partial.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_hidxm_new.restype = ctypes.c_void_p
+        lib.mri_hidxm_new.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+            ctypes.POINTER(_HostStreamStats),
+        ]
+        lib.mri_hidxm_free.restype = None
+        lib.mri_hidxm_free.argtypes = [ctypes.c_void_p]
+        lib.mri_hidxm_emit_range.restype = ctypes.c_int64
+        lib.mri_hidxm_emit_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+        ]
         lib.mri_token_stats.restype = ctypes.c_int32
         lib.mri_token_stats.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
@@ -211,6 +227,7 @@ def load():
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_char_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
         ]
         lib.mri_emit_runs.restype = ctypes.c_int64
         lib.mri_emit_runs.argtypes = [
@@ -591,10 +608,112 @@ class HostIndexStream:
             "emit_ms": stats.emit_ns / 1e6,
         }
 
+    def partial(self) -> dict:
+        """Flatten this worker's scan into per-term doc runs (the paper's
+        per-worker ``partial_a..z`` spill, kept in memory).
+
+        Runs in the calling worker thread with the GIL released, so K
+        workers' partial passes overlap.  Each term's run is sorted
+        ascending even when the steal queue delivered windows out of
+        order.  After this call the handle can only be merged via
+        :class:`HostIndexMerge` — ``finalize_emit`` is no longer valid
+        (the scan buffers are released).  Idempotent.
+        """
+        scan_ns = ctypes.c_int64(0)
+        partial_ns = ctypes.c_int64(0)
+        rc = self._lib.mri_hidx_partial(
+            self._handle, ctypes.byref(scan_ns), ctypes.byref(partial_ns))
+        if rc != 0:
+            raise MemoryError("native host index partial allocation failure")
+        return {
+            "scan_ms": scan_ns.value / 1e6,
+            "partial_ms": partial_ns.value / 1e6,
+        }
+
     def close(self):
         if self._handle:
             self._lib.mri_hidx_free(self._handle)
             self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class HostIndexMerge:
+    """Letter-partitioned parallel reduce over K scanned streams.
+
+    Joins the workers' vocabularies into one global vocabulary + emit
+    order (the fork-join barrier), then :meth:`emit_range` renders any
+    contiguous first-letter range — it is read-only on the merge state
+    and releases the GIL, so M reducer threads (``num_reducers``) call
+    it concurrently.  The union of ``plan_letter_ranges`` calls is
+    byte-identical to a single-stream ``finalize_emit``.
+
+    Keeps references to the source streams: their native runs back the
+    merge until :meth:`close`.
+    """
+
+    def __init__(self, streams):
+        if not streams:
+            raise ValueError("HostIndexMerge needs at least one stream")
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native host merge unavailable: {_lib_error}")
+        self._lib = lib
+        self._streams = list(streams)  # keep worker runs alive
+        handles = (ctypes.c_void_p * len(self._streams))(
+            *[s._handle for s in self._streams])
+        stats = _HostStreamStats()
+        self._handle = ctypes.c_void_p(
+            lib.mri_hidxm_new(handles, len(self._streams),
+                              ctypes.byref(stats)))
+        if not self._handle:
+            raise MemoryError("native host merge allocation failure")
+        self._documents = sum(s._documents for s in self._streams)
+        self._stats = stats
+
+    def stats(self) -> dict:
+        return {
+            "documents": self._documents,
+            "tokens": int(self._stats.raw_tokens),
+            "unique_terms": int(self._stats.vocab_size),
+            "unique_pairs": int(self._stats.num_pairs),
+            "lines_written": int(self._stats.vocab_size),
+            "merge_ms": self._stats.finalize_ns / 1e6,
+        }
+
+    def emit_range(self, letter_lo: int, letter_hi: int, out_dir) -> int:
+        """Write letter files ``[letter_lo, letter_hi)``; bytes written.
+
+        An empty range (``lo == hi``, from ``plan_letter_ranges`` with
+        more reducers than letters) writes nothing and returns 0.
+        """
+        os.makedirs(out_dir, exist_ok=True)
+        n = self._lib.mri_hidxm_emit_range(
+            self._handle, ctypes.c_int32(letter_lo),
+            ctypes.c_int32(letter_hi), str(out_dir).encode())
+        if n == -2:
+            raise MemoryError("native host merge emit allocation failure")
+        if n < 0:
+            raise OSError(
+                f"native host merge failed writing letters "
+                f"[{letter_lo}, {letter_hi}) to {out_dir!r}")
+        return int(n)
+
+    def close(self):
+        if self._handle:
+            self._lib.mri_hidxm_free(self._handle)
+            self._handle = None
+        self._streams = []
 
     def __enter__(self):
         return self
@@ -656,11 +775,17 @@ def emit_native_runs(out_dir, vocab: np.ndarray, order, runs) -> int:
     return int(rc)
 
 
-def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings) -> int:
-    """Native 26-file emit; byte-identical to text.formatter.emit_index.
+def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings,
+                letter_range: tuple[int, int] = (0, 26),
+                idx_bounds: tuple[int, int] | None = None) -> int:
+    """Native letter-file emit; byte-identical to text.formatter.emit_index.
 
     ``vocab`` is the sorted numpy 'S' array; postings may be uint16 or
-    int32.  Returns total bytes written.
+    int32.  ``letter_range`` restricts emission to letters ``[lo, hi)``
+    with ``idx_bounds`` the matching slice of ``order`` (required for a
+    partial range; defaults to the whole permutation) — the per-owner
+    emit the multi-host letter-ownership mode and the parallel reduce
+    share.  Returns total bytes written.
     """
     lib = load()
     if lib is None:
@@ -694,6 +819,10 @@ def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings) -> int
         ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctypes.c_int64)),
         p16, p32,
         str(out_dir).encode(),
+        ctypes.c_int32(letter_range[0]), ctypes.c_int32(letter_range[1]),
+        ctypes.c_int64(idx_bounds[0] if idx_bounds is not None else 0),
+        ctypes.c_int64(idx_bounds[1] if idx_bounds is not None
+                       else vocab_size),
     )
     if rc < 0:
         raise OSError(f"native emit failed writing to {out_dir!r}")

@@ -1362,14 +1362,25 @@ uint32_t MaxDocId(const EmitRun* runs, int32_t n_runs, int32_t vocab_size) {
 // in-flight letter only as a `.tmp`, and never a truncated-but-
 // plausible `<letter>.txt` (the reference's partial_<letter>.txt spill
 // files have the same never-half-a-file property, main.c:332-341).
+//
+// `letter_lo`/`letter_hi` + the matching `idx_start`/`idx_end` order
+// slice restrict the call to a contiguous letter range (the parallel
+// reduce's per-reducer partition, main.c:129-130): only files
+// `letter_lo..letter_hi-1` are written, and buffer sizing covers the
+// slice, not the whole vocab, so M reducers never over-allocate M-fold.
+// Defaults preserve the historical whole-alphabet behavior.
 int64_t EmitLettersRuns(const uint8_t* vocab_packed, int32_t vocab_size,
                         int32_t width, const int64_t* order,
                         const EmitRun* runs, int32_t n_runs,
                         const char* out_dir,
                         const uint32_t* lens = nullptr,
-                        int64_t maxid_hint = -1) {
+                        int64_t maxid_hint = -1,
+                        int32_t letter_lo = 0, int32_t letter_hi = 26,
+                        int64_t idx_start = 0, int64_t idx_end = -1) {
   std::string dir(out_dir);
   if (!dir.empty() && dir.back() != '/') dir += '/';
+  if (idx_end < 0) idx_end = vocab_size;
+  if (letter_lo >= letter_hi) return 0;  // empty partition: no files owned
   // Vectorized id formatting: render each id once, copy 8 bytes per
   // posting.  The table pays for itself whenever postings outnumber
   // distinct ids (always, past trivial corpora).  Callers that track
@@ -1379,7 +1390,7 @@ int64_t EmitLettersRuns(const uint8_t* vocab_packed, int32_t vocab_size,
       maxid_hint >= 0 ? static_cast<uint32_t>(std::min<int64_t>(
                             maxid_hint, kIdTableMax))
                       : MaxDocId(runs, n_runs, vocab_size);
-  if (vocab_size && maxid < kIdTableMax) {
+  if (idx_end > idx_start && maxid < kIdTableMax) {
     id_table.resize(static_cast<size_t>(maxid) + 1);
     for (uint32_t v = 0; v <= maxid; ++v) {
       char* p = id_table[v].s;
@@ -1393,14 +1404,16 @@ int64_t EmitLettersRuns(const uint8_t* vocab_packed, int32_t vocab_size,
   // posting (space + 10 digits), + 8 bytes table-copy overhang slack.
   int64_t total_df = 0;
   for (int32_t r = 0; r < n_runs; ++r)
-    for (int32_t t = 0; t < vocab_size; ++t) total_df += runs[r].counts[t];
-  std::vector<char> buf(static_cast<size_t>(vocab_size) * (width + 4) +
+    for (int64_t i = idx_start; i < idx_end; ++i)
+      total_df += runs[r].counts[order[i]];
+  std::vector<char> buf(static_cast<size_t>(idx_end - idx_start) *
+                            (width + 4) +
                         11ull * total_df + 8);
   int64_t total = 0;
-  int32_t idx = 0;
-  for (int letter = 0; letter < 26; ++letter) {
+  int64_t idx = idx_start;
+  for (int letter = letter_lo; letter < letter_hi; ++letter) {
     char* p = buf.data();
-    for (; idx < vocab_size; ++idx) {
+    for (; idx < idx_end; ++idx) {
       const int64_t t = order[idx];
       const uint8_t* w = vocab_packed + static_cast<int64_t>(t) * width;
       if (w[0] - 'a' != letter) break;
@@ -1487,14 +1500,20 @@ int64_t EmitLetters(const uint8_t* vocab_packed, int32_t vocab_size,
 }  // namespace
 
 // postings16/postings32: exactly one is non-null.  order/df/offsets are
-// int64 (numpy's native index types).  Returns total bytes written, or
-// -1 on IO error.
+// int64 (numpy's native index types).  letter_lo/letter_hi restrict
+// emission to that letter range, with idx_start/idx_end the matching
+// slice of `order` (full emit: 0/26/0/vocab_size) — the per-owner emit
+// of the multi-host "letter" ownership mode and the parallel reduce.
+// Returns total bytes written, or -1 on IO error.
 int64_t mri_emit(const uint8_t* vocab_packed, int32_t vocab_size, int32_t width,
                  const int64_t* order, const int64_t* df, const int64_t* offsets,
                  const uint16_t* postings16, const int32_t* postings32,
-                 const char* out_dir) try {
-  return EmitLetters(vocab_packed, vocab_size, width, order, df, offsets,
-                     postings16, postings32, out_dir);
+                 const char* out_dir, int32_t letter_lo, int32_t letter_hi,
+                 int64_t idx_start, int64_t idx_end) try {
+  const EmitRun run{postings16, postings32, offsets, df};
+  return EmitLettersRuns(vocab_packed, vocab_size, width, order, &run, 1,
+                         out_dir, /*lens=*/nullptr, /*maxid_hint=*/-1,
+                         letter_lo, letter_hi, idx_start, idx_end);
 } catch (const std::bad_alloc&) {
   return -1;
 }
@@ -1688,7 +1707,119 @@ struct HostStreamState {
   std::vector<DocMark> doc_marks;
   int32_t max_doc_id = 0;
   int64_t scan_ns = 0;
+  // Parallel-reduce partial state (mri_hidx_partial): per-term postings
+  // runs, each doc-ascending regardless of window arrival order.  Once
+  // built, pair_ids/doc_marks are released — a partial'd handle can no
+  // longer be finalize_emit'd, only merged via mri_hidxm_new.
+  std::vector<int64_t> local_off;   // local prov id -> run start (+1 end)
+  std::vector<int32_t> local_flat;  // concatenated per-term doc runs
+  bool partial_done = false;
+  int64_t partial_ns = 0;
 };
+
+namespace {
+
+// Emit order for one vocabulary — (letter asc, df desc, word asc) — via
+// a counting pre-partition on the first letter (the bswapped prefix's
+// top byte), which turns one big sort into 26 smaller ones whose
+// comparator never looks at the letter again.  Ties past the 8-byte
+// prefix fall back to the padded tail, which is NUL-filled so prefix
+// words sort first (main.c:55-64 semantics).  `letter_off_out[l]` /
+// `[l+1]` bound letter `l`'s slice of `emit_order` — the letter
+// partition the parallel reduce hands to its reducer workers.
+void BuildEmitOrder(const StreamState& st, const int64_t* df,
+                    int64_t* emit_order, int32_t letter_off_out[27]) {
+  const int32_t vocab = st.next_id;
+  struct EmitKey {
+    uint64_t prefix;
+    int32_t df;
+    int32_t id;
+  };
+  const uint8_t* base = st.arena.data();
+  std::vector<EmitKey> keyed(std::max(vocab, 1));
+  int32_t letter_count[27] = {0};
+  for (int32_t i = 0; i < vocab; ++i) {
+    const uint64_t prefix = __builtin_bswap64(Load64(base + st.word_offsets[i]));
+    ++letter_count[(prefix >> 56) - 'a' + 1];
+    keyed[i] = {prefix, static_cast<int32_t>(df[i]), i};
+  }
+  letter_off_out[0] = 0;
+  for (int i = 1; i < 27; ++i)
+    letter_off_out[i] = letter_off_out[i - 1] + letter_count[i];
+  std::vector<EmitKey> part(std::max(vocab, 1));
+  {
+    int32_t cur[26];
+    std::memcpy(cur, letter_off_out, sizeof(cur));
+    for (int32_t i = 0; i < vocab; ++i)
+      part[cur[(keyed[i].prefix >> 56) - 'a']++] = keyed[i];
+  }
+  const auto by_df_word = [&](const EmitKey& a, const EmitKey& b) {
+    if (a.df != b.df) return a.df > b.df;
+    if (a.prefix != b.prefix) return a.prefix < b.prefix;
+    const uint8_t* pa = base + st.word_offsets[a.id];
+    const uint8_t* pb = base + st.word_offsets[b.id];
+    const uint32_t pla = (st.word_lens[a.id] + 7) & ~7u;
+    const uint32_t plb = (st.word_lens[b.id] + 7) & ~7u;
+    const uint32_t lim = pla > plb ? pla : plb;
+    for (uint32_t i = 8; i < lim; i += 8) {
+      const uint64_t ka = i < pla ? __builtin_bswap64(Load64(pa + i)) : 0;
+      const uint64_t kb = i < plb ? __builtin_bswap64(Load64(pb + i)) : 0;
+      if (ka != kb) return ka < kb;
+    }
+    return false;  // identical words cannot occur (unique vocab)
+  };
+  for (int l = 0; l < 26; ++l)
+    std::sort(part.begin() + letter_off_out[l],
+              part.begin() + letter_off_out[l + 1], by_df_word);
+  for (int32_t i = 0; i < vocab; ++i) emit_order[i] = part[i].id;
+}
+
+// Flatten one worker's scan-order pairs into per-term doc runs
+// (idempotent; runs in the worker's own thread with the GIL released).
+// The steal queue can hand a worker windows in ANY order, so each run
+// is sorted ascending here — a no-op is_sorted check in the common
+// FIFO case — which lets the merged emit restore globally ascending
+// postings with a cheap run merge instead of a token-scale sort.
+void PartialFlatten(HostStreamState& h) {
+  if (h.partial_done) return;
+  const auto t0 = std::chrono::steady_clock::now();
+  StreamState& st = h.st;
+  const int32_t vocab = st.next_id;
+  h.local_off.assign(static_cast<size_t>(std::max(vocab, 1)) + 1, 0);
+  int64_t total = 0;
+  for (int32_t p = 0; p < vocab; ++p) {
+    h.local_off[p] = total;
+    total += st.combiner[p].df;
+  }
+  h.local_off[std::max(vocab, 1)] = total;
+  h.local_flat.resize(std::max<int64_t>(total, 1));
+  {
+    std::vector<int64_t> cursor(h.local_off.begin(), h.local_off.end() - 1);
+    const size_t n_marks = h.doc_marks.size();
+    for (size_t s = 0; s < n_marks; ++s) {
+      const int64_t seg_end = (s + 1 < n_marks) ? h.doc_marks[s + 1].start
+                                                : static_cast<int64_t>(
+                                                      h.pair_ids.size());
+      const int32_t doc = h.doc_marks[s].doc;
+      for (int64_t k = h.doc_marks[s].start; k < seg_end; ++k)
+        h.local_flat[cursor[h.pair_ids[k]]++] = doc;
+    }
+  }
+  for (int32_t p = 0; p < vocab; ++p) {
+    const auto b = h.local_flat.begin() + h.local_off[p];
+    const auto e = h.local_flat.begin() + h.local_off[p + 1];
+    if (!std::is_sorted(b, e)) std::sort(b, e);
+  }
+  // the token-scale scan buffers are spent; release them pre-merge
+  std::vector<int32_t>().swap(h.pair_ids);
+  std::vector<HostStreamState::DocMark>().swap(h.doc_marks);
+  h.partial_done = true;
+  h.partial_ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+}
+
+}  // namespace
 
 void* mri_hidx_new() try {
   return new HostStreamState();
@@ -1765,58 +1896,13 @@ int32_t mri_hidx_finalize_emit(void* handle, const char* out_dir,
     width = std::max(width, static_cast<int32_t>(st.word_lens[i]));
 
   // One sort straight to emit order — (letter asc, df desc, word asc)
-  // — instead of SortedOrder + rank views + a second stable sort.  A
-  // counting pre-partition on the letter (the bswapped prefix's top
-  // byte) turns it into 26 smaller sorts whose comparator never has to
-  // look at the letter again.  Ties past the 8-byte prefix fall back
-  // to the padded tail, which is NUL-filled so prefix words sort first
-  // (main.c:55-64 semantics).
-  struct EmitKey {
-    uint64_t prefix;
-    int32_t df;
-    int32_t id;
-  };
-  const uint8_t* base = st.arena.data();
-  std::vector<EmitKey> keyed(std::max(vocab, 1));
-  int32_t letter_count[27] = {0};
-  for (int32_t i = 0; i < vocab; ++i) {
-    const uint64_t prefix = __builtin_bswap64(Load64(base + st.word_offsets[i]));
-    ++letter_count[(prefix >> 56) - 'a' + 1];
-    keyed[i] = {prefix, static_cast<int32_t>(df_prov[i]), i};
-  }
-  int32_t letter_off[27];
-  letter_off[0] = 0;
-  for (int i = 1; i < 27; ++i)
-    letter_off[i] = letter_off[i - 1] + letter_count[i];
-  std::vector<EmitKey> part(std::max(vocab, 1));
-  {
-    int32_t cur[26];
-    std::memcpy(cur, letter_off, sizeof(cur));
-    for (int32_t i = 0; i < vocab; ++i)
-      part[cur[(keyed[i].prefix >> 56) - 'a']++] = keyed[i];
-  }
-  const auto by_df_word = [&](const EmitKey& a, const EmitKey& b) {
-    if (a.df != b.df) return a.df > b.df;
-    if (a.prefix != b.prefix) return a.prefix < b.prefix;
-    const uint8_t* pa = base + st.word_offsets[a.id];
-    const uint8_t* pb = base + st.word_offsets[b.id];
-    const uint32_t pla = (st.word_lens[a.id] + 7) & ~7u;
-    const uint32_t plb = (st.word_lens[b.id] + 7) & ~7u;
-    const uint32_t lim = pla > plb ? pla : plb;
-    for (uint32_t i = 8; i < lim; i += 8) {
-      const uint64_t ka = i < pla ? __builtin_bswap64(Load64(pa + i)) : 0;
-      const uint64_t kb = i < plb ? __builtin_bswap64(Load64(pb + i)) : 0;
-      if (ka != kb) return ka < kb;
-    }
-    return false;  // identical words cannot occur (unique vocab)
-  };
-  for (int l = 0; l < 26; ++l)
-    std::sort(part.begin() + letter_off[l], part.begin() + letter_off[l + 1],
-              by_df_word);
+  // — instead of SortedOrder + rank views + a second stable sort.
   std::vector<int64_t> emit_order(std::max(vocab, 1));
-  for (int32_t i = 0; i < vocab; ++i) emit_order[i] = part[i].id;
+  int32_t letter_off[27];
+  BuildEmitOrder(st, df_prov.data(), emit_order.data(), letter_off);
 
   // Fixed-width NUL-padded rows for the shared emit core, prov space.
+  const uint8_t* base = st.arena.data();
   std::vector<uint8_t> vocab_packed(
       std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 0);
   for (int32_t p = 0; p < vocab; ++p)
@@ -1839,6 +1925,204 @@ int32_t mri_hidx_finalize_emit(void* handle, const char* out_dir,
   stats->emit_ns =
       std::chrono::duration_cast<std::chrono::nanoseconds>(t2 - t1).count();
   return stats->bytes_written < 0 ? -1 : 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+// ---------------------------------------------------------------------------
+// Parallel reduce over K independently-scanned handles: the paper's M
+// reducer threads (main.c:129-130) rebuilt on the streaming core.  Each
+// of K scan workers owns one HostStreamState; mri_hidx_partial turns
+// its scan buffers into per-term doc runs (the per-worker "partial_a..z"
+// spill, held in memory); mri_hidxm_new joins the K vocabularies into
+// one global vocabulary + emit order; mri_hidxm_emit_range renders a
+// contiguous letter range and is READ-ONLY on the merge state, so M
+// reducer threads call it concurrently with the GIL released.
+//
+// Correctness: every document lives in exactly one window and every
+// window is consumed by exactly one worker, so a term's per-worker doc
+// sets are disjoint — summed df is exact, and an inplace_merge chain
+// over the (individually ascending) runs restores the oracle's globally
+// ascending postings.
+// ---------------------------------------------------------------------------
+
+int32_t mri_hidx_partial(void* handle, int64_t* scan_ns_out,
+                         int64_t* partial_ns_out) try {
+  HostStreamState& h = *static_cast<HostStreamState*>(handle);
+  PartialFlatten(h);
+  if (scan_ns_out) *scan_ns_out = h.scan_ns;
+  if (partial_ns_out) *partial_ns_out = h.partial_ns;
+  return 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+struct HostMergeState {
+  std::vector<HostStreamState*> parts;  // non-owning: caller keeps alive
+  StreamState merged;                   // global vocab when K > 1
+  StreamState* st = nullptr;            // &merged, or part 0's state (K==1)
+  std::vector<int64_t> df_gid;          // global prov id -> merged df
+  // Per-term postings segments as (worker, local id) in CSR layout:
+  // term g's docs are the union of runs seg_off[g] .. seg_off[g+1].
+  std::vector<int64_t> seg_off;
+  std::vector<int32_t> seg_worker, seg_lid;
+  std::vector<int64_t> emit_order;      // global emit permutation
+  int32_t letter_off[27] = {0};         // letter l owns emit_order slice
+  std::vector<uint8_t> vocab_packed;    // prov space, NUL-padded rows
+  int32_t vocab = 0, width = 1, max_doc_id = 0;
+  int64_t raw_tokens = 0, num_pairs = 0;
+};
+
+void* mri_hidxm_new(void* const* handles, int32_t num_handles,
+                    HostStreamStats* stats) try {
+  if (num_handles < 1) return nullptr;
+  const auto t0 = std::chrono::steady_clock::now();
+  auto* m = new HostMergeState();
+  try {
+    const int32_t K = num_handles;
+    m->parts.reserve(K);
+    for (int32_t i = 0; i < K; ++i) {
+      auto* h = static_cast<HostStreamState*>(handles[i]);
+      PartialFlatten(*h);  // no-op when the worker already partial'd
+      m->parts.push_back(h);
+      m->raw_tokens += h->st.raw_tokens;
+      m->num_pairs += h->st.num_pairs;
+      m->max_doc_id = std::max(m->max_doc_id, h->max_doc_id);
+    }
+    // Vocab-scale join in worker order (mri_host_index's merge idiom);
+    // one worker's local state IS the global vocab (identity l2g).
+    std::vector<std::vector<int32_t>> l2g(K);
+    for (int32_t w = 0; w < K; ++w) {
+      StreamState& local = m->parts[w]->st;
+      l2g[w].reserve(local.next_id);
+      const uint8_t* base = local.arena.data();
+      for (int32_t lid = 0; lid < local.next_id; ++lid) {
+        if (K == 1) {
+          l2g[w].push_back(lid);
+          continue;
+        }
+        const uint8_t* word = base + local.word_offsets[lid];
+        const uint32_t wl = local.word_lens[lid];
+        l2g[w].push_back(m->merged.Upsert(word, wl, HashWord(word, wl)));
+      }
+    }
+    m->st = (K == 1) ? &m->parts[0]->st : &m->merged;
+    StreamState& st = *m->st;
+    const int32_t vocab = m->vocab = st.next_id;
+
+    // Disjoint doc sets sum exactly; count segments per global term.
+    m->df_gid.assign(std::max(vocab, 1), 0);
+    std::vector<int64_t> nseg(std::max(vocab, 1), 0);
+    for (int32_t w = 0; w < K; ++w) {
+      StreamState& local = m->parts[w]->st;
+      for (int32_t lid = 0; lid < local.next_id; ++lid) {
+        const int64_t df = local.combiner[lid].df;
+        if (!df) continue;
+        m->df_gid[l2g[w][lid]] += df;
+        ++nseg[l2g[w][lid]];
+      }
+    }
+    m->seg_off.assign(static_cast<size_t>(std::max(vocab, 1)) + 1, 0);
+    for (int32_t g = 0; g < vocab; ++g)
+      m->seg_off[g + 1] = m->seg_off[g] + nseg[g];
+    m->seg_worker.resize(std::max<int64_t>(m->seg_off[std::max(vocab, 1)], 1));
+    m->seg_lid.resize(m->seg_worker.size());
+    {
+      std::vector<int64_t> cur(m->seg_off.begin(), m->seg_off.end() - 1);
+      for (int32_t w = 0; w < K; ++w) {
+        StreamState& local = m->parts[w]->st;
+        for (int32_t lid = 0; lid < local.next_id; ++lid) {
+          if (!local.combiner[lid].df) continue;
+          const int64_t s = cur[l2g[w][lid]]++;
+          m->seg_worker[s] = w;
+          m->seg_lid[s] = lid;
+        }
+      }
+    }
+
+    int32_t width = 1;
+    for (int32_t g = 0; g < vocab; ++g)
+      width = std::max(width, static_cast<int32_t>(st.word_lens[g]));
+    m->width = width;
+    m->vocab_packed.assign(
+        std::max<int64_t>(static_cast<int64_t>(vocab) * width, 1), 0);
+    for (int32_t g = 0; g < vocab; ++g)
+      std::memcpy(m->vocab_packed.data() + static_cast<int64_t>(g) * width,
+                  st.arena.data() + st.word_offsets[g], st.word_lens[g]);
+
+    m->emit_order.resize(std::max(vocab, 1));
+    BuildEmitOrder(st, m->df_gid.data(), m->emit_order.data(), m->letter_off);
+
+    if (stats) {
+      stats->raw_tokens = m->raw_tokens;
+      stats->num_pairs = m->num_pairs;
+      stats->vocab_size = vocab;
+      stats->reserved = 0;
+      stats->bytes_written = 0;
+      stats->scan_ns = 0;
+      stats->finalize_ns =
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - t0)
+              .count();
+      stats->emit_ns = 0;
+    }
+  } catch (...) {
+    delete m;
+    throw;
+  }
+  return m;
+} catch (const std::bad_alloc&) {
+  return nullptr;
+}
+
+void mri_hidxm_free(void* mh) {
+  delete static_cast<HostMergeState*>(mh);
+}
+
+// Render letter files [letter_lo, letter_hi).  Returns bytes written,
+// -1 on IO/range error, -2 on OOM.  Reads only shared merge state plus
+// the workers' immutable runs: safe for concurrent reducer threads.
+int64_t mri_hidxm_emit_range(void* mh, int32_t letter_lo, int32_t letter_hi,
+                             const char* out_dir) try {
+  HostMergeState& m = *static_cast<HostMergeState*>(mh);
+  if (letter_lo < 0 || letter_hi > 26 || letter_lo > letter_hi) return -1;
+  if (letter_lo == letter_hi) return 0;  // empty partition (R > 26)
+  const int64_t idx_start = m.letter_off[letter_lo];
+  const int64_t idx_end = m.letter_off[letter_hi];
+
+  // Range-scoped postings: gather each in-range term's worker runs and
+  // restore global doc-ascending order by chaining inplace_merge over
+  // the (ascending, disjoint) runs.
+  std::vector<int64_t> off(std::max(m.vocab, 1), 0);
+  std::vector<int64_t> cnt(std::max(m.vocab, 1), 0);
+  int64_t range_df = 0;
+  for (int64_t i = idx_start; i < idx_end; ++i)
+    range_df += m.df_gid[m.emit_order[i]];
+  std::vector<int32_t> flat(std::max<int64_t>(range_df, 1));
+  int64_t cur = 0;
+  for (int64_t i = idx_start; i < idx_end; ++i) {
+    const int64_t g = m.emit_order[i];
+    off[g] = cur;
+    cnt[g] = m.df_gid[g];
+    const int64_t term_start = cur;
+    for (int64_t s = m.seg_off[g]; s < m.seg_off[g + 1]; ++s) {
+      const HostStreamState& h = *m.parts[m.seg_worker[s]];
+      const int32_t lid = m.seg_lid[s];
+      const int64_t lo = h.local_off[lid];
+      const int64_t n = h.local_off[lid + 1] - lo;
+      std::copy(h.local_flat.begin() + lo, h.local_flat.begin() + lo + n,
+                flat.begin() + cur);
+      if (cur != term_start)
+        std::inplace_merge(flat.begin() + term_start, flat.begin() + cur,
+                           flat.begin() + cur + n);
+      cur += n;
+    }
+  }
+  const EmitRun run{nullptr, flat.data(), off.data(), cnt.data()};
+  return EmitLettersRuns(m.vocab_packed.data(), m.vocab, m.width,
+                         m.emit_order.data(), &run, 1, out_dir,
+                         m.st->word_lens.data(), m.max_doc_id,
+                         letter_lo, letter_hi, idx_start, idx_end);
 } catch (const std::bad_alloc&) {
   return -2;
 }

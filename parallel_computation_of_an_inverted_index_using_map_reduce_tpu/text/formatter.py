@@ -70,23 +70,36 @@ def emit_index(
     so no host ever assembles the global index.
 
     ``backend`` selects the writer: ``"native"`` requires the C++
-    vectorized emit, ``"auto"`` uses it when available (full letter
-    range only — the native core always writes all 26 files), and
-    ``"python"`` is this module's pure-Python oracle.  All three are
-    byte-identical; the pure-Python path stays authoritative.
+    vectorized emit, ``"auto"`` uses it when available — for partial
+    ranges too, since the native core is letter-range-scoped (the
+    parallel reduce's per-reducer emit shares the same entry point) —
+    and ``"python"`` is this module's pure-Python oracle.  All three
+    are byte-identical; the pure-Python path stays authoritative.
     """
     output_dir = Path(output_dir)
     os.makedirs(output_dir, exist_ok=True)
     if backend not in ("python", "auto", "native"):
         raise ValueError(f"unknown emit backend {backend!r}")
-    if backend in ("auto", "native") and tuple(letter_range) == (0, ALPHABET_SIZE):
+    if backend in ("auto", "native"):
         from .. import native
 
         if native.load() is not None:
+            lr = (int(letter_range[0]), int(letter_range[1]))
+            if lr == (0, ALPHABET_SIZE):
+                idx_bounds = None
+                lines = int(np.asarray(order).shape[0])
+            else:
+                # the order is letter-partitioned: the range's slice is
+                # bounded by its letters' first/last positions
+                letters_in_order = np.asarray(letter_of_term)[order]
+                s, e = np.searchsorted(letters_in_order, [lr[0], lr[1]])
+                idx_bounds = (int(s), int(e))
+                lines = int(e - s)
             bytes_written = native.emit_native(
-                output_dir, np.asarray(vocab), order, df, offsets, postings)
-            return {"lines_written": int(np.asarray(order).shape[0]),
-                    "letters": ALPHABET_SIZE,
+                output_dir, np.asarray(vocab), order, df, offsets, postings,
+                letter_range=lr, idx_bounds=idx_bounds)
+            return {"lines_written": lines,
+                    "letters": lr[1] - lr[0],
                     "bytes_written": int(bytes_written),
                     "emit_backend": "native"}
         if backend == "native":

@@ -30,6 +30,7 @@ from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
     IndexConfig,
     build_index,
     faults,
+    native,
     oracle_index,
     read_manifest,
 )
@@ -458,6 +459,38 @@ def _sigkill_resume_case(tmp_path, window):
 def test_sigkill_at_window_boundary_resume_byte_identical(
         tmp_path, window):
     _sigkill_resume_case(tmp_path, window)
+
+
+# The same crash discipline on the PIPELINED CPU path, at every worker
+# count: the executor's reader threads fire the window-boundary hook
+# with the GLOBAL plan index, so `sigkill:window=2` means the same
+# thing whether one worker or four are stealing windows.  The cpu path
+# has no checkpoint — durability is the atomic tmp+rename emit — so
+# the rerun rebuilds from scratch and must still be byte-identical.
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+@pytest.mark.parametrize("mappers,reducers", [(1, 1), (2, 2), (4, 3)])
+def test_cpu_sigkill_at_window_boundary_rerun_byte_identical(
+        tmp_path, mappers, reducers):
+    m = _corpus(tmp_path, texts=_KILL_TEXTS)
+    oracle_index(m, tmp_path / "clean")
+    golden = read_letter_files(tmp_path / "clean")
+    argv = [str(mappers), str(reducers), str(tmp_path / "list.txt"),
+            "--output-dir", str(tmp_path / "out"),
+            "--backend", "cpu", "--io-prefetch", "2", "--resume", "auto"]
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
+        + argv,
+        cwd=str(REPO_ROOT),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "MRI_CPU_WINDOW_BYTES": "1",  # one doc per window: 5 windows
+             faults.ENV_VAR: "sigkill:window=2"},
+        timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+    # the kill landed before finalize: no complete letter set on disk
+    assert not (tmp_path / "out" / "a.txt").exists()
+    assert main(argv) == 0
+    assert read_letter_files(tmp_path / "out") == golden
 
 
 @pytest.mark.slow

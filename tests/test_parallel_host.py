@@ -1,0 +1,279 @@
+"""Multi-worker host map/reduce: steal queue, (K, M) byte-identity,
+letter-partitioned parallel reduce, and counter/report merging.
+
+The invariant under test everywhere: scheduling — worker count, reducer
+count, steal interleaving — can reorder WORK but never BYTES.  Every
+(num_mappers, num_reducers) combination, under any seeded shuffle of the
+window hand-out order, must write exactly the oracle's letter files.
+"""
+
+import threading
+
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    faults,
+    native,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+    StealQueue,
+    plan_letter_ranges,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.models import (
+    inverted_index as mod,
+)
+
+pytestmark = pytest.mark.parallel_host
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+
+def _small_manifest(tmp_path, num_docs=29, seed=13):
+    docs = zipf_corpus(num_docs=num_docs, vocab_size=500,
+                      tokens_per_doc=60, seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    return read_manifest(tmp_path / "list.txt")
+
+
+# -- StealQueue -------------------------------------------------------
+
+
+def test_steal_queue_drains_complete_in_order():
+    windows = [(0, 3), (3, 7), (7, 9)]
+    q = StealQueue(windows)
+    assert len(q) == 3
+    assert q.pop_window() == (1, (0, 3))
+    assert q.pop_window() == (2, (3, 7))
+    assert q.pop_window() == (3, (7, 9))
+    assert q.pop_window() is None
+    assert q.pop_window() is None  # drained stays drained
+    assert len(q) == 0
+
+
+def test_steal_queue_shuffle_keeps_global_indices():
+    windows = [(i, i + 1) for i in range(10)]
+    q = StealQueue(windows, shuffle_seed=7)
+    popped = []
+    while (item := q.pop_window()) is not None:
+        popped.append(item)
+    # every window handed out exactly once, each with its PLAN index
+    assert sorted(popped) == [(i + 1, (i, i + 1)) for i in range(10)]
+    # and the seed actually shuffles (order differs from the plan)
+    assert popped != sorted(popped)
+    # same seed, same order: deterministic injection/repro contract
+    q2 = StealQueue(windows, shuffle_seed=7)
+    popped2 = [q2.pop_window() for _ in range(10)]
+    assert popped2 == popped
+
+
+def test_steal_queue_concurrent_drain_no_loss_no_dup():
+    windows = [(i, i + 1) for i in range(200)]
+    q = StealQueue(windows)
+    taken = [[] for _ in range(4)]
+
+    def worker(w):
+        while (item := q.pop_window()) is not None:
+            taken[w].append(item)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    merged = sorted(x for part in taken for x in part)
+    assert merged == [(i + 1, (i, i + 1)) for i in range(200)]
+
+
+# -- plan_letter_ranges edges -----------------------------------------
+
+
+@pytest.mark.parametrize("num_reducers", [1, 2, 3, 13, 26, 27, 100])
+def test_letter_ranges_partition_exactly(num_reducers):
+    """The union of reducer ranges is [0, 26) with no overlap at any M,
+    including the reference's degenerate M > 26 regime."""
+    ranges = plan_letter_ranges(num_reducers)
+    assert len(ranges) == num_reducers
+    covered = []
+    for lo, hi in ranges:
+        assert 0 <= lo <= hi <= 26
+        covered.extend(range(lo, hi))
+    assert covered == list(range(26))
+
+
+def test_letter_ranges_over_26_all_letters_on_last():
+    ranges = plan_letter_ranges(30)
+    assert all(lo == hi for lo, hi in ranges[:-1])
+    assert ranges[-1] == (0, 26)
+
+
+# -- native merge parity ----------------------------------------------
+
+
+@needs_native
+def test_host_merge_matches_single_stream(tmp_path):
+    docs = zipf_corpus(num_docs=31, vocab_size=400, tokens_per_doc=50,
+                      seed=4)
+    contents = [d.encode() if isinstance(d, str) else d for d in docs]
+    doc_ids = list(range(1, len(contents) + 1))
+
+    with native.HostIndexStream() as single:
+        single.feed(contents, doc_ids)
+        stats = single.finalize_emit(tmp_path / "single")
+    golden = read_letter_files(tmp_path / "single")
+
+    streams = [native.HostIndexStream() for _ in range(3)]
+    try:
+        for i, (c, d) in enumerate(zip(contents, doc_ids)):
+            streams[i % 3].feed([c], [d])
+        for s in streams:
+            p = s.partial()
+            assert p["partial_ms"] >= 0.0
+        with native.HostIndexMerge(streams) as merge:
+            total = sum(merge.emit_range(lo, hi, tmp_path / "merged")
+                        for lo, hi in plan_letter_ranges(5))
+            mstats = merge.stats()
+    finally:
+        for s in streams:
+            s.close()
+    assert read_letter_files(tmp_path / "merged") == golden
+    assert total == stats["bytes_written"]
+    assert mstats["unique_terms"] == stats["unique_terms"]
+    assert mstats["tokens"] == stats["tokens"]
+    assert mstats["unique_pairs"] == stats["unique_pairs"]
+
+
+@needs_native
+def test_host_merge_out_of_window_order_feed(tmp_path):
+    """A worker that consumed its windows in stolen (non-plan) order
+    still merges byte-identically — partial() re-sorts each run."""
+    docs = zipf_corpus(num_docs=19, vocab_size=300, tokens_per_doc=40,
+                      seed=6)
+    contents = [d.encode() if isinstance(d, str) else d for d in docs]
+    doc_ids = list(range(1, len(contents) + 1))
+    with native.HostIndexStream() as single:
+        single.feed(contents, doc_ids)
+        single.finalize_emit(tmp_path / "single")
+    golden = read_letter_files(tmp_path / "single")
+
+    s = native.HostIndexStream()
+    try:
+        for c, d in reversed(list(zip(contents, doc_ids))):
+            s.feed([c], [d])
+        with native.HostIndexMerge([s]) as merge:
+            merge.emit_range(0, 26, tmp_path / "rev")
+    finally:
+        s.close()
+    assert read_letter_files(tmp_path / "rev") == golden
+
+
+# -- end-to-end (K, M) matrix -----------------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("mappers", [1, 2, 4])
+@pytest.mark.parametrize("reducers", [1, 3, 26])
+def test_parallel_cpu_matrix_matches_oracle(tmp_path, monkeypatch,
+                                            mappers, reducers):
+    monkeypatch.setattr(mod.InvertedIndexModel, "_CPU_WINDOW_BYTES", 1 << 9)
+    m = _small_manifest(tmp_path)
+    oracle_index(m, tmp_path / "oracle")
+    out = tmp_path / f"k{mappers}m{reducers}"
+    r = build_index(m, IndexConfig(backend="cpu", num_mappers=mappers,
+                                   num_reducers=reducers, io_prefetch=2),
+                    output_dir=out)
+    assert read_letter_files(out) == read_letter_files(tmp_path / "oracle")
+    # --host-threads plumbing regression: the pipelined path reports
+    # the RESOLVED worker count, not a hardwired 1
+    assert r["host_threads"] == mappers
+    assert r["io_windows"] > mappers  # the plan actually shards
+    if mappers > 1 or reducers > 1:
+        assert r["reduce_workers"] == reducers
+        assert len(r["stage_read_ms_per_worker"]) == mappers
+        assert len(r["stage_tokenize_ms_per_worker"]) == mappers
+        assert len(r["stage_emit_ms_per_reducer"]) == reducers
+    for key in ("stage_read_ms", "stage_tokenize_ms", "stage_emit_ms"):
+        assert key in r
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [1, 42, 20260805])
+def test_steal_order_shuffle_never_changes_output(tmp_path, monkeypatch,
+                                                  seed):
+    """Adversarial scheduling: hand windows to workers in seeded-random
+    order and the emitted bytes must not move."""
+    monkeypatch.setattr(mod.InvertedIndexModel, "_CPU_WINDOW_BYTES", 1 << 9)
+    m = _small_manifest(tmp_path, num_docs=37, seed=2)
+    oracle_index(m, tmp_path / "oracle")
+    monkeypatch.setenv("MRI_STEAL_SHUFFLE_SEED", str(seed))
+    out = tmp_path / f"shuf{seed}"
+    build_index(m, IndexConfig(backend="cpu", num_mappers=3,
+                               num_reducers=4, io_prefetch=2),
+                output_dir=out)
+    assert read_letter_files(out) == read_letter_files(tmp_path / "oracle")
+
+
+@needs_native
+def test_host_threads_flag_drives_workers(tmp_path):
+    """--host-threads wins over num_mappers, and the stats report it."""
+    m = _small_manifest(tmp_path, num_docs=11, seed=1)
+    r = build_index(m, IndexConfig(backend="cpu", num_mappers=1,
+                                   host_threads=3, io_prefetch=2),
+                    output_dir=tmp_path / "ht")
+    assert r["host_threads"] == 3
+    assert len(r["stage_read_ms_per_worker"]) == 3
+
+
+# -- DegradationReport merging ----------------------------------------
+
+
+def test_degradation_report_merge():
+    a = faults.DegradationReport()
+    b = faults.DegradationReport()
+    a.record_retry()
+    b.record_retry()
+    b.record_retry()
+    b.record_skip(doc_id=7, path="x", reason="boom")
+    a.merge(b)
+    a.merge(a)  # self-merge is a no-op, not a deadlock or double-count
+    s = a.summary()
+    assert s["read_retries"] == 3
+    assert s["skipped_docs"] == [7]
+    assert b.summary()["read_retries"] == 2  # source unchanged
+
+
+@needs_native
+def test_multi_worker_degraded_run_reports_all_skips(tmp_path):
+    """K workers, one unreadable doc: the skip lands in the run-scoped
+    report (merged from the worker's private report) and rides the
+    stats dict — the CLI's exit-3 source of truth."""
+    m = _small_manifest(tmp_path, num_docs=12, seed=3)
+    bad_doc = m.paths[5]
+    import os
+
+    os.unlink(bad_doc)  # hard skip: no retry can save it
+    try:
+        faults.install(None)
+        faults.begin_run()
+        r = build_index(m, IndexConfig(backend="cpu", num_mappers=3,
+                                       num_reducers=2, io_prefetch=2),
+                        output_dir=tmp_path / "deg")
+    finally:
+        faults.install(None)
+        faults.begin_run()
+    assert r["degradation"]["skipped_docs"] == [6]  # 1-based doc id
+    assert "6" in r["degradation"]["skip_reasons"]
