@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast test-faults test-parallel bench bench-scale bench-sweep capture rehearse clean
+.PHONY: build test test-fast test-faults test-parallel test-chaos bench bench-scale bench-sweep capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -33,6 +33,11 @@ test-faults:
 test-parallel:
 	$(PY) -m pytest tests/ -q -m parallel_host
 
+# chaos suite: the fast matrix cycle runs in tier-1 (`chaos and not
+# slow`); this target adds the full 50+-trial seeded soak
+test-chaos:
+	$(PY) -m pytest tests/ -q -m chaos
+
 bench:
 	$(PY) bench.py
 
@@ -56,6 +61,10 @@ capture:
 rehearse:
 	PY=$(PY) bash tools/rehearse.sh $(ROUND)
 
-clean:
+# drop only the hashed native build artifacts (stale .so files from
+# earlier tokenizer.cc revisions are also auto-pruned on every rebuild)
+clean-native:
 	rm -rf parallel_computation_of_an_inverted_index_using_map_reduce_tpu/native/_build
+
+clean: clean-native
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -648,6 +648,10 @@ def main() -> int:
     # tracked, and cpu rounds are ~50 ms each — sample enough that the
     # floor, not the scheduler, is what gets reported
     cpu = _measure("cpu", [{}], rounds=15)
+    # audited cpu run: what the --audit integrity layer costs on the
+    # same corpus (the report carries audit_ms; the contract is < 5 %
+    # of the unaudited cpu_ms)
+    cpu_audited = _measure("cpu", [{"audit": True}], rounds=3)
 
     if tpu is not None:
         value_ms, measured_backend = tpu["best_ms"], "tpu"
@@ -677,6 +681,11 @@ def main() -> int:
         # non-empty skipped_docs means the measurement itself is suspect
         "degradation": cpu.get("report", {}).get(
             "degradation", {"read_retries": 0, "skipped_docs": []}),
+        # integrity-audit overhead (--audit): ledger + merge invariants
+        # + output manifest, measured on a separate audited run
+        "audit_ms": round(
+            cpu_audited.get("report", {}).get("audit_ms", 0.0), 3),
+        "audited_cpu_ms": round(cpu_audited["best_ms"], 2),
         # host map-phase scaling curve (1/2/4 scan workers, same
         # corpus) with the per-worker stage split — tracked round over
         # round; host_cores qualifies what the curve can even show

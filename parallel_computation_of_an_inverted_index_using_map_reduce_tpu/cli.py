@@ -17,16 +17,52 @@ import os
 import sys
 
 from . import faults
+from .audit import AuditError, verify_output_dir
 from .config import IndexConfig
 from .corpus.manifest import read_manifest
 from .models.inverted_index import build_index
 from .utils.checkpoint import CheckpointCorrupt
+
+_EPILOG = """\
+exit codes:
+  0  clean run (output complete and, under --audit, integrity-checked)
+  2  error (bad arguments, I/O failure, integrity/audit failure)
+  3  degraded (completed, but skipped unreadable documents or lost
+     windows after exhausting retry/respawn budgets; see the
+     'degradation' block of --stats)
+
+fault-spec grammar (test/bench only; clauses joined by ';'):
+  read-error:doc=2:times=2       transient OSError, first 2 attempts
+  read-error:all:times=-1        permanent OSError on every doc
+  slow-read:doc=1:ms=50          sleep before the read
+  truncate:doc=4:bytes=10        document bytes cut short
+  reader-death:window=1          silent reader-thread death
+  sigkill:window=2               SIGKILL at stream window boundary
+  worker-death:worker=1:window=2 scan worker dies at a window (the
+                                 lease/requeue recovery rescans it)
+  worker-death:window=2          ... whichever worker scans window 2
+  reducer-death:reducer=0        reduce worker dies pre-emit (a
+                                 survivor re-emits its letter range)
+  scan-error:window=3            native scan failure on window 3
+  scan-error:window=3:silent=1   window silently dropped (--audit
+                                 catches the corruption)
+  chaos:seed=5:n=3               sample 3 faults deterministically
+                                 (bounds: windows= workers= reducers=
+                                 docs= kinds=a,b,c)
+
+verify mode:
+  mri-tpu --verify DIR           re-check DIR's letter files against
+                                 its index.manifest.json (written by
+                                 --audit runs); exit 0 ok, 2 mismatch
+"""
 
 
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mri-tpu",
         description="TPU-native inverted-index MapReduce",
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("num_mappers", type=int,
                    help="host shard count (reference mapper threads; "
@@ -111,13 +147,35 @@ def make_parser() -> argparse.ArgumentParser:
                         "rerun after SIGKILL mid-save)")
     p.add_argument("--fault-spec", default=None,
                    help="arm the deterministic fault injector (faults.py "
-                        "grammar, e.g. 'read-error:doc=2:times=2'; also "
+                        "grammar, e.g. 'read-error:doc=2:times=2' or "
+                        "'worker-death:window=2;chaos:seed=5:n=3'; also "
                         f"readable from ${faults.ENV_VAR}) — test/bench "
                         "only, never needed for production runs")
+    p.add_argument("--audit", action="store_true",
+                   help="integrity audit: per-window feed ledger + merge "
+                        "invariant checks before emit, and an "
+                        "index.manifest.json output manifest (per-file "
+                        "adler32) after it; audit failures exit 2, never "
+                        "silently wrong bytes")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    # --verify DIR is a standalone mode (no positionals): pre-parse it
+    # so 'mri-tpu --verify out/' works without dummy mapper counts.
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--verify" in argv:
+        i = argv.index("--verify")
+        if i + 1 >= len(argv):
+            print("error: --verify needs an output directory",
+                  file=sys.stderr)
+            return 2
+        ok, problems = verify_output_dir(argv[i + 1])
+        for line in problems:
+            print(f"verify: {line}", file=sys.stderr)
+        if ok:
+            print(f"verify: {argv[i + 1]} matches its index manifest")
+        return 0 if ok else 2
     args = make_parser().parse_args(argv)
     # Satellite: validate the reference positionals up front with ONE
     # clear line on stderr — not an IndexConfig traceback, not a
@@ -166,9 +224,15 @@ def main(argv: list[str] | None = None) -> int:
             emit_backend=args.emit_backend,
             io_prefetch=args.io_prefetch,
             resume=args.resume,
+            audit=args.audit,
         )
         stats = build_index(manifest, config)
+    except AuditError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except (OSError, ValueError, CheckpointCorrupt) as e:
+        # Covers RetryPolicy.from_env too: a bad MRI_READ_* value is a
+        # one-line configuration error, not a worker-thread traceback.
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.stats:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
+import time
 
 from ..config import ALPHABET_SIZE
 from .manifest import Manifest
@@ -139,6 +140,23 @@ class StealQueue:
     deterministic under any worker interleaving, and ``shuffle_seed``
     deliberately scrambles hand-out order — the output-invariance tests
     use it to prove scheduling can never change the emitted bytes.
+
+    Lease/ack semantics (the in-run fault-tolerance layer): a popped
+    window is LEASED to the popping worker and only retired by
+    :meth:`ack`.  When a worker dies, :meth:`fail_worker` requeues
+    every window attributed to it — outstanding leases AND windows it
+    already completed, because its partial native handle (holding
+    those windows' postings) is discarded with it — and blacklists the
+    worker so a zombie thread that wakes up later pops nothing more.
+    Requeued windows keep their global plan index, so a rescan by any
+    survivor merges byte-identically; re-execution is MapReduce's
+    defining recovery move (a failed task is rescheduled, the job
+    completes with identical output).
+
+    Callers that never ack (the single-reader plan mode, older tests)
+    see the original contract unchanged: ``pop_window()`` with no
+    worker drains in order and ``len(q)`` counts windows not yet
+    handed out.
     """
 
     def __init__(self, windows, shuffle_seed: int | None = None):
@@ -148,15 +166,70 @@ class StealQueue:
         self._items = items
         self._pos = 0
         self._lock = threading.Lock()
+        self._window_of = {wi: w for wi, w in items}
+        self._leases: dict[int, tuple[object, float]] = {}  # wi -> (worker, t)
+        self._completed: dict[int, object] = {}             # wi -> worker
+        self._failed: set = set()                           # retired workers
 
-    def pop_window(self) -> tuple[int, tuple[int, int]] | None:
-        """Next ``(global_index, (lo, hi))``, or None when drained."""
+    def pop_window(self, worker=None) -> tuple[int, tuple[int, int]] | None:
+        """Next ``(global_index, (lo, hi))``, or None when drained.
+
+        ``worker`` attributes the lease; a worker retired by
+        :meth:`fail_worker` gets None forever (closes the race where a
+        hung reader wakes up after its windows were already requeued
+        and would otherwise strand a fresh lease)."""
         with self._lock:
+            if worker is not None and worker in self._failed:
+                return None
             if self._pos >= len(self._items):
                 return None
             item = self._items[self._pos]
             self._pos += 1
+            self._leases[item[0]] = (worker, time.monotonic())
             return item
+
+    def ack(self, window_index: int, worker=None) -> None:
+        """Retire a completed window (idempotent).  A retired worker's
+        late ack is dropped — its windows were already requeued."""
+        with self._lock:
+            lease = self._leases.pop(window_index, None)
+            owner = lease[0] if lease is not None else worker
+            if owner is not None and owner in self._failed:
+                return
+            self._completed[window_index] = owner
+
+    def fail_worker(self, worker) -> list[int]:
+        """Requeue every window attributed to ``worker`` and retire it.
+
+        Returns the requeued global window indices (sorted).  Both
+        outstanding leases and completed windows come back: the dead
+        worker's native handle — the only place its completed windows'
+        postings lived — is discarded by the caller."""
+        with self._lock:
+            self._failed.add(worker)
+            back = [wi for wi, (w, _) in self._leases.items() if w == worker]
+            back += [wi for wi, w in self._completed.items() if w == worker]
+            back.sort()
+            for wi in back:
+                self._leases.pop(wi, None)
+                self._completed.pop(wi, None)
+                self._items.append((wi, self._window_of[wi]))
+            return back
+
+    def expired_workers(self, deadline_s: float) -> set:
+        """Workers holding any lease older than ``deadline_s`` — the
+        per-window deadline watchdog's trigger set (a worker wedged in
+        a hung read/scan past the deadline is treated as dead)."""
+        now = time.monotonic()
+        with self._lock:
+            return {w for w, t in self._leases.values()
+                    if w is not None and w not in self._failed
+                    and now - t > deadline_s}
+
+    def outstanding(self) -> int:
+        """Leased-but-unacked window count (0 after a clean drain)."""
+        with self._lock:
+            return len(self._leases)
 
     def __len__(self) -> int:
         with self._lock:

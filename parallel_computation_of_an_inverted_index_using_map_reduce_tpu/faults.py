@@ -26,11 +26,29 @@ Three layers live here:
         sigkill:window=2             SIGKILL at stream window boundary
         stream-crash:window=2        RuntimeError from the stream engine
         ckpt-corrupt:save=1          corrupt checkpoint bytes post-save
+        worker-death:worker=1:window=2  scan worker dies at window 2
+        worker-death:window=2        ... whichever worker scans window 2
+        reducer-death:reducer=0      reduce worker 0 dies before emit
+        scan-error:window=3          native scan failure on window 3
+        scan-error:window=3:silent=1 window silently dropped (corruption)
+        chaos:seed=5:n=3             sample 3 faults from a seeded RNG
         seed=7                       RNG seed for ``p=`` rules
 
     ``doc`` / ``every`` match the 0-based manifest index; ``window``
     and ``save`` are 1-based ordinals (matching ``win_i`` in the
-    stream loop and "the Nth save").
+    stream loop and "the Nth save"); ``worker`` / ``reducer`` are the
+    0-based thread ordinals of the parallel host path.  Clauses join
+    with ``;`` into multi-fault schedules.  The death/scan kinds
+    default to ``times=1`` and their firing state is GLOBAL, so a
+    window requeued after a worker death does not re-kill the survivor
+    that rescans it — recovery converges.
+
+    ``chaos:seed=S:n=K`` expands at parse time into K concrete rules
+    sampled deterministically from ``seed`` — the soak harness's
+    randomized-but-reproducible fault schedules.  Optional bounds:
+    ``windows=`` / ``workers=`` / ``reducers=`` / ``docs=`` cap the
+    sampled ordinals, and ``kinds=a,b,c`` restricts the kinds drawn
+    (default: every recoverable kind).
 
 ``RetryPolicy``
     Bounded retries with exponential backoff and a per-document
@@ -83,9 +101,30 @@ class ReaderThreadDeath(BaseException):
     """
 
 
+class WorkerDeath(RuntimeError):
+    """Injected scan-worker death (``worker-death`` rule): escapes the
+    worker's scan loop like any real crash would, exercising the lease
+    requeue + respawn recovery in models/inverted_index."""
+
+
+class ScanError(RuntimeError):
+    """Injected native-scan failure on one window (``scan-error``
+    rule) — the recoverable form; ``silent=1`` drops the window
+    without raising instead, the corruption the audit ledger exists
+    to catch."""
+
+
 # -- injector ---------------------------------------------------------
 
 _READ_KINDS = ("read-error", "slow-read", "truncate")
+_DEATH_KINDS = ("reader-death", "sigkill", "stream-crash", "ckpt-corrupt",
+                "worker-death", "reducer-death", "scan-error", "chaos")
+
+#: What ``chaos:`` may sample by default — every kind the parallel host
+#: path recovers from in-run (sigkill is excluded: its story is the
+#: cross-run ``--resume=auto`` path, not in-run re-execution).
+CHAOS_KINDS = ("worker-death", "reducer-death", "scan-error",
+               "reader-death", "read-error", "slow-read")
 
 
 @dataclasses.dataclass
@@ -97,8 +136,20 @@ class _Rule:
     times: int = 1              # -1 = permanent (read-error)
     ms: float = 0.0             # slow-read
     bytes: int = 0              # truncate
-    window: int = 0             # reader-death / sigkill / stream-crash
+    window: int = 0             # reader-death / sigkill / stream-crash /
+                                # worker-death / scan-error (0 = any)
     save: int = 0               # ckpt-corrupt
+    worker: int | None = None   # worker-death (None = any worker)
+    reducer: int | None = None  # reducer-death (None = any reducer)
+    silent: int = 0             # scan-error: 1 = drop window, no raise
+    # chaos sampler bounds (chaos:seed=S:n=K clause only)
+    seed: int = 0
+    n: int = 0
+    windows: int = 8
+    workers: int = 4
+    reducers: int = 4
+    docs: int = 16
+    kinds: tuple = CHAOS_KINDS
 
 
 def _parse_int(kind: str, key: str, value: str) -> int:
@@ -123,8 +174,7 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             raise FaultSpecError("seed=N must be a clause of its own")
         return None
     rule = _Rule(kind=head)
-    if head not in _READ_KINDS + ("reader-death", "sigkill",
-                                  "stream-crash", "ckpt-corrupt"):
+    if head not in _READ_KINDS + _DEATH_KINDS:
         raise FaultSpecError(f"unknown fault kind {head!r}")
     for field in parts[1:]:
         if field == "all":
@@ -154,6 +204,32 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             rule.window = _parse_int(head, k, v)
         elif k == "save":
             rule.save = _parse_int(head, k, v)
+        elif k == "worker":
+            rule.worker = _parse_int(head, k, v)
+        elif k == "reducer":
+            rule.reducer = _parse_int(head, k, v)
+        elif k == "silent":
+            rule.silent = _parse_int(head, k, v)
+        elif k == "seed" and head == "chaos":
+            rule.seed = _parse_int(head, k, v)
+        elif k == "n" and head == "chaos":
+            rule.n = _parse_int(head, k, v)
+        elif k == "windows" and head == "chaos":
+            rule.windows = _parse_int(head, k, v)
+        elif k == "workers" and head == "chaos":
+            rule.workers = _parse_int(head, k, v)
+        elif k == "reducers" and head == "chaos":
+            rule.reducers = _parse_int(head, k, v)
+        elif k == "docs" and head == "chaos":
+            rule.docs = _parse_int(head, k, v)
+        elif k == "kinds" and head == "chaos":
+            kinds = tuple(s for s in v.split(",") if s)
+            bad = [s for s in kinds if s not in CHAOS_KINDS]
+            if bad:
+                raise FaultSpecError(
+                    f"chaos: kinds not samplable: {bad} "
+                    f"(choose from {list(CHAOS_KINDS)})")
+            rule.kinds = kinds
         else:
             raise FaultSpecError(f"{head}: unknown key {k!r}")
     if rule.kind in ("reader-death", "sigkill", "stream-crash") \
@@ -161,7 +237,54 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         raise FaultSpecError(f"{head} needs window=N (1-based)")
     if rule.kind == "ckpt-corrupt" and rule.save < 1:
         raise FaultSpecError("ckpt-corrupt needs save=N (1-based)")
+    if rule.kind == "scan-error" and rule.window < 1:
+        raise FaultSpecError("scan-error needs window=N (1-based)")
+    if rule.kind == "chaos":
+        if rule.n < 1:
+            raise FaultSpecError("chaos needs n=K (faults to sample)")
+        if min(rule.windows, rule.workers, rule.reducers, rule.docs) < 1 \
+                or not rule.kinds:
+            raise FaultSpecError("chaos bounds must be >= 1")
     return rule
+
+
+def _sample_chaos(rule: _Rule) -> list[_Rule]:
+    """Expand one ``chaos:seed=S:n=K`` clause into K concrete rules.
+
+    Deterministic in ``seed`` (the soak harness's repro contract).
+    Every sampled rule keeps the default ``times=1`` budget, so a
+    schedule is a finite set of one-shot faults — recovery always has
+    a fixed point to converge to.  Permanent read-errors (the degraded
+    exit-3 arm) are sampled with times=-1 occasionally.
+    """
+    rng = random.Random(rule.seed)
+    out: list[_Rule] = []
+    for _ in range(rule.n):
+        kind = rng.choice(rule.kinds)
+        if kind == "worker-death":
+            # mostly any-worker (fires for whoever scans the window, so
+            # the fault is guaranteed to land); occasionally pinned
+            worker = rng.randrange(rule.workers) if rng.random() < 0.25 \
+                else None
+            out.append(_Rule(kind=kind, worker=worker,
+                             window=rng.randint(1, rule.windows)))
+        elif kind == "reducer-death":
+            out.append(_Rule(kind=kind,
+                             reducer=rng.randrange(rule.reducers)))
+        elif kind == "scan-error":
+            out.append(_Rule(kind=kind,
+                             window=rng.randint(1, rule.windows)))
+        elif kind == "reader-death":
+            out.append(_Rule(kind=kind,
+                             window=rng.randint(1, rule.windows)))
+        elif kind == "read-error":
+            out.append(_Rule(kind=kind, doc=rng.randrange(rule.docs),
+                             times=rng.choice((1, 2, 2, -1))))
+        else:  # slow-read
+            out.append(_Rule(kind="slow-read",
+                             doc=rng.randrange(rule.docs),
+                             ms=float(rng.choice((2, 5, 10)))))
+    return out
 
 
 class FaultInjector:
@@ -175,7 +298,11 @@ class FaultInjector:
         self.rules: list[_Rule] = []
         for clause in spec.split(";"):
             rule = _parse_clause(clause, kv_global)
-            if rule is not None:
+            if rule is None:
+                continue
+            if rule.kind == "chaos":
+                self.rules.extend(_sample_chaos(rule))
+            else:
                 self.rules.append(rule)
         if not self.rules and "seed" not in kv_global:
             raise FaultSpecError(f"empty fault spec {spec!r}")
@@ -226,10 +353,16 @@ class FaultInjector:
 
     def on_reader_window(self, window: int) -> None:
         """Fires in the executor's reader thread before window
-        ``window`` (1-based) is read; may raise ReaderThreadDeath."""
-        for rule in self.rules:
-            if rule.kind == "reader-death" and rule.window == window:
-                raise ReaderThreadDeath()
+        ``window`` (1-based) is read; may raise ReaderThreadDeath.
+        The firing budget is GLOBAL (``times=1`` by default) like the
+        other death kinds: when the parallel host path requeues the
+        dead reader's windows, the survivor that re-reads this window
+        must not die of the same injection — recovery converges."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind == "reader-death" and rule.window == window:
+                    if self._fire_once(ri, rule):
+                        raise ReaderThreadDeath()
 
     def on_window_boundary(self, window: int) -> None:
         """Fires after window ``window`` completes — on the stream
@@ -252,6 +385,72 @@ class FaultInjector:
                 raise RuntimeError(
                     f"injected stream crash after window {window} "
                     "(fault spec)")
+
+    def _fire_once(self, ri: int, rule: _Rule) -> bool:
+        """Global once-per-rule firing budget (``times``), shared across
+        workers: a requeued window rescanned by a survivor must NOT
+        re-trigger the fault that killed the first worker, or recovery
+        could never converge.  Caller holds ``self._lock``."""
+        key = (ri, 0)
+        n = self._fired.get(key, 0)
+        if rule.times < 0 or n < rule.times:
+            self._fired[key] = n + 1
+            return True
+        return False
+
+    def on_worker_window(self, worker: int, window: int) -> None:
+        """Fires in scan worker ``worker`` (0-based) as it picks up
+        window ``window`` (1-based global plan index); may raise
+        :class:`WorkerDeath` — the in-run worker-crash injection the
+        lease/requeue recovery is proven against."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "worker-death":
+                    continue
+                if rule.window and rule.window != window:
+                    continue
+                if rule.worker is not None and rule.worker != worker:
+                    continue
+                if self._fire_once(ri, rule):
+                    raise WorkerDeath(
+                        f"injected worker death: worker {worker} at "
+                        f"window {window} (fault spec)")
+
+    def on_scan_window(self, window: int) -> bool:
+        """Fires in the scan worker before window ``window`` is fed to
+        the native scan.  May raise :class:`ScanError` (recoverable —
+        the worker dies and the window is rescanned), or return True
+        for ``silent=1`` rules: the caller drops the window without
+        any error, the silent corruption ``--audit`` must catch."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "scan-error" or rule.window != window:
+                    continue
+                if not self._fire_once(ri, rule):
+                    continue
+                if rule.silent:
+                    log.warning("fault injection: silently dropping "
+                                "window %d from the scan", window)
+                    return True
+                raise ScanError(
+                    f"injected native scan failure on window {window} "
+                    "(fault spec)")
+        return False
+
+    def on_reducer(self, reducer: int) -> None:
+        """Fires in reduce worker ``reducer`` (0-based) before it emits
+        its letter range; may raise — the dead reducer whose range a
+        surviving thread re-emits (takeover off the read-only merge)."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "reducer-death":
+                    continue
+                if rule.reducer is not None and rule.reducer != reducer:
+                    continue
+                if self._fire_once(ri, rule):
+                    raise RuntimeError(
+                        f"injected reducer death: reducer {reducer} "
+                        "(fault spec)")
 
     def on_checkpoint_saved(self, path: str) -> None:
         """Fires after every atomic checkpoint save; the Nth save may
@@ -315,11 +514,31 @@ class RetryPolicy:
     @classmethod
     def from_env(cls) -> "RetryPolicy":
         """Knobs: MRI_READ_RETRIES (attempts), MRI_READ_BACKOFF_MS,
-        MRI_READ_DEADLINE_S."""
+        MRI_READ_DEADLINE_S.
+
+        Invalid values raise a one-line ValueError naming the variable
+        (the CLI maps it to exit 2) instead of surfacing a bare
+        ``int()`` traceback three layers down a worker thread.
+        """
+        def _env(name, default, cast, minimum, exclusive):
+            raw = os.environ.get(name)
+            if raw is None:
+                return default
+            try:
+                val = cast(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{name}={raw!r} is not a valid "
+                    f"{cast.__name__}") from None
+            if val < minimum or (exclusive and val == minimum):
+                bound = f"> {minimum}" if exclusive else f">= {minimum}"
+                raise ValueError(f"{name} must be {bound}, got {raw!r}")
+            return val
+
         return cls(
-            max_attempts=int(os.environ.get("MRI_READ_RETRIES", 3)),
-            backoff_s=float(os.environ.get("MRI_READ_BACKOFF_MS", 5)) / 1e3,
-            deadline_s=float(os.environ.get("MRI_READ_DEADLINE_S", 1.0)),
+            max_attempts=_env("MRI_READ_RETRIES", 3, int, 1, False),
+            backoff_s=_env("MRI_READ_BACKOFF_MS", 5.0, float, 0, False) / 1e3,
+            deadline_s=_env("MRI_READ_DEADLINE_S", 1.0, float, 0, True),
         )
 
     def run(self, fn, *, doc_id: int | None = None, path: str = "",
@@ -357,6 +576,13 @@ class DegradationReport:
         self._lock = threading.Lock()
         self.read_retries = 0
         self.skips: list[dict] = []  # {"doc_id", "path", "reason"}
+        # In-run fault-tolerance tallies (models/inverted_index
+        # parallel host path): a recovered worker death leaves the
+        # output byte-identical, so these are the only observable
+        # trace that recovery ran at all.
+        self.worker_recoveries = 0
+        self.windows_requeued = 0
+        self.reducer_takeovers = 0
 
     def record_retry(self, *, doc_id: int | None = None,
                      path: str = "") -> None:
@@ -371,6 +597,19 @@ class DegradationReport:
             self.skips.append(
                 {"doc_id": doc_id, "path": path, "reason": reason})
 
+    def record_worker_recovery(self, *, windows_requeued: int = 0) -> None:
+        """One scan worker died and its windows went back to the pool
+        (survivors or a respawned replacement rescan them)."""
+        with self._lock:
+            self.worker_recoveries += 1
+            self.windows_requeued += int(windows_requeued)
+
+    def record_reducer_takeover(self) -> None:
+        """One dead reducer's letter range was re-emitted by a
+        surviving thread (atomic tmp+rename makes the re-emit safe)."""
+        with self._lock:
+            self.reducer_takeovers += 1
+
     def merge(self, other: "DegradationReport") -> None:
         """Fold ``other``'s tallies into this report (thread-safe on
         both sides).  The multi-worker host path gives each scan worker
@@ -383,9 +622,15 @@ class DegradationReport:
         with other._lock:
             retries = other.read_retries
             skips = list(other.skips)
+            recoveries = other.worker_recoveries
+            requeued = other.windows_requeued
+            takeovers = other.reducer_takeovers
         with self._lock:
             self.read_retries += retries
             self.skips.extend(skips)
+            self.worker_recoveries += recoveries
+            self.windows_requeued += requeued
+            self.reducer_takeovers += takeovers
 
     @property
     def degraded(self) -> bool:
@@ -403,11 +648,23 @@ class DegradationReport:
                 "skipped_docs": [s["doc_id"] for s in self.skips],
                 "skip_reasons": {
                     str(s["doc_id"]): s["reason"] for s in self.skips},
+                "worker_recoveries": self.worker_recoveries,
+                "windows_requeued": self.windows_requeued,
+                "reducer_takeovers": self.reducer_takeovers,
             }
 
     def log_summary(self, logger: logging.Logger = log) -> None:
         """ONE counted line for the whole run — per-document warnings
         are deduplicated here (each skip is DEBUG-logged at the site)."""
+        with self._lock:
+            recoveries = self.worker_recoveries
+            requeued = self.windows_requeued
+            takeovers = self.reducer_takeovers
+        if recoveries or takeovers:
+            logger.info(
+                "fault tolerance: recovered %d worker death(s) "
+                "(%d window(s) requeued), %d reducer takeover(s)",
+                recoveries, requeued, takeovers)
         if not self.degraded:
             return
         with self._lock:

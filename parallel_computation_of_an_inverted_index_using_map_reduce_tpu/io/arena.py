@@ -30,6 +30,9 @@ class WindowArena:
         self._ids = np.empty(max(int(doc_capacity), 1), dtype=np.int32)
         self.used_bytes = 0
         self.num_docs = 0
+        # global plan index of the window currently held (stamped by
+        # the executor's reader thread; 0 = not window-tagged)
+        self.window_index = 0
 
     def reset(self) -> "WindowArena":
         self.used_bytes = 0

@@ -77,11 +77,16 @@ class PipelinedWindowReader:
                  arenas: list[WindowArena] | None = None,
                  watchdog_s: float | None = 30.0,
                  policy: "faults.RetryPolicy | None" = None,
-                 report: "faults.DegradationReport | None" = None):
+                 report: "faults.DegradationReport | None" = None,
+                 worker: int | None = None):
         self._manifest = manifest
         # a shared StealQueue (duck-typed on pop_window) or a plan list
         self._queue = windows if hasattr(windows, "pop_window") else None
         self._windows = [] if self._queue is not None else list(windows)
+        # lease attribution under the steal-queue schedule: pops are
+        # charged to this worker id so a worker death can requeue
+        # exactly its windows (scheduler.StealQueue.fail_worker)
+        self._worker = worker
         self._depth = max(int(depth), 1)
         self._watchdog_s = watchdog_s
         self.policy = policy if policy is not None else faults.default_policy()
@@ -124,7 +129,10 @@ class PipelinedWindowReader:
             yield from enumerate(self._windows, start=1)
             return
         while True:
-            item = self._queue.pop_window()
+            if self._worker is not None:
+                item = self._queue.pop_window(worker=self._worker)
+            else:
+                item = self._queue.pop_window()
             if item is None:
                 return
             yield item
@@ -144,6 +152,9 @@ class PipelinedWindowReader:
                 read_window_into(self._manifest, lo, hi, arena,
                                  policy=self.policy, report=self.report)
                 self.read_busy_s += time.perf_counter() - t0
+                # the consumer needs the global plan index to ack the
+                # lease (and the audit ledger keys on it)
+                arena.window_index = wi
                 self._ready.put(arena)
                 # window wi is now fully read and handed downstream —
                 # the crash-injection boundary the SIGKILL e2e tests

@@ -16,6 +16,7 @@ Output is byte-identical across backends and to the pthread reference
 from __future__ import annotations
 
 import contextlib
+import logging
 import math
 import os
 import time
@@ -38,6 +39,7 @@ from ..utils.rounding import round_up as _round_up
 from ..utils.timing import PhaseTimer
 from .oracle import oracle_index
 
+log = logging.getLogger("mri_tpu.model")
 
 
 def _profile_ctx(profile_dir):
@@ -96,8 +98,23 @@ class InvertedIndexModel:
         # documents were skipped) and the bench JSON.
         report = faults.begin_run()
         stats = self._run_dispatch(manifest, output_dir)
+        if self.config.audit:
+            # Output manifest AFTER emit (any backend): per-letter-file
+            # digests so --verify can re-check the directory later.
+            # Manifest time counts toward audit_ms — the audit layer's
+            # whole cost must be measurable, not guessed.
+            from .. import audit as audit_mod
+
+            out_dir = (output_dir if output_dir is not None
+                       else self.config.output_dir)
+            t0 = time.perf_counter()
+            audit_mod.write_output_manifest(out_dir)
+            stats["audit_ms"] = round(
+                stats.get("audit_ms", 0.0)
+                + (time.perf_counter() - t0) * 1e3, 3)
         stats["degradation"] = report.summary()
-        if report.degraded:
+        if report.degraded or report.worker_recoveries \
+                or report.reducer_takeovers:
             report.log_summary()
         return stats
 
@@ -244,9 +261,25 @@ class InvertedIndexModel:
         reducer renders its range through the shared vectorized emit.
         Output is byte-identical to the single-worker path at every
         (K, M) — scheduling can reorder work, never bytes.
+
+        Fault tolerance (the MapReduce re-execution move): windows are
+        LEASED, not given away — a worker death (escaping exception,
+        :class:`~..io.executor.ReaderDied`, or the optional
+        ``MRI_WINDOW_DEADLINE_S`` lease watchdog) requeues everything
+        attributed to it, completed windows included (its native handle
+        dies with it), and survivors rescan; when the queue is left
+        non-empty after the join, up to ``MRI_WORKER_RESPAWNS``
+        (default 1) replacement workers drain it.  Only with the budget
+        exhausted do the remaining windows' documents become recorded
+        skips (degraded exit 3).  A dead reducer's letter range is
+        re-emitted off the read-only merge state by the main thread
+        (emit is atomic tmp+rename per file, so re-emit is idempotent).
+        Recovered runs stay byte-identical: merge order is restored
+        from global plan indices, never arrival order.
         """
         import threading
 
+        from .. import audit as audit_mod
         from .. import native
         from ..corpus.scheduler import StealQueue, plan_letter_ranges
         from ..io.executor import PipelinedWindowReader
@@ -261,9 +294,15 @@ class InvertedIndexModel:
         queue = StealQueue(
             windows,
             shuffle_seed=int(shuffle_env) if shuffle_env else None)
+        deadline_env = os.environ.get("MRI_WINDOW_DEADLINE_S")
+        window_deadline_s = float(deadline_env) if deadline_env else None
+        respawns_left = max(0, int(os.environ.get("MRI_WORKER_RESPAWNS",
+                                                  "1")))
 
         # Per-worker arena rings, recycled across run() calls like the
-        # single-worker path's ring (invalidated when K or depth moves).
+        # single-worker path's ring (invalidated when K or depth moves,
+        # or after any recovery — a failed run's arenas may still be
+        # referenced by a leaked thread).
         rings = getattr(self, "_cpu_arena_rings", None)
         if rings is not None and (
                 len(rings) != K
@@ -277,53 +316,179 @@ class InvertedIndexModel:
         # a degraded run still reports every skipped doc id.
         run_report = faults.current_report()
         policy = faults.default_policy()
-        reports = [faults.DegradationReport() for _ in range(K)]
-        readers = [
-            PipelinedWindowReader(
+        ledger = audit_mod.WindowLedger() if cfg.audit else None
+        audit_s = 0.0  # in-path invariant-check time (--audit)
+        inj = faults.active()
+
+        # Workers live in growable slots (respawns append), not fixed
+        # arrays: each slot owns one reader + one native stream, and a
+        # ``failed`` slot's stream is excluded from the merge.
+        slots: list[dict] = []
+        fail_lock = threading.Lock()
+
+        def make_slot(w: int, arenas=None) -> dict:
+            rep = faults.DegradationReport()
+            slot = {
+                "id": w, "report": rep, "partial": None,
+                "fatal": None, "failed": False, "leaked": False,
+                "thread": None,
+                "stream": native.HostIndexStream(),
+            }
+            # reader last: its thread starts pulling windows immediately
+            slot["reader"] = PipelinedWindowReader(
                 manifest, queue, depth=cfg.io_prefetch,
                 byte_capacity=window_bytes + (window_bytes >> 2),
-                doc_capacity=max_docs, arenas=rings[w],
-                policy=policy, report=reports[w])
-            for w in range(K)
-        ]
-        self._cpu_arena_rings = [r.arenas for r in readers]
-        streams = [native.HostIndexStream() for _ in range(K)]
-        partials: list[dict | None] = [None] * K
-        errors: list[BaseException | None] = [None] * K
+                doc_capacity=max_docs, arenas=arenas,
+                policy=policy, report=rep, worker=w)
+            slots.append(slot)
+            return slot
 
-        def scan_worker(w: int) -> None:
-            reader, stream = readers[w], streams[w]
+        def fail_slot(slot: dict, reason: str) -> None:
+            """Idempotent worker-death transition: blacklist the worker,
+            requeue every window attributed to it (its native handle —
+            the only place those windows' postings live — is discarded
+            with it), and count the recovery."""
+            with fail_lock:
+                if slot["failed"]:
+                    return
+                slot["failed"] = True
+                requeued = queue.fail_worker(slot["id"])
+                if ledger is not None:
+                    ledger.discard_worker(slot["id"])
+                run_report.record_worker_recovery(
+                    windows_requeued=len(requeued))
+            log.warning(
+                "scan worker %d died (%s); requeued %d window(s) %s for "
+                "rescan", slot["id"], reason, len(requeued), requeued)
+
+        def scan_worker(slot: dict) -> None:
+            w, reader, stream = slot["id"], slot["reader"], slot["stream"]
             try:
                 for arena in reader:
-                    buf, ends, ids = arena.feed_views()
-                    stream.feed_arrays(buf, ends, ids)
+                    wi = arena.window_index
+                    dropped = False
+                    if inj is not None:
+                        inj.on_worker_window(w, wi)
+                        dropped = inj.on_scan_window(wi)
+                    if not dropped:
+                        buf, ends, ids = arena.feed_views()
+                        stream.feed_arrays(buf, ends, ids)
+                        if ledger is not None:
+                            ledger.record(
+                                wi, worker=w, docs=int(arena.num_docs),
+                                nbytes=int(arena.used_bytes),
+                                checksum=audit_mod.window_checksum(
+                                    buf, ends, ids))
+                    queue.ack(wi, worker=w)
                     reader.recycle(arena)
                 # flatten this worker's postings runs here, inside the
                 # map phase's parallelism, not at the serial join
-                partials[w] = stream.partial()
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                errors[w] = e
+                slot["partial"] = stream.partial()
+            except (KeyboardInterrupt, SystemExit) as e:
+                # not a worker fault: requeue for bookkeeping but carry
+                # the exception out of the scan phase
+                slot["fatal"] = e
+                fail_slot(slot, type(e).__name__)
+            except BaseException as e:  # noqa: BLE001 — recovery path
+                fail_slot(slot, f"{type(e).__name__}: {e}")
+                reader.close()  # unstick + retire this slot's reader
 
         merge = None
+        empty_stream = None
         try:
             with timer.phase("ingest_scan"):
-                threads = [
-                    threading.Thread(target=scan_worker, args=(w,),
-                                     name=f"scan-worker-{w}")
-                    for w in range(1, K)
-                ]
-                for t in threads:
+                for w in range(K):
+                    make_slot(w, arenas=rings[w])
+                for slot in slots[1:]:
+                    t = threading.Thread(
+                        target=scan_worker, args=(slot,),
+                        name=f"scan-worker-{slot['id']}", daemon=True)
+                    slot["thread"] = t
                     t.start()
-                scan_worker(0)  # the caller's thread is worker 0
-                for t in threads:
-                    t.join()
-            for rep in reports:
-                run_report.merge(rep)
-            for err in errors:
-                if err is not None:
-                    raise err
+                scan_worker(slots[0])  # the caller's thread is worker 0
+                # Join survivors; under MRI_WINDOW_DEADLINE_S a worker
+                # holding any lease past the deadline is retired in
+                # absentia (windows requeued) and its thread abandoned
+                # — "leaked": its native stream is never closed, since
+                # the wedged thread may still be inside a native call
+                # (a leak beats a use-after-free).
+                while True:
+                    waiting = [s for s in slots[1:]
+                               if s["thread"] is not None
+                               and s["thread"].is_alive()
+                               and not s["leaked"]]
+                    if not waiting:
+                        break
+                    for s in waiting:
+                        s["thread"].join(
+                            0.2 if window_deadline_s is not None else 60.0)
+                    if window_deadline_s is None:
+                        continue
+                    expired = queue.expired_workers(window_deadline_s)
+                    for s in slots:
+                        if s["id"] in expired and not s["failed"]:
+                            s["leaked"] = True
+                            fail_slot(s, "window lease deadline "
+                                         f"({window_deadline_s}s) expired")
+                # Requeued windows left after every worker exited (a
+                # death can land after survivors already drained out):
+                # respawn replacement workers, fresh ring + fresh native
+                # handle, on this thread, until the queue is dry or the
+                # budget is spent.  A replacement can die too — the loop
+                # handles it like any other worker death.
+                next_id = K
+                while len(queue) > 0 and respawns_left > 0:
+                    respawns_left -= 1
+                    log.warning(
+                        "respawning scan worker %d to rescan %d "
+                        "requeued window(s)", next_id, len(queue))
+                    scan_worker(make_slot(next_id))
+                    next_id += 1
+                lost_windows: list[int] = []
+                if len(queue) > 0:
+                    # Budget exhausted with windows unscanned: the
+                    # honest degraded arm — record exactly which
+                    # documents were lost, then finish with what we
+                    # have (exit 3, never silence).
+                    while True:
+                        item = queue.pop_window()
+                        if item is None:
+                            break
+                        wi, (lo, hi) = item
+                        lost_windows.append(wi)
+                        for i in range(lo, hi):
+                            run_report.record_skip(
+                                doc_id=manifest.doc_id(i),
+                                path=manifest.paths[i],
+                                reason=f"window {wi} lost to worker "
+                                       "death (respawn budget "
+                                       "exhausted)")
+                    log.error(
+                        "worker respawn budget exhausted; %d window(s) "
+                        "%s lost", len(lost_windows), lost_windows)
+            for slot in slots:
+                run_report.merge(slot["report"])
+            for slot in slots:
+                if slot["fatal"] is not None:
+                    raise slot["fatal"]
+            live = [s["stream"] for s in slots if not s["failed"]]
+            if not live:
+                # every worker died: merge one empty stream so the
+                # reduce still writes the 26 (empty) letter files and
+                # the degraded report carries the whole story
+                empty_stream = native.HostIndexStream()
+                live = [empty_stream]
+            if ledger is not None:
+                t0 = time.perf_counter()
+                ledger.check_complete(len(windows),
+                                      missing_ok=lost_windows)
+                audit_s += time.perf_counter() - t0
             with timer.phase("finalize_emit"):
-                merge = native.HostIndexMerge(streams)
+                merge = native.HostIndexMerge(live)
+                if cfg.audit:
+                    t0 = time.perf_counter()
+                    audit_mod.check_merge(merge, live)
+                    audit_s += time.perf_counter() - t0
                 ranges = plan_letter_ranges(cfg.num_reducers)
                 emit_ms = [0.0] * len(ranges)
                 emit_bytes = [0] * len(ranges)
@@ -332,6 +497,8 @@ class InvertedIndexModel:
                 def reduce_worker(r: int, lo: int, hi: int) -> None:
                     t0 = time.perf_counter()
                     try:
+                        if inj is not None:
+                            inj.on_reducer(r)
                         emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
                     except BaseException as e:  # noqa: BLE001
                         emit_errors[r] = e
@@ -347,17 +514,41 @@ class InvertedIndexModel:
                 reduce_worker(0, *ranges[0])
                 for t in reducers:
                     t.join()
-                for err in emit_errors:
-                    if err is not None:
-                        raise err
+                # Reducer takeover: emit_range is read-only on the
+                # merge state and atomic per letter file, so a dead
+                # reducer's range is simply re-emitted here.  A second
+                # failure on the SAME range is a real I/O problem and
+                # raises (exit 2).
+                for r, err in enumerate(emit_errors):
+                    if err is None:
+                        continue
+                    lo, hi = ranges[r]
+                    log.warning(
+                        "reduce worker %d died (%s: %s); re-emitting "
+                        "letters [%d, %d) on the main thread",
+                        r, type(err).__name__, err, lo, hi)
+                    t0 = time.perf_counter()
+                    emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
+                    emit_ms[r] += (time.perf_counter() - t0) * 1e3
+                    run_report.record_reducer_takeover()
+                    emit_errors[r] = None
                 mstats = merge.stats()
         finally:
-            for reader in readers:
-                reader.close()
+            recovered = any(s["failed"] for s in slots)
+            for slot in slots:
+                slot["reader"].close()
             if merge is not None:
                 merge.close()
-            for stream in streams:
-                stream.close()
+            for slot in slots:
+                if not slot["leaked"]:
+                    slot["stream"].close()
+            if empty_stream is not None:
+                empty_stream.close()
+            # cache the rings only for a clean same-shape run
+            if recovered or len(slots) != K:
+                self._cpu_arena_rings = None
+            else:
+                self._cpu_arena_rings = [s["reader"].arenas for s in slots]
 
         for key, value in mstats.items():
             if key != "merge_ms":
@@ -366,9 +557,13 @@ class InvertedIndexModel:
         timer.count("reduce_workers", len(ranges))
         timer.count("io_windows", len(windows))
         timer.count("io_prefetch", cfg.io_prefetch)
-        read_ms = [round(r.read_busy_s * 1e3, 3) for r in readers]
-        tok_ms = [round(p["scan_ms"] + p["partial_ms"], 3)
-                  for p in partials if p is not None]
+        if cfg.audit:
+            timer.count("audit_ms", round(audit_s * 1e3, 3))
+        read_ms = [round(s["reader"].read_busy_s * 1e3, 3) for s in slots]
+        tok_ms = [round(s["partial"]["scan_ms"]
+                        + s["partial"]["partial_ms"], 3)
+                  for s in slots
+                  if s["partial"] is not None and not s["failed"]]
         timer.count("stage_read_ms", round(sum(read_ms), 3))
         timer.count("stage_tokenize_ms",
                     round(sum(tok_ms) + mstats["merge_ms"], 3))
@@ -379,9 +574,11 @@ class InvertedIndexModel:
                     [round(ms, 3) for ms in emit_ms])
         timer.count("merge_ms", round(mstats["merge_ms"], 3))
         timer.count("read_wait_ms",
-                    round(sum(r.read_wait_s for r in readers) * 1e3, 3))
+                    round(sum(s["reader"].read_wait_s
+                              for s in slots) * 1e3, 3))
         timer.count("consume_wait_ms",
-                    round(sum(r.consume_wait_s for r in readers) * 1e3, 3))
+                    round(sum(s["reader"].consume_wait_s
+                              for s in slots) * 1e3, 3))
         return timer.report()
 
     # -- TPU backend ---------------------------------------------------

@@ -102,6 +102,24 @@ def _build_dirs():
 _CXX_FLAGS = ["-O3", "-shared", "-fPIC"]
 
 
+def _prune_stale(d: Path, keep: str) -> None:
+    """Drop hashed builds other than ``keep`` (and orphaned .tmp files)
+    from a build dir — every source edit otherwise leaves a dead ~100 KB
+    artifact behind forever.  Best-effort: a concurrent process may hold
+    an old .so open; unlink still works on POSIX, and failures are
+    ignored."""
+    try:
+        stale = [p for p in d.glob("libmri_tokenizer_*.so") if p.name != keep]
+        stale += list(d.glob("libmri_tokenizer_*.tmp"))
+    except OSError:
+        return
+    for p in stale:
+        try:
+            p.unlink()
+        except OSError:
+            pass
+
+
 def _compile() -> Path:
     src = _SRC.read_bytes()
     tag = hashlib.md5(src + " ".join(_CXX_FLAGS).encode()).hexdigest()[:12]
@@ -110,6 +128,7 @@ def _compile() -> Path:
     for d in _build_dirs():
         so = d / name
         if so.exists():
+            _prune_stale(d, name)
             return so
         try:
             d.mkdir(parents=True, exist_ok=True)
@@ -119,6 +138,7 @@ def _compile() -> Path:
                 check=True, capture_output=True, timeout=120,
             )
             os.replace(tmp, so)
+            _prune_stale(d, name)
             return so
         except (OSError, subprocess.SubprocessError) as e:
             last_err = e
@@ -202,6 +222,15 @@ def load():
         lib.mri_hidx_partial.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_hidx_info.restype = ctypes.c_int32
+        lib.mri_hidx_info.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_hidxm_audit.restype = ctypes.c_int32
+        lib.mri_hidxm_audit.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
         ]
         lib.mri_hidxm_new.restype = ctypes.c_void_p
         lib.mri_hidxm_new.argtypes = [
@@ -630,6 +659,19 @@ class HostIndexStream:
             "partial_ms": partial_ns.value / 1e6,
         }
 
+    def info(self) -> dict:
+        """Scan-state probe for the audit layer: this worker's local
+        vocab size, deduped pair count, and raw token count (read-only,
+        vocab-free — O(1))."""
+        vocab = ctypes.c_int32(0)
+        pairs = ctypes.c_int64(0)
+        raw = ctypes.c_int64(0)
+        self._lib.mri_hidx_info(
+            self._handle, ctypes.byref(vocab), ctypes.byref(pairs),
+            ctypes.byref(raw))
+        return {"vocab": int(vocab.value), "pairs": int(pairs.value),
+                "raw_tokens": int(raw.value)}
+
     def close(self):
         if self._handle:
             self._lib.mri_hidx_free(self._handle)
@@ -708,6 +750,17 @@ class HostIndexMerge:
                 f"native host merge failed writing letters "
                 f"[{letter_lo}, {letter_hi}) to {out_dir!r}")
         return int(n)
+
+    def audit(self) -> tuple[int, int]:
+        """Walk every global term's worker runs checking the merge
+        invariants (df sums, per-run monotonicity) in C++.  Returns
+        ``(rc, bad_term)`` — rc 0 ok, 1 df-sum mismatch, 2 non-monotonic
+        run; interpretation (and the raised :class:`~..audit.AuditError`)
+        lives in audit.py, keeping this layer exception-vocabulary-free.
+        """
+        bad = ctypes.c_int32(-1)
+        rc = self._lib.mri_hidxm_audit(self._handle, ctypes.byref(bad))
+        return int(rc), int(bad.value)
 
     def close(self):
         if self._handle:

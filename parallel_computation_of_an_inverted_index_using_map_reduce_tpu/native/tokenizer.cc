@@ -2127,4 +2127,52 @@ int64_t mri_hidxm_emit_range(void* mh, int32_t letter_lo, int32_t letter_hi,
   return -2;
 }
 
+// ---------------------------------------------------------------------------
+// Integrity probes for the audit layer (audit.py): read-only walks over
+// scan/merge state so the Python-side invariant checks never copy
+// postings out.  Both are safe concurrently with emit_range (nothing
+// here mutates).
+// ---------------------------------------------------------------------------
+
+// Per-worker scan totals: vocab (local provisional ids), deduped
+// (term, doc) pair count, raw token count.
+int32_t mri_hidx_info(void* handle, int32_t* vocab_out, int64_t* pairs_out,
+                      int64_t* raw_tokens_out) {
+  HostStreamState& h = *static_cast<HostStreamState*>(handle);
+  if (vocab_out) *vocab_out = h.st.next_id;
+  if (pairs_out) *pairs_out = h.st.num_pairs;
+  if (raw_tokens_out) *raw_tokens_out = h.st.raw_tokens;
+  return 0;
+}
+
+// Merge invariants over every global term's worker runs: summed run
+// lengths must equal the merged df (disjoint windows sum exactly), and
+// each run must be strictly ascending (each worker's partial restores
+// doc order; equal neighbors would mean a doc counted twice).  Returns
+// 0 ok, 1 df-sum mismatch, 2 non-monotonic run; the offending global
+// term id lands in *bad_term.
+int32_t mri_hidxm_audit(void* mh, int32_t* bad_term) {
+  HostMergeState& m = *static_cast<HostMergeState*>(mh);
+  for (int32_t g = 0; g < m.vocab; ++g) {
+    int64_t total = 0;
+    for (int64_t s = m.seg_off[g]; s < m.seg_off[g + 1]; ++s) {
+      const HostStreamState& h = *m.parts[m.seg_worker[s]];
+      const int32_t lid = m.seg_lid[s];
+      const int64_t lo = h.local_off[lid];
+      const int64_t hi = h.local_off[lid + 1];
+      total += hi - lo;
+      for (int64_t k = lo + 1; k < hi; ++k)
+        if (h.local_flat[k - 1] >= h.local_flat[k]) {
+          if (bad_term) *bad_term = g;
+          return 2;
+        }
+    }
+    if (total != m.df_gid[g]) {
+      if (bad_term) *bad_term = g;
+      return 1;
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -51,6 +51,33 @@ def reference_dir():
     return REFERENCE
 
 
+def run_child(cmd, *, env=None, cwd=None, timeout=300):
+    """Run a CLI child for crash/kill tests with a hang-proof guard.
+
+    The child gets its own process group (``start_new_session``) so a
+    timeout kills the WHOLE group with ``os.killpg`` — a wedged child
+    (or anything it forked) can never outlive the test or hang the
+    suite.  Returns the finished ``Popen`` (check ``.returncode``);
+    a timeout is a test failure, not an exception up the stack.
+    """
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(cmd, cwd=cwd, env=env, start_new_session=True)
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        pytest.fail(
+            f"child process hung past {timeout}s and was group-killed: "
+            f"{' '.join(map(str, cmd[:6]))} ...")
+    return proc
+
+
 def read_letter_files(directory) -> bytes:
     """Concatenate a.txt..z.txt (the golden-diff unit, SURVEY.md §4)."""
     out = bytearray()

@@ -1,0 +1,105 @@
+"""Chaos soak (tools/chaos.py) under pytest: seeded fault schedules
+against the (K, M) plan matrix.
+
+Tier-1 runs one full cycle of the matrix (9 trials, a few seconds); the
+``slow`` soak runs the 50+-trial acceptance sweep.  Both hold every
+trial to the harness's contract: clean ⇒ byte-identical + verified
+manifest, degraded ⇒ reported loss + complete letter set, and never a
+hang.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+from conftest import REPO_ROOT
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+    native,
+)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.faults]
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+
+def _load_chaos():
+    spec = importlib.util.spec_from_file_location(
+        "mri_chaos", REPO_ROOT / "tools" / "chaos.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def chaos():
+    return _load_chaos()
+
+
+@pytest.fixture(autouse=True)
+def _disarm(monkeypatch):
+    # the harness pins MRI_CPU_WINDOW_BYTES itself; monkeypatch makes
+    # sure the pin can't leak past each test
+    monkeypatch.setenv("MRI_CPU_WINDOW_BYTES", "512")
+    faults.install(None)
+    faults.begin_run()
+    yield
+    faults.install(None)
+    faults.begin_run()
+
+
+def _assert_contract(summary):
+    assert summary["failures"] == [], \
+        "chaos contract violated:\n" + "\n".join(
+            json.dumps(f, sort_keys=True) for f in summary["failures"])
+    # every trial landed in one of the two permitted outcomes
+    assert summary["clean"] + summary["degraded"] == summary["trials"]
+
+
+@needs_native
+def test_chaos_matrix_cycle_fast(tmp_path, chaos):
+    """One trial per (K, M) cell — the tier-1 smoke that keeps the
+    harness itself from rotting between full soaks."""
+    summary = chaos.run_soak(Path(tmp_path), trials=9, seed_base=1000,
+                             deadline_s=120.0, verbose=False)
+    _assert_contract(summary)
+    assert summary["trials"] == 9
+
+
+@needs_native
+def test_chaos_trial_reproducible(tmp_path, chaos):
+    """Same seed, same schedule, same verdict — the repro contract the
+    --repro flag depends on."""
+    m = chaos.make_corpus(tmp_path / "corpus")
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        oracle_index,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.formatter import (
+        letters_md5,
+    )
+
+    oracle_index(m, tmp_path / "golden")
+    gold = letters_md5(tmp_path / "golden")
+    a = chaos.run_trial(m, gold, tmp_path / "a", 1004, 2, 3)
+    b = chaos.run_trial(m, gold, tmp_path / "b", 1004, 2, 3)
+    assert a["ok"] and b["ok"]
+    assert a["spec"] == b["spec"]
+    assert (a["outcome"], a["recoveries"], a["takeovers"], a["skipped"]) \
+        == (b["outcome"], b["recoveries"], b["takeovers"], b["skipped"])
+
+
+@needs_native
+@pytest.mark.slow
+def test_chaos_soak_fifty_trials(tmp_path, chaos):
+    """The acceptance soak: >=50 seeded trials across the matrix —
+    zero hangs, zero wrong bytes, every clean run's manifest verifies."""
+    summary = chaos.run_soak(Path(tmp_path), trials=54, seed_base=2000,
+                             deadline_s=120.0, verbose=False)
+    _assert_contract(summary)
+    assert summary["trials"] == 54
+    # a soak that never exercised recovery proves nothing
+    assert summary["recoveries"] + summary["takeovers"] >= 5

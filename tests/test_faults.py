@@ -19,12 +19,11 @@ semantics") is armed here via faults.py and asserted end to end:
 import json
 import os
 import signal
-import subprocess
 import sys
 
 import pytest
 
-from conftest import REPO_ROOT, read_letter_files
+from conftest import REPO_ROOT, read_letter_files, run_child
 
 from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
     IndexConfig,
@@ -150,6 +149,44 @@ def test_retry_policy_deadline_cuts_retries():
     with pytest.raises(OSError):
         policy.run(always)
     assert calls["n"] == 1
+
+
+def test_retry_policy_from_env_reads_knobs(monkeypatch):
+    monkeypatch.setenv("MRI_READ_RETRIES", "5")
+    monkeypatch.setenv("MRI_READ_BACKOFF_MS", "12.5")
+    monkeypatch.setenv("MRI_READ_DEADLINE_S", "7")
+    policy = faults.RetryPolicy.from_env()
+    assert policy.max_attempts == 5
+    assert policy.backoff_s == pytest.approx(0.0125)
+    assert policy.deadline_s == pytest.approx(7.0)
+
+
+@pytest.mark.parametrize("var,bad", [
+    ("MRI_READ_RETRIES", "zero"),
+    ("MRI_READ_RETRIES", "0"),
+    ("MRI_READ_RETRIES", "-1"),
+    ("MRI_READ_RETRIES", "2.5"),
+    ("MRI_READ_BACKOFF_MS", "fast"),
+    ("MRI_READ_BACKOFF_MS", "-10"),
+    ("MRI_READ_DEADLINE_S", "0"),
+    ("MRI_READ_DEADLINE_S", "nope"),
+])
+def test_retry_policy_from_env_rejects_bad_values(monkeypatch, var, bad):
+    """A typo'd env knob is a one-line configuration error naming the
+    variable — never a worker-thread traceback mid-run."""
+    monkeypatch.setenv(var, bad)
+    with pytest.raises(ValueError, match=var):
+        faults.RetryPolicy.from_env()
+
+
+def test_retry_policy_bad_env_is_cli_exit_2(tmp_path, monkeypatch, capsys):
+    m = _corpus(tmp_path)  # noqa: F841 — writes list.txt
+    monkeypatch.setenv("MRI_READ_RETRIES", "lots")
+    rc = main(["1", "1", str(tmp_path / "list.txt"),
+               "--output-dir", str(tmp_path / "out"), "--backend", "cpu"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "MRI_READ_RETRIES" in err and err.count("\n") == 1
 
 
 # -- read paths: retry, skip, truncate --------------------------------
@@ -426,7 +463,7 @@ def _kill_argv(tmp_path):
 def _run_killed_child(tmp_path, window):
     """Run the CLI in a REAL child process armed to SIGKILL itself at
     the given stream-window boundary; assert it died by SIGKILL."""
-    proc = subprocess.run(
+    proc = run_child(
         [sys.executable, "-m",
          "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
         + _kill_argv(tmp_path),
@@ -477,7 +514,7 @@ def test_cpu_sigkill_at_window_boundary_rerun_byte_identical(
     argv = [str(mappers), str(reducers), str(tmp_path / "list.txt"),
             "--output-dir", str(tmp_path / "out"),
             "--backend", "cpu", "--io-prefetch", "2", "--resume", "auto"]
-    proc = subprocess.run(
+    proc = run_child(
         [sys.executable, "-m",
          "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
         + argv,
@@ -501,7 +538,7 @@ def test_sigkill_every_remaining_window(tmp_path, window):
     finalize ran; either way the rerun must converge)."""
     m = _corpus(tmp_path, texts=_KILL_TEXTS)
     oracle_index(m, tmp_path / "clean")
-    proc = subprocess.run(
+    proc = run_child(
         [sys.executable, "-m",
          "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
         + _kill_argv(tmp_path),

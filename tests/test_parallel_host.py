@@ -248,12 +248,24 @@ def test_degradation_report_merge():
     b.record_retry()
     b.record_retry()
     b.record_skip(doc_id=7, path="x", reason="boom")
+    b.record_worker_recovery(windows_requeued=3)
+    b.record_reducer_takeover()
+    a.record_worker_recovery(windows_requeued=1)
     a.merge(b)
     a.merge(a)  # self-merge is a no-op, not a deadlock or double-count
     s = a.summary()
     assert s["read_retries"] == 3
     assert s["skipped_docs"] == [7]
+    assert s["worker_recoveries"] == 2
+    assert s["windows_requeued"] == 4
+    assert s["reducer_takeovers"] == 1
     assert b.summary()["read_retries"] == 2  # source unchanged
+    # recoveries alone never flip the report degraded (exit stays 0)
+    assert b.degraded  # b carries a real skip
+    c = faults.DegradationReport()
+    c.record_worker_recovery(windows_requeued=2)
+    c.record_reducer_takeover()
+    assert not c.degraded
 
 
 @needs_native

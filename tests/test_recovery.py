@@ -1,0 +1,394 @@
+"""In-run fault tolerance: leased re-execution, reducer takeover, audit.
+
+The tentpole invariant: a worker or reducer death INSIDE a run must be
+invisible in the output — survivors (or a respawned replacement) rescan
+the dead worker's windows and the letter files come out byte-identical
+to a fault-free run, at every (K, M) and every death point.  Only when
+the respawn budget is exhausted with no survivors does the run degrade
+(exit 3) — and then it says exactly which documents were lost.
+
+The audit layer's job is the opposite direction: prove that a bug in
+THIS recovery machinery (a silently dropped window) can never produce a
+plausible-but-wrong index without failing loudly first.
+"""
+
+import json
+import time
+
+import pytest
+
+from conftest import read_letter_files
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    faults,
+    native,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.audit import (
+    MANIFEST_NAME,
+    AuditError,
+    WindowLedger,
+    verify_output_dir,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    main,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+    StealQueue,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.io.reader import (
+    plan_byte_windows,
+)
+
+pytestmark = [pytest.mark.faults, pytest.mark.parallel_host]
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+_WINDOW_BYTES = 512  # tiny windows: ~16 windows over the 29-doc corpus
+
+
+@pytest.fixture(autouse=True)
+def _disarm(monkeypatch):
+    """No injector armed before/after, fresh run report, tiny windows."""
+    monkeypatch.setenv("MRI_CPU_WINDOW_BYTES", str(_WINDOW_BYTES))
+    faults.install(None)
+    faults.begin_run()
+    yield
+    faults.install(None)
+    faults.begin_run()
+
+
+@pytest.fixture(scope="session")
+def corpus(tmp_path_factory):
+    """One 29-doc corpus + its oracle golden for the whole module
+    (every recovery run must reproduce these exact bytes)."""
+    root = tmp_path_factory.mktemp("recovery")
+    docs = zipf_corpus(num_docs=29, vocab_size=500,
+                       tokens_per_doc=60, seed=13)
+    paths = write_corpus(root / "docs", docs)
+    write_manifest(root / "list.txt", paths)
+    m = read_manifest(root / "list.txt")
+    oracle_index(m, root / "golden")
+    return m, read_letter_files(root / "golden")
+
+
+def _list_path(manifest):
+    """The manifest file the corpus fixture wrote (docs live one level
+    below it) — for CLI-level tests that need the path, not the object."""
+    from pathlib import Path
+
+    return str(Path(manifest.paths[0]).parent.parent / "list.txt")
+
+
+def _num_windows(manifest):
+    return len(list(plan_byte_windows(manifest, _WINDOW_BYTES)))
+
+
+def _build(manifest, out, K, M, spec=None, audit=False):
+    faults.install(spec)
+    faults.begin_run()
+    try:
+        return build_index(
+            manifest,
+            IndexConfig(backend="cpu", num_mappers=K, num_reducers=M,
+                        io_prefetch=2, audit=audit),
+            output_dir=out)
+    finally:
+        faults.install(None)
+
+
+# -- StealQueue lease/ack contract ------------------------------------
+
+
+def test_steal_queue_lease_requeue_and_blacklist():
+    q = StealQueue([(0, 2), (2, 5), (5, 6)])
+    assert q.pop_window(worker=0) == (1, (0, 2))
+    assert q.pop_window(worker=1) == (2, (2, 5))
+    q.ack(1, worker=0)  # worker 0 completed window 1
+    # worker 0 dies: its lease-free COMPLETED window comes back too
+    # (its native handle held that window's postings)
+    assert q.fail_worker(0) == [1]
+    assert q.pop_window(worker=0) is None  # blacklisted forever
+    # survivor drains the requeue plus the untouched tail
+    got = []
+    while (item := q.pop_window(worker=1)) is not None:
+        got.append(item[0])
+        q.ack(item[0], worker=1)
+    assert sorted(got) == [1, 3]
+    q.ack(2, worker=1)
+    assert q.outstanding() == 0
+
+
+def test_steal_queue_leased_windows_requeue_on_failure():
+    q = StealQueue([(i, i + 1) for i in range(4)])
+    for _ in range(3):
+        q.pop_window(worker=2)  # three outstanding leases, no acks
+    assert q.fail_worker(2) == [1, 2, 3]
+    assert len(q) == 4  # all four hand-outs still ahead
+    assert q.outstanding() == 0
+
+
+def test_steal_queue_late_ack_from_retired_worker_dropped():
+    q = StealQueue([(0, 1), (1, 2)])
+    q.pop_window(worker=0)
+    q.fail_worker(0)
+    q.ack(1, worker=0)  # zombie thread wakes up and acks: ignored
+    got = [q.pop_window(worker=1)[0] for _ in range(2)]
+    assert sorted(got) == [1, 2]  # window 1 still got re-executed
+
+
+def test_steal_queue_expired_workers_watchdog():
+    q = StealQueue([(0, 1), (1, 2)])
+    q.pop_window(worker=0)
+    q.pop_window(worker=1)
+    q.ack(2, worker=1)
+    time.sleep(0.05)
+    assert q.expired_workers(0.01) == {0}  # 1 acked in time
+    assert q.expired_workers(10.0) == set()
+
+
+# -- worker death: byte-identical recovery matrix ---------------------
+
+
+@needs_native
+@pytest.mark.parametrize("mappers", [2, 4])
+@pytest.mark.parametrize("reducers", [1, 3, 26])
+@pytest.mark.parametrize("position", ["early", "middle", "last"])
+def test_worker_death_byte_identical(tmp_path, corpus, mappers, reducers,
+                                     position):
+    m, golden = corpus
+    n = _num_windows(m)
+    window = {"early": 1, "middle": n // 2, "last": n}[position]
+    stats = _build(m, tmp_path / "out", mappers, reducers,
+                   spec=f"worker-death:window={window}")
+    d = stats["degradation"]
+    assert d["worker_recoveries"] >= 1
+    assert d["windows_requeued"] >= 1
+    assert d["skipped_docs"] == []  # recovery is not degradation
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_two_worker_deaths_one_run(tmp_path, corpus):
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 4, 3,
+                   spec="worker-death:worker=1:window=0;"
+                        "worker-death:worker=2:window=0")
+    assert stats["degradation"]["worker_recoveries"] == 2
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_all_workers_die_respawn_drains(tmp_path, corpus):
+    """Both workers die before the queue drains: the respawned
+    replacement (budget default 1) rescans everything, still
+    byte-identical, still exit-0 semantics."""
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 2, 2,
+                   spec="worker-death:worker=0:window=0;"
+                        "worker-death:worker=1:window=0")
+    d = stats["degradation"]
+    assert d["worker_recoveries"] == 2
+    assert d["skipped_docs"] == []
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_single_mapper_parallel_path_recovers(tmp_path, corpus):
+    """K=1 with M>1 still routes through the parallel path: the lone
+    worker's death leaves no survivors, only the respawn."""
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 1, 2,
+                   spec="worker-death:worker=0:window=2")
+    assert stats["degradation"]["worker_recoveries"] == 1
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_scan_error_recovers(tmp_path, corpus):
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 2, 3, spec="scan-error:window=3")
+    assert stats["degradation"]["worker_recoveries"] >= 1
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_reader_death_in_parallel_path_recovers(tmp_path, corpus):
+    """A silently dying reader thread surfaces as ReaderDied in its
+    worker — which is now just another recoverable worker death, not a
+    run-fatal error."""
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 2, 2, spec="reader-death:window=2")
+    assert stats["degradation"]["worker_recoveries"] >= 1
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_respawn_budget_exhausted_degrades_not_dies(tmp_path, corpus,
+                                                    monkeypatch):
+    monkeypatch.setenv("MRI_WORKER_RESPAWNS", "0")
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 2, 2,
+                   spec="worker-death:worker=0:window=0;"
+                        "worker-death:worker=1:window=0")
+    d = stats["degradation"]
+    assert d["worker_recoveries"] == 2
+    assert d["skipped_docs"]  # real data loss is REPORTED data loss
+    # the run still completes: all 26 letter files exist
+    for i in range(26):
+        assert (tmp_path / "out" / f"{chr(ord('a') + i)}.txt").exists()
+    assert read_letter_files(tmp_path / "out") != golden
+
+
+@needs_native
+def test_budget_exhausted_is_cli_exit_3(tmp_path, corpus, monkeypatch,
+                                        capsys):
+    monkeypatch.setenv("MRI_WORKER_RESPAWNS", "0")
+    m, _ = corpus
+    rc = main(["2", "2", _list_path(m), "--backend", "cpu",
+               "--output-dir", str(tmp_path / "out"),
+               "--fault-spec", "worker-death:worker=0:window=0;"
+                               "worker-death:worker=1:window=0"])
+    assert rc == faults.EXIT_DEGRADED
+    assert "DEGRADED" in capsys.readouterr().err
+
+
+@needs_native
+def test_lease_deadline_watchdog_never_hangs(tmp_path, corpus,
+                                             monkeypatch):
+    """A worker wedged in a slow read past MRI_WINDOW_DEADLINE_S is
+    retired in absentia.  Whichever worker the slow window lands on,
+    the run must finish quickly and byte-identically — the watchdog
+    exists so a wedge can never become a hang."""
+    monkeypatch.setenv("MRI_WINDOW_DEADLINE_S", "0.25")
+    m, golden = corpus
+    t0 = time.monotonic()
+    stats = _build(m, tmp_path / "out", 2, 2, spec="slow-read:doc=5:ms=900")
+    assert time.monotonic() - t0 < 30
+    assert stats["degradation"]["skipped_docs"] == []
+    assert stats["degradation"]["worker_recoveries"] in (0, 1)
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+# -- reducer takeover -------------------------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("reducers,dead", [
+    (1, 0), (3, 0), (3, 1), (3, 2), (26, 0), (26, 12), (26, 25),
+])
+def test_reducer_death_range_reemitted(tmp_path, corpus, reducers, dead):
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 2, reducers,
+                   spec=f"reducer-death:reducer={dead}")
+    assert stats["degradation"]["reducer_takeovers"] == 1
+    assert stats["degradation"]["skipped_docs"] == []
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+@needs_native
+def test_worker_and_reducer_death_same_run(tmp_path, corpus):
+    m, golden = corpus
+    stats = _build(m, tmp_path / "out", 4, 3,
+                   spec="worker-death:window=2;reducer-death:reducer=1",
+                   audit=True)
+    d = stats["degradation"]
+    assert d["worker_recoveries"] >= 1 and d["reducer_takeovers"] == 1
+    assert read_letter_files(tmp_path / "out") == golden
+
+
+# -- integrity audit --------------------------------------------------
+
+
+def test_window_ledger_names_dropped_window():
+    led = WindowLedger()
+    for wi in (1, 3):
+        led.record(wi, worker=0, docs=2, nbytes=10, checksum=wi)
+    with pytest.raises(AuditError, match="window 2"):
+        led.check_complete(3)
+
+
+def test_window_ledger_discard_then_reexecute():
+    led = WindowLedger()
+    led.record(1, worker=0, docs=2, nbytes=10, checksum=7)
+    led.record(2, worker=1, docs=2, nbytes=10, checksum=8)
+    assert led.discard_worker(0) == 1
+    led.record(1, worker=2, docs=2, nbytes=10, checksum=7)  # rescan
+    led.record(3, worker=0, docs=1, nbytes=5, checksum=9)  # zombie: ignored
+    with pytest.raises(AuditError, match="window 3"):
+        led.check_complete(3)
+    led.record(3, worker=1, docs=1, nbytes=5, checksum=9)
+    led.check_complete(3)  # complete now
+
+
+def test_window_ledger_double_feed_is_an_error():
+    led = WindowLedger()
+    led.record(1, worker=0, docs=2, nbytes=10, checksum=7)
+    led.record(1, worker=1, docs=2, nbytes=10, checksum=7)
+    with pytest.raises(AuditError, match="more than once"):
+        led.check_complete(1)
+
+
+@needs_native
+def test_audit_passes_on_clean_and_recovered_runs(tmp_path, corpus):
+    m, golden = corpus
+    for name, spec in (("clean", None), ("rec", "worker-death:window=2")):
+        out = tmp_path / name
+        stats = _build(m, out, 2, 3, spec=spec, audit=True)
+        assert stats["audit_ms"] > 0
+        assert read_letter_files(out) == golden
+        manifest_doc = json.loads((out / MANIFEST_NAME).read_text())
+        assert len(manifest_doc["files"]) == 26
+        ok, problems = verify_output_dir(out)
+        assert ok, problems
+
+
+@needs_native
+def test_audit_catches_silently_dropped_window(tmp_path, corpus):
+    """THE reason the audit exists: a window dropped without an
+    exception must fail loudly, naming the window — never exit 0 with
+    missing postings."""
+    m, _ = corpus
+    with pytest.raises(AuditError, match="window 2"):
+        _build(m, tmp_path / "out", 2, 2,
+               spec="scan-error:window=2:silent=1", audit=True)
+
+
+@needs_native
+def test_silent_drop_without_audit_is_wrong_bytes(tmp_path, corpus):
+    """Control for the test above: without --audit the same fault DOES
+    corrupt the output — documenting exactly what the audit buys."""
+    m, golden = corpus
+    _build(m, tmp_path / "out", 2, 2,
+           spec="scan-error:window=2:silent=1", audit=False)
+    assert read_letter_files(tmp_path / "out") != golden
+
+
+@needs_native
+def test_verify_detects_post_run_tampering(tmp_path, corpus):
+    m, _ = corpus
+    _build(m, tmp_path / "out", 2, 2, audit=True)
+    (tmp_path / "out" / "a.txt").write_bytes(b"tampered:[1]\n")
+    ok, problems = verify_output_dir(tmp_path / "out")
+    assert not ok and any("a.txt" in p for p in problems)
+
+
+@needs_native
+def test_cli_verify_mode_exit_codes(tmp_path, corpus, capsys):
+    m, _ = corpus
+    _build(m, tmp_path / "out", 2, 2, audit=True)
+    assert main(["--verify", str(tmp_path / "out")]) == 0
+    (tmp_path / "out" / "b.txt").write_bytes(b"x:[2]\n")
+    assert main(["--verify", str(tmp_path / "out")]) == 2
+    assert "b.txt" in capsys.readouterr().err

@@ -1,0 +1,248 @@
+"""Chaos soak harness: seeded random fault schedules vs the (K, M) grid.
+
+The recovery matrix tests (tests/test_recovery.py) prove each fault
+kind in isolation; this harness proves the COMPOSITION — n faults
+sampled from a seeded RNG (``chaos:seed=S:n=K``, faults.py), thrown at
+every parallel-plan shape — and holds the run to the only two outcomes
+fault tolerance permits:
+
+- **clean**: no documents skipped ⇒ letter files byte-identical to the
+  oracle AND the ``--audit`` output manifest verifies, or
+- **degraded**: documents skipped ⇒ the loss is REPORTED (the exit-3
+  contract) and the run still emitted a complete 26-file letter set.
+
+Never a hang (each trial runs under a hard deadline), never a wrong
+byte on a clean exit, never silent loss.  Every trial is reproducible
+from its printed seed alone:
+
+    python tools/chaos.py --trials 50 --seed-base 1000
+    python tools/chaos.py --repro 1017     # re-run one trial's schedule
+
+The (1, 1) cell routes down the single-worker pipelined path, which has
+no worker/reducer recovery layer by design (nothing to take over for) —
+its trials sample only the read-level kinds the retry policy handles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (  # noqa: E402
+    IndexConfig,
+    build_index,
+    faults,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.audit import (  # noqa: E402
+    verify_output_dir,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (  # noqa: E402
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (  # noqa: E402
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.io.reader import (  # noqa: E402
+    plan_byte_windows,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.formatter import (  # noqa: E402
+    letters_md5,
+)
+
+#: Every parallel-plan shape the soak cycles through.
+PLAN_MATRIX = [(k, m) for k in (1, 2, 4) for m in (1, 3, 26)]
+
+_WINDOW_BYTES = 512
+#: Read-level kinds only: safe on the single-worker pipelined path.
+_PIPELINED_KINDS = "read-error,slow-read"
+
+
+def make_corpus(root: Path, num_docs: int = 29, seed: int = 13):
+    docs = zipf_corpus(num_docs=num_docs, vocab_size=500,
+                       tokens_per_doc=60, seed=seed)
+    paths = write_corpus(root / "docs", docs)
+    write_manifest(root / "list.txt", paths)
+    return read_manifest(root / "list.txt")
+
+
+def trial_spec(seed: int, mappers: int, reducers: int,
+               num_windows: int, num_docs: int, n_faults: int = 3) -> str:
+    spec = (f"chaos:seed={seed}:n={n_faults}:windows={num_windows}"
+            f":workers={mappers}:reducers={reducers}:docs={num_docs}")
+    if mappers == 1 and reducers == 1:
+        spec += f":kinds={_PIPELINED_KINDS}"
+    return spec
+
+
+def run_trial(manifest, golden_md5: str, out_dir: Path, seed: int,
+              mappers: int, reducers: int,
+              deadline_s: float = 120.0) -> dict:
+    """One seeded trial.  Returns a verdict dict; ``ok`` is False only
+    on a contract violation (hang, wrong clean bytes, unreported loss,
+    unexpected error)."""
+    # the spec's window bounds and the run's actual plan must agree
+    os.environ["MRI_CPU_WINDOW_BYTES"] = str(_WINDOW_BYTES)
+    num_windows = len(list(plan_byte_windows(manifest, _WINDOW_BYTES)))
+    spec = trial_spec(seed, mappers, reducers, num_windows, len(manifest))
+    verdict = {"seed": seed, "mappers": mappers, "reducers": reducers,
+               "spec": spec, "ok": False, "outcome": "?"}
+    box: dict = {}
+
+    def target():
+        faults.install(spec)
+        faults.begin_run()
+        try:
+            box["stats"] = build_index(
+                manifest,
+                IndexConfig(backend="cpu", num_mappers=mappers,
+                            num_reducers=reducers, io_prefetch=2,
+                            audit=True),
+                output_dir=out_dir)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            box["error"] = e
+        finally:
+            faults.install(None)
+
+    t0 = time.monotonic()
+    # A trial must never hang the soak: the worker thread gets a hard
+    # deadline.  (A wedged trial is abandoned, not killed — daemon
+    # thread — and counted as the failure it is.)
+    th = threading.Thread(target=target, daemon=True,
+                          name=f"chaos-trial-{seed}")
+    th.start()
+    th.join(deadline_s)
+    verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+    if th.is_alive():
+        verdict["outcome"] = "HANG"
+        return verdict
+    if "error" in box:
+        e = box["error"]
+        verdict["outcome"] = f"error:{type(e).__name__}"
+        verdict["error"] = "".join(
+            traceback.format_exception_only(type(e), e)).strip()
+        return verdict
+    stats = box["stats"]
+    d = stats.get("degradation", {})
+    verdict["recoveries"] = d.get("worker_recoveries", 0)
+    verdict["takeovers"] = d.get("reducer_takeovers", 0)
+    verdict["skipped"] = len(d.get("skipped_docs", []))
+    if verdict["skipped"]:
+        # degraded arm: loss is reported; the letter set must still be
+        # complete on disk (exit-3 semantics, not a crash)
+        missing = [i for i in range(26)
+                   if not (out_dir / f"{chr(ord('a') + i)}.txt").exists()]
+        verdict["outcome"] = "degraded"
+        verdict["ok"] = not missing
+        if missing:
+            verdict["outcome"] = "degraded-INCOMPLETE"
+        return verdict
+    # clean arm: byte identity AND the output manifest verifies
+    md5 = letters_md5(out_dir)
+    ok_manifest, problems = verify_output_dir(out_dir)
+    verdict["outcome"] = "clean"
+    verdict["ok"] = (md5 == golden_md5) and ok_manifest
+    if md5 != golden_md5:
+        verdict["outcome"] = "clean-WRONG-BYTES"
+    elif not ok_manifest:
+        verdict["outcome"] = "clean-BAD-MANIFEST"
+        verdict["problems"] = problems
+    return verdict
+
+
+def run_soak(work_dir: Path, trials: int, seed_base: int,
+             deadline_s: float = 120.0, verbose: bool = True) -> dict:
+    """The full soak: ``trials`` seeded trials cycled over PLAN_MATRIX.
+    Returns a summary dict; ``summary["failures"]`` is empty iff every
+    trial honored the fault-tolerance contract."""
+    saved = os.environ.get("MRI_CPU_WINDOW_BYTES")
+    os.environ["MRI_CPU_WINDOW_BYTES"] = str(_WINDOW_BYTES)
+    try:
+        work_dir.mkdir(parents=True, exist_ok=True)
+        manifest = make_corpus(work_dir / "corpus")
+        oracle_index(manifest, work_dir / "golden")
+        golden_md5 = letters_md5(work_dir / "golden")
+        results = []
+        for t in range(trials):
+            mappers, reducers = PLAN_MATRIX[t % len(PLAN_MATRIX)]
+            seed = seed_base + t
+            out = work_dir / f"trial-{seed}"
+            v = run_trial(manifest, golden_md5, out, seed, mappers,
+                          reducers, deadline_s=deadline_s)
+            results.append(v)
+            if verbose:
+                print(json.dumps(v, sort_keys=True), flush=True)
+            if v["outcome"] == "HANG":
+                break  # a wedged daemon thread poisons later trials
+    finally:
+        if saved is None:
+            os.environ.pop("MRI_CPU_WINDOW_BYTES", None)
+        else:
+            os.environ["MRI_CPU_WINDOW_BYTES"] = saved
+    failures = [v for v in results if not v["ok"]]
+    summary = {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "degraded": sum(v["outcome"] == "degraded" for v in results),
+        "recoveries": sum(v.get("recoveries", 0) for v in results),
+        "takeovers": sum(v.get("takeovers", 0) for v in results),
+        "failures": failures,
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="chaos soak: seeded fault schedules vs the (K, M) "
+                    "plan matrix; byte-identity or honest degradation, "
+                    "never a hang, never a wrong byte")
+    ap.add_argument("--trials", type=int, default=54,
+                    help="seeded trials to run (cycled over the matrix)")
+    ap.add_argument("--seed-base", type=int, default=1000)
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="per-trial hard deadline (s); exceeding it is "
+                         "a HANG failure")
+    ap.add_argument("--work-dir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--repro", type=int, default=None,
+                    help="re-run the single trial with this seed")
+    args = ap.parse_args(argv)
+    if args.work_dir is None:
+        import tempfile
+
+        work = Path(tempfile.mkdtemp(prefix="mri-chaos-"))
+    else:
+        work = Path(args.work_dir)
+    if args.repro is not None:
+        t = args.repro - args.seed_base
+        mappers, reducers = PLAN_MATRIX[t % len(PLAN_MATRIX)]
+        os.environ["MRI_CPU_WINDOW_BYTES"] = str(_WINDOW_BYTES)
+        work.mkdir(parents=True, exist_ok=True)
+        manifest = make_corpus(work / "corpus")
+        oracle_index(manifest, work / "golden")
+        v = run_trial(manifest, letters_md5(work / "golden"),
+                      work / f"repro-{args.repro}", args.repro,
+                      mappers, reducers, deadline_s=args.deadline)
+        print(json.dumps(v, sort_keys=True))
+        return 0 if v["ok"] else 1
+    summary = run_soak(work, args.trials, args.seed_base,
+                       deadline_s=args.deadline)
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if not summary["failures"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
