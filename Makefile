@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast test-faults test-parallel test-chaos bench bench-scale bench-sweep capture rehearse clean clean-native
+.PHONY: build test test-fast test-faults test-parallel test-chaos test-serve bench bench-scale bench-sweep bench-serve capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -38,6 +38,11 @@ test-parallel:
 test-chaos:
 	$(PY) -m pytest tests/ -q -m chaos
 
+# query-serving suite: index.mri format + Engine parity vs a naive text
+# scan, artifact corruption rejection, LRU cache semantics
+test-serve:
+	$(PY) -m pytest tests/ -q -m serve
+
 bench:
 	$(PY) bench.py
 
@@ -50,6 +55,12 @@ bench-scale:
 # same corpus, with the per-worker stage split (prints a JSON line)
 bench-sweep:
 	$(PY) bench.py --sweep
+
+# query-serving QPS/latency bench against the packed artifact (Zipf
+# workload, batch sizes 1/32/1024; prints a JSON line) — see
+# tools/bench_serve.py for the MRI_SERVE_* knobs
+bench-serve:
+	$(PY) tools/bench_serve.py
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

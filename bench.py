@@ -640,7 +640,7 @@ def _bench_sweep() -> int:
     return 0
 
 
-def main() -> int:
+def main(artifact: bool = False) -> int:
     _, metric = _manifest()
     tpu, tpu_log = _run_tpu_attempts()
     # best-of-15: the host path's run-to-run spread on the shared
@@ -652,6 +652,11 @@ def main() -> int:
     # same corpus (the report carries audit_ms; the contract is < 5 %
     # of the unaudited cpu_ms)
     cpu_audited = _measure("cpu", [{"audit": True}], rounds=3)
+    # --artifact: the same corpus built WITH the serving artifact, so
+    # the pack overhead (contract: <= 10 % of the unaudited cpu e2e)
+    # is measured next to the number it dilutes
+    cpu_artifact = (_measure("cpu", [{"artifact": True}], rounds=3)
+                    if artifact else None)
 
     if tpu is not None:
         value_ms, measured_backend = tpu["best_ms"], "tpu"
@@ -691,6 +696,12 @@ def main() -> int:
         # round; host_cores qualifies what the curve can even show
         "host_threads_sweep": _host_threads_sweep(),
     }
+    if cpu_artifact is not None:
+        rep = cpu_artifact.get("report", {})
+        line["artifact_cpu_ms"] = round(cpu_artifact["best_ms"], 2)
+        line["artifact_build_ms"] = round(
+            float(rep.get("artifact_build_ms", 0.0)), 3)
+        line["artifact_bytes"] = int(rep.get("artifact_bytes", 0))
     if tpu is not None:
         line["tpu_platform"] = tpu.get("platform")
         line["tpu_ms"] = round(tpu["best_ms"], 2)
@@ -740,4 +751,4 @@ if __name__ == "__main__":
         sys.exit(_bench_scale())
     if "--sweep" in sys.argv:
         sys.exit(_bench_sweep())
-    sys.exit(main())
+    sys.exit(main(artifact="--artifact" in sys.argv))

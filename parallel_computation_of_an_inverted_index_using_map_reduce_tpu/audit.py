@@ -171,13 +171,20 @@ def check_merge(merge, streams) -> None:
 
 
 def letter_checksums(out_dir) -> dict[str, tuple[str, int]]:
-    """``{filename: (adler32_hex, size_bytes)}`` for a.txt..z.txt."""
+    """``{filename: (adler32_hex, size_bytes)}`` for a.txt..z.txt, plus
+    the ``index.mri`` serving artifact when the run packed one — a torn
+    artifact must fail ``--verify`` exactly like a torn letter file."""
     out_dir = Path(out_dir)
     out: dict[str, tuple[str, int]] = {}
     for letter in range(26):
         name = formatter.letter_filename(letter)
         data = (out_dir / name).read_bytes()
         out[name] = (f"{zlib.adler32(data):08x}", len(data))
+    from .serve import artifact as artifact_mod
+
+    art = out_dir / artifact_mod.ARTIFACT_NAME
+    if art.exists():
+        out[artifact_mod.ARTIFACT_NAME] = artifact_mod.checksum(art)
     return out
 
 

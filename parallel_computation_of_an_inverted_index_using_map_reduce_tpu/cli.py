@@ -51,9 +51,20 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
                                  docs= kinds=a,b,c)
 
 verify mode:
-  mri-tpu --verify DIR           re-check DIR's letter files against
-                                 its index.manifest.json (written by
+  mri-tpu --verify DIR           re-check DIR's letter files (and
+                                 index.mri, when present) against its
+                                 index.manifest.json (written by
                                  --audit runs); exit 0 ok, 2 mismatch
+
+query mode (the serving read path; needs an --artifact build):
+  mri-tpu query DIR word...          df + postings per word (JSON lines)
+  mri-tpu query DIR --batch-file F   one query word per line
+  mri-tpu query DIR --op and w1 w2   docs containing every word
+  mri-tpu query DIR --op or  w1 w2   docs containing any word
+  mri-tpu query DIR --top-k 5 --letter t   the letter's 5 highest-df
+                                 terms (== head -5 DIR/t.txt)
+  a missing/torn index.mri exits 2 with one line on stderr, never
+  garbage answers
 """
 
 
@@ -151,6 +162,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "'worker-death:window=2;chaos:seed=5:n=3'; also "
                         f"readable from ${faults.ENV_VAR}) — test/bench "
                         "only, never needed for production runs")
+    p.add_argument("--artifact", action="store_true",
+                   help="also pack the compact mmap serving artifact "
+                        "(index.mri) next to the letter files at emit "
+                        "time — the read path 'mri-tpu query' and "
+                        "serve.Engine load (serve/artifact.py format)")
     p.add_argument("--audit", action="store_true",
                    help="integrity audit: per-window feed ledger + merge "
                         "invariant checks before emit, and an "
@@ -160,10 +176,91 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _query_main(argv: list[str]) -> int:
+    """``mri-tpu query DIR ...`` — serve from an --artifact build."""
+    p = argparse.ArgumentParser(
+        prog="mri-tpu query",
+        description="batched lookups against a built index.mri artifact")
+    p.add_argument("index_dir", help="output dir of an --artifact run "
+                                     "(or the index.mri file itself)")
+    p.add_argument("terms", nargs="*", help="query words")
+    p.add_argument("--batch-file", default=None,
+                   help="read query words from this file, one per line")
+    p.add_argument("--op", choices=("and", "or"), default=None,
+                   help="combine ALL query words into one multi-term "
+                        "query instead of answering each separately")
+    p.add_argument("--top-k", type=int, default=None, metavar="K",
+                   help="the K highest-df terms of --letter's range")
+    p.add_argument("--letter", default=None,
+                   help="letter for --top-k (a..z)")
+    p.add_argument("--stats", action="store_true",
+                   help="print an engine/cache stats JSON line last")
+    # intermixed: ``query DIR --op and the dog`` must not feed "the dog"
+    # back into --op's greedy positional scan.
+    args = p.parse_intermixed_args(argv)
+
+    from .serve import ArtifactError, Engine
+
+    terms = list(args.terms)
+    if args.batch_file is not None:
+        try:
+            with open(args.batch_file, "r", encoding="utf-8") as f:
+                terms.extend(line.strip() for line in f if line.strip())
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    if args.top_k is None and not terms:
+        print("error: no query terms (positional words, --batch-file, "
+              "or --top-k with --letter)", file=sys.stderr)
+        return 2
+    if args.top_k is not None and args.letter is None:
+        print("error: --top-k needs --letter", file=sys.stderr)
+        return 2
+    try:
+        engine = Engine(args.index_dir)
+    except ArtifactError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        if args.top_k is not None:
+            top = engine.top_k(args.letter, args.top_k)
+            print(json.dumps({
+                "letter": args.letter,
+                "top": [{"term": t.decode("ascii"), "df": d}
+                        for t, d in top]}))
+        if terms and args.op is not None:
+            batch = engine.encode_batch(terms)
+            docs = (engine.query_and(batch) if args.op == "and"
+                    else engine.query_or(batch))
+            print(json.dumps({"op": args.op, "terms": terms,
+                              "docs": docs.tolist()}))
+        elif terms:
+            batch = engine.encode_batch(terms)
+            dfs = engine.df(batch)
+            posts = engine.postings(batch)
+            for term, d, ids in zip(terms, dfs.tolist(), posts):
+                print(json.dumps({
+                    "term": term, "found": ids is not None, "df": d,
+                    "postings": ids.tolist() if ids is not None else []}))
+        if args.stats:
+            print(json.dumps({"vocab": engine.vocab_size,
+                              "artifact_bytes": engine.artifact.nbytes,
+                              "cache": engine.cache_stats()}))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        engine.close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
-    # --verify DIR is a standalone mode (no positionals): pre-parse it
-    # so 'mri-tpu --verify out/' works without dummy mapper counts.
+    # --verify DIR / query DIR are standalone modes (no reference
+    # positionals): pre-parse them so 'mri-tpu --verify out/' and
+    # 'mri-tpu query out/ word' work without dummy mapper counts.
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "query":
+        return _query_main(argv[1:])
     if "--verify" in argv:
         i = argv.index("--verify")
         if i + 1 >= len(argv):
@@ -225,6 +322,7 @@ def main(argv: list[str] | None = None) -> int:
             io_prefetch=args.io_prefetch,
             resume=args.resume,
             audit=args.audit,
+            artifact=args.artifact,
         )
         stats = build_index(manifest, config)
     except AuditError as e:

@@ -173,6 +173,12 @@ class IndexConfig:
     #              emits only its own letter files; no global merge
     #              anywhere.  The multi-host emit strategy.
     emit_ownership: str = "merged"
+    # Serving artifact (serve/artifact.py): pack a compact mmap-able
+    # ``index.mri`` next to the letter files at emit time, so the query
+    # engine (``mri-tpu query``, serve.Engine) never re-parses text.
+    # Needs the merged postings on one host: incompatible with the
+    # letter-ownership emit and the overlap plan's split emit.
+    artifact: bool = False
 
     def resolved_host_threads(self) -> int:
         """The map-phase thread count this run will actually use."""
@@ -236,6 +242,17 @@ class IndexConfig:
                 raise ValueError(
                     "overlap_tail_fraction is single-chip; "
                     "emit_ownership='letter' is the multi-chip emit path")
+        if self.artifact:
+            if self.emit_ownership == "letter":
+                raise ValueError(
+                    "artifact requires the merged emit (one host holds "
+                    "the global postings); emit_ownership='letter' "
+                    "splits them across owners")
+            if self.overlap_tail_fraction is not None:
+                raise ValueError(
+                    "artifact is incompatible with overlap_tail_fraction "
+                    "(the overlap plan emits from two disjoint partial "
+                    "indexes, never materializing merged postings)")
         if self.overlap_device_windows not in (1, 2):
             raise ValueError(
                 f"overlap_device_windows must be 1 or 2, "

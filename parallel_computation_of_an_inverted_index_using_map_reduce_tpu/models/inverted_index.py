@@ -118,6 +118,14 @@ class InvertedIndexModel:
             report.log_summary()
         return stats
 
+    def _artifact_path(self, out_dir) -> str | None:
+        """Where ``--artifact`` packs the serving index (None when off)."""
+        if not self.config.artifact:
+            return None
+        from ..serve import artifact as artifact_mod
+
+        return str(artifact_mod.artifact_path(out_dir))
+
     def _run_dispatch(self, manifest: Manifest,
                       output_dir: str | None = None) -> dict:
         cfg = self.config
@@ -129,7 +137,9 @@ class InvertedIndexModel:
         out_dir = output_dir if output_dir is not None else cfg.output_dir
         if cfg.backend == "oracle":
             with timer.phase("oracle"):
-                stats = oracle_index(manifest, out_dir)
+                stats = oracle_index(
+                    manifest, out_dir,
+                    artifact_path=self._artifact_path(out_dir))
             return {**stats, **timer.report()}
         if cfg.backend == "cpu":
             return self._run_cpu(manifest, out_dir, timer)
@@ -157,11 +167,19 @@ class InvertedIndexModel:
 
         if not self.config.use_native or not native.available():
             with timer.phase("oracle"):
-                stats = oracle_index(manifest, out_dir)
+                stats = oracle_index(
+                    manifest, out_dir,
+                    artifact_path=self._artifact_path(out_dir))
             timer.count("cpu_fallback", "oracle")
             return {**stats, **timer.report()}
         threads = self.config.resolved_host_threads()
         timer.count("host_threads", threads)
+        if self.config.artifact:
+            # The serving artifact packs straight off the merge state's
+            # columnar export (no letter-file round-trip), so --artifact
+            # routes through the parallel reduce even at K = M = 1 —
+            # byte-identical letter files at every (K, M) regardless.
+            return self._run_cpu_parallel(manifest, out_dir, timer, threads)
         if self.config.io_prefetch > 0:
             # resolved_host_threads drives the pipelined path too (it
             # used to fall off to the one-shot call for any K > 1,
@@ -290,6 +308,9 @@ class InvertedIndexModel:
         windows = plan_byte_windows(manifest, window_bytes)
         max_docs = max((hi - lo for lo, hi in windows), default=1)
         K = max(1, num_workers)
+        # --artifact reaches here even with --io-prefetch 0 (the merge
+        # state is the artifact's source); the reader needs depth >= 1
+        depth = max(1, cfg.io_prefetch)
         shuffle_env = os.environ.get("MRI_STEAL_SHUFFLE_SEED")
         queue = StealQueue(
             windows,
@@ -306,7 +327,7 @@ class InvertedIndexModel:
         rings = getattr(self, "_cpu_arena_rings", None)
         if rings is not None and (
                 len(rings) != K
-                or any(len(r) != cfg.io_prefetch + 1 for r in rings)):
+                or any(len(r) != depth + 1 for r in rings)):
             rings = None
         if rings is None:
             rings = [None] * K
@@ -336,7 +357,7 @@ class InvertedIndexModel:
             }
             # reader last: its thread starts pulling windows immediately
             slot["reader"] = PipelinedWindowReader(
-                manifest, queue, depth=cfg.io_prefetch,
+                manifest, queue, depth=depth,
                 byte_capacity=window_bytes + (window_bytes >> 2),
                 doc_capacity=max_docs, arenas=arenas,
                 policy=policy, report=rep, worker=w)
@@ -533,6 +554,16 @@ class InvertedIndexModel:
                     run_report.record_reducer_takeover()
                     emit_errors[r] = None
                 mstats = merge.stats()
+                if cfg.artifact:
+                    from ..serve import artifact as artifact_mod
+
+                    t0 = time.perf_counter()
+                    art_bytes = artifact_mod.build_from_merge(
+                        artifact_mod.artifact_path(out_dir), merge)
+                    timer.count("artifact_bytes", int(art_bytes))
+                    timer.count(
+                        "artifact_build_ms",
+                        round((time.perf_counter() - t0) * 1e3, 3))
         finally:
             recovered = any(s["failed"] for s in slots)
             for slot in slots:
@@ -665,7 +696,9 @@ class InvertedIndexModel:
 
         if pairs_fed == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         with timer.phase("device_index"):
@@ -734,7 +767,9 @@ class InvertedIndexModel:
         num_pairs = int(sum(sizes))
         if num_pairs == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         # vocab-scale host views in prov space, then the O(N) owner-run
@@ -901,7 +936,9 @@ class InvertedIndexModel:
         timer.count("upload_windows", len(chunks_dev))
         if num_pairs == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         profile = _profile_ctx(self.config.profile_dir)
@@ -1132,7 +1169,9 @@ class InvertedIndexModel:
         if num_pairs == 0:
             trace.close()
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         with timer.phase("host_tail"):
@@ -1207,7 +1246,9 @@ class InvertedIndexModel:
         timer.count("device_tokenize_width", width)
         if num_docs == 0 or total == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         profile = _profile_ctx(cfg.profile_dir)
@@ -1290,7 +1331,9 @@ class InvertedIndexModel:
         width = cfg.device_tokenize_width
         if num_pairs == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
         with timer.phase("fetch"):
             nu = min(cap, _round_up(max(num_words, 1), 1 << 13))
@@ -1326,8 +1369,10 @@ class InvertedIndexModel:
                 out_dir, vocab=vocab, letter_of_term=letters,
                 order=order, df=df64, offsets=offsets,
                 postings=postings, max_doc_id=max_doc_id,
-                backend=self._emit_backend())
+                backend=self._emit_backend(),
+                artifact_path=self._artifact_path(out_dir))
         timer.count("lines_written", emit_stats["lines_written"])
+        self._count_artifact_stats(timer, emit_stats)
         return timer.report()
 
     def _run_tpu_device_tokenize_stream(self, manifest: Manifest,
@@ -1502,7 +1547,9 @@ class InvertedIndexModel:
             timer.count("unique_rows_curve", engine_s.rows_curve)
         if engine_s.windows_fed == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
         host_max_len = engine_s.max_word_len
         sort_cols = -(-max(host_max_len, 1) // 4)  # ceil div
@@ -1554,7 +1601,9 @@ class InvertedIndexModel:
         timer.count("device_tokenize_width", width)
         if num_docs == 0 or total == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         with timer.phase("feed"):
@@ -1683,7 +1732,9 @@ class InvertedIndexModel:
             timer.count("tokens", num_pairs)
             if num_pairs == 0:
                 with timer.phase("emit"):
-                    formatter.emit_grouped(out_dir, {})
+                    formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
                 return timer.report()
             vocab = np.concatenate(vocab_parts)
             df64 = np.concatenate(df_parts)
@@ -1700,8 +1751,10 @@ class InvertedIndexModel:
                 out_dir, vocab=vocab, letter_of_term=letters,
                 order=order, df=df64, offsets=offsets,
                 postings=postings, max_doc_id=max_doc_id,
-                backend=self._emit_backend())
+                backend=self._emit_backend(),
+                artifact_path=self._artifact_path(out_dir))
         timer.count("lines_written", emit_stats["lines_written"])
+        self._count_artifact_stats(timer, emit_stats)
         return timer.report()
 
     def _run_tpu_device_tokenize_stream_dist(self, manifest: Manifest,
@@ -1767,7 +1820,9 @@ class InvertedIndexModel:
         timer.count("stream_windows", engine_s.windows_fed)
         if engine_s.windows_fed == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
         sort_cols = -(-max(engine_s.max_word_len, 1) // 4)  # ceil div
         timer.count("sort_cols", sort_cols)
@@ -1883,7 +1938,9 @@ class InvertedIndexModel:
 
         if num_tokens == 0:
             with timer.phase("emit"):
-                formatter.emit_grouped(out_dir, {})
+                formatter.emit_grouped(
+                    out_dir, {},
+                    artifact_path=self._artifact_path(out_dir))
             return timer.report()
 
         num_shards = self._num_shards()
@@ -2030,10 +2087,18 @@ class InvertedIndexModel:
                 postings=host["postings"],
                 max_doc_id=max_doc_id,
                 backend=self._emit_backend(),
+                artifact_path=self._artifact_path(out_dir),
             )
         timer.count("unique_pairs", int(host["num_unique"]))
         timer.count("lines_written", emit_stats["lines_written"])
+        self._count_artifact_stats(timer, emit_stats)
         return timer.report()
+
+    @staticmethod
+    def _count_artifact_stats(timer: PhaseTimer, emit_stats: dict) -> None:
+        for key in ("artifact_bytes", "artifact_build_ms"):
+            if key in emit_stats:
+                timer.count(key, emit_stats[key])
 
 
 def build_index(manifest: Manifest, config: IndexConfig | None = None,

@@ -39,13 +39,18 @@ def group_for_emit(postings: dict[str, list[int]]) -> dict[int, list[tuple[bytes
     return per_letter
 
 
-def oracle_index(manifest: Manifest, output_dir: str | Path = ".") -> dict:
-    """End-to-end oracle run: manifest -> 26 letter files."""
+def oracle_index(manifest: Manifest, output_dir: str | Path = ".",
+                 artifact_path: str | Path | None = None) -> dict:
+    """End-to-end oracle run: manifest -> 26 letter files (and the
+    serving artifact when ``artifact_path`` is set — the conformance
+    oracle for serve/ too)."""
     contents, doc_ids = load_documents(manifest)
     postings = oracle_postings(contents, doc_ids)
-    emit_grouped(output_dir, group_for_emit(postings))
+    art_stats = emit_grouped(output_dir, group_for_emit(postings),
+                             artifact_path=artifact_path)
     return {
         "documents": len(contents),
         "unique_terms": len(postings),
         "postings": sum(len(v) for v in postings.values()),
+        **art_stats,
     }

@@ -243,6 +243,25 @@ def load():
         lib.mri_hidxm_emit_range.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
         ]
+        lib.mri_hidxm_export_info.restype = ctypes.c_int32
+        lib.mri_hidxm_export_info.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_hidxm_export_payload.restype = ctypes.c_int32
+        lib.mri_hidxm_export_payload.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.mri_hidxm_export.restype = ctypes.c_int32
+        lib.mri_hidxm_export.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.mri_token_stats.restype = ctypes.c_int32
         lib.mri_token_stats.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
@@ -750,6 +769,76 @@ class HostIndexMerge:
                 f"native host merge failed writing letters "
                 f"[{letter_lo}, {letter_hi}) to {out_dir!r}")
         return int(n)
+
+    def export_arrays(self) -> dict:
+        """Columnar lex-order export of the merged index — the serving
+        artifact's source arrays, no letter-file text round-trip.
+
+        Returns ``vocab_packed`` ((V, width) uint8 NUL-padded rows),
+        ``word_lens`` (V int32), ``df`` (V int64), ``offsets`` (V+1
+        int64 exclusive prefix), ``postings`` (P int32, globally
+        ascending per term), ``df_order`` (V int64 — emit-order
+        permutation over lex indices), ``letter_off`` (27 int64), plus
+        ``vocab``/``width``/``max_doc_id``/``num_pairs`` scalars.
+        Read-only on the merge state.
+        """
+        V, width, P, _, mdi = self.export_info()
+        vocab_packed = np.zeros((max(V, 1), width), dtype=np.uint8)
+        word_lens = np.zeros(max(V, 1), dtype=np.int32)
+        df = np.zeros(max(V, 1), dtype=np.int64)
+        offsets = np.zeros(V + 1, dtype=np.int64)
+        postings = np.zeros(max(P, 1), dtype=np.int32)
+        df_order = np.zeros(max(V, 1), dtype=np.int64)
+        letter_off = np.zeros(27, dtype=np.int64)
+
+        def ptr(a, ctype):
+            return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+        rc = self._lib.mri_hidxm_export(
+            self._handle, ptr(vocab_packed, ctypes.c_uint8),
+            ptr(word_lens, ctypes.c_int32), ptr(df, ctypes.c_int64),
+            ptr(offsets, ctypes.c_int64), ptr(postings, ctypes.c_int32),
+            ptr(df_order, ctypes.c_int64), ptr(letter_off, ctypes.c_int64))
+        if rc == -2:
+            raise MemoryError("native merge export allocation failure")
+        if rc != 0:
+            raise RuntimeError(f"native merge export failed (rc={rc})")
+        return {
+            "vocab_packed": vocab_packed[:V], "word_lens": word_lens[:V],
+            "df": df[:V], "offsets": offsets, "postings": postings[:P],
+            "df_order": df_order[:V], "letter_off": letter_off,
+            "vocab": V, "width": width, "max_doc_id": mdi,
+            "num_pairs": P,
+        }
+
+    def export_info(self) -> tuple[int, int, int, int, int]:
+        """``(vocab, width, num_pairs, blob_bytes, max_doc_id)`` of the
+        merged index — the artifact layout's scalars, O(V)."""
+        v = ctypes.c_int32(0)
+        w = ctypes.c_int32(0)
+        mdi = ctypes.c_int32(0)
+        pairs = ctypes.c_int64(0)
+        blob = ctypes.c_int64(0)
+        self._lib.mri_hidxm_export_info(
+            self._handle, ctypes.byref(v), ctypes.byref(w),
+            ctypes.byref(mdi), ctypes.byref(pairs), ctypes.byref(blob))
+        return (int(v.value), int(w.value), int(pairs.value),
+                int(blob.value), int(mdi.value))
+
+    def export_payload(self, buf: np.ndarray, offsets: dict) -> None:
+        """One-pass fill of an ``index.mri`` file buffer: every payload
+        section written at ``offsets[section]`` (absolute byte offsets
+        into ``buf``), postings already delta-encoded.  Read-only on the
+        merge state; ``buf`` must be C-contiguous uint8."""
+        rc = self._lib.mri_hidxm_export_payload(
+            self._handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            *(ctypes.c_int64(offsets[name]) for name in (
+                "letter_dir", "term_offsets", "term_blob", "df",
+                "post_offsets", "postings", "df_order")))
+        if rc == -2:
+            raise MemoryError("native artifact export allocation failure")
+        if rc != 0:
+            raise RuntimeError(f"native artifact export failed (rc={rc})")
 
     def audit(self) -> tuple[int, int]:
         """Walk every global term's worker runs checking the merge

@@ -1,0 +1,27 @@
+"""jax version compat: one place that resolves ``shard_map``.
+
+``jax.shard_map`` became a top-level export (with the ``check_vma``
+kwarg) only in newer jax; older releases ship it as
+``jax.experimental.shard_map.shard_map`` where the same knob is called
+``check_rep``.  Every ``parallel/dist_*`` engine (and the ops-layer
+code that runs inside their mapped bodies) imports :func:`shard_map`
+from here so the version probe happens exactly once, at import time —
+call sites keep the modern signature unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.6: the experimental module, check_vma spelled check_rep
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+__all__ = ["shard_map"]

@@ -1,0 +1,15 @@
+"""Read side of the index: the ``index.mri`` serving artifact.
+
+The build engines (models/, native/) end at 26 letter files — a
+write-only artifact.  This package is the query path: a compact,
+memory-mappable columnar artifact packed at emit time
+(:mod:`~.artifact`), a zero-copy vectorized query engine over it
+(:mod:`~.engine`), and the LRU hot-term cache the engine decodes
+postings through (:mod:`~.cache`).  ``mri-tpu query`` (cli.py) and
+``tools/bench_serve.py`` sit on top.
+"""
+
+from .artifact import ARTIFACT_NAME, ArtifactError, load_artifact
+from .engine import Engine
+
+__all__ = ["ARTIFACT_NAME", "ArtifactError", "Engine", "load_artifact"]

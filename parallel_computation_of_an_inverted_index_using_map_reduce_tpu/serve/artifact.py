@@ -1,0 +1,418 @@
+"""``index.mri`` — the compact, memory-mappable serving artifact.
+
+The letter files are the conformance surface (byte-exact against the
+reference); this is the *serving* surface: one columnar file the query
+engine mmaps and reads with zero-copy numpy views, so a process serving
+lookups never re-parses text.
+
+Format v1, little-endian throughout:
+
+    header (96 bytes)
+      magic            8s   b"MRIIDX01"
+      version          u32  1
+      width            u32  fixed term-row width (max term length)
+      vocab            i64  V — number of terms
+      num_postings     i64  P — total (term, doc) pairs
+      max_doc_id       i64
+      term_blob_bytes  i64
+      payload_bytes    i64  everything after the header
+      payload_adler32  u32  over the payload bytes
+      reserved         32 zero bytes
+      header_adler32   u32  over header bytes [0, 92)
+
+    payload — fixed section order, each section 16-byte aligned:
+      letter_dir    i64[27]   lex term-index bounds per first letter
+      term_offsets  i64[V+1]  exclusive prefix into term_blob
+      term_blob     u8[...]   term bytes, lex order, no separators
+      df            i32[V]    document frequency per term
+      post_offsets  i64[V+1]  exclusive prefix into postings
+      postings      i32[P]    per-term runs, delta-encoded: first doc id
+                              absolute, the rest diffs (>= 1 — ids are
+                              strictly ascending within a term)
+      df_order      i32[V]    emit-order permutation over lex indices
+                              (letter asc, df desc, word asc); its
+                              letter bounds are letter_dir too, since
+                              both orders are letter-contiguous
+
+Terms are in lexicographic order — the engine's binary-search key — and
+``df_order`` gives O(k) top-k-by-df per letter.  Writes are atomic
+(tmp + rename), loads verify both checksums before any answer is
+served: a torn artifact raises :class:`ArtifactError`, never garbage.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+#: Written next to a.txt..z.txt by ``--artifact`` runs.
+ARTIFACT_NAME = "index.mri"
+
+MAGIC = b"MRIIDX01"
+VERSION = 1
+HEADER_BYTES = 96
+_ALIGN = 16
+_HEADER_FMT = "<8sIIqqqqqI"  # ... + 32 reserved + u32 header_adler32
+
+
+class ArtifactError(RuntimeError):
+    """The artifact is missing, torn, or not an artifact at all."""
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _layout(vocab: int, num_postings: int, blob_bytes: int):
+    """Section name -> (file offset, byte length), plus total file size.
+
+    Deterministic from the three header scalars, so the loader never
+    stores per-section offsets in the file.
+    """
+    sections = [
+        ("letter_dir", 27 * 8),
+        ("term_offsets", (vocab + 1) * 8),
+        ("term_blob", blob_bytes),
+        ("df", vocab * 4),
+        ("post_offsets", (vocab + 1) * 8),
+        ("postings", num_postings * 4),
+        ("df_order", vocab * 4),
+    ]
+    out: dict[str, tuple[int, int]] = {}
+    cur = HEADER_BYTES
+    for name, nbytes in sections:
+        cur = _align(cur)
+        out[name] = (cur, nbytes)
+        cur += nbytes
+    return out, _align(cur)
+
+
+def artifact_path(index_dir: str | Path) -> Path:
+    return Path(index_dir) / ARTIFACT_NAME
+
+
+def pack(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
+         df: np.ndarray, post_offsets: np.ndarray, postings: np.ndarray,
+         df_order: np.ndarray, max_doc_id: int, width: int | None = None
+         ) -> int:
+    """Write the artifact from lex-order arrays; returns bytes written.
+
+    ``postings`` arrives ABSOLUTE (ascending per term) — the delta
+    encoding happens here, vectorized: one subtraction pass plus a
+    scatter restoring each term's first id.
+    """
+    path = Path(path)
+    term_offsets = np.ascontiguousarray(term_offsets, dtype=np.int64)
+    post_offsets = np.ascontiguousarray(post_offsets, dtype=np.int64)
+    term_blob = np.ascontiguousarray(term_blob, dtype=np.uint8)
+    df = np.ascontiguousarray(df, dtype=np.int32)
+    df_order = np.ascontiguousarray(df_order, dtype=np.int32)
+    postings = np.asarray(postings, dtype=np.int32)
+    vocab = len(df)
+    num_postings = int(post_offsets[-1]) if len(post_offsets) else 0
+    blob_bytes = int(term_offsets[-1]) if len(term_offsets) else 0
+    if width is None:
+        lens = np.diff(term_offsets)
+        width = int(lens.max()) if vocab else 1
+
+    deltas = postings.copy()
+    if num_postings:
+        deltas[1:] -= postings[:-1]
+        starts = post_offsets[:-1][np.diff(post_offsets) > 0]
+        deltas[starts] = postings[starts]
+
+    layout, total = _layout(vocab, num_postings, blob_bytes)
+    buf = np.zeros(total, dtype=np.uint8)
+
+    def put(name: str, arr: np.ndarray) -> None:
+        off, nbytes = layout[name]
+        buf[off:off + nbytes] = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+
+    first_bytes = term_blob[term_offsets[:-1]] if vocab else term_blob[:0]
+    letter_dir = np.searchsorted(
+        first_bytes, np.arange(ord("a"), ord("a") + 27)).astype(np.int64)
+    put("letter_dir", letter_dir)
+    put("term_offsets", term_offsets)
+    put("term_blob", term_blob)
+    put("df", df)
+    put("post_offsets", post_offsets)
+    put("postings", deltas)
+    put("df_order", df_order)
+
+    return _write(path, buf, width=width, vocab=vocab,
+                  num_postings=num_postings, max_doc_id=max_doc_id,
+                  blob_bytes=blob_bytes)
+
+
+def _header(*, width: int, vocab: int, num_postings: int, max_doc_id: int,
+            blob_bytes: int, payload_len: int, payload_crc: int) -> bytes:
+    header = struct.pack(
+        _HEADER_FMT, MAGIC, VERSION, int(max(width, 1)), vocab,
+        num_postings, int(max_doc_id), blob_bytes, payload_len,
+        payload_crc)
+    header = header + b"\0" * (HEADER_BYTES - 4 - len(header))
+    return header + struct.pack("<I", zlib.adler32(header))
+
+
+def _write(path, buf: np.ndarray, *, width: int, vocab: int,
+           num_postings: int, max_doc_id: int, blob_bytes: int) -> int:
+    """Checksum + header a filled file buffer, write atomically."""
+    path = Path(path)
+    payload = buf[HEADER_BYTES:]
+    header = _header(width=width, vocab=vocab, num_postings=num_postings,
+                     max_doc_id=max_doc_id, blob_bytes=blob_bytes,
+                     payload_len=len(payload),
+                     payload_crc=zlib.adler32(payload))
+    buf[:HEADER_BYTES] = np.frombuffer(header, dtype=np.uint8)
+
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(memoryview(buf))
+    os.replace(tmp, path)
+    return len(buf)
+
+
+class Artifact:
+    """Zero-copy numpy views over a verified, mmapped ``index.mri``."""
+
+    def __init__(self, path: Path, mm: mmap.mmap, meta: dict,
+                 views: dict[str, np.ndarray]):
+        self.path = path
+        self._mm = mm
+        self.vocab = meta["vocab"]
+        self.num_postings = meta["num_postings"]
+        self.max_doc_id = meta["max_doc_id"]
+        self.width = meta["width"]
+        self.nbytes = meta["nbytes"]
+        self.letter_dir = views["letter_dir"]
+        self.term_offsets = views["term_offsets"]
+        self.term_blob = views["term_blob"]
+        self.df = views["df"]
+        self.post_offsets = views["post_offsets"]
+        self.postings = views["postings"]  # delta-encoded
+        self.df_order = views["df_order"]
+
+    def term(self, idx: int) -> bytes:
+        lo, hi = self.term_offsets[idx], self.term_offsets[idx + 1]
+        return self.term_blob[lo:hi].tobytes()
+
+    def decode_postings(self, idx: int) -> np.ndarray:
+        """One term's absolute ascending doc ids (a fresh array)."""
+        lo, hi = self.post_offsets[idx], self.post_offsets[idx + 1]
+        return np.cumsum(self.postings[lo:hi], dtype=np.int64).astype(
+            np.int32)
+
+    def close(self) -> None:
+        # drop the views before the mmap: numpy holds buffer references
+        for name in ("letter_dir", "term_offsets", "term_blob", "df",
+                     "post_offsets", "postings", "df_order"):
+            setattr(self, name, None)
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except BufferError:
+                # a caller still holds a view (e.g. an engine's df
+                # column): the map frees when the last view dies
+                pass
+            self._mm = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_artifact(path: str | Path) -> Artifact:
+    """mmap + verify an artifact (a directory means its ``index.mri``).
+
+    Every structural and checksum violation raises :class:`ArtifactError`
+    with a one-line reason — the contract the CLI maps to exit 2.
+    """
+    path = Path(path)
+    if path.is_dir():
+        path = path / ARTIFACT_NAME
+    try:
+        f = open(path, "rb")
+    except OSError as e:
+        raise ArtifactError(f"{path}: cannot open artifact ({e})") from e
+    with f:
+        try:
+            size = os.fstat(f.fileno()).st_size
+            if size < HEADER_BYTES:
+                raise ArtifactError(
+                    f"{path}: {size} bytes is smaller than the "
+                    f"{HEADER_BYTES}-byte header")
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (OSError, ValueError) as e:
+            raise ArtifactError(f"{path}: cannot map artifact ({e})") from e
+    try:
+        head = bytes(mm[:HEADER_BYTES])
+        (want_crc,) = struct.unpack_from("<I", head, HEADER_BYTES - 4)
+        if zlib.adler32(head[:HEADER_BYTES - 4]) != want_crc:
+            raise ArtifactError(f"{path}: header checksum mismatch")
+        (magic, version, width, vocab, num_postings, max_doc_id,
+         blob_bytes, payload_bytes, payload_crc) = struct.unpack_from(
+            _HEADER_FMT, head)
+        if magic != MAGIC:
+            raise ArtifactError(
+                f"{path}: bad magic {magic!r} (not an index.mri)")
+        if version != VERSION:
+            raise ArtifactError(
+                f"{path}: unsupported artifact version {version} "
+                f"(this reader knows version {VERSION})")
+        layout, total = _layout(vocab, num_postings, blob_bytes)
+        if total != size or payload_bytes != size - HEADER_BYTES:
+            raise ArtifactError(
+                f"{path}: truncated artifact — header promises "
+                f"{total} bytes, file has {size}")
+        if zlib.adler32(mm[HEADER_BYTES:]) != payload_crc:
+            raise ArtifactError(f"{path}: payload checksum mismatch")
+
+        raw = np.frombuffer(mm, dtype=np.uint8)
+        dtypes = {"letter_dir": np.int64, "term_offsets": np.int64,
+                  "term_blob": np.uint8, "df": np.int32,
+                  "post_offsets": np.int64, "postings": np.int32,
+                  "df_order": np.int32}
+        views = {name: raw[off:off + nbytes].view(dtypes[name])
+                 for name, (off, nbytes) in layout.items()}
+        meta = {"vocab": vocab, "num_postings": num_postings,
+                "max_doc_id": max_doc_id, "width": width, "nbytes": size}
+        return Artifact(path, mm, meta, views)
+    except ArtifactError:
+        mm.close()
+        raise
+    except Exception:
+        mm.close()
+        raise
+
+
+def checksum(path: str | Path) -> tuple[str, int]:
+    """``(adler32_hex, size)`` of the artifact file — the audit
+    manifest's fingerprint, same scheme as the letter files."""
+    data = Path(path).read_bytes()
+    return f"{zlib.adler32(data):08x}", len(data)
+
+
+# -- builders: lex arrays from each engine family's native shapes --------
+
+
+def build_from_merge(path, merge) -> int:
+    """Pack straight off a live :class:`native.HostIndexMerge`: one C++
+    pass fills every payload section of the final file buffer at the
+    layout's offsets — compact blob, delta-encoded postings and all —
+    leaving only checksums, the header, and the atomic write here.  The
+    cpu backend's fast path: the two-step ``export_arrays`` +
+    :func:`build_from_export` route costs ~2x more on the pack-time
+    budget (<= 10 % of the unaudited e2e)."""
+    vocab, width, num_pairs, blob_bytes, max_doc_id = merge.export_info()
+    layout, total = _layout(vocab, num_pairs, blob_bytes)
+    buf = np.zeros(total, dtype=np.uint8)
+    merge.export_payload(buf, {n: off for n, (off, _) in layout.items()})
+    return _write(path, buf, width=width, vocab=vocab,
+                  num_postings=num_pairs, max_doc_id=max_doc_id,
+                  blob_bytes=blob_bytes)
+
+
+def build_from_export(path, export: dict) -> int:
+    """Pack from :meth:`native.HostIndexMerge.export_arrays` output —
+    the cpu backend's no-text-round-trip path."""
+    vocab_packed = export["vocab_packed"]
+    word_lens = np.asarray(export["word_lens"], dtype=np.int64)
+    term_offsets = np.zeros(len(word_lens) + 1, dtype=np.int64)
+    np.cumsum(word_lens, out=term_offsets[1:])
+    if len(word_lens):
+        # trim the NUL padding out of the fixed-width rows, vectorized:
+        # keep column j of row i when j < word_lens[i]
+        width = vocab_packed.shape[1]
+        mask = np.arange(width) < word_lens[:, None]
+        term_blob = vocab_packed[mask]
+    else:
+        term_blob = np.zeros(0, dtype=np.uint8)
+    return pack(
+        path, term_blob=term_blob, term_offsets=term_offsets,
+        df=export["df"], post_offsets=export["offsets"],
+        postings=export["postings"], df_order=export["df_order"],
+        max_doc_id=export["max_doc_id"], width=export["width"])
+
+
+def build_from_emit_arrays(path, *, vocab: np.ndarray, order: np.ndarray,
+                           df: np.ndarray, offsets: np.ndarray,
+                           postings: np.ndarray, max_doc_id: int) -> int:
+    """Pack from ``formatter.emit_index``'s argument shapes (the device
+    engines' host-side arrays): 'S' terms in ANY order (re-sorted to
+    the lex invariant here), ``order`` the emit permutation over those
+    indices, ``offsets``/``df`` addressing absolute postings in a
+    possibly oversized buffer (gaps re-compacted here)."""
+    vocab = np.asarray(vocab)
+    df = np.asarray(df, dtype=np.int64)
+    order = np.asarray(order, dtype=np.int64)
+    offsets = np.asarray(offsets, dtype=np.int64)
+    postings = np.asarray(postings, dtype=np.int32)
+    V = len(vocab)
+    # original index -> lex rank (identity when vocab arrives sorted,
+    # e.g. from the one-shot device engine's sorted-unique output)
+    perm = np.argsort(vocab, kind="stable")
+    inv = np.empty(V, dtype=np.int64)
+    inv[perm] = np.arange(V)
+    vocab = vocab[perm]
+    df_lex = df[perm]
+    starts_lex = offsets[perm]
+    lens = np.char.str_len(vocab).astype(np.int64) if V else \
+        np.zeros(0, dtype=np.int64)
+    term_offsets = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(lens, out=term_offsets[1:])
+    if V:
+        width = vocab.dtype.itemsize
+        rows = np.ascontiguousarray(vocab).view(np.uint8).reshape(V, width)
+        term_blob = rows[np.arange(width) < lens[:, None]]
+    else:
+        term_blob = np.zeros(0, dtype=np.uint8)
+    post_offsets = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(df_lex, out=post_offsets[1:])
+    P = int(post_offsets[-1])
+    flat = np.zeros(0, dtype=np.int32)
+    if P:
+        src = (np.repeat(starts_lex, df_lex)
+               + (np.arange(P) - np.repeat(post_offsets[:-1], df_lex)))
+        flat = postings[src]
+    return pack(
+        path, term_blob=term_blob, term_offsets=term_offsets, df=df_lex,
+        post_offsets=post_offsets, postings=flat,
+        df_order=inv[order], max_doc_id=int(max_doc_id))
+
+
+def build_from_grouped(path, per_letter: dict) -> int:
+    """Pack from the oracle/empty-path grouped form: per-letter lists of
+    ``(word_bytes, ids)`` already in emit order."""
+    words: list[bytes] = []
+    ids: list[list[int]] = []
+    for letter in sorted(per_letter):
+        for word, docs in per_letter[letter]:
+            words.append(word)
+            ids.append(list(docs))
+    emit_to_lex = np.argsort(np.array(words, dtype="S") if words
+                             else np.zeros(0, dtype="S1"), kind="stable")
+    lex_words = [words[i] for i in emit_to_lex]
+    # df_order[emit position] = lex index: the argsort's inverse
+    df_order = np.empty(len(words), dtype=np.int64)
+    df_order[emit_to_lex] = np.arange(len(words))
+    term_blob = np.frombuffer(b"".join(lex_words), dtype=np.uint8)
+    term_offsets = np.zeros(len(words) + 1, dtype=np.int64)
+    np.cumsum([len(w) for w in lex_words], out=term_offsets[1:])
+    df = np.array([len(ids[i]) for i in emit_to_lex], dtype=np.int64)
+    post_offsets = np.zeros(len(words) + 1, dtype=np.int64)
+    np.cumsum(df, out=post_offsets[1:])
+    flat = (np.concatenate([np.asarray(ids[i], dtype=np.int32)
+                            for i in emit_to_lex])
+            if words else np.zeros(0, dtype=np.int32))
+    max_doc_id = int(flat.max()) if len(flat) else 0
+    return pack(
+        path, term_blob=term_blob, term_offsets=term_offsets, df=df,
+        post_offsets=post_offsets, postings=flat, df_order=df_order,
+        max_doc_id=max_doc_id)

@@ -1,0 +1,66 @@
+"""LRU hot-term cache for decoded posting runs.
+
+The artifact stores postings delta-encoded; decoding is one cumsum per
+term.  Under a Zipf workload a few hundred hot terms cover most lookups,
+so the engine keeps their decoded arrays here — bounded by entry count
+(hot terms are the frequent ones, so bounding by count bounds bytes by
+roughly ``capacity * mean_hot_df * 4``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+_MISSING = object()
+
+
+class LRUCache:
+    """Plain ordered-dict LRU with hit/miss counters (single-thread:
+    one Engine per serving thread, like one cursor per connection)."""
+
+    def __init__(self, capacity: int):
+        if capacity < 0:
+            raise ValueError(f"cache capacity must be >= 0, got {capacity}")
+        self.capacity = capacity
+        self._data: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, default=None):
+        value = self._data.get(key, _MISSING)
+        if value is _MISSING:
+            self.misses += 1
+            return default
+        self._data.move_to_end(key)
+        self.hits += 1
+        return value
+
+    def put(self, key, value) -> None:
+        if self.capacity == 0:
+            return
+        if key in self._data:
+            self._data.move_to_end(key)
+        self._data[key] = value
+        if len(self._data) > self.capacity:
+            self._data.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:  # no counter side effects
+        return key in self._data
+
+    def clear(self) -> None:
+        self._data.clear()
+        self.hits = 0
+        self.misses = 0
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "capacity": self.capacity,
+            "entries": len(self._data),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+        }

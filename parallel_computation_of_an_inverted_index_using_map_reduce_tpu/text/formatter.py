@@ -61,6 +61,7 @@ def emit_index(
     max_doc_id: int,
     letter_range: tuple[int, int] = (0, ALPHABET_SIZE),
     backend: str = "python",
+    artifact_path: str | Path | None = None,
 ) -> dict:
     """Write letter files from the device engine's output arrays.
 
@@ -80,6 +81,26 @@ def emit_index(
     os.makedirs(output_dir, exist_ok=True)
     if backend not in ("python", "auto", "native"):
         raise ValueError(f"unknown emit backend {backend!r}")
+    if artifact_path is not None and tuple(letter_range) != (0, ALPHABET_SIZE):
+        raise ValueError(
+            "artifact_path requires the full letter range: a partial "
+            "emit does not hold the whole index")
+
+    def _pack_artifact() -> dict:
+        if artifact_path is None:
+            return {}
+        import time
+
+        from ..serve import artifact as artifact_mod
+
+        t0 = time.perf_counter()
+        nbytes = artifact_mod.build_from_emit_arrays(
+            artifact_path, vocab=np.asarray(vocab), order=order, df=df,
+            offsets=offsets, postings=postings, max_doc_id=max_doc_id)
+        return {"artifact_bytes": int(nbytes),
+                "artifact_build_ms": round(
+                    (time.perf_counter() - t0) * 1e3, 3)}
+
     if backend in ("auto", "native"):
         from .. import native
 
@@ -101,7 +122,7 @@ def emit_index(
             return {"lines_written": lines,
                     "letters": lr[1] - lr[0],
                     "bytes_written": int(bytes_written),
-                    "emit_backend": "native"}
+                    "emit_backend": "native", **_pack_artifact()}
         if backend == "native":
             raise RuntimeError(
                 f"emit_backend='native' but the native library is "
@@ -132,7 +153,7 @@ def emit_index(
         _maybe_kill_after(letters_done)
     return {"lines_written": lines_written,
             "letters": letter_range[1] - letter_range[0],
-            "emit_backend": "python"}
+            "emit_backend": "python", **_pack_artifact()}
 
 
 def letters_md5(output_dir: str | Path) -> str:
@@ -148,8 +169,11 @@ def letters_md5(output_dir: str | Path) -> str:
 
 
 def emit_grouped(output_dir: str | Path,
-                 per_letter: dict[int, list[tuple[bytes, list[int]]]]) -> None:
-    """Write letter files from already-ordered (word, ids) groups (oracle path)."""
+                 per_letter: dict[int, list[tuple[bytes, list[int]]]],
+                 artifact_path: str | Path | None = None) -> dict:
+    """Write letter files from already-ordered (word, ids) groups
+    (oracle + empty-corpus paths); optionally pack the serving artifact
+    from the same groups.  Returns artifact stats when packed."""
     output_dir = Path(output_dir)
     os.makedirs(output_dir, exist_ok=True)
     for letter in range(ALPHABET_SIZE):
@@ -159,3 +183,13 @@ def emit_grouped(output_dir: str | Path,
             out += word + b":[" + " ".join(map(str, ids)).encode("ascii") + b"]\n"
         _write_letter_atomic(output_dir / letter_filename(letter), bytes(out))
         _maybe_kill_after(letter + 1)
+    if artifact_path is None:
+        return {}
+    import time
+
+    from ..serve import artifact as artifact_mod
+
+    t0 = time.perf_counter()
+    nbytes = artifact_mod.build_from_grouped(artifact_path, per_letter)
+    return {"artifact_bytes": int(nbytes),
+            "artifact_build_ms": round((time.perf_counter() - t0) * 1e3, 3)}

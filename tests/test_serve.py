@@ -1,0 +1,358 @@
+"""Query-serving suite: ``serve.Engine`` golden parity against a naive
+text scan, ``index.mri`` corruption rejection, cache semantics.
+
+The parity oracle is deliberately dumb: re-read every document, apply
+the reference token rules (clean_token), and build a dict of sorted
+postings sets in pure Python.  Every Engine answer — df, postings,
+top-k, AND/OR — must match it exactly, on the 4-doc edge-case smoke
+corpus and on a sampled Zipf corpus built through the real cpu
+pipeline with ``--artifact``.
+"""
+
+import json
+import random
+import re
+
+import numpy as np
+import pytest
+
+from conftest import FIXTURES
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import main
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    ArtifactError, Engine, load_artifact,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.artifact import (
+    HEADER_BYTES, artifact_path,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    clean_token,
+)
+
+pytestmark = pytest.mark.serve
+
+_C_WHITESPACE = re.compile(rb"[ \t\n\v\f\r]+")
+
+
+def naive_index(doc_blobs) -> dict[str, list[int]]:
+    """Reference-rule inverted index by brute force: C-locale whitespace
+    split, clean_token per token, 1-based doc ids, sorted unique."""
+    post: dict[str, set[int]] = {}
+    for doc_id, blob in enumerate(doc_blobs, start=1):
+        for raw in _C_WHITESPACE.split(blob):
+            w = clean_token(raw)
+            if w:
+                post.setdefault(w, set()).add(doc_id)
+    return {t: sorted(d) for t, d in post.items()}
+
+
+def build_corpus(tmp_path, docs: list[bytes]):
+    """Write docs + manifest, run the cpu backend with --artifact."""
+    ddir = tmp_path / "docs"
+    ddir.mkdir()
+    paths = []
+    for i, blob in enumerate(docs):
+        p = ddir / f"d{i:04d}.txt"
+        p.write_bytes(blob)
+        paths.append(str(p))
+    listfile = tmp_path / "list.txt"
+    write_manifest(listfile, paths)
+    out = tmp_path / "out"
+    assert main(["1", "1", str(listfile), "--backend", "cpu",
+                 "--output-dir", str(out), "--artifact"]) == 0
+    return out
+
+
+@pytest.fixture(scope="module")
+def smoke_built(tmp_path_factory):
+    docs = [(FIXTURES / "smoke" / "docs" / f"doc{i}.txt").read_bytes()
+            for i in range(1, 5)]
+    out = build_corpus(tmp_path_factory.mktemp("serve_smoke"), docs)
+    return out, naive_index(docs)
+
+
+@pytest.fixture(scope="module")
+def zipf_built(tmp_path_factory):
+    docs = zipf_corpus(num_docs=60, vocab_size=900, tokens_per_doc=150, seed=11)
+    out = build_corpus(tmp_path_factory.mktemp("serve_zipf"), docs)
+    return out, naive_index(docs)
+
+
+def _assert_engine_matches(engine: Engine, naive: dict[str, list[int]],
+                           terms) -> None:
+    batch = engine.encode_batch(terms)
+    dfs = engine.df(batch)
+    posts = engine.postings(batch)
+    for t, df, post in zip(terms, dfs, posts):
+        want = naive.get(t)
+        if want is None:
+            assert df == 0 and post is None, t
+        else:
+            assert df == len(want), t
+            assert post.tolist() == want, t
+
+
+# -- golden parity ------------------------------------------------------
+
+
+def test_smoke_parity_exhaustive(smoke_built):
+    """Every vocabulary term, both directions: Engine == naive scan."""
+    out, naive = smoke_built
+    with Engine(artifact_path(out)) as engine:
+        assert engine.vocab_size == len(naive)
+        vocab = sorted(naive)
+        _assert_engine_matches(engine, naive, vocab)
+        # and the artifact's own term table is exactly the naive vocab
+        art_terms = [engine.artifact.term(i).decode() for i in range(engine.vocab_size)]
+        assert art_terms == vocab
+
+
+def test_smoke_top_k_matches_letter_files(smoke_built):
+    """top_k == the first k lines of the golden letter files."""
+    out, _ = smoke_built
+    golden = FIXTURES / "smoke" / "golden"
+    with Engine(artifact_path(out)) as engine:
+        for li in range(26):
+            lines = (golden / f"{chr(ord('a') + li)}.txt").read_bytes().splitlines()
+            lines = [ln for ln in lines if ln]
+            got = engine.top_k(li, k=len(lines) or 1)
+            assert len(got) == len(lines)
+            for (term, df), line in zip(got, lines):
+                want_term, _, ids = line.partition(b":")
+                assert term == want_term
+                assert df == len(ids.strip(b"[]").split())
+
+
+def test_zipf_parity_sampled(zipf_built):
+    """Sampled + boundary terms of a pipeline-built Zipf corpus."""
+    out, naive = zipf_built
+    vocab = sorted(naive)
+    rng = random.Random(3)
+    sample = rng.sample(vocab, k=min(200, len(vocab)))
+    # per-letter boundary terms: binary-search edge cases
+    by_letter: dict[str, list[str]] = {}
+    for t in vocab:
+        by_letter.setdefault(t[0], []).append(t)
+    for ts in by_letter.values():
+        sample += [ts[0], ts[-1]]
+    with Engine(artifact_path(out)) as engine:
+        assert engine.vocab_size == len(vocab)
+        _assert_engine_matches(engine, naive, sample)
+
+
+def test_zipf_boolean_parity(zipf_built):
+    """AND/OR against naive set algebra, absent terms included."""
+    out, naive = zipf_built
+    vocab = sorted(naive)
+    rng = random.Random(5)
+    with Engine(artifact_path(out)) as engine:
+        for _ in range(60):
+            k = rng.choice((2, 2, 3))
+            terms = rng.sample(vocab, k=k)
+            if rng.random() < 0.25:
+                terms[rng.randrange(k)] = "notinthecorpusxyz"
+            batch = engine.encode_batch(terms)
+            sets = [set(naive.get(t, ())) for t in terms]
+            want_and = sorted(set.intersection(*sets)) if all(sets) else []
+            want_or = sorted(set.union(*sets))
+            assert engine.query_and(batch).tolist() == want_and, terms
+            assert engine.query_or(batch).tolist() == want_or, terms
+
+
+def test_zipf_fuzz_lookups(zipf_built):
+    """Random batches: present, absent, mixed-case, punctuated, empty."""
+    out, naive = zipf_built
+    vocab = sorted(naive)
+    rng = random.Random(7)
+    junk = ["", "zzzznope", "Aardvark!!", "x1y2z3q4", "a" * 40, "THE"]
+    with Engine(artifact_path(out)) as engine:
+        for _ in range(30):
+            terms = [rng.choice(vocab) if rng.random() < 0.7 else rng.choice(junk)
+                     for _ in range(rng.randrange(1, 33))]
+            # the engine normalizes queries with the same token rules
+            normalized = [clean_token(t) for t in terms]
+            _assert_engine_matches(engine, naive, normalized)
+
+
+# -- artifact integrity -------------------------------------------------
+
+
+def _corrupt(path, offset: int) -> None:
+    data = bytearray(path.read_bytes())
+    data[offset] ^= 0xFF
+    path.write_bytes(bytes(data))
+
+
+def test_corrupt_payload_rejected(smoke_built, tmp_path):
+    out, _ = smoke_built
+    art = tmp_path / "index.mri"
+    art.write_bytes(artifact_path(out).read_bytes())
+    _corrupt(art, HEADER_BYTES + 100)
+    with pytest.raises(ArtifactError, match="payload checksum"):
+        load_artifact(art)
+
+
+def test_corrupt_header_rejected(smoke_built, tmp_path):
+    out, _ = smoke_built
+    art = tmp_path / "index.mri"
+    art.write_bytes(artifact_path(out).read_bytes())
+    _corrupt(art, 12)
+    with pytest.raises(ArtifactError):
+        load_artifact(art)
+
+
+def test_truncated_artifact_rejected(smoke_built, tmp_path):
+    out, _ = smoke_built
+    blob = artifact_path(out).read_bytes()
+    art = tmp_path / "index.mri"
+    for cut in (50, HEADER_BYTES, len(blob) - 7):
+        art.write_bytes(blob[:cut])
+        with pytest.raises(ArtifactError):
+            load_artifact(art)
+
+
+def test_bad_magic_rejected(smoke_built, tmp_path):
+    out, _ = smoke_built
+    data = bytearray(artifact_path(out).read_bytes())
+    data[:8] = b"NOTMRI00"
+    art = tmp_path / "index.mri"
+    art.write_bytes(bytes(data))
+    with pytest.raises(ArtifactError, match="magic"):
+        load_artifact(art)
+
+
+def test_query_cli_corrupt_artifact_exits_2(smoke_built, tmp_path, capsys):
+    """CLI maps ArtifactError to the one-line exit-2 contract."""
+    out, _ = smoke_built
+    qdir = tmp_path / "q"
+    qdir.mkdir()
+    blob = artifact_path(out).read_bytes()
+    (qdir / "index.mri").write_bytes(blob[:50])
+    assert main(["query", str(qdir), "the"]) == 2
+    err = capsys.readouterr().err
+    assert "error:" in err and err.count("\n") == 1
+
+    data = bytearray(blob)
+    data[HEADER_BYTES + 64] ^= 0xFF
+    (qdir / "index.mri").write_bytes(bytes(data))
+    assert main(["query", str(qdir), "the"]) == 2
+    assert "checksum" in capsys.readouterr().err
+
+
+def test_query_cli_missing_artifact_exits_2(tmp_path, capsys):
+    assert main(["query", str(tmp_path), "the"]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_artifact_covered_by_audit_verify(tmp_path, capsys):
+    """--audit manifests index.mri; --verify re-checks it (exit 2 on rot)."""
+    docs = [b"alpha beta", b"beta gamma delta", b"alpha epsilon"]
+    ddir = tmp_path / "docs"
+    ddir.mkdir()
+    paths = []
+    for i, blob in enumerate(docs):
+        p = ddir / f"d{i}.txt"
+        p.write_bytes(blob)
+        paths.append(str(p))
+    listfile = tmp_path / "list.txt"
+    write_manifest(listfile, paths)
+    out = tmp_path / "out"
+    assert main(["1", "1", str(listfile), "--backend", "cpu",
+                 "--output-dir", str(out), "--artifact", "--audit"]) == 0
+    capsys.readouterr()
+    assert main(["--verify", str(out)]) == 0
+    _corrupt(artifact_path(out), HEADER_BYTES + 32)
+    assert main(["--verify", str(out)]) == 2
+    assert "index.mri" in capsys.readouterr().err
+
+
+# -- query CLI ----------------------------------------------------------
+
+
+def test_query_cli_terms_and_ops(smoke_built, capsys):
+    out, naive = smoke_built
+    assert main(["query", str(out), "the", "nosuchword"]) == 0
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    assert lines[0] == {"term": "the", "found": True,
+                        "df": len(naive["the"]), "postings": naive["the"]}
+    assert lines[1] == {"term": "nosuchword", "found": False,
+                        "df": 0, "postings": []}
+
+    assert main(["query", str(out), "--op", "and", "the", "dog"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["docs"] == sorted(set(naive["the"]) & set(naive["dog"]))
+
+    assert main(["query", str(out), "--op", "or", "zebra", "apple"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["docs"] == sorted(set(naive["zebra"]) | set(naive["apple"]))
+
+
+def test_query_cli_top_k(smoke_built, capsys):
+    out, naive = smoke_built
+    assert main(["query", str(out), "--top-k", "2", "--letter", "t"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    t_terms = sorted((t for t in naive if t.startswith("t")),
+                     key=lambda t: (-len(naive[t]), t))[:2]
+    assert [e["term"] for e in got["top"]] == t_terms
+    assert [e["df"] for e in got["top"]] == [len(naive[t]) for t in t_terms]
+
+
+# -- engine internals ---------------------------------------------------
+
+
+def test_lru_cache_semantics(zipf_built):
+    out, naive = zipf_built
+    vocab = sorted(naive)
+    with Engine(artifact_path(out), cache_terms=4) as engine:
+        terms = vocab[:6]
+        engine.postings(engine.encode_batch(terms))       # 6 misses, 2 evictions
+        stats = engine.cache_stats()
+        assert stats["misses"] == 6 and stats["entries"] == 4
+        engine.postings(engine.encode_batch(terms[-4:]))  # all resident
+        assert engine.cache_stats()["hits"] == 4
+        engine.postings(engine.encode_batch(terms[:1]))   # evicted -> miss
+        assert engine.cache_stats()["misses"] == 7
+        engine.cache.clear()
+        assert engine.cache_stats()["entries"] == 0
+        # answers identical with the cache cold again
+        _assert_engine_matches(engine, naive, terms)
+
+
+def test_engine_batched_equals_single(zipf_built):
+    """One big batch == the same lookups one by one."""
+    out, naive = zipf_built
+    vocab = sorted(naive)
+    terms = vocab[:97] + ["missingterm"] + vocab[-97:]
+    with Engine(artifact_path(out)) as engine:
+        batch = engine.encode_batch(terms)
+        dfs = engine.df(batch)
+        posts = engine.postings(batch)
+        for i, t in enumerate(terms):
+            b1 = engine.encode_batch([t])
+            assert engine.df(b1)[0] == dfs[i]
+            p1 = engine.postings(b1)[0]
+            if posts[i] is None:
+                assert p1 is None
+            else:
+                assert np.array_equal(p1, posts[i])
+
+
+def test_artifact_layout_header_fields(smoke_built):
+    out, naive = smoke_built
+    art = load_artifact(artifact_path(out))
+    try:
+        assert art.vocab == len(naive)
+        assert art.num_postings == sum(len(v) for v in naive.values())
+        assert art.max_doc_id == 4
+        assert art.nbytes == artifact_path(out).stat().st_size
+        # sections are struct-aligned views over one mapping
+        for arr in (art.term_offsets, art.df, art.post_offsets, art.postings):
+            assert arr.flags["ALIGNED"]
+    finally:
+        art.close()

@@ -1,0 +1,153 @@
+"""Query-serving benchmark: QPS / latency against the ``index.mri``
+artifact (make bench-serve).
+
+Prints ONE JSON line mirroring bench.py's shape:
+
+    {"metric": "serve_lookups_per_s", "value": N, "unit": "lookups/s",
+     "batches": {"1": {...}, "32": {...}, "1024": {...}}, ...}
+
+The workload is Zipf-distributed over the corpus vocabulary ranked by
+document frequency — rank-1 terms dominate, exactly the hot-head skew a
+serving cache exists for — drawn from the same corpus bench.py measures
+(the reference test_in when mounted, else the deterministic synthetic
+Zipf corpus at the same scale).  For each batch size the engine answers
+pre-generated batches through the full lookup path (term resolve →
+postings decode, LRU-cached); per-batch wall times give p50/p99, and
+``value`` is the cache-warm lookups/s at the largest batch size.
+
+Build overhead is measured the way bench.py measures everything else:
+best-of-N cpu e2e with and without ``--artifact`` on the same corpus,
+plus the pack time the run itself reports (``artifact_build_ms``) — the
+contract is <= 10 % of the unaudited cpu e2e.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+import bench
+
+BATCH_SIZES = tuple(
+    int(b) for b in os.environ.get("MRI_SERVE_BATCHES", "1,32,1024").split(","))
+#: total single-term lookups per batch size (split into batches)
+LOOKUPS = int(os.environ.get("MRI_SERVE_LOOKUPS", 200_000))
+ZIPF_S = float(os.environ.get("MRI_SERVE_ZIPF_S", 1.1))
+SEED = int(os.environ.get("MRI_SERVE_SEED", 17))
+
+
+def _build_index() -> tuple[str, dict]:
+    """One --artifact build of the bench corpus; returns (out_dir, report)."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel,
+    )
+
+    manifest, _ = bench._manifest()
+    out_dir = bench._scratch_mkdtemp("bench_serve_")
+    report = InvertedIndexModel(IndexConfig(
+        backend="cpu", output_dir=out_dir, artifact=True)).run(manifest)
+    return out_dir, report
+
+
+def _zipf_terms(engine, n: int, rng) -> list[str]:
+    """``n`` query words, Zipf over the vocabulary ranked by df desc."""
+    vocab = engine.vocab_size
+    # rank draw: k ~ Zipf(s) clipped to the vocab, then mapped through
+    # the global df-descending order so rank 1 IS the hottest term
+    ranks = np.minimum(rng.zipf(ZIPF_S, size=n), vocab) - 1
+    by_df = np.argsort(-engine._df, kind="stable")
+    idx = by_df[ranks]
+    return [engine.artifact.term(int(i)).decode("ascii") for i in idx]
+
+
+def _measure_batches(engine, terms: list[str], batch: int) -> dict:
+    """Cache-warm QPS + per-batch latency percentiles for one batch size."""
+    batches = [engine.encode_batch(terms[i:i + batch])
+               for i in range(0, len(terms), batch)
+               if i + batch <= len(terms)]
+    for b in batches:  # warm: LRU fill + numpy caches
+        engine.postings(b)
+    lat = np.empty(len(batches))
+    t_all = time.perf_counter()
+    for j, b in enumerate(batches):
+        t0 = time.perf_counter()
+        engine.postings(b)
+        lat[j] = time.perf_counter() - t0
+    wall = time.perf_counter() - t_all
+    n = len(batches) * batch
+    return {
+        "lookups": n,
+        "lookups_per_s": round(n / wall, 1),
+        "batch_p50_us": round(float(np.percentile(lat, 50)) * 1e6, 2),
+        "batch_p99_us": round(float(np.percentile(lat, 99)) * 1e6, 2),
+        "per_term_p50_us": round(
+            float(np.percentile(lat, 50)) * 1e6 / batch, 3),
+    }
+
+
+def main() -> int:
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+
+    _, corpus_metric = bench._manifest()
+    out_dir, build_report = _build_index()
+
+    engine = Engine(os.path.join(out_dir, "index.mri"))
+    rng = np.random.default_rng(SEED)
+    terms = _zipf_terms(engine, LOOKUPS, rng)
+
+    batches = {}
+    for bsz in BATCH_SIZES:
+        engine.cache.clear()
+        batches[str(bsz)] = _measure_batches(engine, terms, bsz)
+    cache = engine.cache_stats()
+
+    # multi-term boolean queries: 2-term AND / OR over Zipf pairs
+    pairs = [terms[i:i + 2] for i in range(0, 2000, 2)]
+    for op, fn in (("and", engine.query_and), ("or", engine.query_or)):
+        enc = [engine.encode_batch(p) for p in pairs]
+        t0 = time.perf_counter()
+        for b in enc:
+            fn(b)
+        batches[f"boolean_{op}_qps"] = round(
+            len(enc) / (time.perf_counter() - t0), 1)
+
+    # build overhead vs the unaudited cpu e2e (same best-of discipline)
+    plain = bench._measure("cpu", [{}], rounds=5)
+    packed = bench._measure("cpu", [{"artifact": True}], rounds=5)
+    build_ms = float(packed.get("report", {}).get(
+        "artifact_build_ms", build_report.get("artifact_build_ms", 0.0)))
+
+    biggest = str(max(BATCH_SIZES))
+    line = {
+        "metric": "serve_lookups_per_s",
+        "value": batches[biggest]["lookups_per_s"],
+        "unit": "lookups/s",
+        "corpus_metric": corpus_metric,
+        "batch_size": int(biggest),
+        "zipf_s": ZIPF_S,
+        "vocab": engine.vocab_size,
+        "batches": batches,
+        "cache": cache,
+        "artifact_bytes": int(build_report.get("artifact_bytes", 0)),
+        "artifact_build_ms": round(build_ms, 3),
+        "cpu_ms": round(plain["best_ms"], 2),
+        "artifact_cpu_ms": round(packed["best_ms"], 2),
+        "build_overhead_pct": round(100 * build_ms / plain["best_ms"], 2),
+        "scratch": bench._scratch_backing(),
+    }
+    engine.close()
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
