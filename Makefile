@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast test-faults test-parallel test-chaos test-serve bench bench-scale bench-sweep bench-serve capture rehearse clean clean-native
+.PHONY: build test test-fast test-faults test-parallel test-chaos test-serve test-serve-device bench bench-scale bench-sweep bench-serve bench-serve-device capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -43,6 +43,13 @@ test-chaos:
 test-serve:
 	$(PY) -m pytest tests/ -q -m serve
 
+# device query-engine suite: host/device byte parity (batches 1..8192),
+# shared-prefix fixup, zero-recompile steady state — forced onto the
+# jax cpu backend so it runs on any box (the same code path serves
+# accelerators)
+test-serve-device:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m device_serve
+
 bench:
 	$(PY) bench.py
 
@@ -61,6 +68,11 @@ bench-sweep:
 # tools/bench_serve.py for the MRI_SERVE_* knobs
 bench-serve:
 	$(PY) tools/bench_serve.py
+
+# host-vs-device serving A/B (batch 1/1K/8K/64K, per-op breakdown,
+# byte-parity + zero-recompile assertions) -> BENCH_SERVE_DEVICE_r06.json
+bench-serve-device:
+	$(PY) tools/bench_serve.py --device-ab
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

@@ -58,11 +58,17 @@ verify mode:
 
 query mode (the serving read path; needs an --artifact build):
   mri-tpu query DIR word...          df + postings per word (JSON lines)
-  mri-tpu query DIR --batch-file F   one query word per line
+  mri-tpu query DIR --batch-file F   one query word per line (an empty
+                                 file is an empty batch: exit 0, no
+                                 output)
   mri-tpu query DIR --op and w1 w2   docs containing every word
   mri-tpu query DIR --op or  w1 w2   docs containing any word
   mri-tpu query DIR --top-k 5 --letter t   the letter's 5 highest-df
                                  terms (== head -5 DIR/t.txt)
+  mri-tpu query DIR --engine device  answer from the device-resident
+                                 jit/shard_map engine (--engine auto,
+                                 the default, picks it on accelerator
+                                 backends); byte-identical to host
   a missing/torn index.mri exits 2 with one line on stderr, never
   garbage answers
 """
@@ -193,13 +199,24 @@ def _query_main(argv: list[str]) -> int:
                    help="the K highest-df terms of --letter's range")
     p.add_argument("--letter", default=None,
                    help="letter for --top-k (a..z)")
+    p.add_argument("--engine", choices=("host", "device", "auto"),
+                   default=None,
+                   help="query backend: host = numpy over mmap views; "
+                        "device = jit/shard_map over device-resident "
+                        "columns (batched lookups sharded across "
+                        "chips); auto = device when jax's default "
+                        "backend is an accelerator, else host "
+                        "(default: MRI_SERVE_ENGINE env, else auto). "
+                        "Answers are byte-identical either way")
     p.add_argument("--stats", action="store_true",
-                   help="print an engine/cache stats JSON line last")
+                   help="print an engine stats JSON line last (engine/"
+                        "shard info, cache hit/miss/eviction counters, "
+                        "per-op timing)")
     # intermixed: ``query DIR --op and the dog`` must not feed "the dog"
     # back into --op's greedy positional scan.
     args = p.parse_intermixed_args(argv)
 
-    from .serve import ArtifactError, Engine
+    from .serve import ArtifactError, create_engine
 
     terms = list(args.terms)
     if args.batch_file is not None:
@@ -210,6 +227,10 @@ def _query_main(argv: list[str]) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
     if args.top_k is None and not terms:
+        # an empty --batch-file is a valid (empty) batch: answer it
+        # with no output, exit 0 — only a missing query is an error
+        if args.batch_file is not None:
+            return 0
         print("error: no query terms (positional words, --batch-file, "
               "or --top-k with --letter)", file=sys.stderr)
         return 2
@@ -217,7 +238,7 @@ def _query_main(argv: list[str]) -> int:
         print("error: --top-k needs --letter", file=sys.stderr)
         return 2
     try:
-        engine = Engine(args.index_dir)
+        engine = create_engine(args.index_dir, args.engine)
     except ArtifactError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -243,9 +264,7 @@ def _query_main(argv: list[str]) -> int:
                     "term": term, "found": ids is not None, "df": d,
                     "postings": ids.tolist() if ids is not None else []}))
         if args.stats:
-            print(json.dumps({"vocab": engine.vocab_size,
-                              "artifact_bytes": engine.artifact.nbytes,
-                              "cache": engine.cache_stats()}))
+            print(json.dumps(engine.describe()))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

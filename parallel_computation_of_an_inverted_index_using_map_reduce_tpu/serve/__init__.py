@@ -3,13 +3,16 @@
 The build engines (models/, native/) end at 26 letter files — a
 write-only artifact.  This package is the query path: a compact,
 memory-mappable columnar artifact packed at emit time
-(:mod:`~.artifact`), a zero-copy vectorized query engine over it
-(:mod:`~.engine`), and the LRU hot-term cache the engine decodes
-postings through (:mod:`~.cache`).  ``mri-tpu query`` (cli.py) and
-``tools/bench_serve.py`` sit on top.
+(:mod:`~.artifact`), two byte-identical vectorized query engines over
+it — host numpy over mmap views (:mod:`~.engine`) and device-resident
+jit/shard_map (:mod:`~.device_engine`, selected via
+:func:`create_engine`) — and the LRU hot-term cache the host engine
+decodes postings through (:mod:`~.cache`).  ``mri-tpu query`` (cli.py)
+and ``tools/bench_serve.py`` sit on top.
 """
 
 from .artifact import ARTIFACT_NAME, ArtifactError, load_artifact
-from .engine import Engine
+from .engine import ENGINE_CHOICES, Engine, create_engine, resolve_engine
 
-__all__ = ["ARTIFACT_NAME", "ArtifactError", "Engine", "load_artifact"]
+__all__ = ["ARTIFACT_NAME", "ArtifactError", "ENGINE_CHOICES", "Engine",
+           "create_engine", "load_artifact", "resolve_engine"]

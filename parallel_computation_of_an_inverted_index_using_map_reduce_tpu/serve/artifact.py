@@ -292,6 +292,72 @@ def load_artifact(path: str | Path) -> Artifact:
         raise
 
 
+def term_table(art: Artifact) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Materialize the engines' term-resolution columns from the blob.
+
+    Returns ``(rows, terms, key8)``:
+
+    - ``rows``   (max(V,1), width) uint8 — NUL-padded fixed-width term
+      rows, scattered from the compact blob in two vectorized ops
+    - ``terms``  (V,) ``S{width}`` view of those rows (exact-match gathers)
+    - ``key8``   (V, 8) uint8 — each term's NUL-padded 8-byte prefix;
+      viewed big-endian, numeric order == lexicographic term order, so
+      it is THE binary-search key column (host: one ``>u8`` view;
+      device: a (hi, lo) ``u32`` pair, x64-free)
+    """
+    V, width = art.vocab, max(art.width, 1)
+    lens = np.diff(art.term_offsets)
+    rows = np.zeros((max(V, 1), width), dtype=np.uint8)
+    if V:
+        rows[np.arange(width) < lens[:, None]] = art.term_blob
+    terms = rows.view(f"S{width}").ravel()[:V]
+    pad = rows if width >= 8 else np.pad(rows, ((0, 0), (0, 8 - width)))
+    key8 = np.ascontiguousarray(pad[:, :8])[:V]
+    return rows, terms, key8
+
+
+def device_columns(art: Artifact) -> dict:
+    """Host-side staging of every column the device engine uploads.
+
+    All integer columns are narrowed to 32-bit (jax default, x64 off):
+    the 8-byte prefix key becomes a big-endian ``(key_hi, key_lo)``
+    uint32 pair whose pairwise lexicographic order equals the u64
+    numeric order, and ``post_offsets`` drops to int32 — guarded, since
+    an artifact with >= 2**31 postings can't be addressed that way.
+    ``max_prefix_group`` is the largest set of vocabulary terms sharing
+    one 8-byte prefix: the static trip count of the device lookup's
+    collision-fixup loop.
+    """
+    if art.num_postings >= 2 ** 31 or art.vocab >= 2 ** 31:
+        raise ArtifactError(
+            f"{art.path}: {art.num_postings} postings / {art.vocab} terms "
+            f"exceed the device engine's int32 addressing")
+    rows, _, key8 = term_table(art)
+    V = art.vocab
+    if V:
+        key_hi = np.ascontiguousarray(key8[:, :4]).view(">u4").ravel()
+        key_lo = np.ascontiguousarray(key8[:, 4:]).view(">u4").ravel()
+        groups = np.unique(key8.view(">u8").ravel(), return_counts=True)[1]
+        max_group = int(groups.max())
+    else:
+        key_hi = key_lo = np.zeros(0, dtype=np.uint32)
+        max_group = 1
+    return {
+        "rows": rows[:V],
+        "key_hi": key_hi.astype(np.uint32),
+        "key_lo": key_lo.astype(np.uint32),
+        "df": np.ascontiguousarray(art.df, dtype=np.int32),
+        "post_offsets": np.ascontiguousarray(
+            art.post_offsets, dtype=np.int32),
+        "postings": np.ascontiguousarray(art.postings, dtype=np.int32),
+        "df_order": np.ascontiguousarray(art.df_order, dtype=np.int32),
+        "letter_dir": np.ascontiguousarray(art.letter_dir, dtype=np.int32),
+        "max_prefix_group": max_group,
+        "vocab": V,
+        "width": max(art.width, 1),
+    }
+
+
 def checksum(path: str | Path) -> tuple[str, int]:
     """``(adler32_hex, size)`` of the artifact file — the audit
     manifest's fingerprint, same scheme as the letter files."""

@@ -25,6 +25,7 @@ class LRUCache:
         self._data: OrderedDict = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get(self, key, default=None):
         value = self._data.get(key, _MISSING)
@@ -43,6 +44,7 @@ class LRUCache:
         self._data[key] = value
         if len(self._data) > self.capacity:
             self._data.popitem(last=False)
+            self.evictions += 1
 
     def __len__(self) -> int:
         return len(self._data)
@@ -54,6 +56,7 @@ class LRUCache:
         self._data.clear()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def stats(self) -> dict:
         total = self.hits + self.misses
@@ -62,5 +65,6 @@ class LRUCache:
             "entries": len(self._data),
             "hits": self.hits,
             "misses": self.misses,
+            "evictions": self.evictions,
             "hit_rate": round(self.hits / total, 4) if total else 0.0,
         }

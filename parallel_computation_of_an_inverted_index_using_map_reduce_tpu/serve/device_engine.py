@@ -1,0 +1,476 @@
+"""Device-resident batched query engine over ``index.mri``.
+
+The host engine (:mod:`.engine`) answers batches with numpy over mmap
+views; this engine uploads the artifact's columns to device memory ONCE
+and answers large batches as jitted XLA programs, the batch dimension
+sharded across devices with ``shard_map`` through the
+``parallel/compat.py`` shim (DrJAX's broadcast/map/reduce shape, arxiv
+2403.07128: columns replicated, queries mapped, results concatenated).
+
+Per batch the pipeline is
+
+  1. term resolution — a fixed-step vectorized bisect over the 8-byte
+     big-endian term-prefix key column.  jax runs x64-free here, so the
+     u64 key is carried as a big-endian ``(hi, lo)`` uint32 pair whose
+     pairwise lexicographic order equals the u64 numeric order; the
+     bisect is ``ceil(log2 V)`` masked ``jnp.where`` steps (the shape
+     ``jnp.searchsorted`` lowers to, spelled out for the pair dtype).
+     Shared-prefix collisions resolve in a static ``max_prefix_group``-
+     step gather-compare over the full fixed-width term rows, fused
+     with the df gather.
+  2. postings decode — segment-gather of each hit's delta run into a
+     fixed-width tier (powers of 4, statically bucketed so steady-state
+     serving never recompiles) and one int32 row-cumsum; invalid lanes
+     carry ``_SENTINEL``.
+  3. compound ops — AND/OR as sorted-set intersection/union over the
+     sentinel-padded posting windows (membership via vectorized
+     ``jnp.searchsorted`` probes; union via sort + neighbor-compare
+     dedup), and top-k as a ``df_order`` gather.
+
+Every answer is byte-identical to the host engine — the parity suite
+(tests/test_serve_device.py) fuzzes both engines against each other at
+batches {1, 32, 1024, 8192} under ``JAX_PLATFORMS=cpu``.
+
+Shape discipline: batches pad to power-of-two buckets (multiples of the
+shard count), posting tiers are powers of 4, and compound ops pad their
+term count to powers of two — so the jit cache stays O(log) in every
+dimension and ``compile_stats()`` can assert a zero-recompile steady
+state after warmup.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import artifact as artifact_mod
+from .cache import LRUCache
+from .engine import OpTimer, encode_terms, letter_index
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.compat import shard_map
+from ..parallel.mesh import SHARD_AXIS, make_mesh
+
+#: pad value in posting windows: larger than any doc id (guarded at
+#: load), so sentinel lanes sort after every real doc.
+_SENTINEL = np.int32(2 ** 31 - 1)
+
+SHARDS_ENV = "MRI_SERVE_SHARDS"
+#: soft cap on decode-window elements per call (B * W); oversize
+#: batches loop in bucket-sized chunks instead of materializing one
+#: giant (B, W) window.
+DECODE_BUDGET_ENV = "MRI_SERVE_DEVICE_DECODE_BUDGET"
+_DEFAULT_DECODE_BUDGET = 1 << 24
+
+#: smallest per-shard batch bucket: tiny batches all share one compile.
+_MIN_LANES = 8
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n >= 1 else 1
+
+
+def _make_lookup(mesh, nsteps: int, group: int):
+    """Jitted fused resolve: (idx, found, df) per query lane."""
+
+    def body(key_hi, key_lo, rows, df, q_hi, q_lo, q_rows):
+        V = key_hi.shape[0]
+
+        def bisect(right: bool):
+            lo = jnp.zeros(q_hi.shape, jnp.int32)
+            hi = jnp.full(q_hi.shape, V, jnp.int32)
+            for _ in range(nsteps):
+                active = lo < hi
+                mid = (lo + hi) >> 1
+                m = jnp.minimum(mid, V - 1)
+                kh, kl = key_hi[m], key_lo[m]
+                go = (kh < q_hi) | ((kh == q_hi)
+                                    & ((kl <= q_lo) if right
+                                       else (kl < q_lo)))
+                lo = jnp.where(active & go, mid + 1, lo)
+                hi = jnp.where(active & ~go, mid, hi)
+            return lo
+
+        lo_i, hi_i = bisect(right=False), bisect(right=True)
+        at = jnp.minimum(lo_i, V - 1)
+        found = jnp.zeros(q_hi.shape, bool)
+        # Shared-prefix fixup: up to `group` vocabulary terms share one
+        # 8-byte key; compare full fixed-width rows at each candidate.
+        for j in range(group):
+            cand = jnp.minimum(lo_i + j, V - 1)
+            ok = ((lo_i + j) < hi_i) & jnp.all(
+                rows[cand] == q_rows, axis=1)
+            at = jnp.where(ok & ~found, cand, at)
+            found = found | ok
+        found = found & ((q_hi | q_lo) != 0)
+        dfv = jnp.where(found, df[at], 0)
+        return at.astype(jnp.int32), found, dfv
+
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(),
+                  P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False))
+
+
+def _decode_window(post_offsets, postings, idx, n, *, width: int):
+    """(len(idx), width) sentinel-padded absolute doc ids: segment
+    gather of the delta runs + one row cumsum."""
+    Ptot = postings.shape[0]
+    start = post_offsets[idx]
+    lane = jnp.arange(width, dtype=jnp.int32)
+    pos = start[:, None] + lane[None, :]
+    valid = lane[None, :] < n[:, None]
+    d = jnp.where(valid, postings[jnp.clip(pos, 0, max(Ptot - 1, 0))], 0)
+    docs = jnp.cumsum(d, axis=1, dtype=jnp.int32)
+    return jnp.where(valid, docs, _SENTINEL)
+
+
+def _make_decode(mesh, width: int):
+    def body(post_offsets, postings, idx, n):
+        return _decode_window(post_offsets, postings, idx, n, width=width)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=P(SHARD_AXIS), check_vma=False))
+
+
+def _make_bool(op: str, width: int):
+    """Jitted T-term AND/OR over sentinel-padded posting windows.
+
+    One query, T terms (T static, padded to a power of two): decode all
+    runs to (T, width), then intersect (membership probes via
+    ``jnp.searchsorted`` on each other run) or union (flat sort +
+    neighbor-compare dedup).  Returns the sorted result pushed to the
+    front plus its count — the host slices."""
+
+    def body(post_offsets, postings, idx, n):
+        docs = _decode_window(post_offsets, postings, idx, n, width=width)
+        T = docs.shape[0]
+        if op == "and":
+            vals = docs[0]
+            alive = jnp.arange(width) < n[0]
+            for t in range(1, T):
+                j = jnp.searchsorted(docs[t], vals)
+                alive = alive & (j < width) & (
+                    docs[t][jnp.minimum(j, width - 1)] == vals)
+            out = jnp.sort(jnp.where(alive, vals, _SENTINEL))
+            return out, alive.sum()
+        flat = jnp.sort(docs.ravel())
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), flat[1:] != flat[:-1]])
+        keep = first & (flat != _SENTINEL)
+        out = jnp.sort(jnp.where(keep, flat, _SENTINEL))
+        return out, keep.sum()
+
+    return jax.jit(body)
+
+
+def _make_topk(k: int):
+    def body(df_order, df, lo):
+        pick = jax.lax.dynamic_slice(df_order, (lo,), (k,))
+        return pick, df[pick]
+
+    return jax.jit(body)
+
+
+class DeviceEngine:
+    """Batched query API over one artifact resident in device memory.
+
+    Mirrors :class:`.engine.Engine`'s surface exactly (same inputs,
+    same outputs, byte-identical answers); ``shards`` sizes the 1-D
+    batch mesh (default: ``$MRI_SERVE_SHARDS`` or every local device).
+    The host LRU posting cache does not apply here — decodes are
+    vectorized device work, so the cache is present but idle (capacity
+    kept for stats-surface parity).
+    """
+
+    engine_name = "device"
+
+    def __init__(self, path, cache_terms: int = 4096,
+                 shards: int | None = None,
+                 decode_budget: int | None = None):
+        self.artifact = artifact_mod.load_artifact(path)
+        art = self.artifact
+        if art.max_doc_id >= int(_SENTINEL):
+            raise artifact_mod.ArtifactError(
+                f"{art.path}: max_doc_id {art.max_doc_id} collides with "
+                f"the device engine's padding sentinel")
+        cols = artifact_mod.device_columns(art)
+        self.vocab_size = cols["vocab"]
+        self._width = cols["width"]
+        self._sdtype = f"S{self._width}"
+        self._group = cols["max_prefix_group"]
+        self._h_df = cols["df"]
+        self._h_letter_dir = cols["letter_dir"]
+
+        if shards is None:
+            env = os.environ.get(SHARDS_ENV)
+            shards = int(env) if env else None
+        self._mesh = make_mesh(shards)
+        self._num_shards = self._mesh.devices.size
+        self._decode_budget = int(
+            decode_budget if decode_budget is not None
+            else os.environ.get(DECODE_BUDGET_ENV, _DEFAULT_DECODE_BUDGET))
+
+        rep = NamedSharding(self._mesh, P())
+        put = lambda a: jax.device_put(a, rep)  # noqa: E731
+        self._d_key_hi = put(cols["key_hi"])
+        self._d_key_lo = put(cols["key_lo"])
+        self._d_rows = put(cols["rows"])
+        self._d_df = put(cols["df"])
+        self._d_post_offsets = put(cols["post_offsets"])
+        self._d_postings = put(cols["postings"])
+        self._d_df_order = put(cols["df_order"])
+
+        # posting tiers: powers of 4 from 8 up to the global max df, so
+        # every batch decodes at the smallest static width covering it
+        max_df = int(self._h_df.max()) if self.vocab_size else 1
+        tiers, t = [], _MIN_LANES
+        while True:
+            tiers.append(t)
+            if t >= max_df:
+                break
+            t *= 4
+        self._tiers = tiers
+
+        nsteps = max(self.vocab_size, 1).bit_length() + 1
+        self._lookup_fn = _make_lookup(self._mesh, nsteps, self._group)
+        self._decode_fns: dict[int, object] = {}
+        self._bool_fns: dict[tuple, object] = {}
+        self._topk_fns: dict[int, object] = {}
+
+        self._cache = LRUCache(cache_terms)  # idle on the device path
+        self._ops = OpTimer()
+
+    # -- shape bucketing ------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        """Padded batch size: power-of-two lanes per shard, min 8."""
+        D = self._num_shards
+        return D * max(_MIN_LANES, _next_pow2(-(-n // D)))
+
+    def _tier(self, max_len: int) -> int:
+        for t in self._tiers:
+            if t >= max_len:
+                return t
+        return self._tiers[-1]
+
+    def _decode_fn(self, width: int):
+        fn = self._decode_fns.get(width)
+        if fn is None:
+            fn = self._decode_fns[width] = _make_decode(self._mesh, width)
+        return fn
+
+    # -- term resolution ------------------------------------------------
+
+    def encode_batch(self, terms) -> np.ndarray:
+        return encode_terms(terms, self._width)
+
+    def _split_keys(self, q: np.ndarray):
+        """S-dtype batch -> (rows u8, key_hi u32, key_lo u32), the
+        device mirror of the artifact's key columns."""
+        B, w = len(q), self._width
+        rows = np.ascontiguousarray(q).view(np.uint8).reshape(B, w)
+        k8 = rows if w >= 8 else np.pad(rows, ((0, 0), (0, 8 - w)))
+        k8 = np.ascontiguousarray(k8[:, :8])
+        q_hi = np.ascontiguousarray(k8[:, :4]).view(">u4").ravel()
+        q_lo = np.ascontiguousarray(k8[:, 4:]).view(">u4").ravel()
+        return rows, q_hi.astype(np.uint32), q_lo.astype(np.uint32)
+
+    def _resolve(self, batch):
+        """(idx i32, found bool, df i32) per query, host numpy."""
+        q = np.asarray(batch, dtype=self._sdtype)
+        B = len(q)
+        if B == 0 or self.vocab_size == 0:
+            return (np.zeros(B, dtype=np.int32),
+                    np.zeros(B, dtype=bool),
+                    np.zeros(B, dtype=np.int32))
+        rows, q_hi, q_lo = self._split_keys(q)
+        Bp = self._bucket(B)
+        if Bp != B:
+            rows = np.vstack(
+                [rows, np.zeros((Bp - B, self._width), np.uint8)])
+            q_hi = np.concatenate([q_hi, np.zeros(Bp - B, np.uint32)])
+            q_lo = np.concatenate([q_lo, np.zeros(Bp - B, np.uint32)])
+        idx, found, dfv = self._lookup_fn(
+            self._d_key_hi, self._d_key_lo, self._d_rows, self._d_df,
+            q_hi, q_lo, rows)
+        return (np.asarray(idx)[:B], np.asarray(found)[:B],
+                np.asarray(dfv)[:B])
+
+    def lookup(self, batch):
+        """Host-API parity: (lex idx, found) per query."""
+        idx, found, _ = self._resolve(batch)
+        return idx.astype(np.int64), found
+
+    # -- single-term answers --------------------------------------------
+
+    def df(self, batch) -> np.ndarray:
+        with self._ops.time("df"):
+            _, _, dfv = self._resolve(batch)
+            return dfv.astype(np.int64)
+
+    def _decode_batch(self, idx, n, width):
+        """Chunked (len(idx), width) sentinel-padded decode, bucketed so
+        B * width stays under the decode budget per device call."""
+        B = len(idx)
+        D = self._num_shards
+        per = max(1, self._decode_budget // max(width, 1) // D)
+        cap = D * max(_MIN_LANES, _pow2_floor(per))
+        out = np.empty((B, width), dtype=np.int32)
+        fn = self._decode_fn(width)
+        step = min(self._bucket(B), cap)
+        for at in range(0, B, step):
+            part_idx = idx[at:at + step]
+            part_n = n[at:at + step]
+            L = len(part_idx)
+            Bp = min(self._bucket(L), step)
+            if Bp != L:
+                part_idx = np.concatenate(
+                    [part_idx, np.zeros(Bp - L, np.int32)])
+                part_n = np.concatenate(
+                    [part_n, np.zeros(Bp - L, np.int32)])
+            win = fn(self._d_post_offsets, self._d_postings,
+                     part_idx.astype(np.int32), part_n.astype(np.int32))
+            out[at:at + L] = np.asarray(win)[:L]
+        return out
+
+    def postings(self, batch) -> list[np.ndarray | None]:
+        with self._ops.time("postings"):
+            idx, found, dfv = self._resolve(batch)
+            B = len(found)
+            if B == 0:
+                return []
+            if not found.any():
+                return [None] * B
+            width = self._tier(int(dfv.max()))
+            win = self._decode_batch(idx, np.where(found, dfv, 0), width)
+            return [win[i, :dfv[i]] if found[i] else None
+                    for i in range(B)]
+
+    # -- compound queries -----------------------------------------------
+
+    def top_k(self, letter, k: int) -> list[tuple[bytes, int]]:
+        letter = letter_index(letter)
+        with self._ops.time("top_k"):
+            lo = int(self._h_letter_dir[letter])
+            hi = int(self._h_letter_dir[letter + 1])
+            k_eff = min(max(k, 0), hi - lo)
+            if k_eff == 0:
+                return []
+            fn = self._topk_fns.get(k_eff)
+            if fn is None:
+                fn = self._topk_fns[k_eff] = _make_topk(k_eff)
+            pick, dfs = fn(self._d_df_order, self._d_df, np.int32(lo))
+            art = self.artifact
+            return [(art.term(int(i)), int(d))
+                    for i, d in zip(np.asarray(pick), np.asarray(dfs))]
+
+    def _bool_fn(self, op: str, T: int, width: int):
+        fn = self._bool_fns.get((op, T, width))
+        if fn is None:
+            fn = self._bool_fns[(op, T, width)] = _make_bool(op, width)
+        return fn
+
+    def _run_bool(self, op: str, uidx: np.ndarray) -> np.ndarray:
+        """Shared AND/OR tail: pad the unique term set to a power of
+        two (AND repeats the first run — intersection-neutral; OR pads
+        empty runs — union-neutral), call the (op, T, W) kernel, slice
+        the count."""
+        n = self._h_df[uidx].astype(np.int32)
+        T = _next_pow2(len(uidx))
+        if T != len(uidx):
+            pad = T - len(uidx)
+            if op == "and":
+                uidx = np.concatenate([uidx, np.repeat(uidx[:1], pad)])
+                n = np.concatenate([n, np.repeat(n[:1], pad)])
+            else:
+                uidx = np.concatenate([uidx, np.zeros(pad, np.int32)])
+                n = np.concatenate([n, np.zeros(pad, np.int32)])
+        width = self._tier(int(n.max()) if len(n) else 1)
+        out, cnt = self._bool_fn(op, T, width)(
+            self._d_post_offsets, self._d_postings,
+            uidx.astype(np.int32), n)
+        return np.asarray(out)[:int(cnt)].astype(np.int32)
+
+    def query_and(self, batch) -> np.ndarray:
+        with self._ops.time("and"):
+            idx, found, _ = self._resolve(batch)
+            if len(found) == 0 or not found.all():
+                return np.zeros(0, dtype=np.int32)
+            return self._run_bool("and", np.unique(idx))
+
+    def query_or(self, batch) -> np.ndarray:
+        with self._ops.time("or"):
+            idx, found, _ = self._resolve(batch)
+            uidx = np.unique(idx[found])
+            if len(uidx) == 0:
+                return np.zeros(0, dtype=np.int32)
+            return self._run_bool("or", uidx)
+
+    # -- bookkeeping ----------------------------------------------------
+
+    @property
+    def cache(self) -> LRUCache:
+        return self._cache
+
+    def cache_stats(self) -> dict:
+        return self._cache.stats()
+
+    def op_stats(self) -> dict:
+        return self._ops.stats()
+
+    def compile_stats(self) -> dict:
+        """Jit-cache census: the bench's zero-recompile assertion
+        compares this before/after the steady-state run."""
+        fns = ([self._lookup_fn] + list(self._decode_fns.values())
+               + list(self._bool_fns.values())
+               + list(self._topk_fns.values()))
+        return {
+            "jit_functions": len(fns),
+            "jit_cache_entries": sum(f._cache_size() for f in fns),
+        }
+
+    def describe(self) -> dict:
+        return {
+            "engine": self.engine_name,
+            "vocab": self.vocab_size,
+            "artifact_bytes": self.artifact.nbytes,
+            "cache": self.cache_stats(),
+            "ops": self.op_stats(),
+            "device": {
+                "platform": jax.default_backend(),
+                "shards": self._num_shards,
+                "devices": [str(d) for d in self._mesh.devices.ravel()],
+                "tiers": self._tiers,
+                "max_prefix_group": self._group,
+                **self.compile_stats(),
+            },
+        }
+
+    def close(self) -> None:
+        self._cache.clear()
+        self._d_key_hi = self._d_key_lo = self._d_rows = None
+        self._d_df = self._d_post_offsets = self._d_postings = None
+        self._d_df_order = None
+        self._decode_fns.clear()
+        self._bool_fns.clear()
+        self._topk_fns.clear()
+        self.artifact.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -13,10 +13,45 @@ is an O(k) slice of the artifact's ``df_order`` permutation.
 
 from __future__ import annotations
 
+import os
+import time
+from contextlib import contextmanager
+
 import numpy as np
 
 from . import artifact as artifact_mod
 from .cache import LRUCache
+
+
+class OpTimer:
+    """Per-op wall-time counters for ``--stats``: calls + total ms per
+    public query op, shared by both engine implementations."""
+
+    def __init__(self):
+        self._ops: dict[str, list] = {}
+
+    @contextmanager
+    def time(self, op: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            rec = self._ops.setdefault(op, [0, 0.0])
+            rec[0] += 1
+            rec[1] += time.perf_counter() - t0
+
+    def stats(self) -> dict:
+        out = {}
+        for op, (calls, secs) in sorted(self._ops.items()):
+            out[op] = {
+                "calls": calls,
+                "total_ms": round(secs * 1e3, 3),
+                "avg_us": round(secs * 1e6 / calls, 2) if calls else 0.0,
+            }
+        return out
+
+    def reset(self) -> None:
+        self._ops.clear()
 
 
 def _normalize(term) -> bytes:
@@ -29,6 +64,27 @@ def _normalize(term) -> bytes:
         else b""
 
 
+def encode_terms(terms, width: int) -> np.ndarray:
+    """Normalize str/bytes queries into the engines' S-dtype batch
+    array.  Terms that normalize away or exceed the vocabulary width
+    become b'' (never found).  Shared by both engine backends so the
+    interchange format is identical."""
+    cleaned = [_normalize(t) for t in terms]
+    return np.array(
+        [t if len(t) <= width else b"" for t in cleaned],
+        dtype=f"S{width}")
+
+
+def letter_index(letter) -> int:
+    """'a'..'z' (str/bytes) or 0..25 -> letter_dir slot, or ValueError."""
+    if isinstance(letter, (str, bytes)):
+        letter = (letter.encode() if isinstance(letter, str) else letter)
+        letter = letter[0] - ord("a")
+    if not 0 <= letter < 26:
+        raise ValueError(f"letter index out of range: {letter}")
+    return letter
+
+
 class Engine:
     """Batched query API over one loaded artifact.
 
@@ -37,26 +93,24 @@ class Engine:
     one byte-equal to a naive scan of the emitted letter files.
     """
 
+    engine_name = "host"
+
     def __init__(self, path, cache_terms: int = 4096):
         self.artifact = artifact_mod.load_artifact(path)
         art = self.artifact
         V, width = art.vocab, max(art.width, 1)
         self.vocab_size = V
-        # Materialized fixed-width term table: (V, width) NUL-padded
-        # rows scattered from the compact blob in two vectorized ops,
-        # then viewed as one S-dtype column for exact-match gathers.
-        lens = np.diff(art.term_offsets)
-        rows = np.zeros((max(V, 1), width), dtype=np.uint8)
-        if V:
-            rows[np.arange(width) < lens[:, None]] = art.term_blob
+        # Materialized fixed-width term table (artifact.term_table):
+        # NUL-padded rows viewed as one S-dtype column for exact-match
+        # gathers, plus big-endian u64 prefix keys — the binary-search
+        # column.
+        rows, terms, key8 = artifact_mod.term_table(art)
         self._rows = rows
-        self._terms = rows.view(f"S{width}").ravel()[:V]
-        # Big-endian u64 prefix keys: the binary-search column.
-        w8 = max(width, 8)
-        pad = rows if width >= 8 else np.pad(rows, ((0, 0), (0, 8 - width)))
-        self._keys = np.ascontiguousarray(pad[:, :8]).view(">u8").ravel()[:V]
+        self._terms = terms
+        self._keys = key8.view(">u8").ravel()
         self._df = art.df
         self._cache = LRUCache(cache_terms)
+        self._ops = OpTimer()
         self._sdtype = f"S{width}"
         self._width = width
 
@@ -66,10 +120,7 @@ class Engine:
         """Normalize a list of str/bytes queries into the S-dtype batch
         array ``lookup`` consumes.  Terms that normalize away or exceed
         the vocabulary width become b'' (never found)."""
-        cleaned = [_normalize(t) for t in terms]
-        return np.array(
-            [t if len(t) <= self._width else b"" for t in cleaned],
-            dtype=self._sdtype)
+        return encode_terms(terms, self._width)
 
     def lookup(self, batch: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Resolve a batch (S-dtype array from :meth:`encode_batch`, or
@@ -102,10 +153,11 @@ class Engine:
 
     def df(self, batch) -> np.ndarray:
         """Document frequency per query (0 when absent), vectorized."""
-        idx, found = self.lookup(batch)
-        if self.vocab_size == 0:
-            return np.zeros(len(found), dtype=np.int64)
-        return np.where(found, self._df[idx], 0).astype(np.int64)
+        with self._ops.time("df"):
+            idx, found = self.lookup(batch)
+            if self.vocab_size == 0:
+                return np.zeros(len(found), dtype=np.int64)
+            return np.where(found, self._df[idx], 0).astype(np.int64)
 
     def postings_by_index(self, idx: int) -> np.ndarray:
         """Decoded ascending doc ids of lex term ``idx`` (LRU-cached)."""
@@ -120,55 +172,56 @@ class Engine:
 
     def postings(self, batch) -> list[np.ndarray | None]:
         """Decoded postings per query term; None where absent."""
-        idx, found = self.lookup(batch)
-        return [self.postings_by_index(i) if ok else None
-                for i, ok in zip(idx.tolist(), found.tolist())]
+        with self._ops.time("postings"):
+            idx, found = self.lookup(batch)
+            return [self.postings_by_index(i) if ok else None
+                    for i, ok in zip(idx.tolist(), found.tolist())]
 
     # -- compound queries -----------------------------------------------
 
     def top_k(self, letter, k: int) -> list[tuple[bytes, int]]:
         """The letter's k highest-df terms, (term, df), in emit order —
         exactly the first k lines of ``<letter>.txt``."""
-        if isinstance(letter, (str, bytes)):
-            letter = (letter.encode() if isinstance(letter, str)
-                      else letter)
-            letter = letter[0] - ord("a")
-        if not 0 <= letter < 26:
-            raise ValueError(f"letter index out of range: {letter}")
-        art = self.artifact
-        lo, hi = int(art.letter_dir[letter]), int(art.letter_dir[letter + 1])
-        pick = art.df_order[lo:min(lo + max(k, 0), hi)]
-        return [(art.term(i), int(self._df[i])) for i in pick]
+        letter = letter_index(letter)
+        with self._ops.time("top_k"):
+            art = self.artifact
+            lo = int(art.letter_dir[letter])
+            hi = int(art.letter_dir[letter + 1])
+            pick = art.df_order[lo:min(lo + max(k, 0), hi)]
+            return [(art.term(i), int(self._df[i])) for i in pick]
 
     def query_and(self, batch) -> np.ndarray:
         """Docs containing EVERY term.  Any absent term → empty.  The
         intersection gallops smallest-run-first: probe the larger sorted
         run with ``searchsorted`` at the surviving candidates only."""
-        idx, found = self.lookup(batch)
-        if len(found) == 0 or not found.all():
-            return np.zeros(0, dtype=np.int32)
-        runs = sorted((self.postings_by_index(i) for i in set(idx.tolist())),
-                      key=len)
-        acc = runs[0]
-        for run in runs[1:]:
-            if len(acc) == 0:
-                break
-            pos = np.searchsorted(run, acc)
-            ok = pos < len(run)
-            ok[ok] = run[pos[ok]] == acc[ok]
-            acc = acc[ok]
-        return acc
+        with self._ops.time("and"):
+            idx, found = self.lookup(batch)
+            if len(found) == 0 or not found.all():
+                return np.zeros(0, dtype=np.int32)
+            runs = sorted(
+                (self.postings_by_index(i) for i in set(idx.tolist())),
+                key=len)
+            acc = runs[0]
+            for run in runs[1:]:
+                if len(acc) == 0:
+                    break
+                pos = np.searchsorted(run, acc)
+                ok = pos < len(run)
+                ok[ok] = run[pos[ok]] == acc[ok]
+                acc = acc[ok]
+            return acc
 
     def query_or(self, batch) -> np.ndarray:
         """Docs containing ANY term (absent terms contribute nothing)."""
-        idx, found = self.lookup(batch)
-        runs = [self.postings_by_index(i)
-                for i in sorted(set(idx[found].tolist()))]
-        if not runs:
-            return np.zeros(0, dtype=np.int32)
-        out = runs[0] if len(runs) == 1 else \
-            np.unique(np.concatenate(runs))
-        return np.asarray(out, dtype=np.int32)
+        with self._ops.time("or"):
+            idx, found = self.lookup(batch)
+            runs = [self.postings_by_index(i)
+                    for i in sorted(set(idx[found].tolist()))]
+            if not runs:
+                return np.zeros(0, dtype=np.int32)
+            out = runs[0] if len(runs) == 1 else \
+                np.unique(np.concatenate(runs))
+            return np.asarray(out, dtype=np.int32)
 
     # -- bookkeeping ----------------------------------------------------
 
@@ -178,6 +231,19 @@ class Engine:
 
     def cache_stats(self) -> dict:
         return self._cache.stats()
+
+    def op_stats(self) -> dict:
+        return self._ops.stats()
+
+    def describe(self) -> dict:
+        """Engine identity + counters for ``mri query --stats``."""
+        return {
+            "engine": self.engine_name,
+            "vocab": self.vocab_size,
+            "artifact_bytes": self.artifact.nbytes,
+            "cache": self.cache_stats(),
+            "ops": self.op_stats(),
+        }
 
     def close(self) -> None:
         self._cache.clear()
@@ -189,3 +255,40 @@ class Engine:
 
     def __exit__(self, *exc):
         self.close()
+
+
+#: ``engine="auto"`` picks the device engine only when jax is importable
+#: AND its default backend is an accelerator — a JAX_PLATFORMS=cpu
+#: process (tier-1, most laptops) serves from the host engine unless
+#: the caller asks for ``device`` explicitly.
+ENGINE_CHOICES = ("host", "device", "auto")
+ENGINE_ENV = "MRI_SERVE_ENGINE"
+
+
+def resolve_engine(engine: str | None = None) -> str:
+    """``host``/``device``/``auto``(+ env override) -> concrete name."""
+    engine = engine or os.environ.get(ENGINE_ENV) or "auto"
+    if engine not in ENGINE_CHOICES:
+        raise ValueError(
+            f"unknown engine {engine!r} (choices: {ENGINE_CHOICES})")
+    if engine != "auto":
+        return engine
+    try:
+        import jax
+        return "device" if jax.default_backend() != "cpu" else "host"
+    except Exception:
+        return "host"
+
+
+def create_engine(path, engine: str | None = None, *,
+                  cache_terms: int = 4096, shards: int | None = None):
+    """Open ``path`` with the selected backend (:data:`ENGINE_CHOICES`).
+
+    Both engines answer the same API byte-identically; ``shards`` only
+    applies to the device engine's batch-dimension mesh.
+    """
+    which = resolve_engine(engine)
+    if which == "device":
+        from .device_engine import DeviceEngine
+        return DeviceEngine(path, cache_terms=cache_terms, shards=shards)
+    return Engine(path, cache_terms=cache_terms)

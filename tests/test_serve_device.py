@@ -1,0 +1,337 @@
+"""Device-engine suite: byte parity against the host engine, the
+shared-prefix searchsorted-fixup path, static-shape compile discipline,
+engine selection, and the empty-batch CLI contract.
+
+Everything here runs under ``JAX_PLATFORMS=cpu`` — the device engine's
+CPU-backend fallback is a tier-1 requirement (the jit/shard_map
+pipeline is identical; only the mesh devices differ), so the parity
+contract is enforced on every box, not just on chips.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from test_serve import build_corpus, naive_index
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import main
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    Engine, create_engine, resolve_engine,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.artifact import (
+    artifact_path, device_columns, load_artifact,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.device_engine import (
+    DeviceEngine,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.device_serve]
+
+
+@pytest.fixture(scope="module")
+def zipf_pair(tmp_path_factory):
+    """(host, device, naive) over one pipeline-built Zipf corpus."""
+    docs = zipf_corpus(num_docs=60, vocab_size=900, tokens_per_doc=150,
+                       seed=11)
+    out = build_corpus(tmp_path_factory.mktemp("serve_dev_zipf"), docs)
+    host = Engine(artifact_path(out))
+    device = DeviceEngine(artifact_path(out))
+    yield host, device, naive_index(docs)
+    device.close()
+    host.close()
+
+
+#: >= 3 vocabulary terms sharing one full 8-byte prefix — the
+#: searchsorted collision-fixup arm — plus prefix-adjacent traps:
+#: the bare 8-byte prefix itself, a shorter sibling, and neighbors.
+PREFIX_DOCS = [
+    b"aaaaaaaab aaaaaaaac common one",
+    b"aaaaaaaad aaaaaaaab common two",
+    b"aaaaaaaa aaaaaaa aaaaaaaabzz three",
+    b"aaaaaaab aaaaaaaac zebra common",
+]
+
+
+@pytest.fixture(scope="module")
+def prefix_pair(tmp_path_factory):
+    out = build_corpus(tmp_path_factory.mktemp("serve_dev_prefix"),
+                       PREFIX_DOCS)
+    host = Engine(artifact_path(out))
+    device = DeviceEngine(artifact_path(out))
+    yield out, host, device, naive_index(PREFIX_DOCS)
+    device.close()
+    host.close()
+
+
+def _assert_pair_matches(host, device, naive, terms):
+    """Every answer byte-identical across engines AND right vs naive."""
+    bh, bd = host.encode_batch(terms), device.encode_batch(terms)
+    assert (bh == bd).all()
+    dh, dd = host.df(bh), device.df(bd)
+    assert dh.dtype == dd.dtype and dh.tolist() == dd.tolist()
+    for t, post_h, post_d in zip(terms, host.postings(bh),
+                                 device.postings(bd)):
+        want = naive.get(t if isinstance(t, str) else t.decode("latin-1"))
+        if want is None or t == "":
+            assert post_h is None and post_d is None, t
+        else:
+            assert post_h is not None and post_d is not None, t
+            assert post_h.tolist() == want, t
+            assert np.array_equal(post_h, post_d), t
+
+
+# -- batched parity fuzz ------------------------------------------------
+
+
+@pytest.mark.parametrize("batch", [1, 32, 1024, 8192])
+def test_device_parity_fuzz(zipf_pair, batch):
+    """df + postings byte-identical at every required batch size,
+    mixing present, absent, and junk terms."""
+    host, device, naive = zipf_pair
+    vocab = sorted(naive)
+    rng = random.Random(batch)
+    junk = ["", "zzzznope", "Aardvark!!", "x1y2z3q4", "a" * 40, "THE"]
+    terms = [vocab[rng.randrange(len(vocab))] if rng.random() < 0.8
+             else junk[rng.randrange(len(junk))] for _ in range(batch)]
+    _assert_pair_matches(host, device, naive, terms)
+
+
+def test_device_boolean_parity(zipf_pair):
+    host, device, naive = zipf_pair
+    vocab = sorted(naive)
+    rng = random.Random(5)
+    for _ in range(40):
+        k = rng.choice((1, 2, 2, 3, 4))
+        terms = rng.sample(vocab, k=k)
+        if rng.random() < 0.25:
+            terms[rng.randrange(k)] = "notinthecorpusxyz"
+        bh, bd = host.encode_batch(terms), device.encode_batch(terms)
+        got_and_h, got_and_d = host.query_and(bh), device.query_and(bd)
+        got_or_h, got_or_d = host.query_or(bh), device.query_or(bd)
+        assert got_and_h.dtype == got_and_d.dtype
+        assert got_and_h.tolist() == got_and_d.tolist(), terms
+        assert got_or_h.tolist() == got_or_d.tolist(), terms
+        # and both equal naive set algebra
+        sets = [set(naive.get(t, ())) for t in terms]
+        want_and = sorted(set.intersection(*sets)) if all(sets) else []
+        assert got_and_d.tolist() == want_and, terms
+        assert got_or_d.tolist() == sorted(set.union(*sets)), terms
+
+
+def test_device_top_k_parity(zipf_pair):
+    host, device, _ = zipf_pair
+    for li in range(26):
+        for k in (1, 3, 1000):
+            assert host.top_k(li, k) == device.top_k(li, k), (li, k)
+    with pytest.raises(ValueError):
+        device.top_k("1", 3)
+
+
+def test_device_lookup_matches_host(zipf_pair):
+    host, device, naive = zipf_pair
+    vocab = sorted(naive)
+    batch = host.encode_batch(vocab[:50] + ["missing"] + vocab[-50:])
+    ih, fh = host.lookup(batch)
+    id_, fd = device.lookup(batch)
+    assert fh.tolist() == fd.tolist()
+    assert ih[fh].tolist() == id_[fd].tolist()
+
+
+def test_device_empty_batch(zipf_pair):
+    _, device, _ = zipf_pair
+    empty = device.encode_batch([])
+    assert device.df(empty).tolist() == []
+    assert device.postings(empty) == []
+    assert device.query_and(empty).tolist() == []
+    assert device.query_or(empty).tolist() == []
+
+
+# -- shared-prefix fixup ------------------------------------------------
+
+
+def test_prefix_columns_see_collision_group(prefix_pair):
+    out, _, device, _ = prefix_pair
+    art = load_artifact(artifact_path(out))
+    try:
+        cols = device_columns(art)
+    finally:
+        art.close()
+    # aaaaaaaab / aaaaaaaabzz / aaaaaaaac / aaaaaaaad share the 8-byte
+    # prefix "aaaaaaaa" with the bare prefix term itself: a 5-way group
+    assert cols["max_prefix_group"] >= 4
+    assert device._group == cols["max_prefix_group"]
+
+
+@pytest.mark.parametrize("engine_kind", ["host", "device"])
+def test_prefix_fixup_single_and_batched(prefix_pair, engine_kind):
+    """Every colliding term resolves, single and batched, both engines."""
+    _, host, device, naive = prefix_pair
+    engine = host if engine_kind == "host" else device
+    probes = ["aaaaaaaa", "aaaaaaa", "aaaaaaaab", "aaaaaaaabzz",
+              "aaaaaaaac", "aaaaaaaad", "aaaaaaab", "aaaaaaaae",
+              "aaaaaaaaz", "common", "zebra", "aaaaaaaabz"]
+    # batched: one array, all collision arms at once
+    batch = engine.encode_batch(probes)
+    dfs = engine.df(batch)
+    posts = engine.postings(batch)
+    for t, df, post in zip(probes, dfs.tolist(), posts):
+        want = naive.get(t)
+        if want is None:
+            assert df == 0 and post is None, t
+        else:
+            assert df == len(want), t
+            assert post.tolist() == want, t
+    # single: each term alone hits the same arm
+    for t in probes:
+        b1 = engine.encode_batch([t])
+        assert engine.df(b1).tolist()[0] == len(naive.get(t, [])), t
+
+
+def test_prefix_fixup_cross_engine_boolean(prefix_pair):
+    _, host, device, naive = prefix_pair
+    for terms in (["aaaaaaaab", "aaaaaaaac"],
+                  ["aaaaaaaa", "aaaaaaaad"],
+                  ["aaaaaaaab", "common", "aaaaaaaad"]):
+        bh, bd = host.encode_batch(terms), device.encode_batch(terms)
+        assert host.query_and(bh).tolist() == device.query_and(bd).tolist()
+        assert host.query_or(bh).tolist() == device.query_or(bd).tolist()
+
+
+# -- compile discipline -------------------------------------------------
+
+
+def test_device_zero_recompile_steady_state(zipf_pair):
+    """After one warm pass over a shape, repeats add no jit entries."""
+    _, device, naive = zipf_pair
+    vocab = sorted(naive)
+    rng = random.Random(9)
+
+    def one_round(seed_terms):
+        device.postings(device.encode_batch(seed_terms))
+        device.query_and(device.encode_batch(seed_terms[:2]))
+        device.query_or(device.encode_batch(seed_terms[:2]))
+
+    # steady state = the working set of (bucket, tier) shapes repeats;
+    # replay the same batches so the second pass IS the steady state
+    # (a fresh sample may legitimately hit a colder posting tier)
+    rounds = [rng.sample(vocab, k=min(b, len(vocab)))
+              for b in (1, 32, 257)]
+    for seed_terms in rounds:
+        one_round(seed_terms)
+    warm = device.compile_stats()
+    for seed_terms in rounds:
+        one_round(seed_terms)
+    assert device.compile_stats() == warm
+
+
+def test_device_batch_bucketing_shares_compiles(zipf_pair):
+    """Batches 200..256 share the 256 bucket: no new compile entries."""
+    _, device, naive = zipf_pair
+    vocab = sorted(naive)
+    device.df(device.encode_batch(vocab[:256]))
+    warm = device.compile_stats()
+    for n in (200, 222, 256, 129):
+        device.df(device.encode_batch(vocab[:n]))
+    assert device.compile_stats() == warm
+
+
+# -- engine selection + stats surface -----------------------------------
+
+
+def test_resolve_engine_auto_cpu_is_host(monkeypatch):
+    # tier-1 runs under JAX_PLATFORMS=cpu: auto must serve host-side
+    assert resolve_engine("auto") == "host"
+    assert resolve_engine("host") == "host"
+    assert resolve_engine("device") == "device"
+    with pytest.raises(ValueError):
+        resolve_engine("gpu")
+    monkeypatch.setenv("MRI_SERVE_ENGINE", "device")
+    assert resolve_engine(None) == "device"
+
+
+def test_create_engine_kinds(prefix_pair):
+    out, _, _, _ = prefix_pair
+    with create_engine(artifact_path(out), "host") as e:
+        assert isinstance(e, Engine) and e.engine_name == "host"
+    with create_engine(artifact_path(out), "device") as e:
+        assert isinstance(e, DeviceEngine)
+        d = e.describe()
+        assert d["engine"] == "device"
+        assert d["device"]["shards"] >= 1
+        assert "jit_cache_entries" in d["device"]
+
+
+def test_describe_and_op_stats(prefix_pair):
+    _, host, _, _ = prefix_pair
+    host._ops.reset()
+    host.df(host.encode_batch(["common"]))
+    d = host.describe()
+    assert d["engine"] == "host"
+    assert d["ops"]["df"]["calls"] == 1
+    assert {"hits", "misses", "evictions"} <= set(d["cache"])
+
+
+def test_eviction_counter(prefix_pair):
+    out, _, _, naive = prefix_pair
+    with Engine(artifact_path(out), cache_terms=2) as e:
+        terms = sorted(naive)[:5]
+        e.postings(e.encode_batch(terms))
+        assert e.cache_stats()["evictions"] == 3
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def test_query_cli_engine_flag_parity(prefix_pair, capsys):
+    out, _, _, _ = prefix_pair
+    outputs = {}
+    for eng in ("host", "device"):
+        assert main(["query", str(out), "--engine", eng,
+                     "aaaaaaaab", "aaaaaaaac", "--stats"]) == 0
+        lines = capsys.readouterr().out.splitlines()
+        outputs[eng] = lines[:-1]
+        stats = json.loads(lines[-1])
+        assert stats["engine"] == eng
+        if eng == "device":
+            assert stats["device"]["shards"] >= 1
+            assert "tiers" in stats["device"]
+    assert outputs["host"] == outputs["device"]
+
+
+def test_query_cli_env_override_selects_engine(prefix_pair, capsys,
+                                               monkeypatch):
+    """MRI_SERVE_ENGINE drives the CLI when --engine isn't given, and an
+    explicit --engine flag beats the env."""
+    out, _, _, _ = prefix_pair
+    monkeypatch.setenv("MRI_SERVE_ENGINE", "device")
+    assert main(["query", str(out), "--stats", "aaaaaaaab"]) == 0
+    stats = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert stats["engine"] == "device"
+    assert main(["query", str(out), "--engine", "host",
+                 "--stats", "aaaaaaaab"]) == 0
+    stats = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert stats["engine"] == "host"
+
+
+def test_query_cli_empty_batch_file_exits_0(prefix_pair, tmp_path, capsys):
+    """The empty-batch contract: exit 0, no output, both engines."""
+    out, _, _, _ = prefix_pair
+    empty = tmp_path / "empty.txt"
+    empty.write_text("")
+    for eng in ("host", "device"):
+        assert main(["query", str(out), "--engine", eng,
+                     "--batch-file", str(empty)]) == 0
+        captured = capsys.readouterr()
+        assert captured.out == "" and captured.err == ""
+    # whitespace-only lines are also an empty batch
+    empty.write_text("\n   \n\t\n")
+    assert main(["query", str(out), "--batch-file", str(empty)]) == 0
+    assert capsys.readouterr().out == ""
+    # but no --batch-file at all is still the old error contract
+    assert main(["query", str(out)]) == 2
+    assert "error:" in capsys.readouterr().err

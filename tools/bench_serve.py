@@ -1,19 +1,26 @@
 """Query-serving benchmark: QPS / latency against the ``index.mri``
-artifact (make bench-serve).
+artifact (make bench-serve / make bench-serve-device).
 
-Prints ONE JSON line mirroring bench.py's shape:
+Three modes, all printing ONE JSON line mirroring bench.py's shape:
 
-    {"metric": "serve_lookups_per_s", "value": N, "unit": "lookups/s",
-     "batches": {"1": {...}, "32": {...}, "1024": {...}}, ...}
+  (default)           closed-loop host-engine QPS/latency at
+                      MRI_SERVE_BATCHES (the r05 bench, unchanged)
+  --open-loop RPS     Poisson arrivals at the offered rate: p50/p99
+                      latency measured from each query's scheduled
+                      arrival (queueing delay included), not from
+                      service start — the number a latency SLO is
+                      actually about
+  --device-ab         host-vs-device A/B at batch 1/1K/8K/64K with a
+                      per-op breakdown, a byte-parity check between the
+                      engines on sampled batches, and a zero-recompile
+                      steady-state assertion; also written to
+                      --out (BENCH_SERVE_DEVICE_r06.json)
 
 The workload is Zipf-distributed over the corpus vocabulary ranked by
 document frequency — rank-1 terms dominate, exactly the hot-head skew a
 serving cache exists for — drawn from the same corpus bench.py measures
 (the reference test_in when mounted, else the deterministic synthetic
-Zipf corpus at the same scale).  For each batch size the engine answers
-pre-generated batches through the full lookup path (term resolve →
-postings decode, LRU-cached); per-batch wall times give p50/p99, and
-``value`` is the cache-warm lookups/s at the largest batch size.
+Zipf corpus at the same scale).
 
 Build overhead is measured the way bench.py measures everything else:
 best-of-N cpu e2e with and without ``--artifact`` on the same corpus,
@@ -23,6 +30,7 @@ contract is <= 10 % of the unaudited cpu e2e.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -37,10 +45,18 @@ import bench
 
 BATCH_SIZES = tuple(
     int(b) for b in os.environ.get("MRI_SERVE_BATCHES", "1,32,1024").split(","))
+AB_BATCH_SIZES = tuple(
+    int(b) for b in os.environ.get(
+        "MRI_SERVE_AB_BATCHES", "1,1024,8192,65536").split(","))
 #: total single-term lookups per batch size (split into batches)
 LOOKUPS = int(os.environ.get("MRI_SERVE_LOOKUPS", 200_000))
+#: per-batch-size cap on timed batches in A/B mode (keeps the batch-1
+#: leg of the slow engine from dominating the run; latency percentiles
+#: are insensitive past this)
+AB_MAX_BATCHES = int(os.environ.get("MRI_SERVE_AB_MAX_BATCHES", 256))
 ZIPF_S = float(os.environ.get("MRI_SERVE_ZIPF_S", 1.1))
 SEED = int(os.environ.get("MRI_SERVE_SEED", 17))
+OPEN_SECONDS = float(os.environ.get("MRI_SERVE_OPEN_SECONDS", 3.0))
 
 
 def _build_index() -> tuple[str, dict]:
@@ -62,17 +78,22 @@ def _zipf_terms(engine, n: int, rng) -> list[str]:
     # rank draw: k ~ Zipf(s) clipped to the vocab, then mapped through
     # the global df-descending order so rank 1 IS the hottest term
     ranks = np.minimum(rng.zipf(ZIPF_S, size=n), vocab) - 1
-    by_df = np.argsort(-engine._df, kind="stable")
+    by_df = np.argsort(-np.asarray(engine.artifact.df), kind="stable")
     idx = by_df[ranks]
     return [engine.artifact.term(int(i)).decode("ascii") for i in idx]
 
 
-def _measure_batches(engine, terms: list[str], batch: int) -> dict:
+def _measure_batches(engine, terms: list[str], batch: int,
+                     max_batches: int | None = None) -> dict:
     """Cache-warm QPS + per-batch latency percentiles for one batch size."""
     batches = [engine.encode_batch(terms[i:i + batch])
                for i in range(0, len(terms), batch)
                if i + batch <= len(terms)]
-    for b in batches:  # warm: LRU fill + numpy caches
+    if max_batches is not None:
+        batches = batches[:max_batches]
+    # warm: LRU / jit-bucket fill + numpy caches (all batches in the
+    # default mode — the r05 discipline — a spot-warm under the A/B cap)
+    for b in (batches if max_batches is None else batches[:32]):
         engine.postings(b)
     lat = np.empty(len(batches))
     t_all = time.perf_counter()
@@ -92,17 +113,195 @@ def _measure_batches(engine, terms: list[str], batch: int) -> dict:
     }
 
 
-def main() -> int:
+def _measure_boolean(engine, terms: list[str]) -> dict:
+    """2-term AND/OR QPS over Zipf pairs."""
+    pairs = [terms[i:i + 2] for i in range(0, 2000, 2)]
+    out = {}
+    for op, fn in (("and", engine.query_and), ("or", engine.query_or)):
+        enc = [engine.encode_batch(p) for p in pairs]
+        for b in enc[:32]:
+            fn(b)  # warm jit (T, W) buckets
+        t0 = time.perf_counter()
+        for b in enc:
+            fn(b)
+        out[f"boolean_{op}_qps"] = round(
+            len(enc) / (time.perf_counter() - t0), 1)
+    return out
+
+
+# -- open-loop (Poisson arrivals) ---------------------------------------
+
+
+def _open_loop(engine, terms: list[str], rps: float, seconds: float,
+               rng) -> dict:
+    """Latency under offered load: queries arrive at Poisson times and
+    the measured latency runs from the SCHEDULED arrival to completion,
+    so a service that can't keep up shows its queueing delay instead of
+    hiding it (closed-loop throughput can't see that)."""
+    n = min(max(int(rps * seconds), 1), len(terms))
+    enc = [engine.encode_batch([t]) for t in terms[:n]]
+    engine.postings(enc[0])  # warm
+    arrivals = np.cumsum(rng.exponential(1.0 / rps, size=n))
+    lat = np.empty(n)
+    t0 = time.perf_counter()
+    for i in range(n):
+        target = t0 + arrivals[i]
+        now = time.perf_counter()
+        if now < target:
+            time.sleep(target - now)
+        engine.postings(enc[i])
+        lat[i] = time.perf_counter() - target
+    wall = time.perf_counter() - t0
+    return {
+        "offered_rps": rps,
+        "achieved_rps": round(n / wall, 1),
+        "requests": n,
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "max_ms": round(float(lat.max()) * 1e3, 3),
+    }
+
+
+# -- host vs device A/B -------------------------------------------------
+
+
+def _assert_parity(host, device, terms: list[str], rng) -> int:
+    """Byte-parity spot check between the engines; returns the number
+    of compared answers (raises on the first mismatch)."""
+    checked = 0
+    for bsz in (1, 7, 64, 1024):
+        sample = [terms[int(i)] for i in
+                  rng.integers(0, len(terms), size=bsz)]
+        bh, bd = host.encode_batch(sample), device.encode_batch(sample)
+        assert host.df(bh).tolist() == device.df(bd).tolist(), bsz
+        for a, b in zip(host.postings(bh), device.postings(bd)):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert np.array_equal(a, b)
+        checked += 2 * bsz
+    for _ in range(50):
+        pair = [terms[int(i)] for i in rng.integers(0, len(terms), size=2)]
+        bh, bd = host.encode_batch(pair), device.encode_batch(pair)
+        assert host.query_and(bh).tolist() == device.query_and(bd).tolist()
+        assert host.query_or(bh).tolist() == device.query_or(bd).tolist()
+        checked += 2
+    for li in range(26):
+        assert host.top_k(li, 10) == device.top_k(li, 10)
+        checked += 1
+    return checked
+
+
+def _device_ab(out_path: str | None) -> dict:
     from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
         Engine,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.device_engine import (
+        DeviceEngine,
+    )
+    import jax
+
+    _, corpus_metric = bench._manifest()
+    out_dir, build_report = _build_index()
+    rng = np.random.default_rng(SEED)
+
+    host = Engine(os.path.join(out_dir, "index.mri"))
+    device = DeviceEngine(os.path.join(out_dir, "index.mri"))
+    terms = _zipf_terms(host, max(LOOKUPS, max(AB_BATCH_SIZES)), rng)
+
+    parity_checked = _assert_parity(host, device, terms, rng)
+
+    engines = {}
+    for name, engine in (("host", host), ("device", device)):
+        per_batch = {}
+        for bsz in AB_BATCH_SIZES:
+            if hasattr(engine, "cache"):
+                engine.cache.clear()
+            engine._ops.reset()
+            per_batch[str(bsz)] = _measure_batches(
+                engine, terms, bsz, max_batches=AB_MAX_BATCHES)
+            per_batch[str(bsz)]["ops"] = engine.op_stats()
+        engine._ops.reset()
+        per_batch.update(_measure_boolean(engine, terms))
+        per_batch["boolean_ops"] = engine.op_stats()
+        engines[name] = per_batch
+
+    # zero-recompile steady state: every (bucket, tier) shape is warm
+    # after the measurement pass above — one more full sweep must not
+    # grow the jit cache
+    before = device.compile_stats()
+    for bsz in AB_BATCH_SIZES:
+        _measure_batches(engine=device, terms=terms, batch=bsz,
+                         max_batches=8)
+    _measure_boolean(device, terms)
+    after = device.compile_stats()
+    assert after == before, f"steady-state recompile: {before} -> {after}"
+
+    biggest = str(max(AB_BATCH_SIZES))
+    speedup = {
+        str(b): round(
+            engines["device"][str(b)]["lookups_per_s"]
+            / engines["host"][str(b)]["lookups_per_s"], 3)
+        for b in AB_BATCH_SIZES
+    }
+    line = {
+        "metric": "serve_device_lookups_per_s",
+        "value": engines["device"][biggest]["lookups_per_s"],
+        "unit": "lookups/s",
+        "corpus_metric": corpus_metric,
+        "batch_sizes": list(AB_BATCH_SIZES),
+        "zipf_s": ZIPF_S,
+        "vocab": host.vocab_size,
+        "engines": engines,
+        "device_speedup_vs_host": speedup,
+        "parity": {"checked_answers": parity_checked,
+                   "result": "byte-identical"},
+        "steady_state": {"recompiles_after_warmup": 0,
+                         "jit_cache": after},
+        "platform": jax.default_backend(),
+        "shards": device._num_shards,
+        "host_cores": os.cpu_count(),
+        "artifact_bytes": int(build_report.get("artifact_bytes", 0)),
+        "scratch": bench._scratch_backing(),
+    }
+    host.close()
+    device.close()
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
+# -- default closed-loop host bench (the r05 shape, unchanged) ----------
+
+
+def _closed_loop(engine_name: str, open_loop_rps: float | None) -> dict:
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        create_engine,
     )
 
     _, corpus_metric = bench._manifest()
     out_dir, build_report = _build_index()
 
-    engine = Engine(os.path.join(out_dir, "index.mri"))
+    engine = create_engine(
+        os.path.join(out_dir, "index.mri"), engine_name)
     rng = np.random.default_rng(SEED)
     terms = _zipf_terms(engine, LOOKUPS, rng)
+
+    if open_loop_rps is not None:
+        line = {
+            "metric": "serve_open_loop_p99_ms",
+            "unit": "ms",
+            "engine": engine.engine_name,
+            "corpus_metric": corpus_metric,
+            "zipf_s": ZIPF_S,
+            "vocab": engine.vocab_size,
+            "open_loop": _open_loop(
+                engine, terms, open_loop_rps, OPEN_SECONDS, rng),
+            "cache": engine.cache_stats(),
+            "scratch": bench._scratch_backing(),
+        }
+        line["value"] = line["open_loop"]["p99_ms"]
+        engine.close()
+        return line
 
     batches = {}
     for bsz in BATCH_SIZES:
@@ -110,15 +309,7 @@ def main() -> int:
         batches[str(bsz)] = _measure_batches(engine, terms, bsz)
     cache = engine.cache_stats()
 
-    # multi-term boolean queries: 2-term AND / OR over Zipf pairs
-    pairs = [terms[i:i + 2] for i in range(0, 2000, 2)]
-    for op, fn in (("and", engine.query_and), ("or", engine.query_or)):
-        enc = [engine.encode_batch(p) for p in pairs]
-        t0 = time.perf_counter()
-        for b in enc:
-            fn(b)
-        batches[f"boolean_{op}_qps"] = round(
-            len(enc) / (time.perf_counter() - t0), 1)
+    batches.update(_measure_boolean(engine, terms))
 
     # build overhead vs the unaudited cpu e2e (same best-of discipline)
     plain = bench._measure("cpu", [{}], rounds=5)
@@ -131,12 +322,14 @@ def main() -> int:
         "metric": "serve_lookups_per_s",
         "value": batches[biggest]["lookups_per_s"],
         "unit": "lookups/s",
+        "engine": engine.engine_name,
         "corpus_metric": corpus_metric,
         "batch_size": int(biggest),
         "zipf_s": ZIPF_S,
         "vocab": engine.vocab_size,
         "batches": batches,
         "cache": cache,
+        "ops": engine.op_stats(),
         "artifact_bytes": int(build_report.get("artifact_bytes", 0)),
         "artifact_build_ms": round(build_ms, 3),
         "cpu_ms": round(plain["best_ms"], 2),
@@ -145,6 +338,32 @@ def main() -> int:
         "scratch": bench._scratch_backing(),
     }
     engine.close()
+    return line
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_serve",
+        description="QPS/latency benchmark over index.mri")
+    p.add_argument("--engine", choices=("host", "device", "auto"),
+                   default="host",
+                   help="engine for the default/open-loop modes")
+    p.add_argument("--open-loop", type=float, default=None, metavar="RPS",
+                   help="open-loop mode: Poisson arrivals at this "
+                        "offered rate; p50/p99 measured from scheduled "
+                        "arrival (queueing delay included)")
+    p.add_argument("--device-ab", action="store_true",
+                   help="host-vs-device A/B at batch "
+                        f"{','.join(map(str, AB_BATCH_SIZES))} with "
+                        "parity + zero-recompile assertions")
+    p.add_argument("--out", default="BENCH_SERVE_DEVICE_r06.json",
+                   help="where --device-ab writes its JSON report")
+    args = p.parse_args(argv)
+
+    if args.device_ab:
+        line = _device_ab(args.out)
+    else:
+        line = _closed_loop(args.engine, args.open_loop)
     print(json.dumps(line))
     return 0
 
