@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build test test-fast test-faults test-parallel test-chaos test-serve test-serve-device bench bench-scale bench-sweep bench-serve bench-serve-device capture rehearse clean clean-native
+.PHONY: build test test-fast test-faults test-parallel test-chaos test-serve test-serve-device test-daemon bench bench-scale bench-sweep bench-serve bench-serve-device bench-daemon capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -50,6 +50,13 @@ test-serve:
 test-serve-device:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m device_serve
 
+# resident serve-daemon suite: JSON-lines protocol parity, admission
+# control / load shedding, deadlines, graceful drain, crash-safe hot
+# reload, serve-side chaos trials; none are `slow`, so the default
+# `make test-fast` sweep runs them too
+test-daemon:
+	$(PY) -m pytest tests/ -q -m daemon
+
 bench:
 	$(PY) bench.py
 
@@ -73,6 +80,13 @@ bench-serve:
 # byte-parity + zero-recompile assertions) -> BENCH_SERVE_DEVICE_r06.json
 bench-serve-device:
 	$(PY) tools/bench_serve.py --device-ab
+
+# resident-daemon bench: coalesced pipelined capacity vs the batch-1
+# closed-loop baseline, plus an open-loop (Poisson) sweep reporting
+# p50/p99 from scheduled arrival, shed rate, and deadline-miss rate at
+# 3 offered loads -> BENCH_DAEMON_r07.json
+bench-daemon:
+	$(PY) tools/bench_serve.py --daemon-bench
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

@@ -25,7 +25,9 @@ from .utils.checkpoint import CheckpointCorrupt
 
 _EPILOG = """\
 exit codes:
-  0  clean run (output complete and, under --audit, integrity-checked)
+  0  clean run (output complete and, under --audit, integrity-checked);
+     for 'serve': graceful drain completed
+  1  serve daemon forced exit (second SIGTERM/SIGINT during drain)
   2  error (bad arguments, I/O failure, integrity/audit failure)
   3  degraded (completed, but skipped unreadable documents or lost
      windows after exhausting retry/respawn budgets; see the
@@ -46,9 +48,17 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
   scan-error:window=3            native scan failure on window 3
   scan-error:window=3:silent=1   window silently dropped (--audit
                                  catches the corruption)
+  handler-crash:req=3            serve daemon: request 3's handler dies
+                                 (answered with a counted 'internal')
+  client-disconnect:req=2        serve daemon: peer vanishes as
+                                 response 2 is written
+  slow-client:req=1:ms=200       serve daemon: response write stalls
+  reload-corrupt                 serve daemon: next hot reload fails
+                                 verification (old artifact keeps
+                                 serving, 'reload_rejected' counted)
   chaos:seed=5:n=3               sample 3 faults deterministically
                                  (bounds: windows= workers= reducers=
-                                 docs= kinds=a,b,c)
+                                 docs= reqs= kinds=a,b,c)
 
 verify mode:
   mri-tpu --verify DIR           re-check DIR's letter files (and
@@ -71,6 +81,26 @@ query mode (the serving read path; needs an --artifact build):
                                  backends); byte-identical to host
   a missing/torn index.mri exits 2 with one line on stderr, never
   garbage answers
+
+serve mode (resident daemon; loads the artifact ONCE):
+  mri-tpu serve DIR --listen 127.0.0.1:7070
+                                 JSON-lines protocol over TCP — one
+                                 request object per line, one response
+                                 line back; ops df/postings/and/or/
+                                 top_k plus stats/healthz/reload;
+                                 pending requests coalesce into micro-
+                                 batches for the vectorized batch path
+                                 (MRI_SERVE_COALESCE_US window); the
+                                 pending queue is bounded (MRI_SERVE_
+                                 QUEUE_DEPTH) with counted 'overloaded'
+                                 shedding, requests may carry
+                                 deadline_ms ('deadline_expired' when
+                                 missed before dispatch); SIGTERM/
+                                 SIGINT = graceful drain then exit 0
+                                 (second signal forces exit 1); SIGHUP
+                                 = crash-safe hot reload of index.mri
+                                 (a failed verification keeps the old
+                                 artifact and counts reload_rejected)
 """
 
 
@@ -273,13 +303,113 @@ def _query_main(argv: list[str]) -> int:
     return 0
 
 
+def _serve_main(argv: list[str]) -> int:
+    """``mri-tpu serve DIR --listen HOST:PORT`` — the resident daemon
+    (serve/daemon.py).  Blocks until drained by SIGTERM/SIGINT."""
+    import signal
+    import threading
+
+    p = argparse.ArgumentParser(
+        prog="mri-tpu serve",
+        description="resident JSON-lines query daemon over a built "
+                    "index.mri artifact (see the main --help epilog "
+                    "for the protocol and signal semantics)")
+    p.add_argument("index_dir", help="output dir of an --artifact run "
+                                     "(or the index.mri file itself)")
+    p.add_argument("--listen", default="127.0.0.1:0", metavar="HOST:PORT",
+                   help="bind address (port 0 = ephemeral; the chosen "
+                        "port is printed in the 'listening' JSON line)")
+    p.add_argument("--engine", choices=("host", "device", "auto"),
+                   default=None,
+                   help="query backend (same choices as 'query')")
+    p.add_argument("--cache-terms", type=int, default=4096,
+                   help="hot-term LRU capacity (host engine)")
+    p.add_argument("--shards", type=int, default=None,
+                   help="device engine mesh size")
+    p.add_argument("--fault-spec", default=None,
+                   help="arm the deterministic fault injector "
+                        "(serve kinds: handler-crash/client-disconnect/"
+                        "slow-client/reload-corrupt) — test/bench only")
+    args = p.parse_args(argv)
+
+    if args.fault_spec is not None:
+        try:
+            faults.install(args.fault_spec)
+        except faults.FaultSpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    host, _, port_s = args.listen.rpartition(":")
+    try:
+        port = int(port_s)
+        if not host or not (0 <= port <= 65535):
+            raise ValueError
+    except ValueError:
+        print(f"error: --listen must be HOST:PORT, got {args.listen!r}",
+              file=sys.stderr)
+        return 2
+
+    from .serve import ArtifactError
+    from .serve.daemon import ServeDaemon
+
+    try:
+        daemon = ServeDaemon(args.index_dir, host, port,
+                             engine=args.engine,
+                             cache_terms=args.cache_terms,
+                             shards=args.shards)
+    except (ArtifactError, ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        daemon.start()
+    except OSError as e:
+        print(f"error: cannot listen on {args.listen}: {e}",
+              file=sys.stderr)
+        return 2
+
+    stop = threading.Event()
+
+    def _on_stop_signal(signum, frame):
+        if stop.is_set():
+            # second signal: the drain is not fast enough for the
+            # operator — documented forced exit, code 1
+            os._exit(1)
+        stop.set()
+
+    def _on_hup(signum, frame):
+        # reload off the signal frame AND off the dispatcher: open +
+        # verify happen on this throwaway thread, only the engine swap
+        # touches the dispatch lock
+        threading.Thread(target=daemon.reload, name="mri-serve-reload",
+                         daemon=True).start()
+
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, _on_stop_signal)
+        signal.signal(signal.SIGINT, _on_stop_signal)
+        signal.signal(signal.SIGHUP, _on_hup)
+
+    bound_host, bound_port = daemon.address
+    print(json.dumps({"event": "listening", "host": bound_host,
+                      "port": bound_port, "pid": os.getpid(),
+                      "engine": daemon._engine.engine_name}),
+          flush=True)
+    while not stop.is_set():
+        stop.wait(0.2)
+    rc = daemon.drain()
+    print(json.dumps({"event": "drained",
+                      "counters": daemon.final_stats["counters"]},
+                     sort_keys=True), flush=True)
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
-    # --verify DIR / query DIR are standalone modes (no reference
-    # positionals): pre-parse them so 'mri-tpu --verify out/' and
-    # 'mri-tpu query out/ word' work without dummy mapper counts.
+    # --verify DIR / query DIR / serve DIR are standalone modes (no
+    # reference positionals): pre-parse them so 'mri-tpu --verify out/'
+    # and 'mri-tpu query out/ word' work without dummy mapper counts.
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "query":
         return _query_main(argv[1:])
+    if argv and argv[0] == "serve":
+        return _serve_main(argv[1:])
     if "--verify" in argv:
         i = argv.index("--verify")
         if i + 1 >= len(argv):

@@ -31,24 +31,31 @@ Three layers live here:
         reducer-death:reducer=0      reduce worker 0 dies before emit
         scan-error:window=3          native scan failure on window 3
         scan-error:window=3:silent=1 window silently dropped (corruption)
+        handler-crash:req=3          serve daemon: handler dies on req 3
+        client-disconnect:req=2      serve daemon: peer gone at response 2
+        slow-client:req=1:ms=200     serve daemon: response write stalls
+        reload-corrupt               serve daemon: next hot reload fails
         chaos:seed=5:n=3             sample 3 faults from a seeded RNG
         seed=7                       RNG seed for ``p=`` rules
 
     ``doc`` / ``every`` match the 0-based manifest index; ``window``
     and ``save`` are 1-based ordinals (matching ``win_i`` in the
     stream loop and "the Nth save"); ``worker`` / ``reducer`` are the
-    0-based thread ordinals of the parallel host path.  Clauses join
-    with ``;`` into multi-fault schedules.  The death/scan kinds
-    default to ``times=1`` and their firing state is GLOBAL, so a
-    window requeued after a worker death does not re-kill the survivor
-    that rescans it — recovery converges.
+    0-based thread ordinals of the parallel host path; ``req`` is the
+    1-based global data-request ordinal of the serve daemon.  Clauses
+    join with ``;`` into multi-fault schedules.  The death/scan/serve
+    kinds default to ``times=1`` and their firing state is GLOBAL, so
+    a window requeued after a worker death does not re-kill the
+    survivor that rescans it — recovery converges.
 
     ``chaos:seed=S:n=K`` expands at parse time into K concrete rules
     sampled deterministically from ``seed`` — the soak harness's
     randomized-but-reproducible fault schedules.  Optional bounds:
-    ``windows=`` / ``workers=`` / ``reducers=`` / ``docs=`` cap the
-    sampled ordinals, and ``kinds=a,b,c`` restricts the kinds drawn
-    (default: every recoverable kind).
+    ``windows=`` / ``workers=`` / ``reducers=`` / ``docs=`` /
+    ``reqs=`` cap the sampled ordinals, and ``kinds=a,b,c`` restricts
+    the kinds drawn (default: every recoverable build-side kind; the
+    serve kinds are samplable only when named explicitly, so build
+    soaks stay build-shaped).
 
 ``RetryPolicy``
     Bounded retries with exponential backoff and a per-document
@@ -114,17 +121,43 @@ class ScanError(RuntimeError):
     to catch."""
 
 
+class HandlerCrash(RuntimeError):
+    """Injected serve-daemon handler failure (``handler-crash`` rule):
+    escapes one request's handling like any real bug would; the daemon
+    must answer that request with a counted well-formed ``internal``
+    error and keep serving every other connection."""
+
+
+class InjectedReloadCorrupt(RuntimeError):
+    """Injected hot-reload verification failure (``reload-corrupt``
+    rule).  Raised from the reload hook as if the replacement
+    ``index.mri`` failed its checksum: the daemon must keep serving
+    the old artifact and count ``reload_rejected`` instead of dying.
+    (A plain RuntimeError, not an ArtifactError subclass — faults.py
+    sits below serve/ in the import graph.)"""
+
+
 # -- injector ---------------------------------------------------------
 
 _READ_KINDS = ("read-error", "slow-read", "truncate")
 _DEATH_KINDS = ("reader-death", "sigkill", "stream-crash", "ckpt-corrupt",
                 "worker-death", "reducer-death", "scan-error", "chaos")
+_SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
+                "handler-crash")
 
 #: What ``chaos:`` may sample by default — every kind the parallel host
 #: path recovers from in-run (sigkill is excluded: its story is the
 #: cross-run ``--resume=auto`` path, not in-run re-execution).
 CHAOS_KINDS = ("worker-death", "reducer-death", "scan-error",
                "reader-death", "read-error", "slow-read")
+
+#: What ``chaos:kinds=...`` may additionally name for daemon soaks —
+#: every serve-side fault the daemon absorbs without dying or sending
+#: a torn response.  Not in the default draw: a build soak armed via
+#: the same grammar should not sample request-ordinal rules that can
+#: never fire.
+SERVE_CHAOS_KINDS = ("client-disconnect", "slow-client", "handler-crash",
+                     "reload-corrupt")
 
 
 @dataclasses.dataclass
@@ -142,6 +175,8 @@ class _Rule:
     worker: int | None = None   # worker-death (None = any worker)
     reducer: int | None = None  # reducer-death (None = any reducer)
     silent: int = 0             # scan-error: 1 = drop window, no raise
+    req: int = 0                # serve kinds: 1-based data-request
+                                # ordinal (0 never matches — admin ops)
     # chaos sampler bounds (chaos:seed=S:n=K clause only)
     seed: int = 0
     n: int = 0
@@ -149,6 +184,7 @@ class _Rule:
     workers: int = 4
     reducers: int = 4
     docs: int = 16
+    reqs: int = 32
     kinds: tuple = CHAOS_KINDS
 
 
@@ -174,7 +210,7 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             raise FaultSpecError("seed=N must be a clause of its own")
         return None
     rule = _Rule(kind=head)
-    if head not in _READ_KINDS + _DEATH_KINDS:
+    if head not in _READ_KINDS + _DEATH_KINDS + _SERVE_KINDS:
         raise FaultSpecError(f"unknown fault kind {head!r}")
     for field in parts[1:]:
         if field == "all":
@@ -210,6 +246,10 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             rule.reducer = _parse_int(head, k, v)
         elif k == "silent":
             rule.silent = _parse_int(head, k, v)
+        elif k == "req":
+            rule.req = _parse_int(head, k, v)
+        elif k == "reqs" and head == "chaos":
+            rule.reqs = _parse_int(head, k, v)
         elif k == "seed" and head == "chaos":
             rule.seed = _parse_int(head, k, v)
         elif k == "n" and head == "chaos":
@@ -224,11 +264,13 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             rule.docs = _parse_int(head, k, v)
         elif k == "kinds" and head == "chaos":
             kinds = tuple(s for s in v.split(",") if s)
-            bad = [s for s in kinds if s not in CHAOS_KINDS]
+            bad = [s for s in kinds
+                   if s not in CHAOS_KINDS + SERVE_CHAOS_KINDS]
             if bad:
                 raise FaultSpecError(
                     f"chaos: kinds not samplable: {bad} "
-                    f"(choose from {list(CHAOS_KINDS)})")
+                    f"(choose from "
+                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS)})")
             rule.kinds = kinds
         else:
             raise FaultSpecError(f"{head}: unknown key {k!r}")
@@ -239,11 +281,16 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         raise FaultSpecError("ckpt-corrupt needs save=N (1-based)")
     if rule.kind == "scan-error" and rule.window < 1:
         raise FaultSpecError("scan-error needs window=N (1-based)")
+    if rule.kind in ("client-disconnect", "slow-client", "handler-crash") \
+            and rule.req < 1:
+        raise FaultSpecError(f"{head} needs req=N (1-based)")
+    if rule.kind == "slow-client" and rule.ms <= 0:
+        rule.ms = 50.0
     if rule.kind == "chaos":
         if rule.n < 1:
             raise FaultSpecError("chaos needs n=K (faults to sample)")
-        if min(rule.windows, rule.workers, rule.reducers, rule.docs) < 1 \
-                or not rule.kinds:
+        if min(rule.windows, rule.workers, rule.reducers, rule.docs,
+               rule.reqs) < 1 or not rule.kinds:
             raise FaultSpecError("chaos bounds must be >= 1")
     return rule
 
@@ -280,10 +327,17 @@ def _sample_chaos(rule: _Rule) -> list[_Rule]:
         elif kind == "read-error":
             out.append(_Rule(kind=kind, doc=rng.randrange(rule.docs),
                              times=rng.choice((1, 2, 2, -1))))
-        else:  # slow-read
+        elif kind == "slow-read":
             out.append(_Rule(kind="slow-read",
                              doc=rng.randrange(rule.docs),
                              ms=float(rng.choice((2, 5, 10)))))
+        elif kind in ("client-disconnect", "handler-crash"):
+            out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs)))
+        elif kind == "slow-client":
+            out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs),
+                             ms=float(rng.choice((20, 50, 100)))))
+        else:  # reload-corrupt
+            out.append(_Rule(kind="reload-corrupt"))
     return out
 
 
@@ -466,6 +520,62 @@ class FaultInjector:
                     f.truncate(max(size // 3, 1))
                 log.warning("fault injection: corrupted checkpoint "
                             "%s (save #%d)", path, saves)
+
+    def on_serve_request(self, req: int) -> None:
+        """Fires in the serve daemon as data request ``req`` (1-based
+        global ordinal) is handled; may raise :class:`HandlerCrash`.
+        Admin ops pass req=0, which never matches an armed rule.  The
+        firing budget is GLOBAL like the other death kinds: the daemon
+        answers the crashed request with a counted ``internal`` error
+        and the next request through the same code path survives."""
+        if req < 1:
+            return
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "handler-crash" or rule.req != req:
+                    continue
+                if self._fire_once(ri, rule):
+                    raise HandlerCrash(
+                        f"injected handler crash on request {req} "
+                        "(fault spec)")
+
+    def on_serve_response(self, req: int) -> bool:
+        """Fires in the serve daemon's writer just before response
+        ``req`` is sent.  ``slow-client`` sleeps here (outside the
+        injector lock — a stalled peer must not serialize the whole
+        daemon); ``client-disconnect`` returns True and the caller
+        drops the connection as if the peer vanished mid-response."""
+        if req < 1:
+            return False
+        delay = 0.0
+        drop = False
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.req != req:
+                    continue
+                if rule.kind == "slow-client":
+                    if self._fire_once(ri, rule):
+                        delay = max(delay, rule.ms / 1e3)
+                elif rule.kind == "client-disconnect":
+                    if self._fire_once(ri, rule):
+                        drop = True
+        if delay:
+            time.sleep(delay)
+        return drop
+
+    def on_reload(self) -> None:
+        """Fires in the serve daemon's hot-reload path after the
+        replacement artifact is opened but before the engine swap; may
+        raise :class:`InjectedReloadCorrupt` — the verification
+        failure a reload must survive by keeping the old artifact."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "reload-corrupt":
+                    continue
+                if self._fire_once(ri, rule):
+                    raise InjectedReloadCorrupt(
+                        "injected reload verification failure "
+                        "(fault spec)")
 
 
 # -- arming -----------------------------------------------------------

@@ -240,7 +240,16 @@ def load_artifact(path: str | Path) -> Artifact:
     try:
         f = open(path, "rb")
     except OSError as e:
-        raise ArtifactError(f"{path}: cannot open artifact ({e})") from e
+        msg = f"{path}: cannot open artifact ({e})"
+        # A letter-file index next to a missing index.mri means the
+        # build ran without --artifact: name the remediation instead of
+        # leaving the operator to diff the two output formats.
+        if path.name == ARTIFACT_NAME and not path.exists() \
+                and (path.parent / "a.txt").exists():
+            msg += ("; directory holds a letter-file index built "
+                    "without --artifact — rebuild with --artifact "
+                    "to pack index.mri")
+        raise ArtifactError(msg) from e
     with f:
         try:
             size = os.fstat(f.fileno()).st_size

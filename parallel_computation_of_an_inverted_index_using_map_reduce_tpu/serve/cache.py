@@ -9,62 +9,76 @@ roughly ``capacity * mean_hot_df * 4``).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 _MISSING = object()
 
 
 class LRUCache:
-    """Plain ordered-dict LRU with hit/miss counters (single-thread:
-    one Engine per serving thread, like one cursor per connection)."""
+    """Ordered-dict LRU with hit/miss counters.
+
+    Thread-safe: the serve daemon shares one Engine (and therefore one
+    cache) across every connection, so ``get``/``put`` race between the
+    dispatcher and admin-stat readers.  A plain lock around the tiny
+    OrderedDict ops costs ~100ns — noise next to the postings cumsum
+    the cache exists to skip.
+    """
 
     def __init__(self, capacity: int):
         if capacity < 0:
             raise ValueError(f"cache capacity must be >= 0, got {capacity}")
         self.capacity = capacity
         self._data: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     def get(self, key, default=None):
-        value = self._data.get(key, _MISSING)
-        if value is _MISSING:
-            self.misses += 1
-            return default
-        self._data.move_to_end(key)
-        self.hits += 1
-        return value
+        with self._lock:
+            value = self._data.get(key, _MISSING)
+            if value is _MISSING:
+                self.misses += 1
+                return default
+            self._data.move_to_end(key)
+            self.hits += 1
+            return value
 
     def put(self, key, value) -> None:
-        if self.capacity == 0:
-            return
-        if key in self._data:
-            self._data.move_to_end(key)
-        self._data[key] = value
-        if len(self._data) > self.capacity:
-            self._data.popitem(last=False)
-            self.evictions += 1
+        with self._lock:
+            if self.capacity == 0:
+                return
+            if key in self._data:
+                self._data.move_to_end(key)
+            self._data[key] = value
+            if len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                self.evictions += 1
 
     def __len__(self) -> int:
-        return len(self._data)
+        with self._lock:
+            return len(self._data)
 
     def __contains__(self, key) -> bool:  # no counter side effects
-        return key in self._data
+        with self._lock:
+            return key in self._data
 
     def clear(self) -> None:
-        self._data.clear()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        with self._lock:
+            self._data.clear()
+            self.hits = 0
+            self.misses = 0
+            self.evictions = 0
 
     def stats(self) -> dict:
-        total = self.hits + self.misses
-        return {
-            "capacity": self.capacity,
-            "entries": len(self._data),
-            "hits": self.hits,
-            "misses": self.misses,
-            "evictions": self.evictions,
-            "hit_rate": round(self.hits / total, 4) if total else 0.0,
-        }
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "capacity": self.capacity,
+                "entries": len(self._data),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            }

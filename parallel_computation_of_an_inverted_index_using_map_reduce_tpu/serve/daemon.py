@@ -1,0 +1,738 @@
+"""Resident serving daemon over one loaded ``index.mri`` artifact.
+
+``mri query`` pays the artifact open + engine warmup on every
+invocation, which caps it near the batch-1 closed-loop floor (~27K
+lookups/s) no matter how fast the engine is at batch 1024 (653K/s,
+BENCH_SERVE_r05.json).  :class:`ServeDaemon` closes that gap: load
+once, accept concurrent connections speaking a JSON-lines protocol,
+and coalesce whatever is pending into micro-batches for the existing
+vectorized batch path — the multi-round batching discipline of
+"Sorting, Searching, and Simulation in the MapReduce Framework"
+applied to read traffic, with the engine kept as the stateless core
+(DrJAX's split between algorithm and orchestration).
+
+The headline is the robustness envelope, not raw QPS:
+
+admission control
+    The pending queue is bounded (``MRI_SERVE_QUEUE_DEPTH``).  A full
+    queue sheds the request with a counted ``{"error":"overloaded"}``
+    response — never a silent drop, never an unbounded queue.
+deadlines
+    Requests may carry ``deadline_ms``; work whose deadline passed is
+    dropped *before* dispatch and answered ``deadline_expired``
+    (counted) — stale work never occupies the engine.
+graceful drain
+    :meth:`drain` (the CLI's SIGTERM/SIGINT) stops accepting, finishes
+    in-flight work within ``MRI_SERVE_DRAIN_S``, flushes stragglers as
+    counted ``draining`` errors, joins every thread, flushes stats,
+    and returns for a clean exit 0.  A second signal forces exit 1.
+crash-safe hot reload
+    :meth:`reload` (the CLI's SIGHUP, or the ``reload`` protocol
+    command) opens and checksum-verifies the replacement artifact off
+    the dispatcher, then swaps engines atomically under the dispatch
+    lock.  Verification failure keeps the old artifact serving and
+    counts ``reload_rejected`` — the tmp+rename/``ArtifactError``
+    discipline extended to live traffic.
+
+Threading model: one accept thread, one dispatcher (the only thread
+that touches the engine's batch path), and a reader/writer pair per
+connection.  Writers own their socket exclusively (responses are
+single ``sendall`` lines — never torn) and are fed through a bounded
+outbound queue, so a stalled peer can only ever cost its own
+connection (counted ``slow_client_closes``), never the dispatcher.
+
+Protocol — one JSON object per line, one response line per request::
+
+    {"id": 1, "op": "df",       "terms": ["the", "magic"]}
+    {"id": 2, "op": "postings", "terms": ["magic"], "deadline_ms": 50}
+    {"id": 3, "op": "and",      "terms": ["big", "cat"]}
+    {"id": 4, "op": "or",       "terms": ["big", "cat"]}
+    {"id": 5, "op": "top_k",    "letter": "a", "k": 3}
+    {"id": 6, "op": "stats"}        # admin: answered inline
+    {"id": 7, "op": "healthz"}      # admin: answered inline
+    {"id": 8, "op": "reload"}       # admin: swap to the new index.mri
+
+Success: ``{"id":1,"ok":true,"df":[5241,3]}``.  Failure:
+``{"id":2,"error":"<kind>","detail":"..."}`` with kind one of
+``overloaded`` / ``deadline_expired`` / ``draining`` /
+``bad_request`` / ``internal`` / ``reload_rejected`` — every one
+counted in ``stats``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+
+from .. import faults
+from .artifact import ArtifactError
+from .engine import create_engine
+
+log = logging.getLogger("mri_tpu.serve.daemon")
+
+COALESCE_ENV = "MRI_SERVE_COALESCE_US"
+QUEUE_ENV = "MRI_SERVE_QUEUE_DEPTH"
+BATCH_ENV = "MRI_SERVE_MAX_BATCH"
+DRAIN_ENV = "MRI_SERVE_DRAIN_S"
+
+#: Per-connection outbound response queue bound: past this, the peer
+#: is not reading and the connection is closed (counted) rather than
+#: letting responses pile up or the dispatcher block.
+OUTBOUND_DEPTH = 1024
+
+DATA_OPS = ("df", "postings", "and", "or", "top_k")
+ADMIN_OPS = ("stats", "healthz", "reload")
+
+_SENTINEL = object()
+
+
+def _env(name: str, default, cast, minimum, exclusive: bool = False):
+    """One env knob: invalid values raise a one-line ValueError naming
+    the variable (the CLI maps it to exit 2), like RetryPolicy.from_env."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = cast(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {cast.__name__}") from None
+    if val < minimum or (exclusive and val == minimum):
+        bound = f"> {minimum}" if exclusive else f">= {minimum}"
+        raise ValueError(f"{name} must be {bound}, got {raw!r}")
+    return val
+
+
+class _Request:
+    """One admitted data request, from queue admission to its single
+    ``finish`` (exactly one response per request — ok or counted
+    error — enforced by the ``done`` flag)."""
+
+    __slots__ = ("conn", "rid", "op", "terms", "letter", "k",
+                 "seq", "expires_at", "done")
+
+    def __init__(self, conn, rid, op, terms, letter, k, seq, expires_at):
+        self.conn = conn
+        self.rid = rid
+        self.op = op
+        self.terms = terms
+        self.letter = letter
+        self.k = k
+        self.seq = seq
+        self.expires_at = expires_at
+        self.done = False
+
+
+class _Conn:
+    """One accepted connection: reader thread (parse + admit), writer
+    thread (sole socket writer), bounded outbound queue between the
+    daemon and the writer."""
+
+    _ids = iter(range(1, 1 << 62))
+
+    def __init__(self, daemon: "ServeDaemon", sock: socket.socket, addr):
+        self.daemon = daemon
+        self.sock = sock
+        self.addr = addr
+        self.outbound: queue.Queue = queue.Queue(maxsize=OUTBOUND_DEPTH)
+        self.lock = threading.Lock()
+        self.pending = 0          # admitted, response not yet enqueued
+        self.read_eof = False
+        self.dead = False
+        self.reader_done = False
+        self.writer_done = False
+        cid = next(self._ids)
+        self.reader = threading.Thread(
+            target=daemon._reader_loop, args=(self,),
+            name=f"mri-serve-read-{cid}", daemon=True)
+        self.writer = threading.Thread(
+            target=daemon._writer_loop, args=(self,),
+            name=f"mri-serve-write-{cid}", daemon=True)
+
+    def start(self) -> None:
+        self.reader.start()
+        self.writer.start()
+
+    def enqueue(self, seq: int, payload: dict) -> bool:
+        """Queue one response line for the writer.  False (and the
+        connection is condemned) when the peer is too slow to drain
+        OUTBOUND_DEPTH responses."""
+        data = (json.dumps(payload, separators=(",", ":")) + "\n").encode()
+        try:
+            self.outbound.put_nowait((seq, data))
+            return True
+        except queue.Full:
+            if not self.dead:
+                self.daemon._count("slow_client_closes")
+            self.kill()
+            return False
+
+    def enqueue_sentinel(self) -> None:
+        try:
+            self.outbound.put_nowait(_SENTINEL)
+        except queue.Full:
+            self.kill()  # writer exits on the closed socket instead
+
+    def kill(self) -> None:
+        """Force-close the socket: both loops unblock and exit."""
+        self.dead = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    @property
+    def finished(self) -> bool:
+        return self.reader_done and self.writer_done
+
+
+class ServeDaemon:
+    """The resident server.  ``start()`` binds and spawns threads;
+    ``drain()`` is the graceful single-exit path (idempotent);
+    ``reload()`` hot-swaps the artifact.  See the module docstring for
+    the protocol and robustness contract."""
+
+    def __init__(self, path, host: str = "127.0.0.1", port: int = 0, *,
+                 engine: str | None = None, cache_terms: int = 4096,
+                 shards: int | None = None,
+                 coalesce_us: int | None = None,
+                 queue_depth: int | None = None,
+                 max_batch: int | None = None,
+                 drain_s: float | None = None):
+        self._path = path
+        self._engine_choice = engine
+        self._cache_terms = cache_terms
+        self._shards = shards
+        self.coalesce_us = coalesce_us if coalesce_us is not None \
+            else _env(COALESCE_ENV, 200, int, 0)
+        self.queue_depth = queue_depth if queue_depth is not None \
+            else _env(QUEUE_ENV, 1024, int, 1)
+        self.max_batch = max_batch if max_batch is not None \
+            else _env(BATCH_ENV, 1024, int, 1)
+        self.drain_s = drain_s if drain_s is not None \
+            else _env(DRAIN_ENV, 5.0, float, 0, exclusive=True)
+
+        self._engine = create_engine(path, engine, cache_terms=cache_terms,
+                                     shards=shards)
+        self._engine_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._queue: queue.Queue = queue.Queue(maxsize=self.queue_depth)
+        self._inflight = 0        # admitted minus finished
+        self._seq = 0             # global data-request ordinal (faults)
+        self._counts = {
+            "requests": 0, "responses": 0, "shed": 0,
+            "deadline_expired": 0, "draining_rejected": 0,
+            "bad_request": 0, "internal_errors": 0,
+            "client_disconnects": 0, "slow_client_closes": 0,
+            "reload_ok": 0, "reload_rejected": 0,
+            "batches": 0, "batched_requests": 0, "connections": 0,
+        }
+        self._count_lock = threading.Lock()
+        self._conns: set[_Conn] = set()
+        self._conn_lock = threading.Lock()
+        self._draining = False
+        self._drain_started = False
+        self._drain_guard = threading.Lock()
+        self._drained = threading.Event()
+        self._dispatch_stop = threading.Event()
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._dispatcher: threading.Thread | None = None
+        self._host = host
+        self._port = port
+        self.final_stats: dict | None = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self._host, self._port))
+        ls.listen(128)
+        ls.settimeout(0.2)
+        self._listener = ls
+        self._host, self._port = ls.getsockname()[:2]
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="mri-serve-dispatch",
+            daemon=True)
+        self._dispatcher.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="mri-serve-accept", daemon=True)
+        self._accept_thread.start()
+        log.info("serving %s on %s:%d (engine=%s coalesce_us=%d "
+                 "queue_depth=%d max_batch=%d)", self._path, self._host,
+                 self._port, self._engine.engine_name, self.coalesce_us,
+                 self.queue_depth, self.max_batch)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._host, self._port
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._count_lock:
+            self._counts[key] += n
+
+    # -- accept / per-connection threads -------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._draining:
+            try:
+                sock, addr = self._listener.accept()
+            except socket.timeout:
+                self._prune_conns()
+                continue
+            except OSError:
+                break  # listener closed by drain()
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(self, sock, addr)
+            with self._conn_lock:
+                self._conns.add(conn)
+            self._count("connections")
+            conn.start()
+
+    def _prune_conns(self) -> None:
+        with self._conn_lock:
+            done = [c for c in self._conns if c.finished]
+            self._conns.difference_update(done)
+
+    def _reader_loop(self, conn: _Conn) -> None:
+        f = None
+        try:
+            f = conn.sock.makefile("rb")
+            for raw in f:
+                self._handle_line(conn, raw)
+                if conn.dead:
+                    break
+        except (OSError, ValueError):
+            pass
+        finally:
+            # The makefile wrapper holds an _io_refs reference on the
+            # socket: until it is closed, socket.close() only marks the
+            # object closed and the OS fd stays open (a leak the conftest
+            # guard would flag).  Close it here, deterministically.
+            if f is not None:
+                with contextlib.suppress(OSError):
+                    f.close()
+            with conn.lock:
+                conn.read_eof = True
+                idle = conn.pending == 0
+            if idle:
+                conn.enqueue_sentinel()
+            conn.reader_done = True
+
+    def _writer_loop(self, conn: _Conn) -> None:
+        inj = faults.active()
+        try:
+            while True:
+                item = conn.outbound.get()
+                if item is _SENTINEL:
+                    break
+                seq, data = item
+                if inj and seq and inj.on_serve_response(seq):
+                    self._count("client_disconnects")
+                    break
+                try:
+                    conn.sock.sendall(data)
+                except OSError:
+                    self._count("client_disconnects")
+                    break
+                self._count("responses")
+        finally:
+            conn.kill()
+            conn.writer_done = True
+
+    # -- request admission ---------------------------------------------
+
+    def _handle_line(self, conn: _Conn, raw: bytes) -> None:
+        line = raw.strip()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as e:
+            self._count("bad_request")
+            conn.enqueue(0, {"error": "bad_request", "detail": str(e)})
+            return
+        rid = req.get("id")
+        op = req.get("op")
+        if op in ADMIN_OPS:
+            self._handle_admin(conn, rid, op)
+            return
+        err = self._validate(req, op)
+        if err:
+            self._count("bad_request")
+            payload = {"error": "bad_request", "detail": err}
+            if rid is not None:
+                payload["id"] = rid
+            conn.enqueue(0, payload)
+            return
+        if self._draining:
+            self._count("draining_rejected")
+            payload = {"error": "draining",
+                       "detail": "daemon is shutting down"}
+            if rid is not None:
+                payload["id"] = rid
+            conn.enqueue(0, payload)
+            return
+        with self._count_lock:
+            self._counts["requests"] += 1
+            self._seq += 1
+            seq = self._seq
+        deadline_ms = req.get("deadline_ms")
+        expires_at = time.monotonic() + deadline_ms / 1e3 \
+            if deadline_ms is not None else None
+        item = _Request(conn, rid, op, req.get("terms"),
+                        req.get("letter"), int(req.get("k") or 0),
+                        seq, expires_at)
+        with conn.lock:
+            conn.pending += 1
+        try:
+            self._queue.put_nowait(item)
+            with self._count_lock:
+                self._inflight += 1
+        except queue.Full:
+            self._count("shed")
+            self._finish(item, {"error": "overloaded",
+                                "detail": f"pending queue at depth "
+                                          f"{self.queue_depth}"},
+                         admitted=False)
+
+    @staticmethod
+    def _validate(req: dict, op) -> str | None:
+        """One-line reason when the request is malformed, else None."""
+        if op not in DATA_OPS:
+            return (f"unknown op {op!r} "
+                    f"(choices: {DATA_OPS + ADMIN_OPS})")
+        dl = req.get("deadline_ms")
+        if dl is not None and (not isinstance(dl, (int, float))
+                               or isinstance(dl, bool) or dl <= 0):
+            return f"deadline_ms must be a positive number, got {dl!r}"
+        if op == "top_k":
+            letter = req.get("letter")
+            if not (isinstance(letter, str) and len(letter) == 1
+                    and "a" <= letter <= "z"):
+                return f"top_k needs letter=a..z, got {letter!r}"
+            k = req.get("k")
+            if not isinstance(k, int) or isinstance(k, bool) or k < 0:
+                return f"top_k needs integer k >= 0, got {k!r}"
+            return None
+        terms = req.get("terms")
+        if not isinstance(terms, list) \
+                or not all(isinstance(t, str) for t in terms):
+            return f"{op} needs terms=[str, ...], got {terms!r}"
+        return None
+
+    def _handle_admin(self, conn: _Conn, rid, op: str) -> None:
+        """stats/healthz/reload answer inline from the reader thread —
+        they must work while the dispatcher is wedged in a batch."""
+        if op == "healthz":
+            payload = {"ok": True,
+                       "status": "draining" if self._draining else "ok",
+                       "queue_depth": self._queue.qsize()}
+        elif op == "stats":
+            payload = {"ok": True, "stats": self.stats()}
+        else:  # reload
+            ok, detail = self.reload()
+            if ok:
+                payload = {"ok": True, "reloaded": True}
+            else:
+                payload = {"error": "reload_rejected", "detail": detail}
+        if rid is not None:
+            payload["id"] = rid
+        conn.enqueue(0, payload)
+
+    # -- dispatch ------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.02)
+            except queue.Empty:
+                if self._dispatch_stop.is_set():
+                    return
+                continue
+            batch = [first]
+            if self.coalesce_us > 0 and self.max_batch > 1 \
+                    and not self._draining:
+                until = time.monotonic() + self.coalesce_us / 1e6
+                while len(batch) < self.max_batch:
+                    rem = until - time.monotonic()
+                    if rem <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=rem))
+                    except queue.Empty:
+                        break
+            while len(batch) < self.max_batch:  # free riders
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            self._execute(batch)
+
+    def _finish(self, item: _Request, payload: dict, *,
+                admitted: bool = True) -> None:
+        """The one response for an admitted request (ok or error)."""
+        if item.done:
+            return
+        item.done = True
+        if item.rid is not None:
+            payload.setdefault("id", item.rid)
+        item.conn.enqueue(item.seq, payload)
+        with item.conn.lock:
+            item.conn.pending -= 1
+            idle = item.conn.read_eof and item.conn.pending == 0
+        if idle:
+            item.conn.enqueue_sentinel()
+        if admitted:
+            with self._count_lock:
+                self._inflight -= 1
+
+    def _execute(self, items: list[_Request]) -> None:
+        inj = faults.active()
+        with self._engine_lock:
+            # expiry is judged NOW — after any wait for the engine, at
+            # the last instant before dispatch — so stale work never
+            # reaches the batch path no matter where the queue stalled
+            now = time.monotonic()
+            live = []
+            for it in items:
+                if it.expires_at is not None and now > it.expires_at:
+                    self._count("deadline_expired")
+                    self._finish(it, {"error": "deadline_expired",
+                                      "detail": "deadline passed "
+                                                "before dispatch"})
+                else:
+                    live.append(it)
+            if not live:
+                return
+            self._count("batches")
+            self._count("batched_requests", len(live))
+            eng = self._engine
+            ready = []
+            for it in live:
+                if inj is not None:
+                    try:
+                        inj.on_serve_request(it.seq)
+                    except faults.HandlerCrash as e:
+                        self._count("internal_errors")
+                        self._finish(it, {"error": "internal",
+                                          "detail": str(e)})
+                        continue
+                ready.append(it)
+            # coalesced groups: one vectorized engine call answers every
+            # df (resp. postings) request in the batch
+            for op in ("df", "postings"):
+                group = [it for it in ready if it.op == op]
+                if not group:
+                    continue
+                try:
+                    terms = [t for it in group for t in it.terms]
+                    batch = eng.encode_batch(terms)
+                    if op == "df":
+                        out = eng.df(batch)
+                        pos = 0
+                        for it in group:
+                            n = len(it.terms)
+                            self._finish(it, {
+                                "ok": True,
+                                "df": out[pos:pos + n].tolist()})
+                            pos += n
+                    else:
+                        runs = eng.postings(batch)
+                        pos = 0
+                        for it in group:
+                            n = len(it.terms)
+                            part = runs[pos:pos + n]
+                            self._finish(it, {
+                                "ok": True,
+                                "postings": [r.tolist() if r is not None
+                                             else None for r in part]})
+                            pos += n
+                except Exception as e:  # group failed: every unanswered
+                    for it in group:    # member gets a counted internal
+                        if not it.done:
+                            self._count("internal_errors")
+                            self._finish(it, {"error": "internal",
+                                              "detail": str(e)})
+            for it in ready:
+                if it.done:
+                    continue
+                try:
+                    if it.op == "and":
+                        docs = eng.query_and(eng.encode_batch(it.terms))
+                        self._finish(it, {"ok": True,
+                                          "docs": docs.tolist()})
+                    elif it.op == "or":
+                        docs = eng.query_or(eng.encode_batch(it.terms))
+                        self._finish(it, {"ok": True,
+                                          "docs": docs.tolist()})
+                    else:  # top_k
+                        top = eng.top_k(it.letter, it.k)
+                        self._finish(it, {
+                            "ok": True,
+                            "top": [[t.decode("ascii", "replace"), int(d)]
+                                    for t, d in top]})
+                except Exception as e:
+                    self._count("internal_errors")
+                    self._finish(it, {"error": "internal",
+                                      "detail": str(e)})
+
+    # -- hot reload ----------------------------------------------------
+
+    def reload(self) -> tuple[bool, str]:
+        """Open + checksum-verify the artifact again and atomically swap
+        engines.  On ANY failure the old engine keeps serving and the
+        attempt is counted ``reload_rejected`` — a bad push can reject,
+        never kill, the daemon.  Runs on the caller's thread (reader or
+        the CLI's SIGHUP thread), off the dispatcher; only the O(1)
+        swap itself holds the dispatch lock."""
+        with self._reload_lock:
+            inj = faults.active()
+            new_engine = None
+            try:
+                new_engine = create_engine(
+                    self._path, self._engine_choice,
+                    cache_terms=self._cache_terms, shards=self._shards)
+                if inj is not None:
+                    inj.on_reload()
+            except (ArtifactError, ValueError, OSError,
+                    faults.InjectedReloadCorrupt) as e:
+                if new_engine is not None:
+                    new_engine.close()
+                self._count("reload_rejected")
+                log.warning("hot reload rejected, keeping current "
+                            "artifact: %s", e)
+                return False, str(e)
+            with self._engine_lock:
+                old, self._engine = self._engine, new_engine
+            old.close()
+            self._count("reload_ok")
+            log.info("hot reload: swapped in %s", self._path)
+            return True, ""
+
+    # -- stats ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._count_lock:
+            counters = dict(self._counts)
+            inflight = self._inflight
+        # serialized against reload's swap+close via _reload_lock, NOT
+        # the dispatch lock: stats must answer even while the
+        # dispatcher is wedged inside a batch
+        engine = {}
+        if not self._drained.is_set():
+            with self._reload_lock:
+                try:
+                    engine = self._engine.describe()
+                except Exception:  # racing a drain's engine close
+                    engine = {}
+        return {
+            "queue_depth": self._queue.qsize(),
+            "inflight": inflight,
+            "draining": self._draining,
+            "connections": len(self._conns),
+            "counters": counters,
+            "engine": engine,
+            "config": {
+                "coalesce_us": self.coalesce_us,
+                "queue_depth": self.queue_depth,
+                "max_batch": self.max_batch,
+                "drain_s": self.drain_s,
+            },
+        }
+
+    # -- drain ---------------------------------------------------------
+
+    def drain(self) -> int:
+        """Graceful shutdown; returns the process exit code (0).
+        Idempotent — the second call just waits for the first."""
+        with self._drain_guard:
+            if self._drain_started:
+                racing = True
+            else:
+                self._drain_started = True
+                racing = False
+        if racing:
+            self._drained.wait()
+            return 0
+        self._draining = True
+        deadline = time.monotonic() + self.drain_s
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        # finish in-flight work within the drain budget
+        while time.monotonic() < deadline:
+            with self._count_lock:
+                idle = self._inflight == 0
+            if idle:
+                break
+            time.sleep(0.005)
+        self._dispatch_stop.set()
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=max(2.0, self.drain_s))
+        # budget expired with work still queued: flush it as counted,
+        # well-formed errors — drain never silently drops a request
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._count("draining_rejected")
+            self._finish(item, {"error": "draining",
+                                "detail": "daemon drained before "
+                                          "dispatch"})
+        # unblock every reader (idle keep-alive clients never EOF on
+        # their own), let writers flush, then force-close stragglers
+        with self._conn_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.sock.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+        grace = max(0.0, deadline - time.monotonic()) + 1.0
+        for conn in conns:
+            conn.reader.join(timeout=grace)
+            conn.enqueue_sentinel()
+        for conn in conns:
+            conn.writer.join(timeout=grace)
+            if conn.writer.is_alive():
+                conn.kill()
+                conn.writer.join(timeout=1.0)
+        with self._conn_lock:
+            self._conns.clear()
+        self.final_stats = self.stats()
+        with self._engine_lock:
+            self._engine.close()
+        self._drained.set()
+        log.info("drained: %s", json.dumps(self.final_stats["counters"]))
+        return 0
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()

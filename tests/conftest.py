@@ -51,6 +51,51 @@ def reference_dir():
     return REFERENCE
 
 
+def _socket_fds() -> set:
+    """(fd, socket-inode) pairs currently open in this process — the
+    leak unit for the daemon guard (inode comparison survives fd-number
+    reuse)."""
+    out = set()
+    for entry in Path("/proc/self/fd").iterdir():
+        try:
+            target = os.readlink(entry)
+        except OSError:
+            continue  # raced with a close
+        if target.startswith("socket:"):
+            out.add((entry.name, target))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _daemon_leak_guard(request):
+    """Every ``daemon``-marked test must leave no stray sockets or
+    background threads behind: a drained ServeDaemon joins every
+    reader/writer/dispatcher/accept thread and closes every socket, so
+    anything surviving the (grace-looped) check is a real leak."""
+    if request.node.get_closest_marker("daemon") is None:
+        yield
+        return
+    import threading
+    import time
+
+    before_threads = set(threading.enumerate())
+    before_socks = _socket_fds()
+    yield
+    deadline = time.monotonic() + 5.0
+    while True:
+        leaked_threads = [t for t in threading.enumerate()
+                          if t not in before_threads and t.is_alive()]
+        leaked_socks = _socket_fds() - before_socks
+        if not leaked_threads and not leaked_socks:
+            return
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    assert not leaked_threads, (
+        f"daemon test leaked threads: {[t.name for t in leaked_threads]}")
+    assert not leaked_socks, f"daemon test leaked sockets: {leaked_socks}"
+
+
 def run_child(cmd, *, env=None, cwd=None, timeout=300):
     """Run a CLI child for crash/kill tests with a hang-proof guard.
 

@@ -1,0 +1,627 @@
+"""Resident serve-daemon suite (``mri-tpu serve`` / serve/daemon.py).
+
+Three layers:
+
+* protocol + parity — every op answered over the JSON-lines protocol
+  matches the naive text-scan oracle (the same one test_serve.py holds
+  the engines to);
+* robustness envelope — admission control sheds with counted
+  ``overloaded`` errors, expired deadlines are dropped before dispatch,
+  drain flushes stragglers as counted ``draining`` errors, hot reload
+  swaps atomically and a rejected reload keeps the old artifact, and
+  every injected serve fault (handler-crash / client-disconnect /
+  slow-client / reload-corrupt) is absorbed without killing the daemon
+  or tearing a response;
+* CLI signal semantics — SIGTERM drains to exit 0, a second signal
+  forces exit 1, SIGHUP hot-reloads, and a missing artifact is a
+  one-line exit 2.
+
+Every test here carries the ``daemon`` marker, so the conftest leak
+guard asserts no stray sockets or threads survive each one.
+"""
+
+import contextlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from conftest import REPO_ROOT
+
+from test_serve import build_corpus, naive_index
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.artifact import (
+    artifact_path,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.daemon import (
+    ServeDaemon,
+)
+
+pytestmark = [pytest.mark.daemon, pytest.mark.serve]
+
+DOCS = [b"the cat sat on the mat", b"the dog ran far", b"cat and dog nap",
+        b"a quiet zebra naps", b"dog dog dog barks the most"]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Each test arms its own fault spec; none may leak to the next."""
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    out = build_corpus(tmp_path_factory.mktemp("daemon_corpus"), DOCS)
+    return out, naive_index(DOCS)
+
+
+@contextlib.contextmanager
+def serving(out, **kw):
+    kw.setdefault("coalesce_us", 100)
+    daemon = ServeDaemon(str(out), **kw)
+    daemon.start()
+    try:
+        yield daemon
+    finally:
+        daemon.drain()
+
+
+class Client:
+    """One protocol connection: pipelined line-at-a-time JSON."""
+
+    def __init__(self, daemon_or_addr, timeout=15.0):
+        addr = daemon_or_addr.address \
+            if isinstance(daemon_or_addr, ServeDaemon) else daemon_or_addr
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.f = self.sock.makefile("rb")
+
+    def send(self, **obj):
+        self.sock.sendall((json.dumps(obj) + "\n").encode())
+
+    def send_raw(self, data: bytes):
+        self.sock.sendall(data)
+
+    def recv(self):
+        line = self.f.readline()
+        assert line, "server closed the connection unexpectedly"
+        return json.loads(line)
+
+    def rpc(self, **obj):
+        self.send(**obj)
+        return self.recv()
+
+    def close(self):
+        with contextlib.suppress(OSError):
+            self.f.close()
+        with contextlib.suppress(OSError):
+            self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- protocol parity ----------------------------------------------------
+
+
+def test_daemon_answers_match_oracle(built):
+    out, naive = built
+    vocab = sorted(naive)
+    with serving(out) as daemon, Client(daemon) as c:
+        r = c.rpc(id=1, op="df", terms=vocab)
+        assert r["ok"] and r["id"] == 1
+        assert r["df"] == [len(naive[t]) for t in vocab]
+
+        r = c.rpc(id=2, op="df", terms=["nosuchword", "cat"])
+        assert r["df"] == [0, len(naive["cat"])]
+
+        r = c.rpc(id=3, op="postings", terms=vocab[:5] + ["zzzz"])
+        assert r["postings"] == [naive[t] for t in vocab[:5]] + [None]
+
+        r = c.rpc(id=4, op="and", terms=["the", "cat"])
+        assert r["docs"] == sorted(set(naive["the"]) & set(naive["cat"]))
+
+        r = c.rpc(id=5, op="or", terms=["zebra", "cat"])
+        assert r["docs"] == sorted(set(naive["zebra"]) | set(naive["cat"]))
+
+        d_terms = sorted((t for t in naive if t.startswith("d")),
+                         key=lambda t: (-len(naive[t]), t))[:2]
+        r = c.rpc(id=6, op="top_k", letter="d", k=2)
+        assert r["top"] == [[t, len(naive[t])] for t in d_terms]
+
+
+def test_daemon_bad_requests_are_counted_one_liners(built):
+    out, _ = built
+    with serving(out) as daemon, Client(daemon) as c:
+        r = c.rpc(id=1, op="frobnicate")
+        assert r["error"] == "bad_request" and r["id"] == 1
+
+        r = c.rpc(id=2, op="df", terms="not-a-list")
+        assert r["error"] == "bad_request"
+
+        r = c.rpc(id=3, op="top_k", letter="!", k=2)
+        assert r["error"] == "bad_request"
+
+        r = c.rpc(id=4, op="df", terms=["ok"], deadline_ms=-5)
+        assert r["error"] == "bad_request"
+
+        c.send_raw(b"this is not json\n")
+        assert c.recv()["error"] == "bad_request"
+
+        # the connection survived every malformed request
+        assert c.rpc(id=5, op="df", terms=["cat"])["ok"]
+    assert daemon.final_stats["counters"]["bad_request"] == 5
+
+
+def test_daemon_stats_and_healthz(built):
+    out, _ = built
+    with serving(out) as daemon, Client(daemon) as c:
+        assert c.rpc(id=1, op="df", terms=["cat", "dog"])["ok"]
+        h = c.rpc(id=2, op="healthz")
+        assert h["ok"] and h["status"] == "ok"
+        s = c.rpc(id=3, op="stats")["stats"]
+        assert s["counters"]["requests"] == 1
+        assert s["counters"]["shed"] == 0
+        assert s["engine"]["engine"] == "host"
+        assert s["engine"]["cache"]["hit_rate"] >= 0.0
+        assert "df" in s["engine"]["ops"]
+        assert s["config"]["queue_depth"] == daemon.queue_depth
+
+
+def test_daemon_coalesces_pipelined_requests(built):
+    """A pipelined burst lands in far fewer dispatch batches than
+    requests — the micro-batching QPS lever, observable in counters."""
+    out, naive = built
+    with serving(out, coalesce_us=100_000, max_batch=64) as daemon:
+        with Client(daemon) as c:
+            n = 24
+            blob = b"".join(
+                (json.dumps({"id": i, "op": "df", "terms": ["cat"]})
+                 + "\n").encode() for i in range(n))
+            c.send_raw(blob)
+            got = [c.recv() for _ in range(n)]
+        assert all(r["ok"] and r["df"] == [len(naive["cat"])] for r in got)
+        assert sorted(r["id"] for r in got) == list(range(n))
+        counters = daemon.stats()["counters"]
+        assert counters["batched_requests"] == n
+        assert counters["batches"] <= 4  # one 100ms window + stragglers
+
+
+# -- robustness envelope ------------------------------------------------
+
+
+def test_daemon_sheds_overload_with_counted_errors(built):
+    """Queue full => counted, well-formed 'overloaded' responses; every
+    request is answered exactly once; nothing is silently dropped."""
+    out, naive = built
+    n = 40
+    with serving(out, queue_depth=4, max_batch=1, coalesce_us=0) as daemon:
+        with Client(daemon) as c:
+            with daemon._engine_lock:  # wedge the dispatcher mid-batch
+                blob = b"".join(
+                    (json.dumps({"id": i, "op": "df", "terms": ["dog"]})
+                     + "\n").encode() for i in range(n))
+                c.send_raw(blob)
+                # wait until admission has classified the whole burst
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if daemon.stats()["counters"]["requests"] >= n:
+                        break
+                    time.sleep(0.01)
+                time.sleep(0.05)
+            # lock released: the queued remainder executes; every one
+            # of the n requests gets exactly one response
+            got = [c.recv() for _ in range(n)]
+        overloaded = [r for r in got if r.get("error") == "overloaded"]
+        ok = [r for r in got if r.get("ok")]
+        assert len(overloaded) + len(ok) == n
+        assert len(overloaded) >= n - 8  # ~ queue_depth + in-dispatch
+        assert all(r["df"] == [len(naive["dog"])] for r in ok)
+        assert sorted(r["id"] for r in got) == list(range(n))
+        counters = daemon.stats()["counters"]
+        assert counters["shed"] == len(overloaded)
+
+
+def test_daemon_drops_expired_deadlines_before_dispatch(built):
+    out, _ = built
+    with serving(out, max_batch=8, coalesce_us=0) as daemon:
+        with Client(daemon) as c:
+            with daemon._engine_lock:  # stall execution past the deadline
+                c.send(id=1, op="df", terms=["cat"], deadline_ms=20)
+                time.sleep(0.15)
+            r = c.recv()
+            assert r["error"] == "deadline_expired" and r["id"] == 1
+            # an un-deadlined request right behind it is fine
+            assert c.rpc(id=2, op="df", terms=["cat"])["ok"]
+        assert daemon.stats()["counters"]["deadline_expired"] == 1
+
+
+def test_daemon_drain_flushes_stragglers_as_counted_errors(built):
+    """Queued-but-undispatched work at drain time is answered with a
+    well-formed 'draining' error — never silently dropped."""
+    out, _ = built
+    daemon = ServeDaemon(str(out), coalesce_us=0, drain_s=0.2)
+    daemon.start()
+    try:
+        with Client(daemon) as c:
+            daemon._dispatch_stop.set()  # park the dispatcher
+            daemon._dispatcher.join(timeout=5.0)
+            n = 6
+            for i in range(n):
+                c.send(id=i, op="df", terms=["cat"])
+            deadline = time.monotonic() + 5.0
+            while daemon.stats()["counters"]["requests"] < n \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert daemon.drain() == 0
+            got = [c.recv() for _ in range(n)]
+            assert all(r["error"] == "draining" for r in got)
+            assert sorted(r["id"] for r in got) == list(range(n))
+        assert daemon.final_stats["counters"]["draining_rejected"] == n
+    finally:
+        daemon.drain()
+
+
+def test_daemon_rejects_new_work_while_draining(built):
+    out, _ = built
+    daemon = ServeDaemon(str(out), coalesce_us=0)
+    daemon.start()
+    try:
+        with Client(daemon) as c:
+            daemon._draining = True  # drain flag flips before teardown
+            r = c.rpc(id=1, op="df", terms=["cat"])
+            assert r["error"] == "draining"
+            h = c.rpc(id=2, op="healthz")  # admin still answers
+            assert h["status"] == "draining"
+    finally:
+        daemon.drain()
+    assert daemon.final_stats["counters"]["draining_rejected"] == 1
+
+
+def test_daemon_hot_reload_swaps_and_rejects(built, tmp_path):
+    """A good reload swaps answers atomically; a torn replacement is
+    rejected, counted, and the old artifact keeps serving."""
+    out, naive = built
+    new_docs = DOCS + [b"zebra zebra cat"]
+    new_out = build_corpus(tmp_path, new_docs)
+    new_naive = naive_index(new_docs)
+    art = artifact_path(out)
+    original = art.read_bytes()
+
+    def push(data: bytes):
+        # The update discipline the daemon documents: stage the new
+        # bytes next to the artifact, then atomically rename over it.
+        # An in-place overwrite would tear the pages under the LIVE
+        # engine's mmap — rename gives the old engine its own inode.
+        staged = art.with_suffix(".push")
+        staged.write_bytes(data)
+        os.replace(staged, art)
+
+    try:
+        with serving(out) as daemon, Client(daemon) as c:
+            assert c.rpc(id=1, op="df", terms=["zebra"])["df"] == \
+                [len(naive["zebra"])]
+            # push the new artifact + reload via the protocol
+            push(artifact_path(new_out).read_bytes())
+            r = c.rpc(id=2, op="reload")
+            assert r["ok"] and r["reloaded"]
+            assert c.rpc(id=3, op="df", terms=["zebra"])["df"] == \
+                [len(new_naive["zebra"])]
+            # torn push: reload must reject and KEEP the new_docs view
+            push(original[:200])
+            r = c.rpc(id=4, op="reload")
+            assert r["error"] == "reload_rejected"
+            assert c.rpc(id=5, op="df", terms=["zebra"])["df"] == \
+                [len(new_naive["zebra"])]
+            counters = c.rpc(id=6, op="stats")["stats"]["counters"]
+            assert counters["reload_ok"] == 1
+            assert counters["reload_rejected"] == 1
+    finally:
+        art.write_bytes(original)
+
+
+def test_daemon_injected_reload_corrupt_keeps_serving(built):
+    out, naive = built
+    faults.install("reload-corrupt")
+    with serving(out) as daemon, Client(daemon) as c:
+        r = c.rpc(id=1, op="reload")
+        assert r["error"] == "reload_rejected"
+        assert "injected" in r["detail"]
+        assert c.rpc(id=2, op="df", terms=["cat"])["df"] == \
+            [len(naive["cat"])]
+        # the once-per-rule budget is spent: the next reload succeeds
+        assert c.rpc(id=3, op="reload")["ok"]
+        counters = daemon.stats()["counters"]
+        assert counters["reload_rejected"] == 1
+        assert counters["reload_ok"] == 1
+
+
+def test_daemon_handler_crash_is_counted_and_isolated(built):
+    """An injected handler crash answers THAT request with a counted
+    'internal' error; neighbors in the same batch still succeed."""
+    out, naive = built
+    faults.install("handler-crash:req=2")
+    with serving(out, coalesce_us=0, max_batch=1) as daemon:
+        with Client(daemon) as c:
+            assert c.rpc(id=1, op="df", terms=["cat"])["ok"]
+            r = c.rpc(id=2, op="df", terms=["cat"])
+            assert r["error"] == "internal" and "injected" in r["detail"]
+            assert c.rpc(id=3, op="df", terms=["cat"])["df"] == \
+                [len(naive["cat"])]
+        assert daemon.stats()["counters"]["internal_errors"] == 1
+
+
+def test_daemon_client_disconnect_mid_response(built):
+    """Peer vanishing as its response is written only costs that
+    connection — counted, and the daemon keeps serving others."""
+    out, _ = built
+    faults.install("client-disconnect:req=1")
+    with serving(out, coalesce_us=0) as daemon:
+        with Client(daemon) as victim:
+            victim.send(id=1, op="df", terms=["cat"])
+            # server drops the conn instead of writing the response
+            try:
+                line = victim.f.readline()
+            except OSError:
+                line = b""
+            assert line == b""
+        with Client(daemon) as c:
+            assert c.rpc(id=2, op="df", terms=["cat"])["ok"]
+        counters = daemon.stats()["counters"]
+        assert counters["client_disconnects"] == 1
+
+
+def test_daemon_slow_client_response_still_correct(built):
+    out, naive = built
+    faults.install("slow-client:req=1:ms=150")
+    with serving(out, coalesce_us=0) as daemon, Client(daemon) as c:
+        t0 = time.monotonic()
+        r = c.rpc(id=1, op="df", terms=["dog"])
+        elapsed = time.monotonic() - t0
+        assert r["ok"] and r["df"] == [len(naive["dog"])]
+        assert elapsed >= 0.12  # the injected stall really happened
+
+
+def test_daemon_concurrent_connections_parity(built):
+    """N threads × M pipelined requests each over separate connections:
+    every response is well-formed, correct, and routed to its id."""
+    out, naive = built
+    vocab = sorted(naive)
+    errors: list = []
+
+    def worker(daemon, wid):
+        try:
+            with Client(daemon) as c:
+                for i in range(20):
+                    t = vocab[(wid * 20 + i) % len(vocab)]
+                    r = c.rpc(id=f"{wid}-{i}", op="df", terms=[t])
+                    assert r["id"] == f"{wid}-{i}", r
+                    assert r["df"] == [len(naive[t])], (t, r)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    with serving(out, coalesce_us=500) as daemon:
+        threads = [threading.Thread(target=worker, args=(daemon, w))
+                   for w in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors, errors
+    assert daemon.final_stats["counters"]["responses"] >= 120
+
+
+# -- CLI signal semantics (subprocess) ----------------------------------
+
+
+def _spawn_serve(out, *extra, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT), JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(out), "--listen", "127.0.0.1:0", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=str(REPO_ROOT), text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise AssertionError(
+            f"daemon died on startup: {proc.stderr.read()}")
+    ready = json.loads(line)
+    assert ready["event"] == "listening"
+    return proc, (ready["host"], ready["port"])
+
+
+def _reap(proc, timeout=30):
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        pytest.fail("serve daemon did not exit within the drain window")
+    proc.stdout.close()
+    proc.stderr.close()
+    return rc
+
+
+def test_cli_sigterm_graceful_drain_exit_0(built):
+    out, naive = built
+    proc, addr = _spawn_serve(out)
+    try:
+        with Client(addr) as c:
+            assert c.rpc(id=1, op="df", terms=["cat"])["df"] == \
+                [len(naive["cat"])]
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        drained = json.loads(proc.stdout.readline())
+        assert rc == 0
+        assert drained["event"] == "drained"
+        assert drained["counters"]["requests"] == 1
+        assert drained["counters"]["responses"] >= 1
+    finally:
+        _reap(proc)
+
+
+def test_cli_second_signal_forces_exit_1(built):
+    """With a writer wedged by a slow client, the drain stalls; the
+    second SIGTERM is the documented forced exit 1."""
+    out, _ = built
+    proc, addr = _spawn_serve(
+        out, "--fault-spec", "slow-client:req=1:ms=20000",
+        env_extra={"MRI_SERVE_DRAIN_S": "30"})
+    try:
+        with Client(addr) as c:
+            c.send(id=1, op="df", terms=["cat"])
+            time.sleep(0.5)  # the writer is now sleeping in the stall
+            proc.send_signal(signal.SIGTERM)
+            time.sleep(0.5)  # drain is blocked on the wedged writer
+            assert proc.poll() is None
+            proc.send_signal(signal.SIGTERM)
+            assert _reap(proc, timeout=10) == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            _reap(proc)
+
+
+def test_cli_sighup_reload_and_corrupt_reload(built, tmp_path):
+    """SIGHUP hot-reloads; a SIGHUP pointing at a torn artifact is
+    rejected while the daemon keeps answering from the old one."""
+    out, naive = built
+    art = artifact_path(out)
+    original = art.read_bytes()
+    proc, addr = _spawn_serve(out)
+    try:
+        with Client(addr) as c:
+            assert c.rpc(id=1, op="df", terms=["cat"])["ok"]
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                s = c.rpc(id=2, op="stats")["stats"]["counters"]
+                if s["reload_ok"] == 1:
+                    break
+                time.sleep(0.05)
+            assert s["reload_ok"] == 1
+            # torn push + SIGHUP: rejected, old artifact still serving
+            # (staged + rename, like a real push — an in-place write
+            # would tear the pages under the live engine's mmap)
+            staged = art.with_suffix(".push")
+            staged.write_bytes(original[:100])
+            os.replace(staged, art)
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                s = c.rpc(id=3, op="stats")["stats"]["counters"]
+                if s["reload_rejected"] == 1:
+                    break
+                time.sleep(0.05)
+            assert s["reload_rejected"] == 1
+            assert c.rpc(id=4, op="df", terms=["cat"])["df"] == \
+                [len(naive["cat"])]
+        proc.send_signal(signal.SIGTERM)
+        assert _reap(proc) == 0
+    finally:
+        art.write_bytes(original)
+        if proc.poll() is None:
+            proc.kill()
+            _reap(proc)
+
+
+def test_cli_serve_missing_artifact_exits_2(tmp_path):
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(tmp_path), "--listen", "127.0.0.1:0"],
+        capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        timeout=120)
+    assert proc.returncode == 2
+    assert proc.stderr.startswith("error:")
+    assert proc.stderr.count("\n") == 1
+
+
+def test_cli_serve_bad_listen_and_env_exit_2(built):
+    out, _ = built
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(out), "--listen", "nonsense"],
+        capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        timeout=120)
+    assert proc.returncode == 2 and "HOST:PORT" in proc.stderr
+
+    env["MRI_SERVE_QUEUE_DEPTH"] = "zero"
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(out), "--listen", "127.0.0.1:0"],
+        capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        timeout=120)
+    assert proc.returncode == 2
+    assert "MRI_SERVE_QUEUE_DEPTH" in proc.stderr
+    assert proc.stderr.count("\n") == 1
+
+
+# -- serve-side chaos soak (tools/chaos.py --daemon) --------------------
+
+
+def _load_chaos():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mri_chaos", REPO_ROOT / "tools" / "chaos.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def chaos():
+    return _load_chaos()
+
+
+def _assert_daemon_contract(summary):
+    assert summary["failures"] == [], \
+        "daemon chaos contract violated:\n" + "\n".join(
+            json.dumps(f, sort_keys=True) for f in summary["failures"])
+    assert summary["clean"] == summary["trials"]
+
+
+@pytest.mark.chaos
+def test_daemon_chaos_scenario_cycle_fast(tmp_path, chaos):
+    """One seeded trial per serve scenario (overload burst, SIGTERM
+    mid-request, corrupt reload, client disconnect) against a real
+    subprocess daemon — the tier-1 smoke for the --daemon soak."""
+    summary = chaos.run_daemon_soak(tmp_path, trials=4, seed_base=7000,
+                                    deadline_s=60.0, verbose=False)
+    _assert_daemon_contract(summary)
+    assert summary["trials"] == 4
+    assert all(n == 1 for n in summary["by_scenario"].values())
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_daemon_chaos_soak(tmp_path, chaos):
+    """The acceptance soak: 16 seeded trials, 4 per scenario — zero
+    hangs, zero lost or duplicated responses, every drain exits 0."""
+    summary = chaos.run_daemon_soak(tmp_path, trials=16, seed_base=7200,
+                                    deadline_s=60.0, verbose=False)
+    _assert_daemon_contract(summary)
+    assert summary["trials"] == 16
+    assert all(n == 4 for n in summary["by_scenario"].values())

@@ -250,6 +250,33 @@ def test_query_cli_missing_artifact_exits_2(tmp_path, capsys):
     assert "error:" in capsys.readouterr().err
 
 
+def test_query_cli_letter_dir_without_artifact_names_remediation(tmp_path, capsys):
+    """Pointing ``mri query`` at a letter-file index built WITHOUT
+    ``--artifact`` is the common operator mistake: the one-line exit-2
+    diagnostic must say how to fix it (rebuild with --artifact), not just
+    'cannot open'."""
+    docs = [b"alpha beta", b"beta gamma"]
+    ddir = tmp_path / "docs"
+    ddir.mkdir()
+    paths = []
+    for i, blob in enumerate(docs):
+        p = ddir / f"d{i}.txt"
+        p.write_bytes(blob)
+        paths.append(str(p))
+    listfile = tmp_path / "list.txt"
+    write_manifest(listfile, paths)
+    out = tmp_path / "out"
+    # note: no --artifact — only a.txt..z.txt letter files are written
+    assert main(["1", "1", str(listfile), "--backend", "cpu",
+                 "--output-dir", str(out)]) == 0
+    capsys.readouterr()
+    assert main(["query", str(out), "alpha"]) == 2
+    err = capsys.readouterr().err
+    assert err.count("\n") == 1, f"diagnostic must be one line: {err!r}"
+    assert err.startswith("error:")
+    assert "--artifact" in err and "letter-file" in err
+
+
 def test_artifact_covered_by_audit_verify(tmp_path, capsys):
     """--audit manifests index.mri; --verify re-checks it (exit 2 on rot)."""
     docs = [b"alpha beta", b"beta gamma delta", b"alpha epsilon"]
@@ -322,6 +349,54 @@ def test_lru_cache_semantics(zipf_built):
         assert engine.cache_stats()["entries"] == 0
         # answers identical with the cache cold again
         _assert_engine_matches(engine, naive, terms)
+
+
+def test_lru_cache_thread_hammer():
+    """N threads hammering one small cache: no exception, no over-capacity
+    growth, no cross-key value corruption, coherent counters.  This is the
+    regression test for the daemon sharing one Engine (one cache) across
+    every connection — the pre-lock OrderedDict raced ``move_to_end``
+    against ``popitem`` and could blow up or corrupt order under exactly
+    this workload."""
+    import threading
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.cache import (
+        LRUCache,
+    )
+
+    cache = LRUCache(capacity=8)
+    keys = [f"k{i}" for i in range(32)]
+    errors: list[BaseException] = []
+    gets_per_thread = 2000
+    n_threads = 8
+    start = threading.Barrier(n_threads)
+
+    def hammer(seed: int) -> None:
+        rng = random.Random(seed)
+        try:
+            start.wait()
+            for _ in range(gets_per_thread):
+                k = rng.choice(keys)
+                v = cache.get(k)
+                if v is None:
+                    cache.put(k, ("payload", k))
+                else:
+                    assert v == ("payload", k), f"corrupt value for {k}: {v}"
+                if rng.random() < 0.01:
+                    cache.stats()
+                    len(cache)
+        except BaseException as e:  # surfaced below — threads swallow otherwise
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, f"cache raced: {errors[:3]}"
+    stats = cache.stats()
+    assert stats["entries"] <= 8 and len(cache) <= 8
+    assert stats["hits"] + stats["misses"] == n_threads * gets_per_thread
 
 
 def test_engine_batched_equals_single(zipf_built):

@@ -1,7 +1,7 @@
 """Query-serving benchmark: QPS / latency against the ``index.mri``
 artifact (make bench-serve / make bench-serve-device).
 
-Three modes, all printing ONE JSON line mirroring bench.py's shape:
+Four modes, all printing ONE JSON line mirroring bench.py's shape:
 
   (default)           closed-loop host-engine QPS/latency at
                       MRI_SERVE_BATCHES (the r05 bench, unchanged)
@@ -9,12 +9,24 @@ Three modes, all printing ONE JSON line mirroring bench.py's shape:
                       latency measured from each query's scheduled
                       arrival (queueing delay included), not from
                       service start — the number a latency SLO is
-                      actually about
+                      actually about.  With --daemon the arrivals are
+                      sent over the wire to a live `mri serve`
+                      subprocess instead of calling the engine inline,
+                      so shed ("overloaded") and deadline-miss rates
+                      are part of the result.
   --device-ab         host-vs-device A/B at batch 1/1K/8K/64K with a
                       per-op breakdown, a byte-parity check between the
                       engines on sampled batches, and a zero-recompile
                       steady-state assertion; also written to
                       --out (BENCH_SERVE_DEVICE_r06.json)
+  --daemon-bench      the resident-daemon sweep (make bench-daemon):
+                      pipelined coalesced capacity + closed-loop rpc
+                      floor vs the in-process batch-1 baseline, then an
+                      open-loop Poisson sweep at 3 offered loads scaled
+                      to the measured capacity — each leg reporting
+                      p50/p99 from scheduled arrival, shed rate, and
+                      deadline-miss rate; written to --out-daemon
+                      (BENCH_DAEMON_r07.json)
 
 The workload is Zipf-distributed over the corpus vocabulary ranked by
 document frequency — rank-1 terms dominate, exactly the hot-head skew a
@@ -57,6 +69,18 @@ AB_MAX_BATCHES = int(os.environ.get("MRI_SERVE_AB_MAX_BATCHES", 256))
 ZIPF_S = float(os.environ.get("MRI_SERVE_ZIPF_S", 1.1))
 SEED = int(os.environ.get("MRI_SERVE_SEED", 17))
 OPEN_SECONDS = float(os.environ.get("MRI_SERVE_OPEN_SECONDS", 3.0))
+
+#: daemon-bench knobs: pipelined capacity-probe size, closed-loop rpc
+#: count, per-leg open-loop duration, the deadline_ms every open-loop
+#: request carries, and the offered-load multipliers applied to the
+#: measured coalesced capacity
+DAEMON_PIPELINE_N = int(os.environ.get("MRI_DAEMON_PIPELINE_N", 60_000))
+DAEMON_CLOSED_N = int(os.environ.get("MRI_DAEMON_CLOSED_N", 3_000))
+DAEMON_OPEN_SECONDS = float(os.environ.get("MRI_DAEMON_OPEN_SECONDS", 2.0))
+DAEMON_DEADLINE_MS = float(os.environ.get("MRI_DAEMON_DEADLINE_MS", 25.0))
+DAEMON_LOAD_FACTORS = tuple(
+    float(f) for f in os.environ.get(
+        "MRI_DAEMON_LOAD_FACTORS", "0.4,0.8,1.6").split(","))
 
 
 def _build_index() -> tuple[str, dict]:
@@ -270,6 +294,381 @@ def _device_ab(out_path: str | None) -> dict:
     return line
 
 
+# -- resident daemon bench (make bench-daemon) --------------------------
+
+
+def _spawn_daemon(out_dir: str, env_extra: dict | None = None):
+    """A real `mri serve` subprocess on a fresh port; returns
+    (proc, addr)."""
+    import subprocess
+
+    repo = str(Path(__file__).resolve().parent.parent)
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", out_dir, "--listen", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=repo, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise RuntimeError(f"daemon died on startup: {proc.stderr.read()}")
+    ready = json.loads(line)
+    return proc, (ready["host"], ready["port"])
+
+
+def _stop_daemon(proc) -> dict:
+    """SIGTERM -> drained counters from the daemon's exit line."""
+    import signal as _signal
+
+    proc.send_signal(_signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    counters = {}
+    for line in proc.stdout:
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("event") == "drained":
+            counters = obj["counters"]
+            break
+    proc.stdout.close()
+    proc.stderr.close()
+    assert rc == 0, f"daemon exited rc={rc}"
+    return counters
+
+
+def _encode_requests(terms: list[str], n: int,
+                     deadline_ms: float | None = None) -> list[bytes]:
+    """Pre-encoded JSON-lines df requests (ids 0..n-1) so the timed
+    loop never pays json.dumps."""
+    extra = {} if deadline_ms is None else {"deadline_ms": deadline_ms}
+    return [json.dumps({"id": i, "op": "df", "terms": [terms[i % len(terms)]],
+                        **extra}).encode() + b"\n"
+            for i in range(n)]
+
+
+class _DaemonReader:
+    """Drains responses on a thread; records per-id completion time and
+    tallies error kinds.  A concurrent reader is mandatory for the
+    pipelined legs: the daemon's bounded outbound queue force-closes a
+    connection whose peer stops reading.  ``on_response`` (optional) is
+    called per response — the windowed sender's flow-control hook."""
+
+    def __init__(self, sock, n: int, on_response=None):
+        import threading
+
+        self.f = sock.makefile("rb")
+        self.done_at = np.full(n, np.nan)
+        self.kinds: dict[str, int] = {}
+        self.ok = 0
+        self.error: str | None = None
+        self._n = n
+        self._on_response = on_response
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for _ in range(self._n):
+                line = self.f.readline()
+                if not line:
+                    self.error = "connection closed early"
+                    return
+                r = json.loads(line)
+                self.done_at[r["id"]] = time.perf_counter()
+                if r.get("ok"):
+                    self.ok += 1
+                else:
+                    k = r.get("error", "?")
+                    self.kinds[k] = self.kinds.get(k, 0) + 1
+                if self._on_response is not None:
+                    self._on_response()
+        except (OSError, ValueError) as e:
+            self.error = str(e)
+        finally:
+            if self._on_response is not None:
+                for _ in range(self._n):  # unblock a waiting sender
+                    self._on_response()
+
+    def join(self, timeout=300):
+        self.thread.join(timeout)
+        assert not self.thread.is_alive(), "reader wedged"
+        assert self.error is None, f"reader failed: {self.error}"
+
+
+#: well-behaved pipelined client window: below the daemon's admission
+#: queue (so nothing sheds) and its outbound queue (so the slow-client
+#: defense never fires) while still giving the dispatcher hundreds of
+#: requests to coalesce per micro-batch
+DAEMON_WINDOW = int(os.environ.get("MRI_DAEMON_WINDOW", 512))
+
+
+def _daemon_pipelined_qps(addr, lines: list[bytes]) -> dict:
+    """Coalesced capacity: one connection, up to DAEMON_WINDOW requests
+    in flight — the dispatcher is free to build large micro-batches.
+    (An unwindowed blast would just measure the admission controller:
+    everything past the queue depth sheds, and the error flood trips
+    the slow-client close.  Real pipelined clients window.)"""
+    import socket as _socket
+    import threading
+
+    sock = _socket.create_connection(addr, timeout=60)
+    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    window = threading.Semaphore(DAEMON_WINDOW)
+    try:
+        reader = _DaemonReader(sock, len(lines),
+                               on_response=window.release)
+        chunk = 64  # amortize syscalls; acquire per request, send per chunk
+        t0 = time.perf_counter()
+        for i in range(0, len(lines), chunk):
+            batch = lines[i:i + chunk]
+            for _ in batch:
+                window.acquire()
+            sock.sendall(b"".join(batch))
+        reader.join()
+        wall = time.perf_counter() - t0
+        assert reader.ok == len(lines), \
+            f"{reader.ok}/{len(lines)} ok, kinds={reader.kinds}"
+        return {"requests": len(lines),
+                "window": DAEMON_WINDOW,
+                "qps": round(len(lines) / wall, 1),
+                "wall_s": round(wall, 3)}
+    finally:
+        sock.close()
+
+
+def _daemon_closed_loop_qps(addr, lines: list[bytes]) -> dict:
+    """One request in flight at a time: the per-request protocol floor
+    (syscall + JSON overhead dominated; no coalescing possible)."""
+    import socket as _socket
+
+    sock = _socket.create_connection(addr, timeout=60)
+    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    f = sock.makefile("rb")
+    try:
+        lat = np.empty(len(lines))
+        t0 = time.perf_counter()
+        for i, line in enumerate(lines):
+            t = time.perf_counter()
+            sock.sendall(line)
+            r = json.loads(f.readline())
+            assert r.get("ok"), r
+            lat[i] = time.perf_counter() - t
+        wall = time.perf_counter() - t0
+        return {"requests": len(lines),
+                "qps": round(len(lines) / wall, 1),
+                "rpc_p50_us": round(float(np.percentile(lat, 50)) * 1e6, 1),
+                "rpc_p99_us": round(float(np.percentile(lat, 99)) * 1e6, 1)}
+    finally:
+        f.close()
+        sock.close()
+
+
+#: open-loop in-flight cap: deliberately ABOVE the daemon's admission
+#: queue (so overload really sheds) but bounded so the burst of shed
+#: error responses cannot overflow the outbound queue into the
+#: slow-client close.  Requests the window delays are still measured
+#: from their scheduled arrival — client-side queueing is latency too.
+DAEMON_OPEN_WINDOW = int(os.environ.get("MRI_DAEMON_OPEN_WINDOW", 2400))
+
+
+def _daemon_open_loop(addr, lines: list[bytes], rps: float,
+                      rng) -> dict:
+    """Poisson arrivals against the live daemon.  Latency runs from the
+    SCHEDULED arrival to response receipt; requests whose arrival time
+    has passed are flushed in one write (micro-burst send), so the
+    client can offer rates far above what per-request sleeps allow.
+    Every request carries deadline_ms, so an overloaded daemon answers
+    with counted `overloaded` / `deadline_expired` instead of building
+    unbounded queue — both rates are part of the result."""
+    import socket as _socket
+    import threading
+
+    n = len(lines)
+    arrivals = np.cumsum(rng.exponential(1.0 / rps, size=n))
+    sock = _socket.create_connection(addr, timeout=60)
+    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    window = threading.Semaphore(DAEMON_OPEN_WINDOW)
+    try:
+        reader = _DaemonReader(sock, n, on_response=window.release)
+        t0 = time.perf_counter()
+        i = 0
+        while i < n:
+            now = time.perf_counter() - t0
+            j = i
+            while j < n and arrivals[j] <= now:
+                j += 1
+            # cap each burst below the window: acquiring more permits
+            # than the window holds before sending any of them would
+            # deadlock once nothing is left in flight to release one
+            j = min(j, i + DAEMON_OPEN_WINDOW // 2)
+            if j > i:
+                for _ in range(j - i):
+                    window.acquire()
+                sock.sendall(b"".join(lines[i:j]))
+                i = j
+            else:
+                time.sleep(min(arrivals[i] - now, 0.001))
+        reader.join()
+        wall = time.perf_counter() - t0
+        lat = reader.done_at - (t0 + arrivals)
+        answered = ~np.isnan(lat)
+        assert answered.all(), f"{(~answered).sum()} requests unanswered"
+        shed = reader.kinds.get("overloaded", 0)
+        missed = reader.kinds.get("deadline_expired", 0)
+        ok_lat = lat  # every response (ok or error) closes its request
+        return {
+            "offered_rps": round(rps, 1),
+            "achieved_rps": round(n / wall, 1),
+            "requests": n,
+            "ok": reader.ok,
+            "shed": shed,
+            "deadline_missed": missed,
+            "shed_rate": round(shed / n, 4),
+            "deadline_miss_rate": round(missed / n, 4),
+            "p50_ms": round(float(np.percentile(ok_lat, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(ok_lat, 99)) * 1e3, 3),
+            "max_ms": round(float(ok_lat.max()) * 1e3, 3),
+        }
+    finally:
+        sock.close()
+
+
+def _daemon_bench(out_path: str | None) -> dict:
+    """The full resident-daemon sweep -> BENCH_DAEMON_r07.json."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+
+    _, corpus_metric = bench._manifest()
+    out_dir, build_report = _build_index()
+    rng = np.random.default_rng(SEED)
+
+    # in-process batch-1 closed loop: the floor `mri query`-per-process
+    # serving sits at (the r05 ~27K lookups/s number), re-measured here
+    # on the same corpus so the comparison is honest
+    engine = Engine(os.path.join(out_dir, "index.mri"))
+    terms = _zipf_terms(engine, max(DAEMON_PIPELINE_N, LOOKUPS), rng)
+    baseline = _measure_batches(engine, terms[:20_000], 1,
+                                max_batches=20_000)
+    engine.close()
+
+    # leg 1+2 — capacity and rpc floor against a default-config daemon
+    proc, addr = _spawn_daemon(out_dir)
+    try:
+        pipelined = _daemon_pipelined_qps(
+            addr, _encode_requests(terms, DAEMON_PIPELINE_N))
+        print(f"# pipelined: {pipelined}", file=sys.stderr, flush=True)
+        closed = _daemon_closed_loop_qps(
+            addr, _encode_requests(terms, DAEMON_CLOSED_N))
+        print(f"# closed_loop: {closed}", file=sys.stderr, flush=True)
+        counters = _stop_daemon(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # leg 3 — open-loop sweep against an admission envelope SIZED TO
+    # THE DEADLINE: queue_depth * (1/capacity) is the worst-case queue
+    # dwell, so queue 512 at ~30K/s keeps dwell near 17ms against the
+    # 25ms deadline — overload then sheds at admission (`overloaded`)
+    # instead of admitting work it can only answer late
+    capacity = pipelined["qps"]
+    open_env = {"MRI_SERVE_QUEUE_DEPTH": "512",
+                "MRI_SERVE_MAX_BATCH": "512"}
+    proc, addr = _spawn_daemon(out_dir, env_extra=open_env)
+    try:
+        open_loop = []
+        for factor in DAEMON_LOAD_FACTORS:
+            rps = capacity * factor
+            n = min(max(int(rps * DAEMON_OPEN_SECONDS), 100),
+                    2 * DAEMON_PIPELINE_N)
+            leg = _daemon_open_loop(
+                addr, _encode_requests(terms, n,
+                                       deadline_ms=DAEMON_DEADLINE_MS),
+                rps, rng)
+            leg["load_factor"] = factor
+            open_loop.append(leg)
+            print(f"# open_loop x{factor}: {leg}", file=sys.stderr,
+                  flush=True)
+        open_counters = _stop_daemon(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # the tentpole's claim: coalescing lifts a resident daemon past the
+    # per-process batch-1 floor
+    assert pipelined["qps"] > baseline["lookups_per_s"], \
+        f"coalesced {pipelined['qps']} <= batch-1 {baseline['lookups_per_s']}"
+
+    line = {
+        "metric": "daemon_coalesced_qps",
+        "value": pipelined["qps"],
+        "unit": "lookups/s",
+        "corpus_metric": corpus_metric,
+        "zipf_s": ZIPF_S,
+        "deadline_ms": DAEMON_DEADLINE_MS,
+        "batch1_engine_baseline_qps": baseline["lookups_per_s"],
+        "coalesced_speedup_vs_batch1": round(
+            pipelined["qps"] / baseline["lookups_per_s"], 2),
+        "pipelined": pipelined,
+        "closed_loop_rpc": closed,
+        "open_loop": open_loop,
+        "open_loop_config": {**{k.lower(): int(v)
+                                for k, v in open_env.items()},
+                            "open_window": DAEMON_OPEN_WINDOW},
+        "daemon_counters": counters,
+        "open_loop_daemon_counters": open_counters,
+        "artifact_bytes": int(build_report.get("artifact_bytes", 0)),
+        "host_cores": os.cpu_count(),
+        "scratch": bench._scratch_backing(),
+    }
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
+def _daemon_single_open_loop(rps: float) -> dict:
+    """`--open-loop RPS --daemon`: one Poisson leg against a live
+    daemon (the engine-inline open loop stays the default)."""
+    _, corpus_metric = bench._manifest()
+    out_dir, _report = _build_index()
+    rng = np.random.default_rng(SEED)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+
+    engine = Engine(os.path.join(out_dir, "index.mri"))
+    terms = _zipf_terms(engine, LOOKUPS, rng)
+    engine.close()
+    proc, addr = _spawn_daemon(out_dir)
+    try:
+        n = max(int(rps * DAEMON_OPEN_SECONDS), 100)
+        leg = _daemon_open_loop(
+            addr, _encode_requests(terms, n, deadline_ms=DAEMON_DEADLINE_MS),
+            rps, rng)
+        counters = _stop_daemon(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    return {
+        "metric": "daemon_open_loop_p99_ms",
+        "value": leg["p99_ms"],
+        "unit": "ms",
+        "corpus_metric": corpus_metric,
+        "zipf_s": ZIPF_S,
+        "open_loop": leg,
+        "daemon_counters": counters,
+        "scratch": bench._scratch_backing(),
+    }
+
+
 # -- default closed-loop host bench (the r05 shape, unchanged) ----------
 
 
@@ -358,9 +757,27 @@ def main(argv: list[str] | None = None) -> int:
                         "parity + zero-recompile assertions")
     p.add_argument("--out", default="BENCH_SERVE_DEVICE_r06.json",
                    help="where --device-ab writes its JSON report")
+    p.add_argument("--daemon", action="store_true",
+                   help="with --open-loop: offer the Poisson arrivals "
+                        "to a live `mri serve` subprocess (shed and "
+                        "deadline-miss rates included) instead of "
+                        "calling the engine inline")
+    p.add_argument("--daemon-bench", action="store_true",
+                   help="resident-daemon sweep: coalesced capacity vs "
+                        "the batch-1 baseline + open-loop legs at "
+                        f"{','.join(map(str, DAEMON_LOAD_FACTORS))}x "
+                        "capacity")
+    p.add_argument("--out-daemon", default="BENCH_DAEMON_r07.json",
+                   help="where --daemon-bench writes its JSON report")
     args = p.parse_args(argv)
 
-    if args.device_ab:
+    if args.daemon_bench:
+        line = _daemon_bench(args.out_daemon)
+    elif args.daemon and args.open_loop is not None:
+        line = _daemon_single_open_loop(args.open_loop)
+    elif args.daemon:
+        p.error("--daemon requires --open-loop RPS (or use --daemon-bench)")
+    elif args.device_ab:
         line = _device_ab(args.out)
     else:
         line = _closed_loop(args.engine, args.open_loop)

@@ -21,6 +21,17 @@ from its printed seed alone:
 The (1, 1) cell routes down the single-worker pipelined path, which has
 no worker/reducer recovery layer by design (nothing to take over for) —
 its trials sample only the read-level kinds the retry policy handles.
+
+``--daemon`` switches to the serve-side soak: seeded trials thrown at a
+REAL ``mri serve`` subprocess, cycled over four scenarios (overload
+burst, SIGTERM mid-request, corrupt hot reload, abrupt client
+disconnect).  The contract mirrors the build-side one: every admitted
+request is answered exactly once (ok or a counted error kind), a
+surviving client always gets oracle-correct answers, SIGTERM always
+drains to exit 0, and nothing ever hangs past the deadline:
+
+    python tools/chaos.py --daemon --trials 12 --seed-base 7000
+    python tools/chaos.py --daemon --repro 7003
 """
 
 from __future__ import annotations
@@ -28,6 +39,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
+import signal
+import socket
+import subprocess
 import sys
 import threading
 import time
@@ -61,6 +76,9 @@ from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.io.reader im
 )
 from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.formatter import (  # noqa: E402
     letters_md5,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (  # noqa: E402
+    clean_token,
 )
 
 #: Every parallel-plan shape the soak cycles through.
@@ -204,6 +222,346 @@ def run_soak(work_dir: Path, trials: int, seed_base: int,
     return summary
 
 
+# -- serve-daemon soak --------------------------------------------------
+#
+# Same philosophy as the build soak, pointed at the resident daemon:
+# each trial spawns a REAL `mri serve` subprocess and throws one seeded
+# scenario at it.  Contract per trial: every request answered exactly
+# once (ok or a counted error kind), surviving clients get
+# oracle-correct answers, SIGTERM drains to exit 0, never a hang.
+
+DAEMON_SCENARIOS = ("overload", "sigterm-mid-request",
+                    "reload-corrupt", "client-disconnect")
+
+#: Error kinds a client may legitimately see under chaos — anything
+#: else (or a missing/duplicate response) fails the trial.
+_DAEMON_OK_ERRORS = {"overloaded", "deadline_expired", "draining"}
+
+_WS = None  # lazily compiled whitespace splitter for the daemon oracle
+
+
+def make_daemon_corpus(root: Path, num_docs: int = 24, seed: int = 17):
+    """Build a small artifact-packed index + a naive df oracle."""
+    import re
+
+    global _WS
+    if _WS is None:
+        _WS = re.compile(rb"[ \t\n\v\f\r]+")
+    docs = zipf_corpus(num_docs=num_docs, vocab_size=300,
+                       tokens_per_doc=50, seed=seed)
+    paths = write_corpus(root / "docs", docs)
+    write_manifest(root / "list.txt", paths)
+    manifest = read_manifest(root / "list.txt")
+    out = root / "out"
+    build_index(manifest,
+                IndexConfig(backend="cpu", num_mappers=1, num_reducers=1,
+                            artifact=True),
+                output_dir=out)
+    oracle: dict[str, set] = {}
+    for doc_id, blob in enumerate(docs, start=1):
+        for raw in _WS.split(blob):
+            w = clean_token(raw)
+            if w:
+                oracle.setdefault(w, set()).add(doc_id)
+    return out, {t: len(d) for t, d in oracle.items()}
+
+
+def _spawn_daemon(out_dir: Path, *extra: str, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT), JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(out_dir), "--listen", "127.0.0.1:0", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=str(REPO_ROOT), text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise RuntimeError(f"daemon died on startup: {proc.stderr.read()}")
+    ready = json.loads(line)
+    return proc, (ready["host"], ready["port"])
+
+
+class _ChaosClient:
+    """Minimal JSON-lines client with a hard socket timeout."""
+
+    def __init__(self, addr, timeout=15.0):
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.f = self.sock.makefile("rb")
+
+    def send(self, **obj):
+        self.sock.sendall((json.dumps(obj) + "\n").encode())
+
+    def recv(self):
+        line = self.f.readline()
+        return json.loads(line) if line else None
+
+    def rpc(self, **obj):
+        self.send(**obj)
+        r = self.recv()
+        if r is None:
+            raise RuntimeError("daemon closed the connection mid-rpc")
+        return r
+
+    def close(self, *, abort=False):
+        try:
+            if abort:
+                # RST instead of FIN: the rudest disconnect a peer can send
+                self.sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    __import__("struct").pack("ii", 1, 0))
+            self.f.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _parity_probe(addr, oracle: dict, rng: random.Random, n: int = 5):
+    """A fresh client must get oracle-exact df answers."""
+    terms = rng.sample(sorted(oracle), min(n, len(oracle)))
+    c = _ChaosClient(addr)
+    try:
+        r = c.rpc(id="probe", op="df", terms=terms)
+        if not r.get("ok"):
+            return f"probe rejected: {r}"
+        want = [oracle[t] for t in terms]
+        if r["df"] != want:
+            return f"probe mismatch: terms={terms} got={r['df']} want={want}"
+        return None
+    finally:
+        c.close()
+
+
+def _drain_to_zero(proc, verdict: dict, timeout: float) -> bool:
+    """SIGTERM -> exit 0 + a parseable drained line; anything else fails."""
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        verdict["outcome"] = "HANG"
+        return False
+    drained = None
+    for line in proc.stdout:
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("event") == "drained":
+            drained = obj
+            break
+    if rc != 0 or drained is None:
+        verdict["outcome"] = f"bad-exit:rc={rc}"
+        verdict["stderr"] = proc.stderr.read()[-2000:]
+        return False
+    verdict["counters"] = drained["counters"]
+    return True
+
+
+def _scenario_overload(addr, oracle, rng, verdict):
+    """Pipelined burst into a tiny queue: every request answered exactly
+    once, each either ok or a counted error kind."""
+    n = rng.randrange(80, 200)
+    c = _ChaosClient(addr)
+    try:
+        blob = b"".join(
+            json.dumps({"id": i, "op": "df",
+                        "terms": ["chaosterm"],
+                        **({"deadline_ms": rng.choice((5, 50, 500))}
+                           if rng.random() < 0.3 else {})}).encode() + b"\n"
+            for i in range(n))
+        c.sock.sendall(blob)
+        seen = set()
+        for _ in range(n):
+            r = c.recv()
+            if r is None:
+                return f"connection died after {len(seen)}/{n} responses"
+            if not r.get("ok") and r.get("error") not in _DAEMON_OK_ERRORS:
+                return f"unexpected error kind: {r}"
+            if r["id"] in seen:
+                return f"duplicate response id {r['id']}"
+            seen.add(r["id"])
+        if seen != set(range(n)):
+            return f"missing responses: {sorted(set(range(n)) - seen)[:5]}"
+        verdict["requests"] = n
+    finally:
+        c.close()
+    return _parity_probe(addr, oracle, rng)
+
+
+def _scenario_sigterm_mid_request(addr, oracle, rng, verdict, proc):
+    """SIGTERM lands while requests are in flight: whatever comes back
+    before EOF is well-formed and unduplicated, then exit 0."""
+    n = rng.randrange(20, 60)
+    c = _ChaosClient(addr)
+    try:
+        blob = b"".join(
+            json.dumps({"id": i, "op": "or",
+                        "terms": ["chaosterm", "otherterm"]}).encode() + b"\n"
+            for i in range(n))
+        c.sock.sendall(blob)
+        proc.send_signal(signal.SIGTERM)  # mid-flight, deliberately
+        seen = set()
+        while True:
+            try:
+                r = c.recv()
+            except (OSError, ValueError):
+                break
+            if r is None:
+                break
+            if r["id"] in seen:
+                return f"duplicate response id {r['id']}"
+            if not r.get("ok") and r.get("error") not in _DAEMON_OK_ERRORS:
+                return f"unexpected error kind: {r}"
+            seen.add(r["id"])
+        verdict["answered_before_exit"] = len(seen)
+    finally:
+        c.close()
+    return None  # _drain_to_zero already signalled; caller just reaps
+
+
+def _scenario_reload_corrupt(addr, oracle, rng, verdict, proc):
+    """SIGHUP with an injected corrupt reload: rejected + counted, old
+    artifact keeps serving, and the NEXT reload succeeds."""
+    c = _ChaosClient(addr)
+    try:
+        proc.send_signal(signal.SIGHUP)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            s = c.rpc(id="s", op="stats")["stats"]["counters"]
+            if s["reload_rejected"] >= 1:
+                break
+            time.sleep(0.05)
+        if s["reload_rejected"] != 1:
+            return f"reload_rejected never counted: {s}"
+        err = _parity_probe(addr, oracle, rng)
+        if err:
+            return f"old artifact stopped serving after rejected reload: {err}"
+        # the once-per-rule fault budget is spent: this reload must land
+        proc.send_signal(signal.SIGHUP)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            s = c.rpc(id="s2", op="stats")["stats"]["counters"]
+            if s["reload_ok"] >= 1:
+                break
+            time.sleep(0.05)
+        if s["reload_ok"] != 1:
+            return f"post-budget reload never landed: {s}"
+    finally:
+        c.close()
+    return _parity_probe(addr, oracle, rng)
+
+
+def _scenario_client_disconnect(addr, oracle, rng, verdict):
+    """Clients vanish mid-conversation (half with an RST); the daemon
+    keeps serving everyone else."""
+    n_conns = rng.randrange(3, 7)
+    for i in range(n_conns):
+        c = _ChaosClient(addr)
+        try:
+            c.send(id=i, op="df", terms=["chaosterm"])
+            if rng.random() < 0.5:
+                c.recv()  # half read their answer first
+        finally:
+            c.close(abort=rng.random() < 0.5)
+    verdict["disconnected"] = n_conns
+    return _parity_probe(addr, oracle, rng)
+
+
+def run_daemon_trial(out_dir: Path, oracle: dict, seed: int,
+                     scenario: str, deadline_s: float = 60.0) -> dict:
+    """One seeded serve-side trial; ``ok`` False only on a contract
+    violation (hang, wrong answer, lost/duplicate response, bad exit)."""
+    rng = random.Random(seed)
+    verdict = {"seed": seed, "scenario": scenario, "ok": False,
+               "outcome": "?"}
+    extra, env_extra = [], {}
+    if scenario == "overload":
+        env_extra = {"MRI_SERVE_QUEUE_DEPTH": str(rng.choice((4, 8, 16))),
+                     "MRI_SERVE_MAX_BATCH": "1",
+                     "MRI_SERVE_COALESCE_US": "0"}
+    elif scenario == "reload-corrupt":
+        extra = ["--fault-spec", "reload-corrupt"]
+    t0 = time.monotonic()
+    try:
+        proc, addr = _spawn_daemon(out_dir, *extra, env_extra=env_extra)
+    except (RuntimeError, OSError, subprocess.TimeoutExpired) as e:
+        verdict["outcome"] = f"spawn-failed:{e}"
+        return verdict
+    try:
+        try:
+            if scenario == "overload":
+                err = _scenario_overload(addr, oracle, rng, verdict)
+            elif scenario == "sigterm-mid-request":
+                err = _scenario_sigterm_mid_request(
+                    addr, oracle, rng, verdict, proc)
+            elif scenario == "reload-corrupt":
+                err = _scenario_reload_corrupt(
+                    addr, oracle, rng, verdict, proc)
+            elif scenario == "client-disconnect":
+                err = _scenario_client_disconnect(addr, oracle, rng, verdict)
+            else:
+                raise ValueError(f"unknown scenario {scenario!r}")
+        except (OSError, RuntimeError, ValueError, KeyError) as e:
+            err = f"{type(e).__name__}: {e}"
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        if scenario == "sigterm-mid-request":
+            # SIGTERM already sent mid-scenario; just hold it to exit 0
+            try:
+                rc = proc.wait(timeout=deadline_s)
+            except subprocess.TimeoutExpired:
+                verdict["outcome"] = "HANG"
+                return verdict
+            if rc != 0:
+                verdict["outcome"] = f"bad-exit:rc={rc}"
+                verdict["stderr"] = proc.stderr.read()[-2000:]
+                return verdict
+        elif not _drain_to_zero(proc, verdict,
+                                timeout=max(10.0, deadline_s - (
+                                    time.monotonic() - t0))):
+            return verdict
+        verdict["outcome"] = "clean"
+        verdict["ok"] = True
+        return verdict
+    finally:
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        proc.stdout.close()
+        proc.stderr.close()
+
+
+def run_daemon_soak(work_dir: Path, trials: int, seed_base: int,
+                    deadline_s: float = 60.0, verbose: bool = True) -> dict:
+    """``trials`` seeded serve trials cycled over DAEMON_SCENARIOS."""
+    work_dir.mkdir(parents=True, exist_ok=True)
+    out_dir, oracle = make_daemon_corpus(work_dir / "serve-corpus")
+    results = []
+    for t in range(trials):
+        scenario = DAEMON_SCENARIOS[t % len(DAEMON_SCENARIOS)]
+        v = run_daemon_trial(out_dir, oracle, seed_base + t, scenario,
+                             deadline_s=deadline_s)
+        results.append(v)
+        if verbose:
+            print(json.dumps(v, sort_keys=True), flush=True)
+    failures = [v for v in results if not v["ok"]]
+    return {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "by_scenario": {s: sum(v["scenario"] == s and v["ok"]
+                               for v in results)
+                        for s in DAEMON_SCENARIOS},
+        "failures": failures,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos soak: seeded fault schedules vs the (K, M) "
@@ -219,6 +577,10 @@ def main(argv=None) -> int:
                     help="scratch dir (default: a fresh tempdir)")
     ap.add_argument("--repro", type=int, default=None,
                     help="re-run the single trial with this seed")
+    ap.add_argument("--daemon", action="store_true",
+                    help="soak the resident serve daemon instead of the "
+                         "build pipeline (scenarios: "
+                         + ", ".join(DAEMON_SCENARIOS) + ")")
     args = ap.parse_args(argv)
     if args.work_dir is None:
         import tempfile
@@ -226,6 +588,20 @@ def main(argv=None) -> int:
         work = Path(tempfile.mkdtemp(prefix="mri-chaos-"))
     else:
         work = Path(args.work_dir)
+    if args.daemon:
+        if args.repro is not None:
+            t = args.repro - args.seed_base
+            scenario = DAEMON_SCENARIOS[t % len(DAEMON_SCENARIOS)]
+            work.mkdir(parents=True, exist_ok=True)
+            out_dir, oracle = make_daemon_corpus(work / "serve-corpus")
+            v = run_daemon_trial(out_dir, oracle, args.repro, scenario,
+                                 deadline_s=args.deadline)
+            print(json.dumps(v, sort_keys=True))
+            return 0 if v["ok"] else 1
+        summary = run_daemon_soak(work, args.trials, args.seed_base,
+                                  deadline_s=args.deadline)
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if not summary["failures"] else 1
     if args.repro is not None:
         t = args.repro - args.seed_base
         mappers, reducers = PLAN_MATRIX[t % len(PLAN_MATRIX)]
