@@ -6,11 +6,16 @@
 
 PY ?= python
 
-.PHONY: build test test-fast test-faults test-parallel test-chaos test-serve test-serve-device test-daemon bench bench-scale bench-sweep bench-serve bench-serve-device bench-daemon capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-daemon capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
 	          assert native.available(), 'native build failed'; print('native runtime built')"
+
+# repo-contract static analysis (tools/mrilint): exit 0 means clean
+# against the checked-in shrink-only baseline
+lint:
+	$(PY) -m tools.mrilint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -19,9 +24,14 @@ test:
 # multi-worker map/reduce tests — parallelized over workers when
 # pytest-xdist is installed (falls back to a serial run when not —
 # the verify pipeline's own serial invocation is untouched)
-test-fast:
+test-fast: lint
 	$(PY) -m pytest tests/ -q -m "not slow" \
 	  $$($(PY) -c "import importlib.util as u; print('-n auto' if u.find_spec('xdist') else '')")
+
+# mrilint's own suite: checker semantics on planted fixtures under
+# tests/fixtures/lint/ plus the repo-clean gate
+test-lint:
+	$(PY) -m pytest tests/ -q -m lint
 
 # failure-semantics suite only: fault injection, retry/skip policy,
 # crash-safe resume (tests marked `faults`)
@@ -49,6 +59,34 @@ test-serve:
 # accelerators)
 test-serve-device:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m device_serve
+
+# the sanitizer suite targets the native C++ runtime: every native /
+# tokenizer / emit test plus the oracle conformance check.  Tests that
+# jit through jax are excluded under ASan only — its __cxa_throw
+# interceptor aborts inside jaxlib's bundled MLIR bindings (a toolchain
+# clash, not a bug in this code) — and kept out of the ubsan run too so
+# both targets certify the same selection.
+NATIVE_SAN_TESTS = tests/test_native.py tests/test_tokenizer.py \
+  tests/test_emit_backend.py tests/test_conformance.py
+NATIVE_SAN_K = not tpu and not single_chip and not numpy_tokenizer \
+  and not backends_agree and not degenerate_configs
+
+# native tokenizer under AddressSanitizer: MRI_NATIVE_SANITIZE=asan
+# compiles a separately-tagged .so (never shadows the production one)
+# and the runtime loads it.  libasan must be first in the process, so
+# it is LD_PRELOADed into the python interpreter; leak checking is off
+# because the long-lived interpreter never frees everything at exit.
+test-native-asan:
+	LD_PRELOAD=$$(g++ -print-file-name=libasan.so) \
+	ASAN_OPTIONS=detect_leaks=0 \
+	MRI_NATIVE_SANITIZE=asan JAX_PLATFORMS=cpu \
+	$(PY) -m pytest $(NATIVE_SAN_TESTS) -q -m "not slow" -k "$(NATIVE_SAN_K)"
+
+# same suite under UndefinedBehaviorSanitizer (traps on UB, no preload
+# needed — libubsan is a direct dependency of the tagged .so)
+test-native-ubsan:
+	MRI_NATIVE_SANITIZE=ubsan JAX_PLATFORMS=cpu \
+	$(PY) -m pytest $(NATIVE_SAN_TESTS) -q -m "not slow" -k "$(NATIVE_SAN_K)"
 
 # resident serve-daemon suite: JSON-lines protocol parity, admission
 # control / load shedding, deadlines, graceful drain, crash-safe hot
@@ -98,10 +136,12 @@ capture:
 rehearse:
 	PY=$(PY) bash tools/rehearse.sh $(ROUND)
 
-# drop only the hashed native build artifacts (stale .so files from
-# earlier tokenizer.cc revisions are also auto-pruned on every rebuild)
+# drop every hashed native build artifact — production AND sanitizer
+# variants, in both the in-tree dir and the /tmp fallback (stale .so
+# files of the same variant are also auto-pruned on every rebuild)
 clean-native:
 	rm -rf parallel_computation_of_an_inverted_index_using_map_reduce_tpu/native/_build
+	rm -rf /tmp/mri_tpu_native_$$(id -u)
 
 clean: clean-native
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -251,6 +251,7 @@ def _query_main(argv: list[str]) -> int:
     terms = list(args.terms)
     if args.batch_file is not None:
         try:
+            # mrilint: allow(fault-boundary) operator-supplied batch file, not corpus I/O; OSError maps to exit 2 below
             with open(args.batch_file, "r", encoding="utf-8") as f:
                 terms.extend(line.strip() for line in f if line.strip())
         except OSError as e:
@@ -372,6 +373,7 @@ def _serve_main(argv: list[str]) -> int:
         if stop.is_set():
             # second signal: the drain is not fast enough for the
             # operator — documented forced exit, code 1
+            # mrilint: allow(exit-code) the one sanctioned exit-1 path
             os._exit(1)
         stop.set()
 

@@ -47,6 +47,7 @@ class Manifest:
         loader turns that into warn-and-skip, main.c:97-100).  Virtual
         manifests (corpus/synthetic.SyntheticManifest) override this to
         generate content without a filesystem."""
+        # mrilint: allow(fault-boundary) raw read primitive; the loader's read policy owns retries/skips
         with open(self.paths[index], "rb") as f:
             return f.read()
 
@@ -60,6 +61,7 @@ class Manifest:
         OSError like :meth:`read_doc`."""
         mv = memoryview(dest)
         total = 0
+        # mrilint: allow(fault-boundary) raw read primitive; the loader's read policy owns retries/skips
         with open(self.paths[index], "rb") as f:
             while total < len(mv):
                 n = f.readinto(mv[total:])
@@ -115,6 +117,7 @@ def read_manifest(list_path: str | Path, base_dir: str | Path | None = None) -> 
 
 def write_manifest(manifest_path: str | Path, paths: list[str]) -> None:
     """Write a file list in the reference's count-header format."""
+    # mrilint: allow(fault-boundary) corpus-prep utility, not on the fault-injected read path
     with open(manifest_path, "w", encoding="utf-8") as f:
         f.write(f"{len(paths)}\n")
         for p in paths:

@@ -163,13 +163,14 @@ class StealQueue:
         items = list(enumerate(windows, start=1))
         if shuffle_seed is not None:
             random.Random(shuffle_seed).shuffle(items)
-        self._items = items
-        self._pos = 0
+        self._items = items  # guarded by: self._lock
+        self._pos = 0        # guarded by: self._lock
         self._lock = threading.Lock()
         self._window_of = {wi: w for wi, w in items}
-        self._leases: dict[int, tuple[object, float]] = {}  # wi -> (worker, t)
-        self._completed: dict[int, object] = {}             # wi -> worker
-        self._failed: set = set()                           # retired workers
+        # wi -> (worker, t)  # guarded by: self._lock
+        self._leases: dict[int, tuple[object, float]] = {}
+        self._completed: dict[int, object] = {}  # wi -> worker  # guarded by: self._lock
+        self._failed: set = set()  # retired workers  # guarded by: self._lock
 
     def pop_window(self, worker=None) -> tuple[int, tuple[int, int]] | None:
         """Next ``(global_index, (lo, hi))``, or None when drained.

@@ -80,6 +80,8 @@ import signal
 import threading
 import time
 
+from .utils import envknobs
+
 log = logging.getLogger("mri_tpu.faults")
 
 ENV_VAR = "MRI_FAULTS"
@@ -600,8 +602,8 @@ def active() -> FaultInjector | None:
     if _active is _UNSET:
         with _active_lock:
             if _active is _UNSET:
-                _active = (FaultInjector(os.environ[ENV_VAR])
-                           if os.environ.get(ENV_VAR) else None)
+                spec = envknobs.get(ENV_VAR)
+                _active = FaultInjector(spec) if spec else None
     return _active  # type: ignore[return-value]
 
 
@@ -626,29 +628,16 @@ class RetryPolicy:
         """Knobs: MRI_READ_RETRIES (attempts), MRI_READ_BACKOFF_MS,
         MRI_READ_DEADLINE_S.
 
-        Invalid values raise a one-line ValueError naming the variable
+        Invalid values raise a one-line KnobError naming the variable
         (the CLI maps it to exit 2) instead of surfacing a bare
-        ``int()`` traceback three layers down a worker thread.
+        ``int()`` traceback three layers down a worker thread; the
+        casts and bounds live with the declarations in
+        :mod:`..utils.envknobs`.
         """
-        def _env(name, default, cast, minimum, exclusive):
-            raw = os.environ.get(name)
-            if raw is None:
-                return default
-            try:
-                val = cast(raw)
-            except ValueError:
-                raise ValueError(
-                    f"{name}={raw!r} is not a valid "
-                    f"{cast.__name__}") from None
-            if val < minimum or (exclusive and val == minimum):
-                bound = f"> {minimum}" if exclusive else f">= {minimum}"
-                raise ValueError(f"{name} must be {bound}, got {raw!r}")
-            return val
-
         return cls(
-            max_attempts=_env("MRI_READ_RETRIES", 3, int, 1, False),
-            backoff_s=_env("MRI_READ_BACKOFF_MS", 5.0, float, 0, False) / 1e3,
-            deadline_s=_env("MRI_READ_DEADLINE_S", 1.0, float, 0, True),
+            max_attempts=envknobs.get("MRI_READ_RETRIES"),
+            backoff_s=envknobs.get("MRI_READ_BACKOFF_MS") / 1e3,
+            deadline_s=envknobs.get("MRI_READ_DEADLINE_S"),
         )
 
     def run(self, fn, *, doc_id: int | None = None, path: str = "",
@@ -684,15 +673,16 @@ class DegradationReport:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.read_retries = 0
-        self.skips: list[dict] = []  # {"doc_id", "path", "reason"}
+        self.read_retries = 0  # guarded by: self._lock
+        # {"doc_id", "path", "reason"}  # guarded by: self._lock
+        self.skips: list[dict] = []
         # In-run fault-tolerance tallies (models/inverted_index
         # parallel host path): a recovered worker death leaves the
         # output byte-identical, so these are the only observable
         # trace that recovery ran at all.
-        self.worker_recoveries = 0
-        self.windows_requeued = 0
-        self.reducer_takeovers = 0
+        self.worker_recoveries = 0   # guarded by: self._lock
+        self.windows_requeued = 0    # guarded by: self._lock
+        self.reducer_takeovers = 0   # guarded by: self._lock
 
     def record_retry(self, *, doc_id: int | None = None,
                      path: str = "") -> None:
@@ -744,7 +734,8 @@ class DegradationReport:
 
     @property
     def degraded(self) -> bool:
-        return bool(self.skips)
+        with self._lock:
+            return bool(self.skips)
 
     def skipped_doc_ids(self) -> list[int]:
         with self._lock:
@@ -780,11 +771,12 @@ class DegradationReport:
         with self._lock:
             ids = [s["doc_id"] for s in self.skips]
             first = self.skips[0]
+            retries = self.read_retries
         logger.warning(
             "degraded run: skipped %d unreadable document(s) "
             "(doc ids %s) after %d retr%s; first reason: %s",
-            len(ids), ids, self.read_retries,
-            "y" if self.read_retries == 1 else "ies", first["reason"])
+            len(ids), ids, retries,
+            "y" if retries == 1 else "ies", first["reason"])
 
 
 _report_lock = threading.Lock()

@@ -102,9 +102,9 @@ class PipelinedWindowReader:
             self._free.put(a)
         self._done = object()
         self._stop = threading.Event()
-        self.read_wait_s = 0.0
-        self.read_busy_s = 0.0
-        self.consume_wait_s = 0.0
+        self.read_wait_s = 0.0     # owned by: reader thread
+        self.read_busy_s = 0.0     # owned by: reader thread
+        self.consume_wait_s = 0.0  # owned by: consumer thread
         # Reading starts NOW, not at first iteration: the first window
         # has nothing to hide behind once consumption starts, so let it
         # fill while the caller sets up its scan state.

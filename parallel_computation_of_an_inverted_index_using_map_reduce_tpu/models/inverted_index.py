@@ -30,6 +30,7 @@ from ..config import IndexConfig
 from ..parallel import dist_engine
 from ..parallel.mesh import make_mesh, replicated_spec, shard_spec, sharding
 from ..utils import checkpoint
+from ..utils import envknobs
 from ..corpus.manifest import Manifest, load_documents
 from ..ops import engine
 from ..ops import keys as K
@@ -207,8 +208,8 @@ class InvertedIndexModel:
         # MRI_CPU_WINDOW_BYTES forces tiny windows from a subprocess —
         # the SIGKILL-at-window-boundary e2e tests need a multi-window
         # plan on a corpus small enough to kill deterministically.
-        return int(os.environ.get("MRI_CPU_WINDOW_BYTES",
-                                  self._CPU_WINDOW_BYTES))
+        override = envknobs.get("MRI_CPU_WINDOW_BYTES")
+        return override if override is not None else self._CPU_WINDOW_BYTES
 
     def _run_cpu_pipelined(self, manifest: Manifest, out_dir: str,
                            timer: PhaseTimer) -> dict:
@@ -311,14 +312,11 @@ class InvertedIndexModel:
         # --artifact reaches here even with --io-prefetch 0 (the merge
         # state is the artifact's source); the reader needs depth >= 1
         depth = max(1, cfg.io_prefetch)
-        shuffle_env = os.environ.get("MRI_STEAL_SHUFFLE_SEED")
         queue = StealQueue(
             windows,
-            shuffle_seed=int(shuffle_env) if shuffle_env else None)
-        deadline_env = os.environ.get("MRI_WINDOW_DEADLINE_S")
-        window_deadline_s = float(deadline_env) if deadline_env else None
-        respawns_left = max(0, int(os.environ.get("MRI_WORKER_RESPAWNS",
-                                                  "1")))
+            shuffle_seed=envknobs.get("MRI_STEAL_SHUFFLE_SEED"))
+        window_deadline_s = envknobs.get("MRI_WINDOW_DEADLINE_S")
+        respawns_left = max(0, envknobs.get("MRI_WORKER_RESPAWNS"))
 
         # Per-worker arena rings, recycled across run() calls like the
         # single-worker path's ring (invalidated when K or depth moves,
@@ -1429,8 +1427,7 @@ class InvertedIndexModel:
                     timer.count("resumed_from_window", resume_from)
         # test hook: simulate the round-3 on-chip TPU worker crash
         # (SCALE_r03.json) at a deterministic stream position
-        crash_after = int(os.environ.get(
-            "MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", 0))
+        crash_after = envknobs.get("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS")
         total_windows = -(-len(manifest) // cfg.stream_chunk_docs)
         ckpt_seconds, ckpt_saves = 0.0, 0
         ckpt_ms_per_save: list[float] = []
@@ -1449,9 +1446,9 @@ class InvertedIndexModel:
         # always has a checkpoint at most stretch+1 cadence intervals
         # old.  The rate re-calibrates from every save actually
         # measured (so a fast local link stops skipping).
-        ckpt_budget_s = float(os.environ.get("MRI_TPU_CKPT_BUDGET_S", 120))
-        ckpt_rate_mbps = float(os.environ.get("MRI_TPU_CKPT_LINK_MBPS", 8.0))
-        ckpt_stretch = int(os.environ.get("MRI_TPU_CKPT_STRETCH", 4))
+        ckpt_budget_s = envknobs.get("MRI_TPU_CKPT_BUDGET_S")
+        ckpt_rate_mbps = envknobs.get("MRI_TPU_CKPT_LINK_MBPS")
+        ckpt_stretch = envknobs.get("MRI_TPU_CKPT_STRETCH")
         ckpt_consec_skips = 0
 
         profile = _profile_ctx(cfg.profile_dir)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -20,9 +21,12 @@ from pathlib import Path
 
 import numpy as np
 
+from ..utils import envknobs
+
 _SRC = Path(__file__).parent / "tokenizer.cc"
 _lib = None
 _lib_error: str | None = None
+_lib_variant: str | None = None
 
 
 class _TokenizeResult(ctypes.Structure):
@@ -101,15 +105,39 @@ def _build_dirs():
 # plain -O3 is within noise for this workload.
 _CXX_FLAGS = ["-O3", "-shared", "-fPIC"]
 
+#: MRI_NATIVE_SANITIZE selects a hardened build variant; sanitized .so
+#: names carry the variant in their stem so an ASan build can never
+#: shadow (or be pruned by) the production library.  Loading the asan
+#: variant into CPython needs LD_PRELOAD=libasan.so (see Makefile
+#: test-native-asan); ubsan links its runtime via DT_NEEDED.
+_SANITIZE_FLAGS = {
+    "": [],
+    "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"],
+    "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+              "-g"],
+}
 
-def _prune_stale(d: Path, keep: str) -> None:
-    """Drop hashed builds other than ``keep`` (and orphaned .tmp files)
-    from a build dir — every source edit otherwise leaves a dead ~100 KB
-    artifact behind forever.  Best-effort: a concurrent process may hold
-    an old .so open; unlink still works on POSIX, and failures are
-    ignored."""
+#: exact-name pattern per variant: the production glob
+#: ``libmri_tokenizer_*`` would otherwise match (and prune) the
+#: sanitizer-suffixed builds too
+_SO_NAME_RE = {
+    "": re.compile(r"libmri_tokenizer_[0-9a-f]{12}\.so\Z"),
+    "asan": re.compile(r"libmri_tokenizer_asan_[0-9a-f]{12}\.so\Z"),
+    "ubsan": re.compile(r"libmri_tokenizer_ubsan_[0-9a-f]{12}\.so\Z"),
+}
+
+
+def _prune_stale(d: Path, keep: str, variant: str = "") -> None:
+    """Drop hashed builds of ``variant`` other than ``keep`` (and
+    orphaned .tmp files of any variant) from a build dir — every source
+    edit otherwise leaves a dead ~100 KB artifact behind forever.
+    Other variants' current builds are left alone.  Best-effort: a
+    concurrent process may hold an old .so open; unlink still works on
+    POSIX, and failures are ignored."""
+    name_re = _SO_NAME_RE[variant]
     try:
-        stale = [p for p in d.glob("libmri_tokenizer_*.so") if p.name != keep]
+        stale = [p for p in d.glob("libmri_tokenizer_*.so")
+                 if p.name != keep and name_re.match(p.name)]
         stale += list(d.glob("libmri_tokenizer_*.tmp"))
     except OSError:
         return
@@ -120,25 +148,27 @@ def _prune_stale(d: Path, keep: str) -> None:
             pass
 
 
-def _compile() -> Path:
+def _compile(variant: str = "") -> Path:
+    flags = _CXX_FLAGS + _SANITIZE_FLAGS[variant]
     src = _SRC.read_bytes()
-    tag = hashlib.md5(src + " ".join(_CXX_FLAGS).encode()).hexdigest()[:12]
-    name = f"libmri_tokenizer_{tag}.so"
+    tag = hashlib.md5(src + " ".join(flags).encode()).hexdigest()[:12]
+    stem = "libmri_tokenizer" + (f"_{variant}" if variant else "")
+    name = f"{stem}_{tag}.so"
     last_err: Exception | None = None
     for d in _build_dirs():
         so = d / name
         if so.exists():
-            _prune_stale(d, name)
+            _prune_stale(d, name, variant)
             return so
         try:
             d.mkdir(parents=True, exist_ok=True)
             tmp = so.with_suffix(f".{os.getpid()}.tmp")
             subprocess.run(
-                ["g++", *_CXX_FLAGS, "-o", str(tmp), str(_SRC)],
+                ["g++", *flags, "-o", str(tmp), str(_SRC)],
                 check=True, capture_output=True, timeout=120,
             )
             os.replace(tmp, so)
-            _prune_stale(d, name)
+            _prune_stale(d, name, variant)
             return so
         except (OSError, subprocess.SubprocessError) as e:
             last_err = e
@@ -151,12 +181,19 @@ def load_error() -> str | None:
 
 
 def load():
-    """The compiled library, or None (with the reason cached)."""
-    global _lib, _lib_error
+    """The compiled library, or None (with the reason cached).
+
+    The MRI_NATIVE_SANITIZE variant is re-read on every call; flipping
+    it invalidates the cached handle so a test process can opt into
+    the sanitized build it was launched for."""
+    global _lib, _lib_error, _lib_variant
+    variant = envknobs.get("MRI_NATIVE_SANITIZE")
+    if variant != _lib_variant:
+        _lib, _lib_error, _lib_variant = None, None, variant
     if _lib is not None or _lib_error is not None:
         return _lib
     try:
-        lib = ctypes.CDLL(str(_compile()))
+        lib = ctypes.CDLL(str(_compile(variant)))
         lib.mri_tokenize.restype = ctypes.POINTER(_TokenizeResult)
         lib.mri_tokenize.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,

@@ -34,6 +34,7 @@ from jax import lax
 
 from . import keys as K
 from .segment import compact, first_occurrence_mask, sorted_segment_counts
+from ..utils import envknobs
 
 
 def _quiet_donation(fn):
@@ -62,7 +63,7 @@ def _quiet_donation(fn):
 #   "auto"  — compiled kernel on TPU, XLA elsewhere (default)
 #   "force" — always (interpret mode off-TPU; used by tests)
 #   "off"   — XLA everywhere
-_PALLAS_MODE = os.environ.get("MRI_TPU_PALLAS", "auto")
+_PALLAS_MODE = envknobs.get("MRI_TPU_PALLAS")
 
 
 def _dedup_mask(keys_s, valid_limit: int):

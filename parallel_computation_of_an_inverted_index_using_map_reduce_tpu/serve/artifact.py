@@ -171,6 +171,7 @@ def _write(path, buf: np.ndarray, *, width: int, vocab: int,
     buf[:HEADER_BYTES] = np.frombuffer(header, dtype=np.uint8)
 
     tmp = path.with_name(path.name + ".tmp")
+    # mrilint: allow(fault-boundary) atomic tmp+rename publish; a crash leaves only the .tmp
     with open(tmp, "wb") as f:
         f.write(memoryview(buf))
     os.replace(tmp, path)

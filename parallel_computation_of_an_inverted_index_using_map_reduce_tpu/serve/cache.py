@@ -29,11 +29,11 @@ class LRUCache:
         if capacity < 0:
             raise ValueError(f"cache capacity must be >= 0, got {capacity}")
         self.capacity = capacity
-        self._data: OrderedDict = OrderedDict()
+        self._data: OrderedDict = OrderedDict()  # guarded by: self._lock
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self.hits = 0        # guarded by: self._lock
+        self.misses = 0      # guarded by: self._lock
+        self.evictions = 0   # guarded by: self._lock
 
     def get(self, key, default=None):
         with self._lock:

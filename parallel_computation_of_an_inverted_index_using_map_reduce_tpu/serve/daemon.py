@@ -71,6 +71,7 @@ import threading
 import time
 
 from .. import faults
+from ..utils import envknobs
 from .artifact import ArtifactError
 from .engine import create_engine
 
@@ -90,23 +91,6 @@ DATA_OPS = ("df", "postings", "and", "or", "top_k")
 ADMIN_OPS = ("stats", "healthz", "reload")
 
 _SENTINEL = object()
-
-
-def _env(name: str, default, cast, minimum, exclusive: bool = False):
-    """One env knob: invalid values raise a one-line ValueError naming
-    the variable (the CLI maps it to exit 2), like RetryPolicy.from_env."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        val = cast(raw)
-    except ValueError:
-        raise ValueError(
-            f"{name}={raw!r} is not a valid {cast.__name__}") from None
-    if val < minimum or (exclusive and val == minimum):
-        bound = f"> {minimum}" if exclusive else f">= {minimum}"
-        raise ValueError(f"{name} must be {bound}, got {raw!r}")
-    return val
 
 
 class _Request:
@@ -142,7 +126,7 @@ class _Conn:
         self.addr = addr
         self.outbound: queue.Queue = queue.Queue(maxsize=OUTBOUND_DEPTH)
         self.lock = threading.Lock()
-        self.pending = 0          # admitted, response not yet enqueued
+        self.pending = 0  # admitted, not yet enqueued  # guarded by: self.lock
         self.read_eof = False
         self.dead = False
         self.reader_done = False
@@ -214,22 +198,22 @@ class ServeDaemon:
         self._cache_terms = cache_terms
         self._shards = shards
         self.coalesce_us = coalesce_us if coalesce_us is not None \
-            else _env(COALESCE_ENV, 200, int, 0)
+            else envknobs.get(COALESCE_ENV)
         self.queue_depth = queue_depth if queue_depth is not None \
-            else _env(QUEUE_ENV, 1024, int, 1)
+            else envknobs.get(QUEUE_ENV)
         self.max_batch = max_batch if max_batch is not None \
-            else _env(BATCH_ENV, 1024, int, 1)
+            else envknobs.get(BATCH_ENV)
         self.drain_s = drain_s if drain_s is not None \
-            else _env(DRAIN_ENV, 5.0, float, 0, exclusive=True)
+            else envknobs.get(DRAIN_ENV)
 
-        self._engine = create_engine(path, engine, cache_terms=cache_terms,
-                                     shards=shards)
         self._engine_lock = threading.Lock()
         self._reload_lock = threading.Lock()
+        self._engine = create_engine(path, engine, cache_terms=cache_terms,
+                                     shards=shards)  # guarded by: self._engine_lock
         self._queue: queue.Queue = queue.Queue(maxsize=self.queue_depth)
-        self._inflight = 0        # admitted minus finished
-        self._seq = 0             # global data-request ordinal (faults)
-        self._counts = {
+        self._inflight = 0  # admitted minus finished  # guarded by: self._count_lock
+        self._seq = 0  # data-request ordinal (faults)  # guarded by: self._count_lock
+        self._counts = {  # guarded by: self._count_lock
             "requests": 0, "responses": 0, "shed": 0,
             "deadline_expired": 0, "draining_rejected": 0,
             "bad_request": 0, "internal_errors": 0,
@@ -238,10 +222,10 @@ class ServeDaemon:
             "batches": 0, "batched_requests": 0, "connections": 0,
         }
         self._count_lock = threading.Lock()
-        self._conns: set[_Conn] = set()
+        self._conns: set[_Conn] = set()  # guarded by: self._conn_lock
         self._conn_lock = threading.Lock()
         self._draining = False
-        self._drain_started = False
+        self._drain_started = False  # guarded by: self._drain_guard
         self._drain_guard = threading.Lock()
         self._drained = threading.Event()
         self._dispatch_stop = threading.Event()
@@ -255,6 +239,7 @@ class ServeDaemon:
     # -- lifecycle ----------------------------------------------------
 
     def start(self) -> None:
+        # mrilint: allow(fault-boundary) serving plane; faults.py hooks cover the index build path
         ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         ls.bind((self._host, self._port))
@@ -269,6 +254,7 @@ class ServeDaemon:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="mri-serve-accept", daemon=True)
         self._accept_thread.start()
+        # mrilint: allow(guarded-by) no reload can race start()
         log.info("serving %s on %s:%d (engine=%s coalesce_us=%d "
                  "queue_depth=%d max_batch=%d)", self._path, self._host,
                  self._port, self._engine.engine_name, self.coalesce_us,
@@ -313,6 +299,7 @@ class ServeDaemon:
     def _reader_loop(self, conn: _Conn) -> None:
         f = None
         try:
+            # mrilint: allow(fault-boundary) serving plane; client disconnects are handled right here
             f = conn.sock.makefile("rb")
             for raw in f:
                 self._handle_line(conn, raw)
@@ -641,14 +628,17 @@ class ServeDaemon:
         if not self._drained.is_set():
             with self._reload_lock:
                 try:
+                    # mrilint: allow(guarded-by) serialized by _reload_lock
                     engine = self._engine.describe()
                 except Exception:  # racing a drain's engine close
                     engine = {}
+        with self._conn_lock:
+            connections = len(self._conns)
         return {
             "queue_depth": self._queue.qsize(),
             "inflight": inflight,
             "draining": self._draining,
-            "connections": len(self._conns),
+            "connections": connections,
             "counters": counters,
             "engine": engine,
             "config": {

@@ -54,6 +54,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.compat import shard_map
 from ..parallel.mesh import SHARD_AXIS, make_mesh
+from ..utils import envknobs
 
 #: pad value in posting windows: larger than any doc id (guarded at
 #: load), so sentinel lanes sort after every real doc.
@@ -63,8 +64,7 @@ SHARDS_ENV = "MRI_SERVE_SHARDS"
 #: soft cap on decode-window elements per call (B * W); oversize
 #: batches loop in bucket-sized chunks instead of materializing one
 #: giant (B, W) window.
-DECODE_BUDGET_ENV = "MRI_SERVE_DEVICE_DECODE_BUDGET"
-_DEFAULT_DECODE_BUDGET = 1 << 24
+DECODE_BUDGET_ENV = "MRI_SERVE_DEVICE_DECODE_BUDGET"  # default: envknobs
 
 #: smallest per-shard batch bucket: tiny batches all share one compile.
 _MIN_LANES = 8
@@ -215,13 +215,12 @@ class DeviceEngine:
         self._h_letter_dir = cols["letter_dir"]
 
         if shards is None:
-            env = os.environ.get(SHARDS_ENV)
-            shards = int(env) if env else None
+            shards = envknobs.get(SHARDS_ENV)
         self._mesh = make_mesh(shards)
         self._num_shards = self._mesh.devices.size
         self._decode_budget = int(
             decode_budget if decode_budget is not None
-            else os.environ.get(DECODE_BUDGET_ENV, _DEFAULT_DECODE_BUDGET))
+            else envknobs.get(DECODE_BUDGET_ENV))
 
         rep = NamedSharding(self._mesh, P())
         put = lambda a: jax.device_put(a, rep)  # noqa: E731

@@ -21,6 +21,7 @@ import numpy as np
 
 from . import artifact as artifact_mod
 from .cache import LRUCache
+from ..utils import envknobs
 
 
 class OpTimer:
@@ -267,7 +268,7 @@ ENGINE_ENV = "MRI_SERVE_ENGINE"
 
 def resolve_engine(engine: str | None = None) -> str:
     """``host``/``device``/``auto``(+ env override) -> concrete name."""
-    engine = engine or os.environ.get(ENGINE_ENV) or "auto"
+    engine = engine or envknobs.get(ENGINE_ENV) or "auto"
     if engine not in ENGINE_CHOICES:
         raise ValueError(
             f"unknown engine {engine!r} (choices: {ENGINE_CHOICES})")

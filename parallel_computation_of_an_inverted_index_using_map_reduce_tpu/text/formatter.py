@@ -17,6 +17,7 @@ from pathlib import Path
 import numpy as np
 
 from ..config import ALPHABET_SIZE
+from ..utils import envknobs
 
 
 def letter_filename(letter_index: int) -> str:
@@ -33,6 +34,7 @@ def _write_letter_atomic(path: Path, payload: bytes) -> None:
     file that parses as a smaller-but-plausible index (matches the
     native emit core's write discipline)."""
     tmp = path.with_name(path.name + ".tmp")
+    # mrilint: allow(fault-boundary) atomic tmp+rename publish; a crash leaves only the .tmp
     with open(tmp, "wb") as f:
         f.write(payload)
     os.replace(tmp, path)
@@ -43,8 +45,8 @@ def _maybe_kill_after(letters_done: int) -> None:
     # N complete letter files, die without unwinding (SIGKILL — no
     # flush, no atexit), so the test observes exactly what a hard crash
     # leaves on disk.
-    target = os.environ.get("MRI_EMIT_KILL_AFTER_LETTERS")
-    if target is not None and letters_done == int(target):
+    target = envknobs.get("MRI_EMIT_KILL_AFTER_LETTERS")
+    if target is not None and letters_done == target:
         import signal
 
         os.kill(os.getpid(), signal.SIGKILL)

@@ -1,0 +1,328 @@
+"""Single declaration point for every ``MRI_*`` environment knob.
+
+Every knob the package, the benches, and the tools read from the
+environment is declared here once — name, type, default, bounds, and a
+help line — and read through :func:`get`.  Invalid values raise a
+one-line :class:`KnobError` (a ``ValueError``) naming the variable, so
+every CLI surface maps it to exit 2 instead of surfacing a bare
+``int()`` traceback three layers down a worker thread.
+
+The ``mrilint`` env-knobs checker rejects raw ``os.environ["MRI_*"]``
+reads anywhere else, and the readme-knobs checker keeps the README
+table in sync with :func:`markdown_table`.  This module is
+deliberately stdlib-only and free of package-relative imports so the
+linter can load it standalone (no jax import) via its file path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Iterator
+
+
+class KnobError(ValueError):
+    """One-line validation error naming the knob (CLI exit 2)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    cast: Callable[[str], Any]
+    default: Any
+    help: str
+    scope: str = "build"
+    minimum: Any = None
+    exclusive: bool = False
+    choices: tuple | None = None
+
+    def parse(self, raw: str) -> Any:
+        """Cast + validate ``raw``; one-line :class:`KnobError` on bad."""
+        try:
+            val = self.cast(raw)
+        except ValueError:
+            raise KnobError(
+                f"{self.name}={raw!r} is not a valid "
+                f"{self.cast.__name__}") from None
+        if self.choices is not None and val not in self.choices:
+            raise KnobError(
+                f"{self.name}={raw!r} not in {self.choices}")
+        if self.minimum is not None and (
+                val < self.minimum
+                or (self.exclusive and val == self.minimum)):
+            bound = (f"> {self.minimum}" if self.exclusive
+                     else f">= {self.minimum}")
+            raise KnobError(f"{self.name} must be {bound}, got {raw!r}")
+        return val
+
+
+_REGISTRY: dict[str, Knob] = {}
+
+
+def declare(name: str, cast: Callable[[str], Any], default: Any,
+            help: str, *, scope: str = "build", minimum: Any = None,
+            exclusive: bool = False, choices: tuple | None = None) -> Knob:
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    knob = Knob(name=name, cast=cast, default=default, help=help,
+                scope=scope, minimum=minimum, exclusive=exclusive,
+                choices=choices)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def get(name: str) -> Any:
+    """The knob's parsed value from the environment, or its default.
+
+    ``KeyError`` on an undeclared name is a programming error, caught
+    by the env-knobs lint rule before it ships.
+    """
+    knob = _REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default
+    return knob.parse(raw)
+
+
+def is_set(name: str) -> bool:
+    """Whether the (declared) knob is present in the environment."""
+    _ = _REGISTRY[name]
+    return name in os.environ
+
+
+def knobs() -> Iterator[Knob]:
+    """All declared knobs, sorted by (scope, name)."""
+    return iter(sorted(_REGISTRY.values(),
+                       key=lambda k: (k.scope, k.name)))
+
+
+_SCOPE_TITLES = {
+    "build": "Build / index pipeline",
+    "faults": "Fault injection & retries",
+    "serve": "Query serving",
+    "bench": "Benchmarks",
+    "test": "Test hooks",
+}
+
+
+def markdown_table() -> str:
+    """The README env-knob table (kept in sync by the lint rule)."""
+    out: list[str] = []
+    scope = None
+    for k in knobs():
+        if k.scope != scope:
+            scope = k.scope
+            if out:
+                out.append("")
+            out.append(f"**{_SCOPE_TITLES.get(scope, scope)}**")
+            out.append("")
+            out.append("| Knob | Type | Default | Meaning |")
+            out.append("|---|---|---|---|")
+        if k.default is None:
+            default = "unset"
+        elif k.default == "":
+            default = "`\"\"`"
+        else:
+            default = f"`{k.default}`"
+        constraint = ""
+        if k.choices is not None:
+            constraint = " one of " + "/".join(
+                f"`{c}`" if c != "" else "`\"\"`" for c in k.choices)
+        elif k.minimum is not None:
+            op = ">" if k.exclusive else ">="
+            constraint = f" ({op} {k.minimum})"
+        out.append(f"| `{k.name}` | {k.cast.__name__}{constraint} "
+                   f"| {default} | {k.help} |")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------
+# Declarations.  Scopes: build (index pipeline), faults, serve, bench,
+# test (crash-injection hooks the e2e suite arms in subprocesses).
+# ---------------------------------------------------------------------
+
+# -- build / index pipeline -------------------------------------------
+declare("MRI_CPU_WINDOW_BYTES", int, None,
+        "Bytes per host scan window (default: the backend's ~2 MB); "
+        "tests force tiny values for deterministic multi-window plans.")
+declare("MRI_STEAL_SHUFFLE_SEED", int, None,
+        "Seeded shuffle of the steal queue's window order (unset: "
+        "manifest order).")
+declare("MRI_WINDOW_DEADLINE_S", float, None,
+        "Per-window watchdog deadline; a wedged worker past it is "
+        "abandoned and its window requeued (unset: no watchdog).")
+declare("MRI_WORKER_RESPAWNS", int, 1,
+        "Scan-worker respawn budget after crashes (clamped to >= 0).")
+declare("MRI_TPU_PALLAS", str, "auto",
+        "Fused Pallas dedup kernel: auto (TPU only), force "
+        "(interpret mode off-TPU), off (XLA everywhere).")
+declare("MRI_TPU_CKPT_BUDGET_S", float, 120.0,
+        "Snapshot-tax budget: a projected checkpoint save slower than "
+        "this is skipped (recorded, not paid).")
+declare("MRI_TPU_CKPT_LINK_MBPS", float, 8.0,
+        "Assumed device->host link rate seeding the checkpoint cost "
+        "projection (re-calibrated from measured saves).")
+declare("MRI_TPU_CKPT_STRETCH", int, 4,
+        "Max consecutive over-budget checkpoint skips before one save "
+        "is forced.")
+declare("MRI_NATIVE_SANITIZE", str, "",
+        "Native tokenizer build variant: \"\" (production), asan, or "
+        "ubsan — sanitized builds get suffix-tagged .so names.",
+        choices=("", "asan", "ubsan"))
+
+# -- fault injection & retries ----------------------------------------
+declare("MRI_FAULTS", str, None,
+        "Fault-injection spec armed at first faults.active() call "
+        "(subprocess arming); same grammar as --fault-spec.",
+        scope="faults")
+declare("MRI_READ_RETRIES", int, 3,
+        "Read attempts per document (counts the first try).",
+        scope="faults", minimum=1)
+declare("MRI_READ_BACKOFF_MS", float, 5.0,
+        "Initial retry backoff in milliseconds (doubles per retry).",
+        scope="faults", minimum=0)
+declare("MRI_READ_DEADLINE_S", float, 1.0,
+        "Total per-document retry deadline in seconds.",
+        scope="faults", minimum=0, exclusive=True)
+
+# -- query serving ----------------------------------------------------
+declare("MRI_SERVE_ENGINE", str, None,
+        "Engine when 'mri query' gets no --engine flag: host, device, "
+        "or auto (validated by serve.engine.resolve_engine).",
+        scope="serve")
+declare("MRI_SERVE_SHARDS", int, None,
+        "Device-engine shard count (unset: all visible devices).",
+        scope="serve")
+declare("MRI_SERVE_DEVICE_DECODE_BUDGET", int, 1 << 24,
+        "Max postings rows the device engine decodes per batch tier.",
+        scope="serve")
+declare("MRI_SERVE_COALESCE_US", int, 200,
+        "Daemon micro-batch coalescing window in microseconds "
+        "(0: dispatch immediately).",
+        scope="serve", minimum=0)
+declare("MRI_SERVE_QUEUE_DEPTH", int, 1024,
+        "Daemon admission queue depth; requests past it are shed as "
+        "'overloaded'.",
+        scope="serve", minimum=1)
+declare("MRI_SERVE_MAX_BATCH", int, 1024,
+        "Max coalesced requests dispatched as one engine batch.",
+        scope="serve", minimum=1)
+declare("MRI_SERVE_DRAIN_S", float, 5.0,
+        "Graceful-drain deadline after SIGTERM/SIGINT before inflight "
+        "requests are abandoned.",
+        scope="serve", minimum=0, exclusive=True)
+
+# -- benchmarks -------------------------------------------------------
+declare("MRI_TPU_BENCH_ATTEMPTS", int, 3,
+        "Attempts per bench probe before recording a failure.",
+        scope="bench")
+declare("MRI_TPU_BENCH_TIMEOUTS", str, "480,300,240",
+        "Comma list of per-attempt bench timeouts in seconds.",
+        scope="bench")
+declare("MRI_TPU_BENCH_CORPUS", str, None,
+        "Corpus directory override for the e2e bench legs.",
+        scope="bench")
+declare("MRI_TPU_BENCH_PLATFORM", str, None,
+        "Force a JAX platform for bench subprocesses (e.g. cpu).",
+        scope="bench")
+declare("MRI_TPU_BENCH_PROBE_S", int, 75,
+        "SIGALRM deadline for the e2e bench probe.", scope="bench")
+declare("MRI_TPU_GRID_PROBE_S", int, 240,
+        "SIGALRM deadline for the (mappers, reducers) grid probe.",
+        scope="bench")
+declare("MRI_TPU_KERNEL_PROBE_S", int, 90,
+        "SIGALRM deadline for the Pallas kernel probe.", scope="bench")
+declare("MRI_TPU_DEVTOK_PROBE_S", int, 240,
+        "SIGALRM deadline for the device-tokenizer probe.",
+        scope="bench")
+declare("MRI_TPU_BENCH_ATTEST", str, None,
+        "Attestation file path (default: BENCH_ATTEST.json next to "
+        "bench.py).", scope="bench")
+declare("MRI_TPU_SCALE_PLATFORM", str, None,
+        "Force a JAX platform for the scale bench.", scope="bench")
+declare("MRI_TPU_SCALE_DOCS", int, 1_000_000,
+        "Synthetic corpus size for the scale bench.", scope="bench")
+declare("MRI_TPU_SCALE_VOCAB", int, 100_000,
+        "Synthetic vocabulary size for the scale bench.", scope="bench")
+declare("MRI_TPU_SCALE_SHARDS", int, 0,
+        "Scale-bench shard count (0: all devices).", scope="bench")
+declare("MRI_TPU_SCALE_DEVTOK", int, 0,
+        "1: scale bench runs the device-tokenizer streaming path.",
+        scope="bench")
+declare("MRI_TPU_SCALE_REALTEXT", int, 0,
+        "1: synthesize Zipf-ish real-looking text instead of uniform "
+        "tokens.", scope="bench")
+declare("MRI_TPU_SCALE_SALT", int, 1,
+        "1: salt the synthetic corpus per repeat (defeats caching).",
+        scope="bench")
+declare("MRI_TPU_SCALE_REPEATS", int, 8,
+        "Timed repeats per scale-bench configuration.", scope="bench")
+declare("MRI_TPU_SCALE_CHUNK", int, 100_000,
+        "Docs per streamed chunk in the scale bench.", scope="bench")
+declare("MRI_TPU_SCALE_CKPT", str, None,
+        "Checkpoint directory for the devtok scale leg (unset: no "
+        "checkpointing).", scope="bench")
+declare("MRI_TPU_SCALE_CKPT_EVERY", int, 2,
+        "Checkpoint cadence in chunks for the devtok scale leg.",
+        scope="bench")
+declare("MRI_TPU_SCALE_SKEW", str, None,
+        "Truthy: report per-letter skew for the realtext corpus.",
+        scope="bench")
+declare("MRI_TPU_SCALE_CROSSCHECK", str, None,
+        "Truthy: cross-check scale-bench output against the oracle.",
+        scope="bench")
+declare("MRI_BENCH_SWEEP_WORKERS", str, "1,2,4",
+        "Comma list of worker counts for the host sweep.",
+        scope="bench")
+declare("MRI_SERVE_BATCHES", str, "1,32,1024",
+        "Comma list of batch sizes for the serve bench.",
+        scope="bench")
+declare("MRI_SERVE_AB_BATCHES", str, "1,1024,8192,65536",
+        "Comma list of batch sizes for the host/device A/B leg.",
+        scope="bench")
+declare("MRI_SERVE_LOOKUPS", int, 200_000,
+        "Total single-term lookups per serve-bench batch size.",
+        scope="bench")
+declare("MRI_SERVE_AB_MAX_BATCHES", int, 256,
+        "Per-batch-size cap on timed batches in A/B mode.",
+        scope="bench")
+declare("MRI_SERVE_ZIPF_S", float, 1.1,
+        "Zipf exponent of the serve-bench term-popularity draw.",
+        scope="bench")
+declare("MRI_SERVE_SEED", int, 17,
+        "RNG seed for serve-bench workloads.", scope="bench")
+declare("MRI_SERVE_OPEN_SECONDS", float, 3.0,
+        "Per-leg duration of the open-loop serve bench.",
+        scope="bench")
+declare("MRI_DAEMON_PIPELINE_N", int, 60_000,
+        "Requests in the daemon pipelined capacity probe.",
+        scope="bench")
+declare("MRI_DAEMON_CLOSED_N", int, 3_000,
+        "RPCs in the daemon closed-loop latency leg.", scope="bench")
+declare("MRI_DAEMON_OPEN_SECONDS", float, 2.0,
+        "Per-leg duration of the daemon open-loop bench.",
+        scope="bench")
+declare("MRI_DAEMON_DEADLINE_MS", float, 25.0,
+        "deadline_ms carried by every open-loop bench request.",
+        scope="bench")
+declare("MRI_DAEMON_LOAD_FACTORS", str, "0.4,0.8,1.6",
+        "Comma list of offered-load multipliers over measured "
+        "capacity.", scope="bench")
+declare("MRI_DAEMON_WINDOW", int, 512,
+        "In-flight window of the daemon pipelined probe.",
+        scope="bench")
+declare("MRI_DAEMON_OPEN_WINDOW", int, 2400,
+        "Max in-flight requests in the daemon open-loop bench.",
+        scope="bench")
+
+# -- test hooks -------------------------------------------------------
+declare("MRI_EMIT_KILL_AFTER_LETTERS", int, None,
+        "Crash hook: SIGKILL the process after N complete letter "
+        "files (kill-mid-emit durability test).", scope="test")
+declare("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", int, 0,
+        "Crash hook: die at a deterministic device-stream position "
+        "(0: disabled).", scope="test")
+declare("MRI_TPU_TESTS_ON_TPU", str, "",
+        "Truthy: run the test suite against the real chip instead of "
+        "the forced-CPU default.", scope="test")

@@ -1,0 +1,14 @@
+"""Planted unwrapped-raise violation (filename ends in cli.py on purpose:
+the raise rule only applies to CLI entry-point files)."""
+
+
+def main(argv):
+    if not argv:
+        raise ValueError("no args")  # violation: escapes as exit 1
+    if argv[0] == "usage":
+        raise SystemExit(2)  # clean: maps onto the contract
+    return 0
+
+
+def helper(x):
+    raise RuntimeError(x)  # clean: not an entry point

@@ -1,0 +1,8 @@
+"""Planted env-knobs violations: three raw reads; the write is legal."""
+import os
+
+chunk = os.environ.get("MRI_FIXTURE_CHUNK", "4")      # violation: .get()
+flag = os.environ["MRI_FIXTURE_FLAG"]                 # violation: subscript
+present = "MRI_FIXTURE_FLAG" in os.environ            # violation: membership
+os.environ["MRI_FIXTURE_CHILD"] = "1"                 # clean: write for a child
+other = os.environ.get("PATH", "")                    # clean: not an MRI_* knob

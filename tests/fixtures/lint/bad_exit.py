@@ -1,0 +1,10 @@
+"""Planted exit-code violation: exit 1 is reserved by the contract."""
+import sys
+
+
+def main(argv):
+    if not argv:
+        sys.exit(1)  # violation: 1 is outside the 0/2/3 contract
+    if argv[0] == "bad":
+        raise SystemExit(2)  # clean: sanctioned usage-error exit
+    return 0

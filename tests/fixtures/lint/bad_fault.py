@@ -1,0 +1,7 @@
+"""Planted fault-boundary violation: raw I/O with no hook or suppression.
+(The rule only fires for package files; the test rebinds the path.)"""
+
+
+def read_raw(path):
+    with open(path, "rb") as f:  # violation when inside the package
+        return f.read()

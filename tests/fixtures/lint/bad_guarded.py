@@ -1,0 +1,19 @@
+"""Planted guarded-by violation: one unguarded write, one clean read."""
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded by: self._lock
+
+    def bump(self):
+        self.value += 1  # violation: write without the lock
+
+    def read_locked(self):
+        with self._lock:
+            return self.value  # clean: lock held
+
+    # mrilint: holds(self._lock)
+    def _bump_locked(self):
+        self.value += 1  # clean: helper documents the caller holds it

@@ -1,0 +1,24 @@
+"""Planted lifecycle violations: dropped and leaked handles."""
+
+
+def read_chained(path):
+    return open(path).read()  # violation: handle dropped after chained read
+
+
+def leak_handle(path):
+    f = open(path, "rb")  # violation: never closed on any path
+    f.read()
+    return None
+
+
+def read_managed(path):
+    with open(path, "rb") as f:  # clean: context-managed
+        return f.read()
+
+
+def read_finally(path):
+    f = open(path, "rb")  # clean: closed in a finally
+    try:
+        return f.read()
+    finally:
+        f.close()

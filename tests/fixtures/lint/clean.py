@@ -1,0 +1,26 @@
+"""Clean fixture: every rule satisfied, plus one reasoned suppression."""
+import os
+import threading
+
+suppressed = os.environ.get("MRI_FIXTURE_OK", "")  # mrilint: allow(env-knobs) fixture demonstrates suppression
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # guarded by: self._lock
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+def read_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def main(argv):
+    if not argv:
+        raise SystemExit(2)
+    return 0

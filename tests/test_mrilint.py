@@ -1,0 +1,132 @@
+"""mrilint suite: checker semantics on planted fixtures, suppression and
+baseline mechanics, and the repo-clean gate (`make lint` exit 0)."""
+import subprocess
+import sys
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tools.mrilint.core import (  # noqa: E402
+    PACKAGE,
+    REPO_ROOT,
+    Source,
+    iter_files,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from tools.mrilint.checks import (  # noqa: E402
+    CHECKERS,
+    env_knobs,
+    exit_codes,
+    fault_boundary,
+    guarded_by,
+    lifecycle,
+)
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+
+
+def _check(module, name):
+    return module.check(Source(FIXTURES / name))
+
+
+# -- checker semantics on planted fixtures ---------------------------------
+
+def test_guarded_by_flags_unlocked_write_only():
+    findings = _check(guarded_by, "bad_guarded.py")
+    assert [f.key for f in findings] == ["SharedCounter.value@bump"]
+    assert "with self._lock" in findings[0].message
+
+
+def test_env_knobs_flags_reads_not_writes():
+    findings = _check(env_knobs, "bad_env.py")
+    assert sorted(f.key for f in findings) == [
+        "MRI_FIXTURE_CHUNK@os.environ.get()",
+        "MRI_FIXTURE_FLAG@membership test",
+        "MRI_FIXTURE_FLAG@os.environ[...]",
+    ]
+
+
+def test_exit_code_flags_reserved_code():
+    findings = _check(exit_codes, "bad_exit.py")
+    assert [f.key for f in findings] == ["sys.exit(1)@main"]
+
+
+def test_exit_code_flags_unwrapped_raise_in_cli():
+    findings = _check(exit_codes, "bad_cli.py")
+    # only the entry point's ValueError; SystemExit(2) and the helper pass
+    assert [f.key for f in findings] == ["raise@main"]
+
+
+def test_lifecycle_flags_dropped_and_leaked_handles():
+    findings = _check(lifecycle, "bad_lifecycle.py")
+    assert sorted(f.key for f in findings) == [
+        "open@leak_handle", "open@read_chained"]
+
+
+def test_fault_boundary_scopes_to_package():
+    src = Source(FIXTURES / "bad_fault.py")
+    assert fault_boundary.check(src) == []  # outside the package: silent
+    src.rel = f"{PACKAGE}/corpus/bad_fault.py"
+    findings = fault_boundary.check(src)
+    assert [f.key for f in findings] == ["open@read_raw"]
+
+
+def test_clean_fixture_passes_every_checker():
+    src = Source(FIXTURES / "clean.py")
+    for checker in CHECKERS:
+        assert checker.check(src) == [], checker.__name__
+
+
+def test_suppression_comment_silences_env_knobs():
+    # clean.py reads MRI_FIXTURE_OK raw but carries an allow() comment
+    src = Source(FIXTURES / "clean.py")
+    assert "MRI_FIXTURE_OK" in src.text
+    assert env_knobs.check(src) == []
+
+
+# -- baseline mechanics ----------------------------------------------------
+
+def test_baseline_roundtrip_and_shrink_only(tmp_path):
+    path = tmp_path / "baseline.txt"
+    entries = Counter({"rule|a.py|k1": 2, "rule|b.py|k2": 1})
+    write_baseline(entries, path)
+    assert load_baseline(path) == entries
+    # pruning intersects with current findings — it can only shrink
+    current = Counter({"rule|a.py|k1": 1, "rule|c.py|new": 1})
+    write_baseline(entries & current, path)
+    assert load_baseline(path) == Counter({"rule|a.py|k1": 1})
+
+
+def test_cli_nonzero_on_fixtures():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mrilint", "--no-baseline",
+         str(FIXTURES)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for rule in ("guarded-by", "env-knobs", "exit-code", "lifecycle"):
+        assert f"[{rule}]" in proc.stdout
+
+
+# -- the repo-clean gate ---------------------------------------------------
+
+def test_repo_is_clean_against_baseline():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mrilint"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_baseline_entries_still_correspond_to_findings():
+    # every baseline line must match a live finding (stale entries are
+    # a failed shrink — prune with --update-baseline)
+    baseline = load_baseline()
+    current = Counter(f.baseline_key for f in run_lint(iter_files()))
+    stale = baseline - current
+    assert not stale, f"stale baseline entries: {sorted(stale)}"

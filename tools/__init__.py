@@ -1,0 +1,2 @@
+"""Repo tooling (benches, chaos harness, mrilint).  A real package so
+``python -m tools.mrilint`` resolves from the repo root."""

@@ -54,33 +54,32 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 import bench
+from bench import envknobs
 
 BATCH_SIZES = tuple(
-    int(b) for b in os.environ.get("MRI_SERVE_BATCHES", "1,32,1024").split(","))
+    int(b) for b in envknobs.get("MRI_SERVE_BATCHES").split(","))
 AB_BATCH_SIZES = tuple(
-    int(b) for b in os.environ.get(
-        "MRI_SERVE_AB_BATCHES", "1,1024,8192,65536").split(","))
+    int(b) for b in envknobs.get("MRI_SERVE_AB_BATCHES").split(","))
 #: total single-term lookups per batch size (split into batches)
-LOOKUPS = int(os.environ.get("MRI_SERVE_LOOKUPS", 200_000))
+LOOKUPS = envknobs.get("MRI_SERVE_LOOKUPS")
 #: per-batch-size cap on timed batches in A/B mode (keeps the batch-1
 #: leg of the slow engine from dominating the run; latency percentiles
 #: are insensitive past this)
-AB_MAX_BATCHES = int(os.environ.get("MRI_SERVE_AB_MAX_BATCHES", 256))
-ZIPF_S = float(os.environ.get("MRI_SERVE_ZIPF_S", 1.1))
-SEED = int(os.environ.get("MRI_SERVE_SEED", 17))
-OPEN_SECONDS = float(os.environ.get("MRI_SERVE_OPEN_SECONDS", 3.0))
+AB_MAX_BATCHES = envknobs.get("MRI_SERVE_AB_MAX_BATCHES")
+ZIPF_S = envknobs.get("MRI_SERVE_ZIPF_S")
+SEED = envknobs.get("MRI_SERVE_SEED")
+OPEN_SECONDS = envknobs.get("MRI_SERVE_OPEN_SECONDS")
 
 #: daemon-bench knobs: pipelined capacity-probe size, closed-loop rpc
 #: count, per-leg open-loop duration, the deadline_ms every open-loop
 #: request carries, and the offered-load multipliers applied to the
 #: measured coalesced capacity
-DAEMON_PIPELINE_N = int(os.environ.get("MRI_DAEMON_PIPELINE_N", 60_000))
-DAEMON_CLOSED_N = int(os.environ.get("MRI_DAEMON_CLOSED_N", 3_000))
-DAEMON_OPEN_SECONDS = float(os.environ.get("MRI_DAEMON_OPEN_SECONDS", 2.0))
-DAEMON_DEADLINE_MS = float(os.environ.get("MRI_DAEMON_DEADLINE_MS", 25.0))
+DAEMON_PIPELINE_N = envknobs.get("MRI_DAEMON_PIPELINE_N")
+DAEMON_CLOSED_N = envknobs.get("MRI_DAEMON_CLOSED_N")
+DAEMON_OPEN_SECONDS = envknobs.get("MRI_DAEMON_OPEN_SECONDS")
+DAEMON_DEADLINE_MS = envknobs.get("MRI_DAEMON_DEADLINE_MS")
 DAEMON_LOAD_FACTORS = tuple(
-    float(f) for f in os.environ.get(
-        "MRI_DAEMON_LOAD_FACTORS", "0.4,0.8,1.6").split(","))
+    float(f) for f in envknobs.get("MRI_DAEMON_LOAD_FACTORS").split(","))
 
 
 def _build_index() -> tuple[str, dict]:
@@ -399,12 +398,21 @@ class _DaemonReader:
         assert not self.thread.is_alive(), "reader wedged"
         assert self.error is None, f"reader failed: {self.error}"
 
+    def close(self):
+        # The makefile wrapper holds its own reference to the socket
+        # fd — closing only the socket leaks it (the conftest leak
+        # guard caught exactly this).
+        try:
+            self.f.close()
+        except OSError:
+            pass
+
 
 #: well-behaved pipelined client window: below the daemon's admission
 #: queue (so nothing sheds) and its outbound queue (so the slow-client
 #: defense never fires) while still giving the dispatcher hundreds of
 #: requests to coalesce per micro-batch
-DAEMON_WINDOW = int(os.environ.get("MRI_DAEMON_WINDOW", 512))
+DAEMON_WINDOW = envknobs.get("MRI_DAEMON_WINDOW")
 
 
 def _daemon_pipelined_qps(addr, lines: list[bytes]) -> dict:
@@ -419,6 +427,7 @@ def _daemon_pipelined_qps(addr, lines: list[bytes]) -> dict:
     sock = _socket.create_connection(addr, timeout=60)
     sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
     window = threading.Semaphore(DAEMON_WINDOW)
+    reader = None
     try:
         reader = _DaemonReader(sock, len(lines),
                                on_response=window.release)
@@ -439,6 +448,8 @@ def _daemon_pipelined_qps(addr, lines: list[bytes]) -> dict:
                 "wall_s": round(wall, 3)}
     finally:
         sock.close()
+        if reader is not None:
+            reader.close()
 
 
 def _daemon_closed_loop_qps(addr, lines: list[bytes]) -> dict:
@@ -473,7 +484,7 @@ def _daemon_closed_loop_qps(addr, lines: list[bytes]) -> dict:
 #: error responses cannot overflow the outbound queue into the
 #: slow-client close.  Requests the window delays are still measured
 #: from their scheduled arrival — client-side queueing is latency too.
-DAEMON_OPEN_WINDOW = int(os.environ.get("MRI_DAEMON_OPEN_WINDOW", 2400))
+DAEMON_OPEN_WINDOW = envknobs.get("MRI_DAEMON_OPEN_WINDOW")
 
 
 def _daemon_open_loop(addr, lines: list[bytes], rps: float,
@@ -493,6 +504,7 @@ def _daemon_open_loop(addr, lines: list[bytes], rps: float,
     sock = _socket.create_connection(addr, timeout=60)
     sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
     window = threading.Semaphore(DAEMON_OPEN_WINDOW)
+    reader = None
     try:
         reader = _DaemonReader(sock, n, on_response=window.release)
         t0 = time.perf_counter()
@@ -536,6 +548,8 @@ def _daemon_open_loop(addr, lines: list[bytes], rps: float,
         }
     finally:
         sock.close()
+        if reader is not None:
+            reader.close()
 
 
 def _daemon_bench(out_path: str | None) -> dict:

@@ -186,6 +186,7 @@ def run_soak(work_dir: Path, trials: int, seed_base: int,
     """The full soak: ``trials`` seeded trials cycled over PLAN_MATRIX.
     Returns a summary dict; ``summary["failures"]`` is empty iff every
     trial honored the fault-tolerance contract."""
+    # mrilint: allow(env-knobs) raw save/restore of the child-process env
     saved = os.environ.get("MRI_CPU_WINDOW_BYTES")
     os.environ["MRI_CPU_WINDOW_BYTES"] = str(_WINDOW_BYTES)
     try:

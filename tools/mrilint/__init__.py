@@ -1,0 +1,19 @@
+"""mrilint — repo-contract static analysis for this codebase.
+
+Five AST-based checkers enforce the contracts that were previously
+convention-only (see tools/mrilint/core.py for the runner and
+tools/mrilint/checks/ for the rules):
+
+- ``guarded-by``     lock-annotation discipline on shared classes
+- ``env-knobs``      all MRI_* env reads go through utils/envknobs.py
+- ``exit-code``      CLI exits use the 0/2/3 contract (1 is reserved)
+- ``lifecycle``      open()/socket/mmap are context-managed or closed
+- ``fault-boundary`` package I/O sites route through faults.py hooks
+- ``readme-knobs``   README env-knob table matches the registry
+
+Run ``python -m tools.mrilint`` (or ``make lint``).  Findings are
+compared against the checked-in ``baseline.txt`` which may only
+shrink; suppress a deliberate violation in place with
+``# mrilint: allow(<rule>) reason``.
+"""
+from .core import main, run_lint  # noqa: F401

@@ -1,0 +1,12 @@
+"""Checker registry.  Each module exposes ``check(src) -> list[Finding]``."""
+from . import (  # noqa: F401
+    env_knobs,
+    exit_codes,
+    fault_boundary,
+    guarded_by,
+    lifecycle,
+    readme_knobs,
+)
+
+#: per-file checkers, run in order (readme_knobs is repo-level, not here)
+CHECKERS = (guarded_by, env_knobs, exit_codes, lifecycle, fault_boundary)

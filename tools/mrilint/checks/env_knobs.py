@@ -1,0 +1,76 @@
+"""env-knobs: every MRI_* environment read goes through the registry.
+
+``utils/envknobs.py`` is the single declaration point for runtime
+knobs: name, type, default, and validation live there, and misuse dies
+with a one-line exit-2 instead of a traceback deep in a worker.  Raw
+``os.environ`` / ``os.getenv`` reads of a literal ``MRI_*`` key
+anywhere else are findings.  Writes (tests and the chaos harness set
+knobs for child processes) are allowed; so are dynamic keys.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Source
+
+RULE = "env-knobs"
+
+#: the registry itself is the one sanctioned raw reader
+_EXEMPT_SUFFIXES = ("utils/envknobs.py",)
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+def _mri_literal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("MRI_"):
+        return node.value
+    return None
+
+
+def check(src: Source) -> list[Finding]:
+    if src.rel.endswith(_EXEMPT_SUFFIXES):
+        return []
+    findings: list[Finding] = []
+
+    def flag(node: ast.AST, name: str, how: str) -> None:
+        if src.allowed(node, RULE):
+            return
+        findings.append(Finding(
+            rule=RULE, path=src.rel, line=node.lineno,
+            key=f"{name}@{how}",
+            message=(f"raw {how} of {name} — declare it in "
+                     f"utils/envknobs.py and use envknobs.get()")))
+
+    for node in ast.walk(src.tree):
+        # os.environ["MRI_X"] — reads only; Store/Del set knobs for children
+        if isinstance(node, ast.Subscript) and _is_os_environ(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            name = _mri_literal(node.slice)
+            if name:
+                flag(node, name, "os.environ[...]")
+        # os.environ.get / os.environ.setdefault / os.getenv
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in ("get", "setdefault") \
+                    and _is_os_environ(fn.value) and node.args:
+                name = _mri_literal(node.args[0])
+                if name:
+                    flag(node, name, f"os.environ.{fn.attr}()")
+            elif isinstance(fn, ast.Attribute) and fn.attr == "getenv" \
+                    and isinstance(fn.value, ast.Name) and fn.value.id == "os" \
+                    and node.args:
+                name = _mri_literal(node.args[0])
+                if name:
+                    flag(node, name, "os.getenv()")
+        # "MRI_X" in os.environ
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and _is_os_environ(node.comparators[0]):
+            name = _mri_literal(node.left)
+            if name:
+                flag(node, name, "membership test")
+    return findings

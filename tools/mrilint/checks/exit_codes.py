@@ -1,0 +1,77 @@
+"""exit-code: process exits obey the 0 / 2 / 3 contract.
+
+Exit 0 is success, 2 is usage/validation error, 3 is
+degraded-but-complete output (``faults.EXIT_DEGRADED``).  Exit 1 is
+reserved (the daemon's second-signal forced exit is the one sanctioned
+use, suppressed in place), so any other integer-literal exit code is a
+finding.  In ``cli.py`` entry points, a ``raise`` with no enclosing
+``try`` is also a finding — it would escape as a traceback with exit
+1 instead of being mapped onto the contract.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Source
+
+RULE = "exit-code"
+
+_ALLOWED_CODES = {0, 2, 3}
+
+
+def _exit_callee(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in ("exit", "SystemExit"):
+        return fn.id
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if (fn.value.id, fn.attr) in (("sys", "exit"), ("os", "_exit")):
+            return f"{fn.value.id}.{fn.attr}"
+    return None
+
+
+def _is_entry_point(func: ast.AST) -> bool:
+    name = getattr(func, "name", "")
+    return name == "main" or name.endswith("_main")
+
+
+def check(src: Source) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            callee = _exit_callee(node)
+            if callee is None or len(node.args) != 1:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, int)):
+                continue
+            if arg.value in _ALLOWED_CODES:
+                continue
+            if src.allowed(node, RULE):
+                continue
+            func = src.enclosing_function(node)
+            where = func.name if func else "<module>"
+            findings.append(Finding(
+                rule=RULE, path=src.rel, line=node.lineno,
+                key=f"{callee}({arg.value})@{where}",
+                message=(f"{callee}({arg.value}) violates the exit-code "
+                         f"contract (0 ok / 2 usage / 3 degraded)")))
+        elif isinstance(node, ast.Raise) and src.rel.endswith("cli.py"):
+            func = src.enclosing_function(node)
+            if func is None or not _is_entry_point(func):
+                continue
+            exc = node.exc
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name) \
+                    and exc.func.id == "SystemExit":
+                continue  # covered by the exit-call rule above
+            if any(isinstance(a, (ast.Try,)) for a in src.ancestors(node)):
+                continue  # something catches (or deliberately re-raises)
+            if src.allowed(node, RULE):
+                continue
+            what = ast.unparse(exc) if exc else "re-raise"
+            findings.append(Finding(
+                rule=RULE, path=src.rel, line=node.lineno,
+                key=f"raise@{func.name}",
+                message=(f"unwrapped `raise {what}` in entry point "
+                         f"{func.name}() escapes as exit 1 — map it to "
+                         f"the 2/3 contract")))
+    return findings

@@ -1,0 +1,63 @@
+"""fault-boundary: package I/O sites route through faults.py hooks.
+
+Every file/socket acquisition inside the package should sit in a
+function that consults the fault-injection/retry layer — otherwise a
+chaos run silently skips it and the coverage claim in the fault
+tolerance suite is a lie.  The check is a heuristic by design: the
+enclosing function's source must mention ``faults``, ``policy`` or
+``retry`` (the idioms used by the hooks), or the call site carries an
+explicit ``# mrilint: allow(fault-boundary) reason``.
+
+Scope: package files only; ``faults.py`` itself is exempt (it IS the
+boundary), as are test hooks and the lint tooling outside the package.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Source, PACKAGE
+
+RULE = "fault-boundary"
+
+_IO_TAILS = {"open", "socket", "create_connection", "makefile", "mmap"}
+_HOOK_MARKERS = ("faults", "policy", "retry")
+
+
+def _tail(fn: ast.AST) -> str | None:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def check(src: Source) -> list[Finding]:
+    if not src.rel.startswith(PACKAGE + "/"):
+        return []
+    if src.rel.endswith("/faults.py"):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(node.func)
+        if tail not in _IO_TAILS:
+            continue
+        func = src.enclosing_function(node)
+        if func is not None:
+            span = "\n".join(src.lines[func.lineno - 1:func.end_lineno])
+            where = func.name
+        else:
+            stmt = src.statement_of(node)
+            span = "\n".join(src.lines[stmt.lineno - 1:stmt.end_lineno])
+            where = "<module>"
+        if any(marker in span for marker in _HOOK_MARKERS):
+            continue
+        if src.allowed(node, RULE):
+            continue
+        findings.append(Finding(
+            rule=RULE, path=src.rel, line=node.lineno,
+            key=f"{tail}@{where}",
+            message=(f"{tail}(...) in {where}() bypasses the faults.py "
+                     f"hooks — wrap it or suppress with a reason")))
+    return findings

@@ -1,0 +1,117 @@
+"""guarded-by: lock-annotation discipline on shared-state classes.
+
+Opt-in per attribute: a ``# guarded by: <lockexpr>`` trailing comment on
+the attribute's assignment (or on a comment line directly above it)
+declares the lock that must be held for every later read or write.  The
+checker then flags any access to that attribute outside a lexical
+``with <lockexpr>:`` block.
+
+Escape hatches:
+- ``# mrilint: holds(<lockexpr>)`` on a ``def`` line marks a private
+  helper whose callers already hold the lock.
+- ``# owned by: <thread>`` documents a single-writer attribute; it is
+  recorded but not enforced (no lock exists to check against).
+- ``# mrilint: allow(guarded-by) reason`` suppresses one access.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Source
+
+RULE = "guarded-by"
+
+_GUARD_RE = re.compile(r"#\s*guarded by:\s*(.+?)\s*$")
+_OWNED_RE = re.compile(r"#\s*owned by:")
+
+
+def _norm(expr: str) -> str:
+    return expr.replace(" ", "")
+
+
+def _annotation_for(src: Source, stmt: ast.stmt) -> tuple[str | None, bool]:
+    """(lock expression, owned-by?) declared on this statement's lines
+    or on a pure-comment line directly above it."""
+    lo, hi = stmt.lineno, stmt.end_lineno or stmt.lineno
+    candidates = list(range(lo, hi + 1))
+    if lo - 1 >= 1 and src.lines[lo - 2].lstrip().startswith("#"):
+        candidates.insert(0, lo - 1)
+    lock, owned = None, False
+    for ln in candidates:
+        line = src.lines[ln - 1]
+        m = _GUARD_RE.search(line)
+        if m:
+            lock = _norm(m.group(1))
+        elif _OWNED_RE.search(line):
+            owned = True
+    return lock, owned
+
+
+def _collect(src: Source, cls: ast.ClassDef) -> tuple[dict[str, str], set[str]]:
+    guarded: dict[str, str] = {}
+    owned: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        names = []
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                names.append(t.attr)
+            elif isinstance(t, ast.Name) and src.parent(node) is cls:
+                names.append(t.id)  # class-level default
+        if not names:
+            continue
+        lock, is_owned = _annotation_for(src, node)
+        for name in names:
+            if lock:
+                guarded[name] = lock
+            elif is_owned:
+                owned.add(name)
+    return guarded, owned
+
+
+def _held_locks(src: Source, node: ast.AST) -> set[str]:
+    """Locks lexically held at ``node``: enclosing ``with`` contexts
+    plus ``holds(...)`` annotations on every enclosing function."""
+    held: set[str] = set()
+    for anc in src.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                held.add(_norm(ast.unparse(item.context_expr)))
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            held.update(src.holds_locks(anc))
+    return held
+
+
+def check(src: Source) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in [n for n in ast.walk(src.tree) if isinstance(n, ast.ClassDef)]:
+        guarded, _owned = _collect(src, cls)
+        if not guarded:
+            continue
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in guarded):
+                continue
+            func = src.enclosing_function(node)
+            if func is None or func.name in ("__init__", "__del__"):
+                continue
+            if src.enclosing_class(func) is not cls:
+                continue  # nested class — handled on its own pass
+            lock = guarded[node.attr]
+            if lock in _held_locks(src, node):
+                continue
+            if src.allowed(node, RULE):
+                continue
+            mode = "write" if isinstance(node.ctx, ast.Store) else "read"
+            findings.append(Finding(
+                rule=RULE, path=src.rel, line=node.lineno,
+                key=f"{cls.name}.{node.attr}@{func.name}",
+                message=(f"{mode} of {cls.name}.{node.attr} outside "
+                         f"`with {lock}` (declared guarded by it)")))
+    return findings

@@ -1,0 +1,144 @@
+"""lifecycle: acquired resources are context-managed or closed.
+
+Flags ``open()`` / ``socket.socket()`` / ``create_connection()`` /
+``.makefile()`` / ``mmap.mmap()`` call sites whose result is neither
+used as a context manager nor provably released:
+
+- ``with open(...) ...`` / ``closing(...)`` / ``enter_context(...)``  ok
+- ``return open(...)`` or passing the handle to a call               ok
+  (ownership transferred to the caller/callee)
+- ``self.f = open(...)`` where the class has a release method
+  (``close``/``stop``/``shutdown``/``__exit__``/``__del__``)          ok
+- ``f = open(...)`` later entered as a ``with`` context, closed in a
+  ``finally``, returned, stored on ``self``, or handed to a call     ok
+- ``open(p).read()`` (chained, handle dropped) or a bare expression  FINDING
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Source
+
+RULE = "lifecycle"
+
+_ACQUIRERS = {"open", "socket", "create_connection", "makefile", "mmap"}
+_WRAPPERS = {"closing", "enter_context"}
+_RELEASERS = {"close", "stop", "shutdown", "__exit__", "__del__"}
+
+
+def _tail(fn: ast.AST) -> str | None:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _in_withitem(src: Source, node: ast.AST) -> bool:
+    cur, parent = node, src.parent(node)
+    while parent is not None:
+        if isinstance(parent, (ast.With, ast.AsyncWith)):
+            return any(item.context_expr is cur or _contains(item.context_expr, node)
+                       for item in parent.items)
+        if isinstance(parent, ast.stmt):
+            return False
+        cur, parent = parent, src.parent(parent)
+    return False
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(tree))
+
+
+def _class_has_releaser(src: Source, node: ast.AST) -> bool:
+    cls = src.enclosing_class(node)
+    if cls is None:
+        return False
+    return any(isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and s.name in _RELEASERS for s in cls.body)
+
+
+def _scope(src: Source, node: ast.AST) -> ast.AST:
+    return src.enclosing_function(node) or src.tree
+
+
+def _name_released(src: Source, name: str, scope: ast.AST,
+                   after_line: int) -> bool:
+    """True if ``name`` is later context-managed, closed in a finally,
+    returned, stored on an attribute, or handed to another call."""
+    for n in ast.walk(scope):
+        if getattr(n, "lineno", 0) < after_line:
+            continue
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                for ref in ast.walk(item.context_expr):
+                    if isinstance(ref, ast.Name) and ref.id == name:
+                        return True
+        elif isinstance(n, ast.Return) and n.value is not None:
+            if any(isinstance(r, ast.Name) and r.id == name
+                   for r in ast.walk(n.value)):
+                return True
+        elif isinstance(n, ast.Assign):
+            if any(isinstance(t, ast.Attribute) for t in n.targets) \
+                    and isinstance(n.value, ast.Name) and n.value.id == name:
+                return True
+        elif isinstance(n, ast.Call):
+            # name.close()/.shutdown() under a finally, or escape via arg
+            fn = n.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _RELEASERS \
+                    and isinstance(fn.value, ast.Name) and fn.value.id == name:
+                if any(isinstance(a, ast.Try) and _in_finalbody(a, n)
+                       for a in src.ancestors(n)):
+                    return True
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if any(isinstance(r, ast.Name) and r.id == name
+                       for r in ast.walk(arg)):
+                    return True
+    return False
+
+
+def _in_finalbody(try_node: ast.Try, node: ast.AST) -> bool:
+    return any(_contains(s, node) for s in try_node.finalbody)
+
+
+def check(src: Source) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(node.func)
+        if tail not in _ACQUIRERS:
+            continue
+        if _in_withitem(src, node):
+            continue
+        parent = src.parent(node)
+        ok = False
+        if isinstance(parent, ast.Call):
+            ok = True  # closing()/enter_context() or ownership escape
+        elif isinstance(parent, ast.Return):
+            ok = True
+        elif isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets if isinstance(parent, ast.Assign) \
+                else [parent.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    ok = ok or _class_has_releaser(src, node)
+                elif isinstance(t, ast.Name):
+                    ok = ok or _name_released(
+                        src, t.id, _scope(src, node), parent.lineno)
+        elif isinstance(parent, ast.keyword):
+            ok = True  # kwarg escape into a call
+        elif isinstance(parent, (ast.Attribute, ast.Expr)):
+            ok = False  # chained use / dropped handle
+        else:
+            ok = True  # conservative: unusual shapes pass
+        if ok or src.allowed(node, RULE):
+            continue
+        func = src.enclosing_function(node)
+        where = func.name if func else "<module>"
+        findings.append(Finding(
+            rule=RULE, path=src.rel, line=node.lineno,
+            key=f"{tail}@{where}",
+            message=(f"{tail}(...) result is never context-managed or "
+                     f"closed — use `with` or close in a finally")))
+    return findings

@@ -1,0 +1,82 @@
+"""readme-knobs: the README env-knob table matches the registry.
+
+The table between ``<!-- envknobs:begin -->`` and
+``<!-- envknobs:end -->`` in README.md is generated from
+``utils/envknobs.py`` via ``python -m tools.mrilint --write-readme``.
+Hand edits or a new knob without a regen show up as drift findings.
+
+The registry is loaded by file path so this never imports the package
+(and therefore never imports jax) — mrilint stays stdlib-fast.
+"""
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+from ..core import Finding, PACKAGE
+
+RULE = "readme-knobs"
+
+_BEGIN = "<!-- envknobs:begin -->"
+_END = "<!-- envknobs:end -->"
+
+
+def _load_registry(root: Path):
+    name = "mrilint_envknobs"
+    if name in sys.modules:
+        return sys.modules[name]
+    path = root / PACKAGE / "utils" / "envknobs.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing introspects sys.modules[cls.__module__]
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _expected_block(root: Path) -> str:
+    return _load_registry(root).markdown_table().strip()
+
+
+def _split(readme_text: str):
+    """(prefix, current block, suffix) or None when markers absent."""
+    try:
+        head, rest = readme_text.split(_BEGIN, 1)
+        block, tail = rest.split(_END, 1)
+    except ValueError:
+        return None
+    return head, block.strip(), tail
+
+
+def check_repo(root: Path) -> list[Finding]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return [Finding(rule=RULE, path="README.md", line=1, key="missing",
+                        message="README.md not found")]
+    parts = _split(readme.read_text(encoding="utf-8"))
+    if parts is None:
+        return [Finding(
+            rule=RULE, path="README.md", line=1, key="markers",
+            message=(f"README.md lacks the {_BEGIN} / {_END} markers "
+                     f"for the generated env-knob table"))]
+    _, block, _ = parts
+    if block != _expected_block(root):
+        return [Finding(
+            rule=RULE, path="README.md", line=1, key="drift",
+            message=("README env-knob table is out of date — run "
+                     "`python -m tools.mrilint --write-readme`"))]
+    return []
+
+
+def write_readme(root: Path) -> None:
+    readme = root / "README.md"
+    parts = _split(readme.read_text(encoding="utf-8"))
+    if parts is None:
+        raise SystemExit(
+            f"mrilint: README.md lacks {_BEGIN} / {_END} markers — add "
+            f"them where the table should live, then re-run")
+    head, _, tail = parts
+    readme.write_text(
+        f"{head}{_BEGIN}\n{_expected_block(root)}\n{_END}{tail}",
+        encoding="utf-8")
