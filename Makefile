@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-daemon capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-daemon capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -118,6 +118,11 @@ bench-serve:
 # byte-parity + zero-recompile assertions) -> BENCH_SERVE_DEVICE_r06.json
 bench-serve-device:
 	$(PY) tools/bench_serve.py --device-ab
+
+# artifact format v1-vs-v2 A/B (bytes on disk, boolean QPS, cold-decode
+# latency, BM25 throughput; byte-parity gated) -> BENCH_SERVE_V2_r09.json
+bench-serve-v2:
+	$(PY) tools/bench_serve.py --format-ab
 
 # resident-daemon bench: coalesced pipelined capacity vs the batch-1
 # closed-loop baseline, plus an open-loop (Poisson) sweep reporting

@@ -75,6 +75,10 @@ query mode (the serving read path; needs an --artifact build):
   mri-tpu query DIR --op or  w1 w2   docs containing any word
   mri-tpu query DIR --top-k 5 --letter t   the letter's 5 highest-df
                                  terms (== head -5 DIR/t.txt)
+  mri-tpu query DIR --score bm25 --top-k 5 w1 w2   the 5 best-scoring
+                                 docs for the words (BM25: tf + df +
+                                 doc-length norm; format-v2 artifacts
+                                 carry real tf, v1 scores with tf=1)
   mri-tpu query DIR --engine device  answer from the device-resident
                                  jit/shard_map engine (--engine auto,
                                  the default, picks it on accelerator
@@ -226,9 +230,18 @@ def _query_main(argv: list[str]) -> int:
                    help="combine ALL query words into one multi-term "
                         "query instead of answering each separately")
     p.add_argument("--top-k", type=int, default=None, metavar="K",
-                   help="the K highest-df terms of --letter's range")
+                   help="df mode: the K highest-df terms of --letter's "
+                        "range; bm25 mode (--score bm25): the K best-"
+                        "scoring documents for the query words")
     p.add_argument("--letter", default=None,
                    help="letter for --top-k (a..z)")
+    p.add_argument("--score", choices=("df", "bm25"), default=None,
+                   help="--top-k scoring mode: df = per-letter highest-"
+                        "df terms (today's behavior), bm25 = ranked "
+                        "document retrieval over the query words (tf + "
+                        "df + doc-length norm; needs a v2 artifact for "
+                        "real tf, v1 scores with tf=1). Default: "
+                        "MRI_SERVE_SCORE env, else df")
     p.add_argument("--engine", choices=("host", "device", "auto"),
                    default=None,
                    help="query backend: host = numpy over mmap views; "
@@ -247,7 +260,13 @@ def _query_main(argv: list[str]) -> int:
     args = p.parse_intermixed_args(argv)
 
     from .serve import ArtifactError, create_engine
+    from .serve.engine import resolve_score
 
+    try:
+        score = resolve_score(args.score)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     terms = list(args.terms)
     if args.batch_file is not None:
         try:
@@ -265,8 +284,14 @@ def _query_main(argv: list[str]) -> int:
         print("error: no query terms (positional words, --batch-file, "
               "or --top-k with --letter)", file=sys.stderr)
         return 2
-    if args.top_k is not None and args.letter is None:
-        print("error: --top-k needs --letter", file=sys.stderr)
+    ranked = args.top_k is not None and score == "bm25"
+    if args.top_k is not None and not ranked and args.letter is None:
+        print("error: --top-k needs --letter (or --score bm25 with "
+              "query terms)", file=sys.stderr)
+        return 2
+    if ranked and not terms:
+        print("error: --score bm25 --top-k needs query terms",
+              file=sys.stderr)
         return 2
     try:
         engine = create_engine(args.index_dir, args.engine)
@@ -274,19 +299,26 @@ def _query_main(argv: list[str]) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     try:
-        if args.top_k is not None:
+        if ranked:
+            top = engine.top_k_scored(engine.encode_batch(terms),
+                                      args.top_k)
+            print(json.dumps({
+                "score": "bm25", "k": args.top_k, "terms": terms,
+                "docs": [{"doc": d, "score": round(s, 6)}
+                         for d, s in top]}))
+        elif args.top_k is not None:
             top = engine.top_k(args.letter, args.top_k)
             print(json.dumps({
                 "letter": args.letter,
                 "top": [{"term": t.decode("ascii"), "df": d}
                         for t, d in top]}))
-        if terms and args.op is not None:
+        if terms and not ranked and args.op is not None:
             batch = engine.encode_batch(terms)
             docs = (engine.query_and(batch) if args.op == "and"
                     else engine.query_or(batch))
             print(json.dumps({"op": args.op, "terms": terms,
                               "docs": docs.tolist()}))
-        elif terms:
+        elif terms and not ranked:
             batch = engine.encode_batch(terms)
             dfs = engine.df(batch)
             posts = engine.postings(batch)

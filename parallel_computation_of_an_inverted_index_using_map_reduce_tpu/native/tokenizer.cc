@@ -341,14 +341,18 @@ struct StreamState {
 };
 
 // Scan a contiguous run of documents; emit (prov_id, doc_id) pairs
-// through `emit` — combiner-deduped when `dedup`.  `data` is the whole
+// through `emit` — combiner-deduped when `dedup`; repeat occurrences of
+// an already-emitted (term, doc) pair go through `emit_dup` instead, so
+// a caller can count within-document term frequencies without widening
+// the combiner's one-cache-line TermState.  `data` is the whole
 // window's concatenated bytes (`data_len` total — loads never read past
 // it); this call scans docs `[doc_lo, doc_hi)` whose bytes span
 // `[start_pos, doc_ends[doc_hi-1])`.
-template <typename Emit>
+template <typename Emit, typename EmitDup>
 void ScanChunkScalar(StreamState& st, const uint8_t* data, int64_t start_pos,
                      const int64_t* doc_ends, const int32_t* doc_id_values,
-                     int32_t doc_lo, int32_t doc_hi, bool dedup, Emit&& emit) {
+                     int32_t doc_lo, int32_t doc_hi, bool dedup, Emit&& emit,
+                     EmitDup&& emit_dup) {
   uint8_t word[kMaxWordLetters + 8];  // +8: zero pad for block loads
   int64_t pos = start_pos;
   for (int32_t d = doc_lo; d < doc_hi; ++d, ++st.doc_ordinal) {
@@ -370,7 +374,10 @@ void ScanChunkScalar(StreamState& st, const uint8_t* data, int64_t start_pos,
       ++st.raw_tokens;
       if (dedup) {
         StreamState::TermState& ts = st.combiner[id];
-        if (ts.last_doc == ordinal) continue;  // (term, doc) already out
+        if (ts.last_doc == ordinal) {  // (term, doc) already out
+          emit_dup(id);
+          continue;
+        }
         ts.last_doc = ordinal;
         ++ts.df;
       }
@@ -417,12 +424,13 @@ static inline int CleanTokenChunked(const MaskSpan& m, const uint8_t* data,
 // Mask-driven scan: identical observable behavior to ScanChunkScalar
 // (fuzz-tested against it via the oracle conformance suite), ~2x faster
 // on real text.
-template <typename Emit>
+template <typename Emit, typename EmitDup>
 __attribute__((target("avx2,bmi2")))
 void ScanChunkSimd(StreamState& st, const uint8_t* data, int64_t data_len,
                    int64_t start_pos, const int64_t* doc_ends,
                    const int32_t* doc_id_values, int32_t doc_lo,
-                   int32_t doc_hi, bool dedup, Emit&& emit) {
+                   int32_t doc_hi, bool dedup, Emit&& emit,
+                   EmitDup&& emit_dup) {
   const int64_t span_end = doc_ends[doc_hi - 1];
   MaskSpan m;
   BuildMasks(data, data_len, start_pos, span_end, m);
@@ -493,7 +501,10 @@ void ScanChunkSimd(StreamState& st, const uint8_t* data, int64_t data_len,
       ++st.raw_tokens;
       if (dedup) {
         StreamState::TermState& ts = st.combiner[id];
-        if (ts.last_doc == ordinal) continue;
+        if (ts.last_doc == ordinal) {
+          emit_dup(id);
+          continue;
+        }
         ts.last_doc = ordinal;
         ++ts.df;
       }
@@ -509,22 +520,33 @@ const bool kHaveSimdScan =
 
 #endif  // __x86_64__
 
-template <typename Emit>
+template <typename Emit, typename EmitDup>
 void ScanChunk(StreamState& st, const uint8_t* data, int64_t data_len,
                int64_t start_pos, const int64_t* doc_ends,
                const int32_t* doc_id_values, int32_t doc_lo, int32_t doc_hi,
-               bool dedup, Emit&& emit) {
+               bool dedup, Emit&& emit, EmitDup&& emit_dup) {
   if (doc_lo >= doc_hi) return;
 #if defined(__x86_64__)
   if (kHaveSimdScan) {
     ScanChunkSimd(st, data, data_len, start_pos, doc_ends, doc_id_values,
-                  doc_lo, doc_hi, dedup, emit);
+                  doc_lo, doc_hi, dedup, emit, emit_dup);
     return;
   }
 #endif
   (void)data_len;
   ScanChunkScalar(st, data, start_pos, doc_ends, doc_id_values, doc_lo,
-                  doc_hi, dedup, emit);
+                  doc_hi, dedup, emit, emit_dup);
+}
+
+// Callers that only need first (term, doc) occurrences drop duplicate
+// tokens on the floor.
+template <typename Emit>
+void ScanChunk(StreamState& st, const uint8_t* data, int64_t data_len,
+               int64_t start_pos, const int64_t* doc_ends,
+               const int32_t* doc_id_values, int32_t doc_lo, int32_t doc_hi,
+               bool dedup, Emit&& emit) {
+  ScanChunk(st, data, data_len, start_pos, doc_ends, doc_id_values, doc_lo,
+            doc_hi, dedup, emit, [](int32_t) {});
 }
 
 // Sorted-vocab order of provisional ids (== strcmp order: letters only).
@@ -1707,12 +1729,22 @@ struct HostStreamState {
   std::vector<DocMark> doc_marks;
   int32_t max_doc_id = 0;
   int64_t scan_ns = 0;
+  // Within-document term frequencies for the v2 artifact's scoring
+  // column: pair_tf[k] counts how often pair_ids[k]'s term occurred in
+  // its document (>= 1), bumped via the scan's emit_dup callback;
+  // term_last_pair maps a prov id to its latest pair index so the bump
+  // is O(1).  doc_tokens records each document's cleaned token count
+  // (the BM25 doc-length column) at document scale.
+  std::vector<int32_t> pair_tf;
+  std::vector<int64_t> term_last_pair;
+  std::vector<std::pair<int32_t, int64_t>> doc_tokens;
   // Parallel-reduce partial state (mri_hidx_partial): per-term postings
   // runs, each doc-ascending regardless of window arrival order.  Once
   // built, pair_ids/doc_marks are released — a partial'd handle can no
   // longer be finalize_emit'd, only merged via mri_hidxm_new.
   std::vector<int64_t> local_off;   // local prov id -> run start (+1 end)
   std::vector<int32_t> local_flat;  // concatenated per-term doc runs
+  std::vector<int32_t> local_flat_tf;  // tf aligned with local_flat
   bool partial_done = false;
   int64_t partial_ns = 0;
 };
@@ -1793,6 +1825,7 @@ void PartialFlatten(HostStreamState& h) {
   }
   h.local_off[std::max(vocab, 1)] = total;
   h.local_flat.resize(std::max<int64_t>(total, 1));
+  h.local_flat_tf.resize(h.local_flat.size());
   {
     std::vector<int64_t> cursor(h.local_off.begin(), h.local_off.end() - 1);
     const size_t n_marks = h.doc_marks.size();
@@ -1801,22 +1834,127 @@ void PartialFlatten(HostStreamState& h) {
                                                 : static_cast<int64_t>(
                                                       h.pair_ids.size());
       const int32_t doc = h.doc_marks[s].doc;
-      for (int64_t k = h.doc_marks[s].start; k < seg_end; ++k)
-        h.local_flat[cursor[h.pair_ids[k]]++] = doc;
+      for (int64_t k = h.doc_marks[s].start; k < seg_end; ++k) {
+        const int64_t c = cursor[h.pair_ids[k]]++;
+        h.local_flat[c] = doc;
+        h.local_flat_tf[c] = h.pair_tf[k];
+      }
     }
   }
   for (int32_t p = 0; p < vocab; ++p) {
-    const auto b = h.local_flat.begin() + h.local_off[p];
-    const auto e = h.local_flat.begin() + h.local_off[p + 1];
-    if (!std::is_sorted(b, e)) std::sort(b, e);
+    const int64_t b = h.local_off[p], e = h.local_off[p + 1];
+    if (std::is_sorted(h.local_flat.begin() + b, h.local_flat.begin() + e))
+      continue;
+    // out-of-order window arrival: co-sort the run and its tf column
+    // through one packed (doc << 32 | tf) key
+    std::vector<uint64_t> packed(static_cast<size_t>(e - b));
+    for (int64_t j = b; j < e; ++j)
+      packed[j - b] =
+          (static_cast<uint64_t>(static_cast<uint32_t>(h.local_flat[j]))
+           << 32) |
+          static_cast<uint32_t>(h.local_flat_tf[j]);
+    std::sort(packed.begin(), packed.end());
+    for (int64_t j = b; j < e; ++j) {
+      h.local_flat[j] = static_cast<int32_t>(packed[j - b] >> 32);
+      h.local_flat_tf[j] =
+          static_cast<int32_t>(packed[j - b] & 0xffffffffu);
+    }
   }
   // the token-scale scan buffers are spent; release them pre-merge
+  // (doc_tokens survives: it is document-scale and feeds the v2
+  // artifact's doc-length column)
   std::vector<int32_t>().swap(h.pair_ids);
+  std::vector<int32_t>().swap(h.pair_tf);
+  std::vector<int64_t>().swap(h.term_last_pair);
   std::vector<HostStreamState::DocMark>().swap(h.doc_marks);
   h.partial_done = true;
   h.partial_ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
                      std::chrono::steady_clock::now() - t0)
                      .count();
+}
+
+// Lex order of the vocab by LSD radix sort on the big-endian u64 prefix
+// keys — O(V) per pass, 8 passes, no comparator branches; terms sharing
+// a full 8-byte prefix land adjacent and their (rare) groups get a tiny
+// comparison sort over the padded tails afterwards.  Shared by the v1
+// and v2 artifact exporters (on the 1-core bench container this is ~3x
+// faster than the comparison sort in SortedOrder, which the pack-time
+// budget cannot afford).
+std::vector<int32_t> LexOrderRadix(const StreamState& st, int32_t V) {
+  const uint8_t* arena = st.arena.data();
+  std::vector<std::pair<uint64_t, int32_t>> part(std::max(V, 1));
+  for (int32_t i = 0; i < V; ++i)
+    part[i] = {__builtin_bswap64(Load64(arena + st.word_offsets[i])), i};
+  {
+    std::vector<std::pair<uint64_t, int32_t>> tmp(std::max(V, 1));
+    for (int pass = 0; pass < 8; ++pass) {
+      const int shift = pass * 8;
+      int32_t cnt[257] = {0};
+      for (int32_t i = 0; i < V; ++i)
+        ++cnt[((part[i].first >> shift) & 0xff) + 1];
+      for (int b = 1; b <= 256; ++b) cnt[b] += cnt[b - 1];
+      for (int32_t i = 0; i < V; ++i)
+        tmp[cnt[(part[i].first >> shift) & 0xff]++] = part[i];
+      part.swap(tmp);
+    }
+  }
+  const auto tail_cmp = [&](const std::pair<uint64_t, int32_t>& a,
+                            const std::pair<uint64_t, int32_t>& b) {
+    const int32_t ia = a.second, ib = b.second;
+    const uint8_t* pa = arena + st.word_offsets[ia];
+    const uint8_t* pb = arena + st.word_offsets[ib];
+    const uint32_t pla = (st.word_lens[ia] + 7) & ~7u;
+    const uint32_t plb = (st.word_lens[ib] + 7) & ~7u;
+    const uint32_t lim = pla > plb ? pla : plb;
+    for (uint32_t i = 8; i < lim; i += 8) {
+      const uint64_t ka = i < pla ? __builtin_bswap64(Load64(pa + i)) : 0;
+      const uint64_t kb = i < plb ? __builtin_bswap64(Load64(pb + i)) : 0;
+      if (ka != kb) return ka < kb;
+    }
+    return false;  // identical words cannot occur (unique vocab)
+  };
+  for (int32_t i = 0; i < V;) {
+    int32_t j = i + 1;
+    while (j < V && part[j].first == part[i].first) ++j;
+    if (j - i > 1) std::sort(part.begin() + i, part.begin() + j, tail_cmp);
+    i = j;
+  }
+  std::vector<int32_t> lex(std::max(V, 1));
+  for (int32_t r = 0; r < V; ++r) lex[r] = part[r].second;
+  return lex;
+}
+
+// Little-endian bit packer over u32 words (format v2 postings/tf): a
+// value's bit k lands at stream bit nbits+k, and stream bit i is bit
+// (i & 31) of word (i >> 5) — exactly what np.unpackbits(bitorder=
+// 'little') recovers on the serve side.
+struct BitPacker {
+  std::vector<uint32_t>& out;
+  uint64_t acc = 0;
+  int nbits = 0;
+  explicit BitPacker(std::vector<uint32_t>& o) : out(o) {}
+  void Push(uint32_t v, int w) {  // caller guarantees v < 2^w, w <= 31
+    acc |= static_cast<uint64_t>(v) << nbits;
+    nbits += w;
+    while (nbits >= 32) {
+      out.push_back(static_cast<uint32_t>(acc));
+      acc >>= 32;
+      nbits -= 32;
+    }
+  }
+  void Flush() {  // pad to the next word boundary (block alignment)
+    if (nbits) {
+      out.push_back(static_cast<uint32_t>(acc));
+      acc = 0;
+      nbits = 0;
+    }
+  }
+};
+
+// Smallest width that can hold v (0 when v == 0: the all-ones delta /
+// all-ones tf case packs to zero bytes).
+inline int BitWidth(uint32_t v) {
+  return v == 0 ? 0 : 32 - __builtin_clz(v);
 }
 
 }  // namespace
@@ -1836,19 +1974,35 @@ int32_t mri_hidx_feed(void* handle, const uint8_t* data, int64_t len,
                       int32_t num_docs) try {
   HostStreamState& h = *static_cast<HostStreamState*>(handle);
   const auto t0 = std::chrono::steady_clock::now();
-  if (h.pair_ids.capacity() == h.pair_ids.size())
+  if (h.pair_ids.capacity() == h.pair_ids.size()) {
     h.pair_ids.reserve(std::max<size_t>(h.pair_ids.size() * 2, 1 << 16));
+    h.pair_tf.reserve(h.pair_ids.capacity());
+  }
   for (int32_t d = 0; d < num_docs; ++d)
     h.max_doc_id = std::max(h.max_doc_id, doc_id_values[d]);
   int32_t cur_doc = h.doc_marks.empty() ? -1 : h.doc_marks.back().doc;
   ScanChunk(h.st, data, len, 0, doc_ends, doc_id_values, 0, num_docs,
-            /*dedup=*/true, [&](int32_t id, int32_t doc) {
+            /*dedup=*/true,
+            [&](int32_t id, int32_t doc) {
               if (doc != cur_doc) {
                 cur_doc = doc;
                 h.doc_marks.push_back(
                     {static_cast<int64_t>(h.pair_ids.size()), doc});
+                h.doc_tokens.push_back({doc, 0});
               }
+              // a document's first token is always a new pair, so
+              // doc_tokens.back() below is this doc in both callbacks
+              if (static_cast<size_t>(id) >= h.term_last_pair.size())
+                h.term_last_pair.resize(h.st.word_offsets.size(), -1);
+              h.term_last_pair[id] =
+                  static_cast<int64_t>(h.pair_ids.size());
               h.pair_ids.push_back(id);
+              h.pair_tf.push_back(1);
+              ++h.doc_tokens.back().second;
+            },
+            [&](int32_t id) {
+              ++h.pair_tf[h.term_last_pair[id]];
+              ++h.doc_tokens.back().second;
             });
   h.scan_ns += std::chrono::duration_cast<std::chrono::nanoseconds>(
                    std::chrono::steady_clock::now() - t0)
@@ -1971,6 +2125,15 @@ struct HostMergeState {
   std::vector<uint8_t> vocab_packed;    // prov space, NUL-padded rows
   int32_t vocab = 0, width = 1, max_doc_id = 0;
   int64_t raw_tokens = 0, num_pairs = 0;
+  // Format-v2 export plan (mri_hidxm_export_v2_prepare fills, _payload
+  // consumes and releases): lex permutation, per-block skip entries and
+  // bit widths, packed postings/tf words, and the doc-length column.
+  std::vector<int32_t> v2_lex;
+  std::vector<int32_t> v2_blk_max, v2_blk_first;
+  std::vector<uint8_t> v2_blk_width, v2_blk_tf_width;
+  std::vector<uint32_t> v2_post_data, v2_tf_data;
+  std::vector<int32_t> v2_doc_lens;
+  int32_t v2_block_size = 0;
 };
 
 void* mri_hidxm_new(void* const* handles, int32_t num_handles,
@@ -2277,53 +2440,10 @@ int32_t mri_hidxm_export_payload(void* mh, uint8_t* base,
   int32_t* df_order = reinterpret_cast<int32_t*>(base + off_df_order);
 
   for (int l = 0; l < 27; ++l) letter_dir[l] = m.letter_off[l];
-  // Lex order by LSD radix sort on the big-endian u64 prefix keys —
-  // O(V) per pass, 8 passes, no comparator branches.  On the 1-core
-  // bench container this is ~3x faster than the comparison sort
-  // (SortedOrder) that the pack-time budget cannot afford; terms
-  // sharing a full 8-byte prefix land adjacent and their (rare) groups
-  // get a tiny comparison sort over the padded tails afterwards.
   const uint8_t* arena = st.arena.data();
-  std::vector<std::pair<uint64_t, int32_t>> part(std::max(V, 1));
-  for (int32_t i = 0; i < V; ++i)
-    part[i] = {__builtin_bswap64(Load64(arena + st.word_offsets[i])), i};
-  {
-    std::vector<std::pair<uint64_t, int32_t>> tmp(std::max(V, 1));
-    for (int pass = 0; pass < 8; ++pass) {
-      const int shift = pass * 8;
-      int32_t cnt[257] = {0};
-      for (int32_t i = 0; i < V; ++i)
-        ++cnt[((part[i].first >> shift) & 0xff) + 1];
-      for (int b = 1; b <= 256; ++b) cnt[b] += cnt[b - 1];
-      for (int32_t i = 0; i < V; ++i)
-        tmp[cnt[(part[i].first >> shift) & 0xff]++] = part[i];
-      part.swap(tmp);
-    }
-  }
-  const auto tail_cmp = [&](const std::pair<uint64_t, int32_t>& a,
-                            const std::pair<uint64_t, int32_t>& b) {
-    const int32_t ia = a.second, ib = b.second;
-    const uint8_t* pa = arena + st.word_offsets[ia];
-    const uint8_t* pb = arena + st.word_offsets[ib];
-    const uint32_t pla = (st.word_lens[ia] + 7) & ~7u;
-    const uint32_t plb = (st.word_lens[ib] + 7) & ~7u;
-    const uint32_t lim = pla > plb ? pla : plb;
-    for (uint32_t i = 8; i < lim; i += 8) {
-      const uint64_t ka = i < pla ? __builtin_bswap64(Load64(pa + i)) : 0;
-      const uint64_t kb = i < plb ? __builtin_bswap64(Load64(pb + i)) : 0;
-      if (ka != kb) return ka < kb;
-    }
-    return false;  // identical words cannot occur (unique vocab)
-  };
-  for (int32_t i = 0; i < V;) {
-    int32_t j = i + 1;
-    while (j < V && part[j].first == part[i].first) ++j;
-    if (j - i > 1) std::sort(part.begin() + i, part.begin() + j, tail_cmp);
-    i = j;
-  }
-
+  const std::vector<int32_t> lex = LexOrderRadix(st, V);
   std::vector<int32_t> inv(std::max(V, 1));
-  for (int32_t r = 0; r < V; ++r) inv[part[r].second] = r;
+  for (int32_t r = 0; r < V; ++r) inv[lex[r]] = r;
   // blob writes may use fixed-width 8-byte stores (the arena pads every
   // word to an 8-byte multiple, so the LOAD is always safe); the store
   // may spill past the word into bytes a later term overwrites, bounded
@@ -2337,25 +2457,25 @@ int32_t mri_hidxm_export_payload(void* mh, uint8_t* base,
     // prefetch keeps several of those misses in flight: first-level
     // rows far ahead, the second-level values they feed closer in.
     if (r + 16 < V) {
-      const int32_t gf = part[r + 16].second;
+      const int32_t gf = lex[r + 16];
       __builtin_prefetch(&m.seg_off[gf]);
       __builtin_prefetch(&m.df_gid[gf]);
       __builtin_prefetch(&st.word_offsets[gf]);
     }
     if (r + 4 < V) {
-      const int32_t gn = part[r + 4].second;
+      const int32_t gn = lex[r + 4];
       __builtin_prefetch(arena + st.word_offsets[gn]);
       const int64_t sn = m.seg_off[gn];
       __builtin_prefetch(&m.seg_worker[sn]);
       __builtin_prefetch(&m.seg_lid[sn]);
     }
     if (r + 1 < V) {
-      const int32_t g1 = part[r + 1].second;
+      const int32_t g1 = lex[r + 1];
       const int64_t s1 = m.seg_off[g1];
       const HostStreamState& h1 = *m.parts[m.seg_worker[s1]];
       __builtin_prefetch(h1.local_flat.data() + h1.local_off[m.seg_lid[s1]]);
     }
-    const int32_t g = part[r].second;
+    const int32_t g = lex[r];
     term_offsets[r] = blob_cur;
     const uint32_t wl = st.word_lens[g];
     if (wl <= 8 && blob_cur + 8 <= blob_room)
@@ -2406,6 +2526,184 @@ int32_t mri_hidxm_export_payload(void* mh, uint8_t* base,
   post_offsets[V] = cur;
   for (int32_t i = 0; i < V; ++i)
     df_order[i] = inv[m.emit_order[i]];
+  return 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+// ---------------------------------------------------------------------------
+// Format-v2 export (serve/artifact.py build_from_merge with
+// MRI_SERVE_FORMAT=2): postings as fixed-size blocks of bitpacked
+// (delta - 1) values with per-block skip entries (max doc id, first doc
+// id, bit width), a parallel bitpacked (tf - 1) column, and the BM25
+// doc-length column.  Two calls: _prepare merges + packs everything
+// into the plan vectors (one pass over the runs, block widths chosen on
+// the fly) and reports the section sizes the Python layout needs;
+// _payload memcpys the plan into the caller's file buffer at the layout
+// offsets and releases it.
+// ---------------------------------------------------------------------------
+
+int32_t mri_hidxm_export_v2_prepare(void* mh, int32_t block_size,
+                                    int64_t* num_blocks_out,
+                                    int64_t* post_bytes_out,
+                                    int64_t* tf_bytes_out) try {
+  HostMergeState& m = *static_cast<HostMergeState*>(mh);
+  const StreamState& st = *m.st;
+  const int32_t V = m.vocab;
+  const int32_t B = block_size;
+  if (B < 2 || B > (1 << 20) || (B & (B - 1)) != 0) return -1;
+  m.v2_block_size = B;
+  m.v2_lex = LexOrderRadix(st, V);
+  m.v2_blk_max.clear();
+  m.v2_blk_first.clear();
+  m.v2_blk_width.clear();
+  m.v2_blk_tf_width.clear();
+  m.v2_post_data.clear();
+  m.v2_tf_data.clear();
+
+  // doc-length column: each worker's doc_tokens entries are disjoint
+  // doc spans, so += sums exactly (a doc split across feeds of one
+  // worker contributes multiple entries)
+  m.v2_doc_lens.assign(static_cast<size_t>(m.max_doc_id) + 1, 0);
+  for (const HostStreamState* p : m.parts)
+    for (const auto& dt : p->doc_tokens)
+      if (dt.first >= 0 && dt.first <= m.max_doc_id)
+        m.v2_doc_lens[dt.first] += static_cast<int32_t>(dt.second);
+
+  BitPacker pp(m.v2_post_data), tp(m.v2_tf_data);
+  std::vector<int32_t> docs, tfs;
+  std::vector<uint64_t> packed;
+  for (int32_t r = 0; r < V; ++r) {
+    const int32_t g = m.v2_lex[r];
+    const int64_t df = m.df_gid[g];
+    if (df == 0) continue;
+    const int64_t seg_lo = m.seg_off[g], seg_hi = m.seg_off[g + 1];
+    const int32_t* dptr;
+    const int32_t* tptr;
+    if (seg_hi - seg_lo == 1) {
+      // single worker run (the K=1 common case): pack straight from
+      // the worker's immutable run, no copy
+      const HostStreamState& h = *m.parts[m.seg_worker[seg_lo]];
+      const int32_t lid = m.seg_lid[seg_lo];
+      const int64_t lo = h.local_off[lid];
+      dptr = h.local_flat.data() + lo;
+      tptr = h.local_flat_tf.data() + lo;
+    } else {
+      // multi-run: co-merge docs and tf through packed u64 keys (docs
+      // are disjoint across workers, so doc order == key order)
+      packed.resize(static_cast<size_t>(df));
+      int64_t cur = 0;
+      for (int64_t s = seg_lo; s < seg_hi; ++s) {
+        const HostStreamState& h = *m.parts[m.seg_worker[s]];
+        const int32_t lid = m.seg_lid[s];
+        const int64_t lo = h.local_off[lid];
+        const int64_t n = h.local_off[lid + 1] - lo;
+        for (int64_t j = 0; j < n; ++j)
+          packed[cur + j] =
+              (static_cast<uint64_t>(
+                   static_cast<uint32_t>(h.local_flat[lo + j]))
+               << 32) |
+              static_cast<uint32_t>(h.local_flat_tf[lo + j]);
+        if (cur)
+          std::inplace_merge(packed.begin(), packed.begin() + cur,
+                             packed.begin() + cur + n);
+        cur += n;
+      }
+      docs.resize(static_cast<size_t>(df));
+      tfs.resize(static_cast<size_t>(df));
+      for (int64_t j = 0; j < df; ++j) {
+        docs[j] = static_cast<int32_t>(packed[j] >> 32);
+        tfs[j] = static_cast<int32_t>(packed[j] & 0xffffffffu);
+      }
+      dptr = docs.data();
+      tptr = tfs.data();
+    }
+    for (int64_t b0 = 0; b0 < df; b0 += B) {
+      const int32_t cnt = static_cast<int32_t>(std::min<int64_t>(B, df - b0));
+      m.v2_blk_first.push_back(dptr[b0]);
+      m.v2_blk_max.push_back(dptr[b0 + cnt - 1]);
+      uint32_t maxd = 0, maxt = 0;
+      for (int32_t j = 1; j < cnt; ++j)
+        maxd = std::max(
+            maxd, static_cast<uint32_t>(dptr[b0 + j] - dptr[b0 + j - 1] - 1));
+      for (int32_t j = 0; j < cnt; ++j)
+        maxt = std::max(maxt, static_cast<uint32_t>(tptr[b0 + j] - 1));
+      const int wd = BitWidth(maxd);
+      const int wt = BitWidth(maxt);
+      m.v2_blk_width.push_back(static_cast<uint8_t>(wd));
+      m.v2_blk_tf_width.push_back(static_cast<uint8_t>(wt));
+      for (int32_t j = 1; j < cnt; ++j)
+        pp.Push(static_cast<uint32_t>(dptr[b0 + j] - dptr[b0 + j - 1] - 1),
+                wd);
+      pp.Flush();
+      for (int32_t j = 0; j < cnt; ++j)
+        tp.Push(static_cast<uint32_t>(tptr[b0 + j] - 1), wt);
+      tp.Flush();
+    }
+  }
+  if (num_blocks_out)
+    *num_blocks_out = static_cast<int64_t>(m.v2_blk_max.size());
+  if (post_bytes_out)
+    *post_bytes_out = static_cast<int64_t>(m.v2_post_data.size()) * 4;
+  if (tf_bytes_out)
+    *tf_bytes_out = static_cast<int64_t>(m.v2_tf_data.size()) * 4;
+  return 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+// Fill the v2 payload sections.  `offs` holds 12 byte offsets into
+// `base`, in fixed section order: letter_dir, term_offsets, term_blob,
+// df, blk_max, blk_first, blk_width, blk_tf_width, post_data, tf_data,
+// doc_lens, df_order.  Releases the prepare plan on success.
+int32_t mri_hidxm_export_v2_payload(void* mh, uint8_t* base,
+                                    const int64_t* offs,
+                                    int32_t n_offs) try {
+  HostMergeState& m = *static_cast<HostMergeState*>(mh);
+  const StreamState& st = *m.st;
+  const int32_t V = m.vocab;
+  if (n_offs != 12 || m.v2_block_size == 0) return -1;
+  int64_t* letter_dir = reinterpret_cast<int64_t*>(base + offs[0]);
+  int64_t* term_offsets = reinterpret_cast<int64_t*>(base + offs[1]);
+  uint8_t* term_blob = base + offs[2];
+  int32_t* df = reinterpret_cast<int32_t*>(base + offs[3]);
+  int32_t* df_order = reinterpret_cast<int32_t*>(base + offs[11]);
+
+  for (int l = 0; l < 27; ++l) letter_dir[l] = m.letter_off[l];
+  const uint8_t* arena = st.arena.data();
+  int64_t blob_cur = 0;
+  for (int32_t r = 0; r < V; ++r) {
+    const int32_t g = m.v2_lex[r];
+    term_offsets[r] = blob_cur;
+    std::memcpy(term_blob + blob_cur, arena + st.word_offsets[g],
+                st.word_lens[g]);
+    blob_cur += st.word_lens[g];
+    df[r] = static_cast<int32_t>(m.df_gid[g]);
+  }
+  term_offsets[V] = blob_cur;
+  const auto copy_bytes = [&](int idx, const void* src, size_t n) {
+    if (n) std::memcpy(base + offs[idx], src, n);
+  };
+  copy_bytes(4, m.v2_blk_max.data(), m.v2_blk_max.size() * 4);
+  copy_bytes(5, m.v2_blk_first.data(), m.v2_blk_first.size() * 4);
+  copy_bytes(6, m.v2_blk_width.data(), m.v2_blk_width.size());
+  copy_bytes(7, m.v2_blk_tf_width.data(), m.v2_blk_tf_width.size());
+  copy_bytes(8, m.v2_post_data.data(), m.v2_post_data.size() * 4);
+  copy_bytes(9, m.v2_tf_data.data(), m.v2_tf_data.size() * 4);
+  copy_bytes(10, m.v2_doc_lens.data(), m.v2_doc_lens.size() * 4);
+  std::vector<int32_t> inv(std::max(V, 1));
+  for (int32_t r = 0; r < V; ++r) inv[m.v2_lex[r]] = r;
+  for (int32_t i = 0; i < V; ++i) df_order[i] = inv[m.emit_order[i]];
+
+  std::vector<int32_t>().swap(m.v2_lex);
+  std::vector<int32_t>().swap(m.v2_blk_max);
+  std::vector<int32_t>().swap(m.v2_blk_first);
+  std::vector<uint8_t>().swap(m.v2_blk_width);
+  std::vector<uint8_t>().swap(m.v2_blk_tf_width);
+  std::vector<uint32_t>().swap(m.v2_post_data);
+  std::vector<uint32_t>().swap(m.v2_tf_data);
+  std::vector<int32_t>().swap(m.v2_doc_lens);
+  m.v2_block_size = 0;
   return 0;
 } catch (const std::bad_alloc&) {
   return -2;

@@ -38,6 +38,42 @@ Terms are in lexicographic order — the engine's binary-search key — and
 ``df_order`` gives O(k) top-k-by-df per letter.  Writes are atomic
 (tmp + rename), loads verify both checksums before any answer is
 served: a torn artifact raises :class:`ArtifactError`, never garbage.
+
+Format v2 (``$MRI_SERVE_FORMAT``, the default) keeps the header
+discipline and the term sections but stores postings as fixed-size
+blocks of ``block_size`` doc ids (``$MRI_SERVE_BLOCK_SIZE``, default
+128, power of two).  The reserved header bytes gain, at offset 60:
+
+      block_size       u32
+      reserved0        u32
+      num_blocks       i64  NB — total blocks over all terms
+      post_data_bytes  i64
+      tf_data_bytes    i64
+
+and the payload becomes (same alignment discipline):
+
+      letter_dir    i64[27]   as v1
+      term_offsets  i64[V+1]  as v1
+      term_blob     u8[...]   as v1
+      df            i32[V]    as v1
+      blk_max       i32[NB]   skip table: last doc id per block
+      blk_first     i32[NB]   first doc id per block (absolute, so any
+                              block decodes without its predecessors)
+      blk_width     u8[NB]    bit width of the block's packed deltas
+      blk_tf_width  u8[NB]    bit width of the block's packed tf
+      post_data     u8[...]   per block: (count-1) values of
+                              (delta - 1) at blk_width bits, LSB-first
+                              little-endian, zero-padded to a 4-byte
+                              boundary per block (width 0 => 0 bytes)
+      tf_data       u8[...]   per block: count values of (tf - 1) at
+                              blk_tf_width bits, same packing
+      doc_lens      i32[max_doc_id + 1]  tokens per document (BM25
+                              length norm; 0 = absent doc)
+      df_order      i32[V]    as v1
+
+Nothing else is stored: block counts per term derive from ``df``, and
+each block's byte offset derives from the width/count columns — the
+loader reconstructs both prefix sums vectorized at load time.
 """
 
 from __future__ import annotations
@@ -50,14 +86,26 @@ from pathlib import Path
 
 import numpy as np
 
+from ..utils import envknobs
+
 #: Written next to a.txt..z.txt by ``--artifact`` runs.
 ARTIFACT_NAME = "index.mri"
 
 MAGIC = b"MRIIDX01"
 VERSION = 1
+VERSION_V2 = 2
 HEADER_BYTES = 96
 _ALIGN = 16
 _HEADER_FMT = "<8sIIqqqqqI"  # ... + 32 reserved + u32 header_adler32
+_HEADER_V2_FMT = "<IIqqq"    # v2: packed into the 32 reserved bytes
+_HEADER_V2_OFF = struct.calcsize(_HEADER_FMT)  # 60
+
+#: Artifact format written by the builders (1 or 2; v1 stays readable
+#: forever) and the v2 postings block size (power of two >= 2).
+FORMAT_ENV = "MRI_SERVE_FORMAT"
+BLOCK_ENV = "MRI_SERVE_BLOCK_SIZE"
+
+DEFAULT_BLOCK_SIZE = 128
 
 
 class ArtifactError(RuntimeError):
@@ -92,20 +140,77 @@ def _layout(vocab: int, num_postings: int, blob_bytes: int):
     return out, _align(cur)
 
 
+def _layout_v2(vocab: int, blob_bytes: int, num_blocks: int,
+               post_data_bytes: int, tf_data_bytes: int, max_doc_id: int):
+    """v2 section name -> (file offset, byte length), plus total size —
+    deterministic from the header scalars, like :func:`_layout`."""
+    sections = [
+        ("letter_dir", 27 * 8),
+        ("term_offsets", (vocab + 1) * 8),
+        ("term_blob", blob_bytes),
+        ("df", vocab * 4),
+        ("blk_max", num_blocks * 4),
+        ("blk_first", num_blocks * 4),
+        ("blk_width", num_blocks),
+        ("blk_tf_width", num_blocks),
+        ("post_data", post_data_bytes),
+        ("tf_data", tf_data_bytes),
+        ("doc_lens", (max_doc_id + 1) * 4),
+        ("df_order", vocab * 4),
+    ]
+    out: dict[str, tuple[int, int]] = {}
+    cur = HEADER_BYTES
+    for name, nbytes in sections:
+        cur = _align(cur)
+        out[name] = (cur, nbytes)
+        cur += nbytes
+    return out, _align(cur)
+
+
+def resolve_format(fmt: int | None = None) -> int:
+    """The artifact version the builders should write: the explicit
+    argument, else ``$MRI_SERVE_FORMAT`` (default 2)."""
+    fmt = int(envknobs.get(FORMAT_ENV) if fmt is None else fmt)
+    if fmt not in (VERSION, VERSION_V2):
+        raise ValueError(f"unsupported artifact format {fmt}")
+    return fmt
+
+
+def resolve_block_size(block_size: int | None = None) -> int:
+    """The v2 postings block size: the explicit argument, else
+    ``$MRI_SERVE_BLOCK_SIZE``.  Must be a power of two >= 2."""
+    b = int(envknobs.get(BLOCK_ENV) if block_size is None else block_size)
+    if b < 2 or b > (1 << 20) or b & (b - 1):
+        raise ValueError(
+            f"{BLOCK_ENV}={b} is not a power of two in [2, 2**20]")
+    return b
+
+
 def artifact_path(index_dir: str | Path) -> Path:
     return Path(index_dir) / ARTIFACT_NAME
 
 
 def pack(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
          df: np.ndarray, post_offsets: np.ndarray, postings: np.ndarray,
-         df_order: np.ndarray, max_doc_id: int, width: int | None = None
+         df_order: np.ndarray, max_doc_id: int, width: int | None = None,
+         fmt: int | None = None, tf: np.ndarray | None = None,
+         doc_lens: np.ndarray | None = None, block_size: int | None = None
          ) -> int:
     """Write the artifact from lex-order arrays; returns bytes written.
 
-    ``postings`` arrives ABSOLUTE (ascending per term) — the delta
-    encoding happens here, vectorized: one subtraction pass plus a
-    scatter restoring each term's first id.
+    ``postings`` arrives ABSOLUTE (ascending per term) — the wire
+    encoding (v1 deltas or v2 bitpacked blocks, per ``fmt`` /
+    ``$MRI_SERVE_FORMAT``) happens here.  ``tf``/``doc_lens`` only
+    matter for v2; absent, every tf is 1 and doc lengths fall back to
+    the per-doc posting count — self-consistent BM25 stats for builders
+    that never saw token-level frequencies.
     """
+    if resolve_format(fmt) == VERSION_V2:
+        return pack_v2(
+            path, term_blob=term_blob, term_offsets=term_offsets, df=df,
+            post_offsets=post_offsets, postings=postings, df_order=df_order,
+            max_doc_id=max_doc_id, width=width, tf=tf, doc_lens=doc_lens,
+            block_size=block_size)
     path = Path(path)
     term_offsets = np.ascontiguousarray(term_offsets, dtype=np.int64)
     post_offsets = np.ascontiguousarray(post_offsets, dtype=np.int64)
@@ -150,24 +255,31 @@ def pack(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
 
 
 def _header(*, width: int, vocab: int, num_postings: int, max_doc_id: int,
-            blob_bytes: int, payload_len: int, payload_crc: int) -> bytes:
+            blob_bytes: int, payload_len: int, payload_crc: int,
+            version: int = VERSION, v2: dict | None = None) -> bytes:
     header = struct.pack(
-        _HEADER_FMT, MAGIC, VERSION, int(max(width, 1)), vocab,
+        _HEADER_FMT, MAGIC, version, int(max(width, 1)), vocab,
         num_postings, int(max_doc_id), blob_bytes, payload_len,
         payload_crc)
+    if v2 is not None:
+        header += struct.pack(
+            _HEADER_V2_FMT, v2["block_size"], 0, v2["num_blocks"],
+            v2["post_data_bytes"], v2["tf_data_bytes"])
     header = header + b"\0" * (HEADER_BYTES - 4 - len(header))
     return header + struct.pack("<I", zlib.adler32(header))
 
 
 def _write(path, buf: np.ndarray, *, width: int, vocab: int,
-           num_postings: int, max_doc_id: int, blob_bytes: int) -> int:
+           num_postings: int, max_doc_id: int, blob_bytes: int,
+           version: int = VERSION, v2: dict | None = None) -> int:
     """Checksum + header a filled file buffer, write atomically."""
     path = Path(path)
     payload = buf[HEADER_BYTES:]
     header = _header(width=width, vocab=vocab, num_postings=num_postings,
                      max_doc_id=max_doc_id, blob_bytes=blob_bytes,
                      payload_len=len(payload),
-                     payload_crc=zlib.adler32(payload))
+                     payload_crc=zlib.adler32(payload),
+                     version=version, v2=v2)
     buf[:HEADER_BYTES] = np.frombuffer(header, dtype=np.uint8)
 
     tmp = path.with_name(path.name + ".tmp")
@@ -178,41 +290,259 @@ def _write(path, buf: np.ndarray, *, width: int, vocab: int,
     return len(buf)
 
 
+def _pack_bits(vals: np.ndarray, w: int) -> np.ndarray:
+    """Pack ``vals`` (< 2**w each) at ``w`` bits LSB-first into a
+    word-aligned little-endian uint8 array (the C++ BitPacker's wire
+    form; width 0 packs to nothing)."""
+    if w == 0 or not len(vals):
+        return np.zeros(0, dtype=np.uint8)
+    bits = np.unpackbits(
+        np.ascontiguousarray(vals, dtype="<u4").view(np.uint8).reshape(-1, 4),
+        axis=1, bitorder="little")[:, :w].ravel()
+    pad = (-len(bits)) % 32
+    if pad:
+        bits = np.concatenate([bits, np.zeros(pad, dtype=np.uint8)])
+    return np.packbits(bits, bitorder="little")
+
+
+def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
+            df: np.ndarray, post_offsets: np.ndarray, postings: np.ndarray,
+            df_order: np.ndarray, max_doc_id: int, width: int | None = None,
+            tf: np.ndarray | None = None,
+            doc_lens: np.ndarray | None = None,
+            block_size: int | None = None) -> int:
+    """Write a format-v2 artifact from lex-order ABSOLUTE postings (the
+    pure-Python packer — the cpu backend's merge handle has a one-pass
+    native equivalent in :func:`build_from_merge`).
+
+    ``tf`` aligns with ``postings`` (defaults to all-ones); ``doc_lens``
+    defaults to each doc's tf sum, so scoring stays self-consistent for
+    builders without token-level data.
+    """
+    path = Path(path)
+    B = resolve_block_size(block_size)
+    term_offsets = np.ascontiguousarray(term_offsets, dtype=np.int64)
+    post_offsets = np.ascontiguousarray(post_offsets, dtype=np.int64)
+    term_blob = np.ascontiguousarray(term_blob, dtype=np.uint8)
+    df = np.ascontiguousarray(df, dtype=np.int32)
+    df_order = np.ascontiguousarray(df_order, dtype=np.int32)
+    postings = np.asarray(postings, dtype=np.int32)
+    vocab = len(df)
+    num_postings = int(post_offsets[-1]) if len(post_offsets) else 0
+    blob_bytes = int(term_offsets[-1]) if len(term_offsets) else 0
+    if width is None:
+        lens = np.diff(term_offsets)
+        width = int(lens.max()) if vocab else 1
+    if tf is None:
+        tf = np.ones(num_postings, dtype=np.int32)
+    tf = np.ascontiguousarray(tf, dtype=np.int32)
+    if doc_lens is None:
+        doc_lens = np.bincount(
+            postings, weights=tf,
+            minlength=max_doc_id + 1).astype(np.int32)
+    doc_lens = np.ascontiguousarray(doc_lens, dtype=np.int32)
+    if len(doc_lens) != max_doc_id + 1:
+        out = np.zeros(max_doc_id + 1, dtype=np.int32)
+        out[:len(doc_lens)] = doc_lens[:max_doc_id + 1]
+        doc_lens = out
+
+    blk_max: list[int] = []
+    blk_first: list[int] = []
+    blk_width: list[int] = []
+    blk_tf_width: list[int] = []
+    post_parts: list[np.ndarray] = []
+    tf_parts: list[np.ndarray] = []
+    for t in range(vocab):
+        lo, hi = int(post_offsets[t]), int(post_offsets[t + 1])
+        for b0 in range(lo, hi, B):
+            b1 = min(b0 + B, hi)
+            docs = postings[b0:b1].astype(np.int64)
+            tfs = tf[b0:b1].astype(np.int64)
+            blk_first.append(int(docs[0]))
+            blk_max.append(int(docs[-1]))
+            deltas = np.diff(docs) - 1
+            w = int(deltas.max()).bit_length() if len(deltas) and \
+                deltas.max() > 0 else 0
+            tw = int(tfs.max() - 1).bit_length() if tfs.max() > 1 else 0
+            blk_width.append(w)
+            blk_tf_width.append(tw)
+            post_parts.append(_pack_bits(deltas, w))
+            tf_parts.append(_pack_bits(tfs - 1, tw))
+    post_data = (np.concatenate(post_parts) if post_parts
+                 else np.zeros(0, dtype=np.uint8))
+    tf_data = (np.concatenate(tf_parts) if tf_parts
+               else np.zeros(0, dtype=np.uint8))
+    num_blocks = len(blk_max)
+
+    layout, total = _layout_v2(vocab, blob_bytes, num_blocks,
+                               len(post_data), len(tf_data), max_doc_id)
+    buf = np.zeros(total, dtype=np.uint8)
+
+    def put(name: str, arr: np.ndarray) -> None:
+        off, nbytes = layout[name]
+        buf[off:off + nbytes] = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+
+    first_bytes = term_blob[term_offsets[:-1]] if vocab else term_blob[:0]
+    letter_dir = np.searchsorted(
+        first_bytes, np.arange(ord("a"), ord("a") + 27)).astype(np.int64)
+    put("letter_dir", letter_dir)
+    put("term_offsets", term_offsets)
+    put("term_blob", term_blob)
+    put("df", df)
+    put("blk_max", np.asarray(blk_max, dtype=np.int32))
+    put("blk_first", np.asarray(blk_first, dtype=np.int32))
+    put("blk_width", np.asarray(blk_width, dtype=np.uint8))
+    put("blk_tf_width", np.asarray(blk_tf_width, dtype=np.uint8))
+    put("post_data", post_data)
+    put("tf_data", tf_data)
+    put("doc_lens", doc_lens)
+    put("df_order", df_order)
+
+    return _write(path, buf, width=width, vocab=vocab,
+                  num_postings=num_postings, max_doc_id=max_doc_id,
+                  blob_bytes=blob_bytes, version=VERSION_V2,
+                  v2={"block_size": B, "num_blocks": num_blocks,
+                      "post_data_bytes": len(post_data),
+                      "tf_data_bytes": len(tf_data)})
+
+
 class Artifact:
-    """Zero-copy numpy views over a verified, mmapped ``index.mri``."""
+    """Zero-copy numpy views over a verified, mmapped ``index.mri``.
+
+    Both format versions present the same decode API; v2 additionally
+    exposes the block skip table (``blk_max``/``blk_first``/widths), the
+    derived block geometry (``term_block_off``, ``blk_cnt``, word-offset
+    prefix sums) and the BM25 columns (``decode_tf``, ``doc_lens``).
+    """
+
+    _VIEW_NAMES = ("letter_dir", "term_offsets", "term_blob", "df",
+                   "post_offsets", "postings", "df_order",
+                   "blk_max", "blk_first", "blk_width", "blk_tf_width",
+                   "post_words", "tf_words", "doc_lens")
 
     def __init__(self, path: Path, mm: mmap.mmap, meta: dict,
                  views: dict[str, np.ndarray]):
         self.path = path
         self._mm = mm
+        self.version = meta.get("version", VERSION)
         self.vocab = meta["vocab"]
         self.num_postings = meta["num_postings"]
         self.max_doc_id = meta["max_doc_id"]
         self.width = meta["width"]
         self.nbytes = meta["nbytes"]
-        self.letter_dir = views["letter_dir"]
-        self.term_offsets = views["term_offsets"]
-        self.term_blob = views["term_blob"]
-        self.df = views["df"]
-        self.post_offsets = views["post_offsets"]
-        self.postings = views["postings"]  # delta-encoded
-        self.df_order = views["df_order"]
+        for name in self._VIEW_NAMES:
+            setattr(self, name, views.get(name))
+        # v2 derived block geometry (computed by the loader, vectorized)
+        self.block_size = meta.get("block_size", 0)
+        self.num_blocks = meta.get("num_blocks", 0)
+        self.term_block_off = meta.get("term_block_off")
+        self.blk_cnt = meta.get("blk_cnt")
+        self.blk_woff = meta.get("blk_woff")
+        self.blk_tf_woff = meta.get("blk_tf_woff")
 
     def term(self, idx: int) -> bytes:
         lo, hi = self.term_offsets[idx], self.term_offsets[idx + 1]
         return self.term_blob[lo:hi].tobytes()
 
+    def _gather_packed(self, sel: np.ndarray, words: np.ndarray,
+                       woff: np.ndarray, widths: np.ndarray,
+                       nvals: np.ndarray) -> np.ndarray:
+        """Decode variable-width packed values for the selected blocks.
+
+        ``sel`` indexes blocks; block i holds ``nvals[i]`` values at
+        ``widths[i]`` bits starting at word ``woff[i]`` of ``words``.
+        Returns an (len(sel), max(nvals)) int64 matrix; entries past a
+        block's count are 0.  Fully vectorized: one word gather, one
+        unpackbits, one broadcast bit-gather, one matmul.
+        """
+        n = len(sel)
+        J = int(nvals.max()) if n else 0
+        out = np.zeros((n, max(J, 1)), dtype=np.int64)
+        if not n or not J:
+            return out
+        W = int(widths.max())
+        wlen = (nvals * widths + 31) >> 5
+        total = int(wlen.sum())
+        if not W or not total:
+            return out
+        starts = np.zeros(n, dtype=np.int64)
+        np.cumsum(wlen[:-1], out=starts[1:])
+        word_src = np.repeat(woff - starts, wlen) + np.arange(total)
+        bits = np.unpackbits(
+            np.ascontiguousarray(words[word_src]).view(np.uint8),
+            bitorder="little")
+        j = np.arange(J)
+        k = np.arange(W)
+        bitpos = (starts * 32)[:, None] + j[None, :] * widths[:, None]
+        idx3 = bitpos[:, :, None] + k[None, None, :]
+        np.clip(idx3, 0, bits.size - 1, out=idx3)
+        valid = (j[None, :, None] < nvals[:, None, None]) & \
+                (k[None, None, :] < widths[:, None, None])
+        g = np.where(valid, bits[idx3], 0)
+        out[:, :J] = g @ (np.int64(1) << k)
+        return out
+
+    def decode_blocks(self, sel: np.ndarray) -> tuple[np.ndarray,
+                                                      np.ndarray]:
+        """v2: absolute doc ids of the selected (global) block indices.
+
+        Returns ``(ids, cnt)`` — an (len(sel), block_size) int32 matrix
+        (entries past ``cnt[i]`` are garbage; mask with
+        ``arange(block_size) < cnt[:, None]``) and the per-block counts.
+        """
+        sel = np.asarray(sel, dtype=np.int64)
+        cnt = self.blk_cnt[sel].astype(np.int64)
+        w = self.blk_width[sel].astype(np.int64)
+        deltas = self._gather_packed(sel, self.post_words,
+                                     self.blk_woff[sel], w, cnt - 1)
+        B = self.block_size
+        out = np.zeros((len(sel), B), dtype=np.int64)
+        out[:, 0] = self.blk_first[sel]
+        out[:, 1:deltas.shape[1] + 1] = np.where(
+            np.arange(deltas.shape[1])[None, :] < (cnt - 1)[:, None],
+            deltas + 1, 0)
+        np.cumsum(out, axis=1, out=out)
+        return out.astype(np.int32), cnt
+
     def decode_postings(self, idx: int) -> np.ndarray:
         """One term's absolute ascending doc ids (a fresh array)."""
-        lo, hi = self.post_offsets[idx], self.post_offsets[idx + 1]
-        return np.cumsum(self.postings[lo:hi], dtype=np.int64).astype(
-            np.int32)
+        if self.version == VERSION:
+            lo, hi = self.post_offsets[idx], self.post_offsets[idx + 1]
+            return np.cumsum(self.postings[lo:hi], dtype=np.int64).astype(
+                np.int32)
+        b0, b1 = self.term_block_off[idx], self.term_block_off[idx + 1]
+        if b0 == b1:
+            return np.zeros(0, dtype=np.int32)
+        ids, cnt = self.decode_blocks(np.arange(b0, b1))
+        return ids[np.arange(self.block_size)[None, :] < cnt[:, None]]
+
+    def decode_tf(self, idx: int) -> np.ndarray:
+        """One term's per-document term frequencies, aligned with
+        :meth:`decode_postings` (v1 artifacts carry no tf: all ones)."""
+        if self.version == VERSION:
+            df = int(self.post_offsets[idx + 1] - self.post_offsets[idx])
+            return np.ones(df, dtype=np.int32)
+        b0, b1 = self.term_block_off[idx], self.term_block_off[idx + 1]
+        if b0 == b1:
+            return np.zeros(0, dtype=np.int32)
+        sel = np.arange(b0, b1)
+        cnt = self.blk_cnt[sel].astype(np.int64)
+        tw = self.blk_tf_width[sel].astype(np.int64)
+        vals = self._gather_packed(sel, self.tf_words,
+                                   self.blk_tf_woff[sel], tw, cnt)
+        tfm = (vals + 1)[:, :self.block_size]
+        if tfm.shape[1] < self.block_size and len(sel) > 1:
+            tfm = np.pad(tfm, ((0, 0),
+                               (0, self.block_size - tfm.shape[1])))
+        return tfm[np.arange(tfm.shape[1])[None, :]
+                   < cnt[:, None]].astype(np.int32)
 
     def close(self) -> None:
         # drop the views before the mmap: numpy holds buffer references
-        for name in ("letter_dir", "term_offsets", "term_blob", "df",
-                     "post_offsets", "postings", "df_order"):
+        for name in self._VIEW_NAMES:
             setattr(self, name, None)
+        self.term_block_off = self.blk_cnt = None
+        self.blk_woff = self.blk_tf_woff = None
         if self._mm is not None:
             try:
                 self._mm.close()
@@ -272,11 +602,24 @@ def load_artifact(path: str | Path) -> Artifact:
         if magic != MAGIC:
             raise ArtifactError(
                 f"{path}: bad magic {magic!r} (not an index.mri)")
-        if version != VERSION:
+        if version not in (VERSION, VERSION_V2):
             raise ArtifactError(
                 f"{path}: unsupported artifact version {version} "
-                f"(this reader knows version {VERSION})")
-        layout, total = _layout(vocab, num_postings, blob_bytes)
+                f"(this reader knows versions {VERSION} and {VERSION_V2})")
+        v2 = None
+        if version == VERSION_V2:
+            (block_size, _res, num_blocks, post_data_bytes,
+             tf_data_bytes) = struct.unpack_from(
+                _HEADER_V2_FMT, head, _HEADER_V2_OFF)
+            if block_size < 2 or block_size & (block_size - 1):
+                raise ArtifactError(
+                    f"{path}: invalid v2 block size {block_size}")
+            v2 = (block_size, num_blocks, post_data_bytes, tf_data_bytes)
+            layout, total = _layout_v2(
+                vocab, blob_bytes, num_blocks, post_data_bytes,
+                tf_data_bytes, max_doc_id)
+        else:
+            layout, total = _layout(vocab, num_postings, blob_bytes)
         if total != size or payload_bytes != size - HEADER_BYTES:
             raise ArtifactError(
                 f"{path}: truncated artifact — header promises "
@@ -288,11 +631,53 @@ def load_artifact(path: str | Path) -> Artifact:
         dtypes = {"letter_dir": np.int64, "term_offsets": np.int64,
                   "term_blob": np.uint8, "df": np.int32,
                   "post_offsets": np.int64, "postings": np.int32,
-                  "df_order": np.int32}
-        views = {name: raw[off:off + nbytes].view(dtypes[name])
-                 for name, (off, nbytes) in layout.items()}
-        meta = {"vocab": vocab, "num_postings": num_postings,
+                  "df_order": np.int32,
+                  "blk_max": np.int32, "blk_first": np.int32,
+                  "blk_width": np.uint8, "blk_tf_width": np.uint8,
+                  "post_words": np.uint32, "tf_words": np.uint32,
+                  "doc_lens": np.int32}
+        names = {"post_data": "post_words", "tf_data": "tf_words"}
+        views = {}
+        for name, (off, nbytes) in layout.items():
+            name = names.get(name, name)
+            views[name] = raw[off:off + nbytes].view(dtypes[name])
+        meta = {"version": version, "vocab": vocab,
+                "num_postings": num_postings,
                 "max_doc_id": max_doc_id, "width": width, "nbytes": size}
+        if v2 is not None:
+            block_size, num_blocks, post_data_bytes, tf_data_bytes = v2
+            df = views["df"].astype(np.int64)
+            bpt = -(-df // block_size)  # ceil(df / B); 0 for df == 0
+            term_block_off = np.zeros(vocab + 1, dtype=np.int64)
+            np.cumsum(bpt, out=term_block_off[1:])
+            if term_block_off[-1] != num_blocks:
+                raise ArtifactError(
+                    f"{path}: v2 geometry mismatch — df implies "
+                    f"{int(term_block_off[-1])} blocks, header says "
+                    f"{num_blocks}")
+            blk_cnt = np.full(num_blocks, block_size, dtype=np.int32)
+            last = term_block_off[1:][bpt > 0] - 1
+            blk_cnt[last] = (df[bpt > 0]
+                             - (bpt[bpt > 0] - 1) * block_size)
+            cnt64 = blk_cnt.astype(np.int64)
+            pw = (np.maximum(cnt64 - 1, 0)
+                  * views["blk_width"].astype(np.int64) + 31) >> 5
+            tw = (cnt64 * views["blk_tf_width"].astype(np.int64)
+                  + 31) >> 5
+            blk_woff = np.zeros(num_blocks + 1, dtype=np.int64)
+            np.cumsum(pw, out=blk_woff[1:])
+            blk_tf_woff = np.zeros(num_blocks + 1, dtype=np.int64)
+            np.cumsum(tw, out=blk_tf_woff[1:])
+            if blk_woff[-1] * 4 != post_data_bytes \
+                    or blk_tf_woff[-1] * 4 != tf_data_bytes:
+                raise ArtifactError(
+                    f"{path}: v2 geometry mismatch — widths imply "
+                    f"{int(blk_woff[-1]) * 4}/{int(blk_tf_woff[-1]) * 4} "
+                    f"packed bytes, header says "
+                    f"{post_data_bytes}/{tf_data_bytes}")
+            meta.update(block_size=block_size, num_blocks=num_blocks,
+                        term_block_off=term_block_off, blk_cnt=blk_cnt,
+                        blk_woff=blk_woff, blk_tf_woff=blk_tf_woff)
         return Artifact(path, mm, meta, views)
     except ArtifactError:
         mm.close()
@@ -352,20 +737,78 @@ def device_columns(art: Artifact) -> dict:
     else:
         key_hi = key_lo = np.zeros(0, dtype=np.uint32)
         max_group = 1
-    return {
+    cols = {
+        "format": art.version,
         "rows": rows[:V],
         "key_hi": key_hi.astype(np.uint32),
         "key_lo": key_lo.astype(np.uint32),
         "df": np.ascontiguousarray(art.df, dtype=np.int32),
-        "post_offsets": np.ascontiguousarray(
-            art.post_offsets, dtype=np.int32),
-        "postings": np.ascontiguousarray(art.postings, dtype=np.int32),
         "df_order": np.ascontiguousarray(art.df_order, dtype=np.int32),
         "letter_dir": np.ascontiguousarray(art.letter_dir, dtype=np.int32),
         "max_prefix_group": max_group,
         "vocab": V,
         "width": max(art.width, 1),
+        "max_doc_id": art.max_doc_id,
     }
+    if art.version == VERSION:
+        cols["post_offsets"] = np.ascontiguousarray(
+            art.post_offsets, dtype=np.int32)
+        cols["postings"] = np.ascontiguousarray(
+            art.postings, dtype=np.int32)
+        return cols
+    # v2: blocked layout.  All word offsets must fit int32 addressing;
+    # one zero pad word past each packed stream lets the unaligned
+    # two-word bit-window gather read words[i + 1] unconditionally.
+    if art.blk_woff[-1] >= 2 ** 31 - 1 \
+            or art.blk_tf_woff[-1] >= 2 ** 31 - 1:
+        raise ArtifactError(
+            f"{art.path}: packed postings exceed the device engine's "
+            f"int32 word addressing")
+    pad = np.zeros(1, dtype=np.uint32)
+    cols.update({
+        "block_size": art.block_size,
+        "term_block_off": np.ascontiguousarray(
+            art.term_block_off, dtype=np.int32),
+        "blk_first": np.ascontiguousarray(art.blk_first, dtype=np.int32),
+        "blk_width": np.ascontiguousarray(art.blk_width, dtype=np.int32),
+        "blk_tf_width": np.ascontiguousarray(
+            art.blk_tf_width, dtype=np.int32),
+        "blk_woff": np.ascontiguousarray(art.blk_woff, dtype=np.int32),
+        "blk_tf_woff": np.ascontiguousarray(
+            art.blk_tf_woff, dtype=np.int32),
+        "post_words": np.concatenate([art.post_words, pad]),
+        "tf_words": np.concatenate([art.tf_words, pad]),
+        "doc_lens": np.ascontiguousarray(art.doc_lens, dtype=np.int32),
+    })
+    return cols
+
+
+def bm25_corpus(art: Artifact) -> tuple[np.ndarray, int, float]:
+    """``(doc_lens float64, ndocs, avgdl)`` for BM25 scoring.
+
+    v2 reads the packed doc-length column; v1 carries no lengths, so
+    they are reconstructed from the postings themselves (each stored
+    pair counts 1 — the same tf=1 fallback the scorer uses).  Shared by
+    both engines so their corpus statistics agree exactly.
+    """
+    if art.version == VERSION_V2:
+        doc_lens = art.doc_lens.astype(np.float64)
+    elif art.num_postings:
+        flat = art.postings.astype(np.int64)
+        starts = art.post_offsets[:-1]
+        csum = np.cumsum(flat)
+        # undo the per-term delta encoding in one pass: subtract each
+        # term's cumulative base, re-anchor at its first absolute id
+        base = np.repeat(
+            csum[starts] - flat[starts], np.diff(art.post_offsets))
+        doc_lens = np.bincount(
+            (csum - base).astype(np.int64),
+            minlength=art.max_doc_id + 1).astype(np.float64)
+    else:
+        doc_lens = np.zeros(art.max_doc_id + 1, dtype=np.float64)
+    ndocs = int(np.count_nonzero(doc_lens))
+    avgdl = float(doc_lens[doc_lens > 0].mean()) if ndocs else 1.0
+    return doc_lens, ndocs, avgdl
 
 
 def checksum(path: str | Path) -> tuple[str, int]:
@@ -378,15 +821,37 @@ def checksum(path: str | Path) -> tuple[str, int]:
 # -- builders: lex arrays from each engine family's native shapes --------
 
 
-def build_from_merge(path, merge) -> int:
+def build_from_merge(path, merge, *, fmt: int | None = None,
+                     block_size: int | None = None) -> int:
     """Pack straight off a live :class:`native.HostIndexMerge`: one C++
     pass fills every payload section of the final file buffer at the
     layout's offsets — compact blob, delta-encoded postings and all —
     leaving only checksums, the header, and the atomic write here.  The
     cpu backend's fast path: the two-step ``export_arrays`` +
     :func:`build_from_export` route costs ~2x more on the pack-time
-    budget (<= 10 % of the unaudited e2e)."""
+    budget (<= 10 % of the unaudited e2e).
+
+    ``fmt``/``block_size`` default to the ``MRI_SERVE_FORMAT`` /
+    ``MRI_SERVE_BLOCK_SIZE`` knobs; format 2 runs the native two-call
+    v2 export (prepare sizes the packed streams, payload fills them).
+    """
     vocab, width, num_pairs, blob_bytes, max_doc_id = merge.export_info()
+    if resolve_format(fmt) == VERSION_V2:
+        block_size = resolve_block_size(block_size)
+        num_blocks, post_bytes, tf_bytes = \
+            merge.export_v2_prepare(block_size)
+        layout, total = _layout_v2(vocab, blob_bytes, num_blocks,
+                                   post_bytes, tf_bytes, max_doc_id)
+        buf = np.zeros(total, dtype=np.uint8)
+        merge.export_v2_payload(
+            buf, {n: off for n, (off, _) in layout.items()})
+        return _write(path, buf, width=width, vocab=vocab,
+                      num_postings=num_pairs, max_doc_id=max_doc_id,
+                      blob_bytes=blob_bytes, version=VERSION_V2,
+                      v2={"block_size": block_size,
+                          "num_blocks": num_blocks,
+                          "post_data_bytes": post_bytes,
+                          "tf_data_bytes": tf_bytes})
     layout, total = _layout(vocab, num_pairs, blob_bytes)
     buf = np.zeros(total, dtype=np.uint8)
     merge.export_payload(buf, {n: off for n, (off, _) in layout.items()})

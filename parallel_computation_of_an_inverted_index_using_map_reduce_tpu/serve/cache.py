@@ -56,6 +56,14 @@ class LRUCache:
                 self._data.popitem(last=False)
                 self.evictions += 1
 
+    def peek(self, key, default=None):
+        """``get`` without recency promotion or hit/miss accounting —
+        for callers that only want to know whether paying the decode
+        can be avoided (e.g. the v2 skip-AND arm)."""
+        with self._lock:
+            value = self._data.get(key, _MISSING)
+            return default if value is _MISSING else value
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._data)

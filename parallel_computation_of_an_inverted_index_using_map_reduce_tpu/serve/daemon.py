@@ -48,6 +48,8 @@ Protocol — one JSON object per line, one response line per request::
     {"id": 3, "op": "and",      "terms": ["big", "cat"]}
     {"id": 4, "op": "or",       "terms": ["big", "cat"]}
     {"id": 5, "op": "top_k",    "letter": "a", "k": 3}
+    {"id": 5, "op": "top_k",    "score": "bm25", "k": 3,
+                                "terms": ["big", "cat"]}
     {"id": 6, "op": "stats"}        # admin: answered inline
     {"id": 7, "op": "healthz"}      # admin: answered inline
     {"id": 8, "op": "reload"}       # admin: swap to the new index.mri
@@ -98,16 +100,18 @@ class _Request:
     ``finish`` (exactly one response per request — ok or counted
     error — enforced by the ``done`` flag)."""
 
-    __slots__ = ("conn", "rid", "op", "terms", "letter", "k",
+    __slots__ = ("conn", "rid", "op", "terms", "letter", "k", "score",
                  "seq", "expires_at", "done")
 
-    def __init__(self, conn, rid, op, terms, letter, k, seq, expires_at):
+    def __init__(self, conn, rid, op, terms, letter, k, score, seq,
+                 expires_at):
         self.conn = conn
         self.rid = rid
         self.op = op
         self.terms = terms
         self.letter = letter
         self.k = k
+        self.score = score
         self.seq = seq
         self.expires_at = expires_at
         self.done = False
@@ -387,7 +391,7 @@ class ServeDaemon:
             if deadline_ms is not None else None
         item = _Request(conn, rid, op, req.get("terms"),
                         req.get("letter"), int(req.get("k") or 0),
-                        seq, expires_at)
+                        req.get("score") or "df", seq, expires_at)
         with conn.lock:
             conn.pending += 1
         try:
@@ -412,13 +416,23 @@ class ServeDaemon:
                                or isinstance(dl, bool) or dl <= 0):
             return f"deadline_ms must be a positive number, got {dl!r}"
         if op == "top_k":
+            score = req.get("score") or "df"
+            if score not in ("df", "bm25"):
+                return f"top_k score must be df or bm25, got {score!r}"
+            k = req.get("k")
+            if not isinstance(k, int) or isinstance(k, bool) or k < 0:
+                return f"top_k needs integer k >= 0, got {k!r}"
+            if score == "bm25":
+                terms = req.get("terms")
+                if not isinstance(terms, list) or not terms \
+                        or not all(isinstance(t, str) for t in terms):
+                    return ("top_k score=bm25 needs terms=[str, ...], "
+                            f"got {terms!r}")
+                return None
             letter = req.get("letter")
             if not (isinstance(letter, str) and len(letter) == 1
                     and "a" <= letter <= "z"):
                 return f"top_k needs letter=a..z, got {letter!r}"
-            k = req.get("k")
-            if not isinstance(k, int) or isinstance(k, bool) or k < 0:
-                return f"top_k needs integer k >= 0, got {k!r}"
             return None
         terms = req.get("terms")
         if not isinstance(terms, list) \
@@ -571,7 +585,13 @@ class ServeDaemon:
                         docs = eng.query_or(eng.encode_batch(it.terms))
                         self._finish(it, {"ok": True,
                                           "docs": docs.tolist()})
-                    else:  # top_k
+                    elif it.op == "top_k" and it.score == "bm25":
+                        top = eng.top_k_scored(
+                            eng.encode_batch(it.terms), it.k)
+                        self._finish(it, {
+                            "ok": True,
+                            "docs": [[d, s] for d, s in top]})
+                    else:  # top_k by df
                         top = eng.top_k(it.letter, it.k)
                         self._finish(it, {
                             "ok": True,

@@ -46,7 +46,7 @@ import numpy as np
 
 from . import artifact as artifact_mod
 from .cache import LRUCache
-from .engine import OpTimer, encode_terms, letter_index
+from .engine import BM25_B, BM25_K1, OpTimer, encode_terms, letter_index
 
 import jax
 import jax.numpy as jnp
@@ -145,6 +145,86 @@ def _make_decode(mesh, width: int):
         out_specs=P(SHARD_AXIS), check_vma=False))
 
 
+def _bit_window(words, word_ix, off, nbits):
+    """Per-lane unaligned read of a ``nbits``-bit little-endian value
+    starting ``off`` bits into word ``word_ix``: two word gathers + a
+    fixed shift-or (``words`` carries one zero pad word so ``+ 1`` never
+    reads past the stream)."""
+    r = (off & 31).astype(jnp.uint32)
+    w0 = words[word_ix]
+    w1 = words[word_ix + 1]
+    val = (w0 >> r) | jnp.where(
+        r == 0, jnp.uint32(0), w1 << ((jnp.uint32(32) - r) & 31))
+    nb = nbits.astype(jnp.uint32)
+    mask = jnp.where(nb == 0, jnp.uint32(0), (jnp.uint32(1) << nb)
+                     - jnp.uint32(1))
+    return (val & mask).astype(jnp.int32)
+
+
+def _decode_window_v2(term_block_off, blk_first, blk_width, blk_woff,
+                      post_words, idx, n, *, width: int,
+                      block_size: int):
+    """v2 mirror of :func:`_decode_window`: (len(idx), width) sentinel-
+    padded absolute doc ids straight from the blocked bitpacked layout.
+
+    Lane j of a term maps statically to block ``j // block_size`` slot
+    ``j % block_size``; slot 0 reads the skip table's absolute
+    ``blk_first``, every other slot bit-extracts its (delta - 1).  The
+    cumsum then runs PER BLOCK (blocks re-anchor absolutely), so a
+    partially-filled block's trailing garbage never contaminates the
+    next block — and invalid lanes are sentinel-masked exactly as v1.
+    """
+    lane = jnp.arange(width, dtype=jnp.int32)
+    s = lane & (block_size - 1)
+    qb = lane >> (block_size.bit_length() - 1)
+    bl = term_block_off[idx][:, None] + qb[None, :]
+    w = blk_width[bl]
+    off = jnp.maximum(s - 1, 0)[None, :] * w
+    delta = _bit_window(post_words, blk_woff[bl] + (off >> 5),
+                        off, w) + 1
+    vals = jnp.where(s[None, :] == 0, blk_first[bl], delta)
+    if width <= block_size:
+        docs = jnp.cumsum(vals, axis=1, dtype=jnp.int32)
+    else:
+        T = vals.shape[0]
+        docs = jnp.cumsum(
+            vals.reshape(T, width // block_size, block_size),
+            axis=2, dtype=jnp.int32).reshape(T, width)
+    valid = lane[None, :] < n[:, None]
+    return jnp.where(valid, docs, _SENTINEL)
+
+
+def _tf_window_v2(term_block_off, blk_tf_width, blk_tf_woff, tf_words,
+                  idx, n, *, width: int, block_size: int):
+    """(len(idx), width) term frequencies aligned with
+    :func:`_decode_window_v2` (slot s reads packed value s; no cumsum —
+    tf entries are independent).  Invalid lanes carry 0."""
+    lane = jnp.arange(width, dtype=jnp.int32)
+    s = lane & (block_size - 1)
+    qb = lane >> (block_size.bit_length() - 1)
+    bl = term_block_off[idx][:, None] + qb[None, :]
+    tw = blk_tf_width[bl]
+    off = s[None, :] * tw
+    tf = _bit_window(tf_words, blk_tf_woff[bl] + (off >> 5),
+                     off, tw) + 1
+    valid = lane[None, :] < n[:, None]
+    return jnp.where(valid, tf, 0)
+
+
+def _make_decode_v2(mesh, width: int, block_size: int):
+    def body(term_block_off, blk_first, blk_width, blk_woff, post_words,
+             idx, n):
+        return _decode_window_v2(
+            term_block_off, blk_first, blk_width, blk_woff, post_words,
+            idx, n, width=width, block_size=block_size)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(),
+                  P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=P(SHARD_AXIS), check_vma=False))
+
+
 def _make_bool(op: str, width: int):
     """Jitted T-term AND/OR over sentinel-padded posting windows.
 
@@ -156,22 +236,84 @@ def _make_bool(op: str, width: int):
 
     def body(post_offsets, postings, idx, n):
         docs = _decode_window(post_offsets, postings, idx, n, width=width)
-        T = docs.shape[0]
-        if op == "and":
-            vals = docs[0]
-            alive = jnp.arange(width) < n[0]
-            for t in range(1, T):
-                j = jnp.searchsorted(docs[t], vals)
-                alive = alive & (j < width) & (
-                    docs[t][jnp.minimum(j, width - 1)] == vals)
-            out = jnp.sort(jnp.where(alive, vals, _SENTINEL))
-            return out, alive.sum()
-        flat = jnp.sort(docs.ravel())
-        first = jnp.concatenate(
-            [jnp.ones((1,), bool), flat[1:] != flat[:-1]])
-        keep = first & (flat != _SENTINEL)
-        out = jnp.sort(jnp.where(keep, flat, _SENTINEL))
-        return out, keep.sum()
+        return _bool_tail(op, docs, n, width)
+
+    return jax.jit(body)
+
+
+def _bool_tail(op: str, docs, n, width: int):
+    """Shared AND/OR combine over a (T, width) sentinel-padded window."""
+    T = docs.shape[0]
+    if op == "and":
+        vals = docs[0]
+        alive = jnp.arange(width) < n[0]
+        for t in range(1, T):
+            j = jnp.searchsorted(docs[t], vals)
+            alive = alive & (j < width) & (
+                docs[t][jnp.minimum(j, width - 1)] == vals)
+        out = jnp.sort(jnp.where(alive, vals, _SENTINEL))
+        return out, alive.sum()
+    flat = jnp.sort(docs.ravel())
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), flat[1:] != flat[:-1]])
+    keep = first & (flat != _SENTINEL)
+    out = jnp.sort(jnp.where(keep, flat, _SENTINEL))
+    return out, keep.sum()
+
+
+def _make_bool_v2(op: str, width: int, block_size: int):
+    def body(term_block_off, blk_first, blk_width, blk_woff, post_words,
+             idx, n):
+        docs = _decode_window_v2(
+            term_block_off, blk_first, blk_width, blk_woff, post_words,
+            idx, n, width=width, block_size=block_size)
+        return _bool_tail(op, docs, n, width)
+
+    return jax.jit(body)
+
+
+def _bm25_tail(docs, tfs, n, found, doc_lens, ndocs, avgdl, width: int,
+               k: int):
+    """Scatter-add BM25 contributions into a dense doc-score column and
+    ``lax.top_k`` it: ties prefer the lower doc id (top_k is stable)."""
+    lane_ok = (jnp.arange(width)[None, :] < n[:, None]) \
+        & found[:, None] & (docs != _SENTINEL)
+    dfv = jnp.where(found, n, 0).astype(jnp.float32)
+    idf = jnp.log(1.0 + (ndocs - dfv + 0.5) / (dfv + 0.5))
+    tff = tfs.astype(jnp.float32)
+    dl = doc_lens[jnp.where(lane_ok, docs, 0)]
+    denom = tff + BM25_K1 * (1.0 - BM25_B + BM25_B * dl / avgdl)
+    contrib = jnp.where(
+        lane_ok, idf[:, None] * tff * (BM25_K1 + 1.0) / denom, 0.0)
+    scores = jnp.zeros(doc_lens.shape[0], jnp.float32).at[
+        jnp.where(lane_ok, docs, 0).ravel()].add(contrib.ravel())
+    vals, ids = jax.lax.top_k(scores, k)
+    return ids, vals
+
+
+def _make_bm25(width: int, k: int):
+    def body(post_offsets, postings, idx, n, found, doc_lens, ndocs,
+             avgdl):
+        docs = _decode_window(post_offsets, postings, idx, n, width=width)
+        tfs = jnp.ones(docs.shape, jnp.int32)  # v1: no tf column
+        return _bm25_tail(docs, tfs, n, found, doc_lens, ndocs, avgdl,
+                          width, k)
+
+    return jax.jit(body)
+
+
+def _make_bm25_v2(width: int, k: int, block_size: int):
+    def body(term_block_off, blk_first, blk_width, blk_woff, post_words,
+             blk_tf_width, blk_tf_woff, tf_words, idx, n, found,
+             doc_lens, ndocs, avgdl):
+        docs = _decode_window_v2(
+            term_block_off, blk_first, blk_width, blk_woff, post_words,
+            idx, n, width=width, block_size=block_size)
+        tfs = _tf_window_v2(
+            term_block_off, blk_tf_width, blk_tf_woff, tf_words,
+            idx, n, width=width, block_size=block_size)
+        return _bm25_tail(docs, tfs, n, found, doc_lens, ndocs, avgdl,
+                          width, k)
 
     return jax.jit(body)
 
@@ -228,9 +370,29 @@ class DeviceEngine:
         self._d_key_lo = put(cols["key_lo"])
         self._d_rows = put(cols["rows"])
         self._d_df = put(cols["df"])
-        self._d_post_offsets = put(cols["post_offsets"])
-        self._d_postings = put(cols["postings"])
         self._d_df_order = put(cols["df_order"])
+        self._fmt = cols["format"]
+        if self._fmt == artifact_mod.VERSION_V2:
+            self._block_size = cols["block_size"]
+            self._d_term_block_off = put(cols["term_block_off"])
+            self._d_blk_first = put(cols["blk_first"])
+            self._d_blk_width = put(cols["blk_width"])
+            self._d_blk_woff = put(cols["blk_woff"])
+            self._d_post_words = put(cols["post_words"])
+            self._d_blk_tf_width = put(cols["blk_tf_width"])
+            self._d_blk_tf_woff = put(cols["blk_tf_woff"])
+            self._d_tf_words = put(cols["tf_words"])
+            self._decode_cols = (
+                self._d_term_block_off, self._d_blk_first,
+                self._d_blk_width, self._d_blk_woff, self._d_post_words)
+            self._d_post_offsets = self._d_postings = None
+        else:
+            self._block_size = 0
+            self._d_post_offsets = put(cols["post_offsets"])
+            self._d_postings = put(cols["postings"])
+            self._decode_cols = (self._d_post_offsets, self._d_postings)
+        self._d_doc_lens = None  # lazy: uploaded at first top_k_scored
+        self._bm25_scalars = None
 
         # posting tiers: powers of 4 from 8 up to the global max df, so
         # every batch decodes at the smallest static width covering it
@@ -248,6 +410,7 @@ class DeviceEngine:
         self._decode_fns: dict[int, object] = {}
         self._bool_fns: dict[tuple, object] = {}
         self._topk_fns: dict[int, object] = {}
+        self._bm25_fns: dict[tuple, object] = {}
 
         self._cache = LRUCache(cache_terms)  # idle on the device path
         self._ops = OpTimer()
@@ -268,7 +431,11 @@ class DeviceEngine:
     def _decode_fn(self, width: int):
         fn = self._decode_fns.get(width)
         if fn is None:
-            fn = self._decode_fns[width] = _make_decode(self._mesh, width)
+            if self._fmt == artifact_mod.VERSION_V2:
+                fn = _make_decode_v2(self._mesh, width, self._block_size)
+            else:
+                fn = _make_decode(self._mesh, width)
+            self._decode_fns[width] = fn
         return fn
 
     # -- term resolution ------------------------------------------------
@@ -340,7 +507,7 @@ class DeviceEngine:
                     [part_idx, np.zeros(Bp - L, np.int32)])
                 part_n = np.concatenate(
                     [part_n, np.zeros(Bp - L, np.int32)])
-            win = fn(self._d_post_offsets, self._d_postings,
+            win = fn(*self._decode_cols,
                      part_idx.astype(np.int32), part_n.astype(np.int32))
             out[at:at + L] = np.asarray(win)[:L]
         return out
@@ -379,7 +546,11 @@ class DeviceEngine:
     def _bool_fn(self, op: str, T: int, width: int):
         fn = self._bool_fns.get((op, T, width))
         if fn is None:
-            fn = self._bool_fns[(op, T, width)] = _make_bool(op, width)
+            if self._fmt == artifact_mod.VERSION_V2:
+                fn = _make_bool_v2(op, width, self._block_size)
+            else:
+                fn = _make_bool(op, width)
+            self._bool_fns[(op, T, width)] = fn
         return fn
 
     def _run_bool(self, op: str, uidx: np.ndarray) -> np.ndarray:
@@ -399,8 +570,7 @@ class DeviceEngine:
                 n = np.concatenate([n, np.zeros(pad, np.int32)])
         width = self._tier(int(n.max()) if len(n) else 1)
         out, cnt = self._bool_fn(op, T, width)(
-            self._d_post_offsets, self._d_postings,
-            uidx.astype(np.int32), n)
+            *self._decode_cols, uidx.astype(np.int32), n)
         return np.asarray(out)[:int(cnt)].astype(np.int32)
 
     def query_and(self, batch) -> np.ndarray:
@@ -417,6 +587,63 @@ class DeviceEngine:
             if len(uidx) == 0:
                 return np.zeros(0, dtype=np.int32)
             return self._run_bool("or", uidx)
+
+    # -- ranked retrieval -----------------------------------------------
+
+    def _bm25_device(self):
+        """Upload the doc-length column + corpus scalars once."""
+        if self._d_doc_lens is None:
+            doc_lens, ndocs, avgdl = artifact_mod.bm25_corpus(
+                self.artifact)
+            rep = NamedSharding(self._mesh, P())
+            self._d_doc_lens = jax.device_put(
+                doc_lens.astype(np.float32), rep)
+            self._bm25_scalars = (np.float32(ndocs), np.float32(avgdl))
+        return self._d_doc_lens, self._bm25_scalars
+
+    def _bm25_fn(self, T: int, width: int, k: int):
+        fn = self._bm25_fns.get((T, width, k))
+        if fn is None:
+            if self._fmt == artifact_mod.VERSION_V2:
+                fn = _make_bm25_v2(width, k, self._block_size)
+            else:
+                fn = _make_bm25(width, k)
+            self._bm25_fns[(T, width, k)] = fn
+        return fn
+
+    def top_k_scored(self, batch, k: int) -> list[tuple[int, float]]:
+        """BM25-ranked ``(doc_id, score)``, best first, ties by doc id —
+        the device mirror of ``Engine.top_k_scored`` (float32 on
+        device, so scores agree with the host to ~1e-6 relative)."""
+        with self._ops.time("top_k_scored"):
+            idx, found, dfv = self._resolve(batch)
+            doc_lens, (ndocs, avgdl) = self._bm25_device()
+            D = int(doc_lens.shape[0])
+            if k <= 0 or D == 0 or not found.any():
+                return []
+            # duplicates accumulate (host parity): keep the full batch,
+            # padded to a power of two with never-found zero lanes
+            T = _next_pow2(len(idx))
+            if T != len(idx):
+                pad = T - len(idx)
+                idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+                found = np.concatenate([found, np.zeros(pad, bool)])
+                dfv = np.concatenate([dfv, np.zeros(pad, np.int32)])
+            n = np.where(found, dfv, 0).astype(np.int32)
+            width = self._tier(int(n.max()) if len(n) else 1)
+            k_eff = min(max(k, 0), D)
+            if self._fmt == artifact_mod.VERSION_V2:
+                cols = self._decode_cols + (
+                    self._d_blk_tf_width, self._d_blk_tf_woff,
+                    self._d_tf_words)
+            else:
+                cols = self._decode_cols
+            ids, vals = self._bm25_fn(T, width, k_eff)(
+                *cols, idx.astype(np.int32), n, found, doc_lens,
+                ndocs, avgdl)
+            ids, vals = np.asarray(ids), np.asarray(vals)
+            return [(int(d), float(s))
+                    for d, s in zip(ids, vals) if s > 0.0]
 
     # -- bookkeeping ----------------------------------------------------
 
@@ -435,7 +662,8 @@ class DeviceEngine:
         compares this before/after the steady-state run."""
         fns = ([self._lookup_fn] + list(self._decode_fns.values())
                + list(self._bool_fns.values())
-               + list(self._topk_fns.values()))
+               + list(self._topk_fns.values())
+               + list(self._bm25_fns.values()))
         return {
             "jit_functions": len(fns),
             "jit_cache_entries": sum(f._cache_size() for f in fns),
@@ -444,6 +672,7 @@ class DeviceEngine:
     def describe(self) -> dict:
         return {
             "engine": self.engine_name,
+            "format": self._fmt,
             "vocab": self.vocab_size,
             "artifact_bytes": self.artifact.nbytes,
             "cache": self.cache_stats(),
@@ -462,10 +691,17 @@ class DeviceEngine:
         self._cache.clear()
         self._d_key_hi = self._d_key_lo = self._d_rows = None
         self._d_df = self._d_post_offsets = self._d_postings = None
-        self._d_df_order = None
+        self._d_df_order = self._d_doc_lens = None
+        self._decode_cols = ()
+        if self._fmt == artifact_mod.VERSION_V2:
+            self._d_term_block_off = self._d_blk_first = None
+            self._d_blk_width = self._d_blk_woff = None
+            self._d_post_words = self._d_blk_tf_width = None
+            self._d_blk_tf_woff = self._d_tf_words = None
         self._decode_fns.clear()
         self._bool_fns.clear()
         self._topk_fns.clear()
+        self._bm25_fns.clear()
         self.artifact.close()
 
     def __enter__(self):

@@ -111,9 +111,18 @@ class Engine:
         self._keys = key8.view(">u8").ravel()
         self._df = art.df
         self._cache = LRUCache(cache_terms)
+        self._tf_cache = LRUCache(cache_terms)
         self._ops = OpTimer()
         self._sdtype = f"S{width}"
         self._width = width
+        # small-batch term-resolution memo: encoded query bytes ->
+        # lex index (-1: absent).  Zipf query streams resolve the same
+        # few terms over and over; a dict probe replaces the whole
+        # searchsorted arm for them.
+        self._memo: dict[bytes, int] = {}
+        self._decode = {"blocks_decoded": 0, "blocks_skipped": 0,
+                        "bytes_decoded": 0}
+        self._bm25_cols = None  # lazy (doc_lens, ndocs, avgdl)
 
     # -- term resolution ------------------------------------------------
 
@@ -133,6 +142,15 @@ class Engine:
         if V == 0:
             return (np.zeros(len(q), dtype=np.int64),
                     np.zeros(len(q), dtype=bool))
+        n = len(q)
+        memo = self._memo
+        if 0 < n <= 8:
+            hits = [memo.get(t) for t in q.tolist()]
+            if None not in hits:
+                at = np.array(hits, dtype=np.int64)
+                found = at >= 0
+                at[~found] = 0
+                return at, found
         # S -> S8 cast pads (width < 8) or truncates (width > 8) to the
         # 8-byte prefix; big-endian u64 view preserves lex order.
         qkeys = np.ascontiguousarray(q.astype("S8")).view(">u8")
@@ -148,6 +166,11 @@ class Engine:
             if j < hi[i] and self._terms[j] == q[i]:
                 at[i] = j
                 found[i] = True
+        if n <= 8:
+            if len(memo) > (1 << 16):
+                memo.clear()
+            for t, i, ok in zip(q.tolist(), at.tolist(), found.tolist()):
+                memo[t] = i if ok else -1
         return at, found
 
     # -- single-term answers --------------------------------------------
@@ -166,9 +189,32 @@ class Engine:
         hit = self._cache.get(idx)
         if hit is not None:
             return hit
-        decoded = self.artifact.decode_postings(idx)
+        art = self.artifact
+        decoded = art.decode_postings(idx)
+        dec = self._decode
+        if art.version == artifact_mod.VERSION_V2:
+            b0 = int(art.term_block_off[idx])
+            b1 = int(art.term_block_off[idx + 1])
+            dec["blocks_decoded"] += b1 - b0
+            dec["bytes_decoded"] += \
+                int(art.blk_woff[b1] - art.blk_woff[b0]) * 4
+        else:
+            dec["blocks_decoded"] += 1
+            dec["bytes_decoded"] += decoded.nbytes
         decoded.setflags(write=False)
         self._cache.put(idx, decoded)
+        return decoded
+
+    def tf_by_index(self, idx: int) -> np.ndarray:
+        """Per-doc term frequencies of lex term ``idx``, aligned with
+        :meth:`postings_by_index` (all ones on a v1 artifact)."""
+        idx = int(idx)
+        hit = self._tf_cache.get(idx)
+        if hit is not None:
+            return hit
+        decoded = self.artifact.decode_tf(idx)
+        decoded.setflags(write=False)
+        self._tf_cache.put(idx, decoded)
         return decoded
 
     def postings(self, batch) -> list[np.ndarray | None]:
@@ -191,26 +237,69 @@ class Engine:
             pick = art.df_order[lo:min(lo + max(k, 0), hi)]
             return [(art.term(i), int(self._df[i])) for i in pick]
 
+    def _and_probe(self, acc: np.ndarray, run: np.ndarray) -> np.ndarray:
+        """Keep the members of sorted ``acc`` present in sorted ``run``
+        (galloping ``searchsorted`` probe)."""
+        pos = np.searchsorted(run, acc)
+        ok = pos < len(run)
+        ok[ok] = run[pos[ok]] == acc[ok]
+        return acc[ok]
+
+    def _and_skip(self, acc: np.ndarray, idx: int) -> np.ndarray:
+        """v2 AND arm: intersect ``acc`` against term ``idx`` WITHOUT
+        decoding its whole postings run.  The per-block skip table
+        (``blk_max``) routes every surviving candidate to the single
+        block that could hold it; only those blocks are bit-unpacked.
+        """
+        art = self.artifact
+        dec = self._decode
+        b0 = int(art.term_block_off[idx])
+        b1 = int(art.term_block_off[idx + 1])
+        blk = np.searchsorted(art.blk_max[b0:b1], acc)
+        ok = blk < (b1 - b0)
+        blk, cand = blk[ok], acc[ok]
+        if not len(cand):
+            dec["blocks_skipped"] += b1 - b0
+            return cand
+        need = np.unique(blk)
+        ids, _ = art.decode_blocks(need + b0)
+        dec["blocks_decoded"] += len(need)
+        dec["blocks_skipped"] += (b1 - b0) - len(need)
+        dec["bytes_decoded"] += int(
+            (art.blk_woff[need + b0 + 1]
+             - art.blk_woff[need + b0]).sum()) * 4
+        # rows beyond a block's count repeat its last real doc id
+        # (cumsum of zero deltas), so a plain membership test is exact.
+        rows = ids[np.searchsorted(need, blk)]
+        return cand[(rows == cand[:, None]).any(axis=1)]
+
     def query_and(self, batch) -> np.ndarray:
         """Docs containing EVERY term.  Any absent term → empty.  The
         intersection gallops smallest-run-first: probe the larger sorted
-        run with ``searchsorted`` at the surviving candidates only."""
+        run with ``searchsorted`` at the surviving candidates only.  On
+        a v2 artifact an uncached large run is never fully decoded —
+        the skip table gallops past whole blocks (``--stats`` counts
+        them)."""
         with self._ops.time("and"):
             idx, found = self.lookup(batch)
             if len(found) == 0 or not found.all():
                 return np.zeros(0, dtype=np.int32)
-            runs = sorted(
-                (self.postings_by_index(i) for i in set(idx.tolist())),
-                key=len)
-            acc = runs[0]
-            for run in runs[1:]:
+            uniq = list(set(idx.tolist()))
+            uniq.sort(key=lambda i: int(self._df[i]))
+            acc = self.postings_by_index(uniq[0])
+            v2 = self.artifact.version == artifact_mod.VERSION_V2
+            B = self.artifact.block_size
+            for i in uniq[1:]:
                 if len(acc) == 0:
                     break
-                pos = np.searchsorted(run, acc)
-                ok = pos < len(run)
-                ok[ok] = run[pos[ok]] == acc[ok]
-                acc = acc[ok]
-            return acc
+                cached = self._cache.peek(i)
+                if cached is not None:
+                    acc = self._and_probe(acc, cached)
+                elif v2 and len(acc) * B < int(self._df[i]):
+                    acc = self._and_skip(acc, i)
+                else:
+                    acc = self._and_probe(acc, self.postings_by_index(i))
+            return np.ascontiguousarray(acc, dtype=np.int32)
 
     def query_or(self, batch) -> np.ndarray:
         """Docs containing ANY term (absent terms contribute nothing)."""
@@ -224,6 +313,41 @@ class Engine:
                 np.unique(np.concatenate(runs))
             return np.asarray(out, dtype=np.int32)
 
+    # -- ranked retrieval -----------------------------------------------
+
+    def _bm25_corpus(self) -> tuple[np.ndarray, int, float]:
+        """``(doc_lens, ndocs, avgdl)`` — v2 reads the packed doc-length
+        column; v1 reconstructs lengths from the postings themselves
+        (every stored pair counts 1: the no-tf fallback), lazily and
+        once."""
+        if self._bm25_cols is None:
+            self._bm25_cols = artifact_mod.bm25_corpus(self.artifact)
+        return self._bm25_cols
+
+    def top_k_scored(self, batch, k: int) -> list[tuple[int, float]]:
+        """BM25-ranked ``(doc_id, score)`` for the query terms, best
+        first, ties broken by ascending doc id.  Absent terms contribute
+        nothing; duplicated query terms accumulate twice (same as the
+        scoring oracle).  Parameters: k1=BM25_K1, b=BM25_B; idf is the
+        Robertson-Sparck-Jones ``ln(1 + (N - df + 0.5)/(df + 0.5))``."""
+        with self._ops.time("top_k_scored"):
+            idx, found = self.lookup(batch)
+            doc_lens, ndocs, avgdl = self._bm25_corpus()
+            scores = np.zeros(len(doc_lens), dtype=np.float64)
+            k1, b = BM25_K1, BM25_B
+            for i, ok in zip(idx.tolist(), found.tolist()):
+                if not ok:
+                    continue
+                docs = self.postings_by_index(i)
+                tf = self.tf_by_index(i).astype(np.float64)
+                dfi = len(docs)
+                idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
+                denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
+                scores[docs] += idf * tf * (k1 + 1.0) / denom
+            cand = np.nonzero(scores > 0.0)[0]
+            top = cand[np.lexsort((cand, -scores[cand]))][:max(k, 0)]
+            return [(int(d), float(scores[d])) for d in top]
+
     # -- bookkeeping ----------------------------------------------------
 
     @property
@@ -236,18 +360,27 @@ class Engine:
     def op_stats(self) -> dict:
         return self._ops.stats()
 
+    def decode_stats(self) -> dict:
+        """Skip/decode counters — the gallop win, observable."""
+        return dict(self._decode)
+
     def describe(self) -> dict:
         """Engine identity + counters for ``mri query --stats``."""
         return {
             "engine": self.engine_name,
+            "format": self.artifact.version,
             "vocab": self.vocab_size,
             "artifact_bytes": self.artifact.nbytes,
             "cache": self.cache_stats(),
             "ops": self.op_stats(),
+            "decode": self.decode_stats(),
         }
 
     def close(self) -> None:
         self._cache.clear()
+        self._tf_cache.clear()
+        self._memo.clear()
+        self._bm25_cols = None
         self._df = self._keys = self._terms = self._rows = None
         self.artifact.close()
 
@@ -264,6 +397,22 @@ class Engine:
 #: the caller asks for ``device`` explicitly.
 ENGINE_CHOICES = ("host", "device", "auto")
 ENGINE_ENV = "MRI_SERVE_ENGINE"
+
+#: BM25 free parameters (README "Format v2": classic defaults).
+BM25_K1 = 1.2
+BM25_B = 0.75
+
+SCORE_CHOICES = ("df", "bm25")
+SCORE_ENV = "MRI_SERVE_SCORE"
+
+
+def resolve_score(score: str | None = None) -> str:
+    """``df``/``bm25`` (+ MRI_SERVE_SCORE default) -> concrete mode."""
+    score = score or envknobs.get(SCORE_ENV)
+    if score not in SCORE_CHOICES:
+        raise ValueError(
+            f"unknown score mode {score!r} (choices: {SCORE_CHOICES})")
+    return score
 
 
 def resolve_engine(engine: str | None = None) -> str:

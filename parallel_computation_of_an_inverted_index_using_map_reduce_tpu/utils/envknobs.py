@@ -212,6 +212,17 @@ declare("MRI_SERVE_DRAIN_S", float, 5.0,
         "Graceful-drain deadline after SIGTERM/SIGINT before inflight "
         "requests are abandoned.",
         scope="serve", minimum=0, exclusive=True)
+declare("MRI_SERVE_FORMAT", int, 2,
+        "Artifact format packed when no explicit version is requested: "
+        "1 (plain delta postings) or 2 (block-bitpacked + skip table).",
+        scope="serve", choices=(1, 2))
+declare("MRI_SERVE_BLOCK_SIZE", int, 128,
+        "Format-v2 postings block size in doc ids (power of two).",
+        scope="serve", minimum=2)
+declare("MRI_SERVE_SCORE", str, "df",
+        "Default top_k scoring mode when no --score flag is given: "
+        "df (document frequency) or bm25 (ranked retrieval).",
+        scope="serve", choices=("df", "bm25"))
 
 # -- benchmarks -------------------------------------------------------
 declare("MRI_TPU_BENCH_ATTEMPTS", int, 3,

@@ -542,6 +542,91 @@ def test_cli_sighup_reload_and_corrupt_reload(built, tmp_path):
             _reap(proc)
 
 
+def test_cli_sighup_reload_v1_to_v2_across_formats(tmp_path):
+    """A live daemon serving a FORMAT V1 artifact hot-swaps to a v2
+    build of the same corpus on SIGHUP — answers stay correct across
+    the swap, the reported engine format flips, and a torn v2 push is
+    rejected without dropping the v2 view."""
+    from test_format_v2 import build_corpus_fmt
+
+    (tmp_path / "v1").mkdir()
+    (tmp_path / "v2").mkdir()
+    out_v1 = build_corpus_fmt(tmp_path / "v1", DOCS, 1)
+    out_v2 = build_corpus_fmt(tmp_path / "v2", DOCS, 2)
+    naive = naive_index(DOCS)
+    art = artifact_path(out_v1)
+    v2_bytes = artifact_path(out_v2).read_bytes()
+
+    def push(data: bytes):
+        staged = art.with_suffix(".push")
+        staged.write_bytes(data)
+        os.replace(staged, art)
+
+    proc, addr = _spawn_serve(out_v1)
+    try:
+        with Client(addr) as c:
+            s = c.rpc(id=1, op="stats")["stats"]
+            assert s["engine"]["format"] == 1
+            assert c.rpc(id=2, op="df", terms=["cat"])["df"] == \
+                [len(naive["cat"])]
+            push(v2_bytes)
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                # requests keep flowing while the reload lands
+                assert c.rpc(id=3, op="df", terms=["cat"])["df"] == \
+                    [len(naive["cat"])]
+                s = c.rpc(id=4, op="stats")["stats"]
+                if s["counters"]["reload_ok"] == 1:
+                    break
+                time.sleep(0.05)
+            assert s["counters"]["reload_ok"] == 1
+            assert s["engine"]["format"] == 2
+            # torn v2 push: rejected, the good v2 view keeps serving
+            push(v2_bytes[:200])
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                s = c.rpc(id=5, op="stats")["stats"]
+                if s["counters"]["reload_rejected"] == 1:
+                    break
+                time.sleep(0.05)
+            assert s["counters"]["reload_rejected"] == 1
+            assert s["engine"]["format"] == 2
+            assert c.rpc(id=6, op="df", terms=["dog"])["df"] == \
+                [len(naive["dog"])]
+        proc.send_signal(signal.SIGTERM)
+        assert _reap(proc) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            _reap(proc)
+
+
+def test_daemon_bm25_top_k_over_protocol(built):
+    """score=bm25 over the wire: ranked [doc, score] pairs that agree
+    with the engine's own top_k_scored on the same artifact."""
+    out, naive = built
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (  # noqa: E501
+        Engine,
+    )
+    with Engine(artifact_path(out)) as eng:
+        want = eng.top_k_scored(eng.encode_batch(["dog", "cat"]), 5)
+    with serving(out) as daemon, Client(daemon) as c:
+        r = c.rpc(id=1, op="top_k", score="bm25", k=5,
+                  terms=["dog", "cat"])
+        assert r["ok"]
+        assert [d for d, _ in r["docs"]] == [d for d, _ in want]
+        for (_, gs), (_, ws) in zip(r["docs"], want):
+            assert abs(gs - ws) < 1e-9
+        # validation: bm25 without terms is a counted bad request
+        r = c.rpc(id=2, op="top_k", score="bm25", k=5)
+        assert r["error"] == "bad_request"
+        r = c.rpc(id=3, op="top_k", score="nonsense", k=5,
+                  terms=["dog"])
+        assert r["error"] == "bad_request"
+
+
 def test_cli_serve_missing_artifact_exits_2(tmp_path):
     env = dict(os.environ, PYTHONPATH=str(REPO_ROOT), JAX_PLATFORMS="cpu")
     proc = subprocess.run(

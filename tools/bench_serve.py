@@ -19,6 +19,12 @@ Four modes, all printing ONE JSON line mirroring bench.py's shape:
                       engines on sampled batches, and a zero-recompile
                       steady-state assertion; also written to
                       --out (BENCH_SERVE_DEVICE_r06.json)
+  --format-ab         artifact format v1-vs-v2 A/B on the same corpus:
+                      bytes on disk, two-term boolean QPS, cold-decode
+                      latency, skip counters, and BM25 top-k
+                      throughput — gated on a byte-parity sweep across
+                      every existing op; written to --out-format
+                      (BENCH_SERVE_V2_r09.json, make bench-serve-v2)
   --daemon-bench      the resident-daemon sweep (make bench-daemon):
                       pipelined coalesced capacity + closed-loop rpc
                       floor vs the in-process batch-1 baseline, then an
@@ -288,6 +294,151 @@ def _device_ab(out_path: str | None) -> dict:
     }
     host.close()
     device.close()
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
+# -- format v1 vs v2 A/B (make bench-serve-v2) --------------------------
+
+
+def _build_index_fmt(fmt: int) -> tuple[str, dict]:
+    """One --artifact build pinned to an artifact format version."""
+    # mrilint: allow(env-knobs) save/restore around a pinned build, not a read
+    old = os.environ.get("MRI_SERVE_FORMAT")
+    os.environ["MRI_SERVE_FORMAT"] = str(fmt)
+    try:
+        return _build_index()
+    finally:
+        if old is None:
+            os.environ.pop("MRI_SERVE_FORMAT", None)
+        else:
+            os.environ["MRI_SERVE_FORMAT"] = old
+
+
+def _measure_cold_decode(engine, terms: list[str]) -> dict:
+    """Cold postings decode: every term distinct, cache cleared once up
+    front, batch 1 — each timed call pays the full wire decode (v1
+    cumsum vs v2 block unpack), never an LRU hit."""
+    distinct = list(dict.fromkeys(terms))[:2000]
+    enc = [engine.encode_batch([t]) for t in distinct]
+    engine.postings(enc[0])  # touch the mmap pages / jit once
+    engine.cache.clear()
+    lat = np.empty(len(enc))
+    t_all = time.perf_counter()
+    for i, b in enumerate(enc):
+        t0 = time.perf_counter()
+        engine.postings(b)
+        lat[i] = time.perf_counter() - t0
+    wall = time.perf_counter() - t_all
+    return {
+        "terms": len(enc),
+        "decodes_per_s": round(len(enc) / wall, 1),
+        "p50_us": round(float(np.percentile(lat, 50)) * 1e6, 2),
+        "p99_us": round(float(np.percentile(lat, 99)) * 1e6, 2),
+    }
+
+
+def _measure_bm25(engine, terms: list[str]) -> dict:
+    """Ranked top-k QPS over the same Zipf 2-term pairs the boolean
+    legs use."""
+    pairs = [terms[i:i + 2] for i in range(0, 2000, 2)]
+    enc = [engine.encode_batch(p) for p in pairs]
+    for b in enc[:32]:
+        engine.top_k_scored(b, 10)
+    t0 = time.perf_counter()
+    for b in enc:
+        engine.top_k_scored(b, 10)
+    return {"bm25_top10_qps": round(
+        len(enc) / (time.perf_counter() - t0), 1)}
+
+
+def _format_ab(out_path: str | None) -> dict:
+    """v1-vs-v2 artifact A/B on the bench corpus: size, boolean QPS,
+    cold-decode latency, skip-table effectiveness, BM25 throughput —
+    after a byte-parity sweep across every existing op."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+
+    _, corpus_metric = bench._manifest()
+    v1_dir, v1_report = _build_index_fmt(1)
+    v2_dir, v2_report = _build_index_fmt(2)
+    rng = np.random.default_rng(SEED)
+
+    e1 = Engine(os.path.join(v1_dir, "index.mri"))
+    e2 = Engine(os.path.join(v2_dir, "index.mri"))
+    assert e1.artifact.version == 1 and e2.artifact.version == 2
+    terms = _zipf_terms(e1, LOOKUPS, rng)
+
+    # same-answers first: df/postings/AND/OR/top-k, v1 vs v2
+    parity_checked = _assert_parity(e1, e2, terms, rng)
+
+    formats = {}
+    for name, eng in (("v1", e1), ("v2", e2)):
+        res = {}
+        for bsz in BATCH_SIZES:
+            eng.cache.clear()
+            res[str(bsz)] = _measure_batches(eng, terms, bsz)
+        res.update(_measure_boolean(eng, terms))
+        res["cold_decode"] = _measure_cold_decode(eng, terms)
+        res.update(_measure_bm25(eng, terms))
+        res["decode"] = eng.decode_stats()
+        res["artifact_bytes"] = int(
+            os.path.getsize(os.path.join(
+                v1_dir if name == "v1" else v2_dir, "index.mri")))
+        formats[name] = res
+
+    v1b, v2b = formats["v1"]["artifact_bytes"], formats["v2"]["artifact_bytes"]
+    ratios = {
+        "artifact_bytes_v2_over_v1": round(v2b / v1b, 4),
+        "boolean_and_speedup": round(
+            formats["v2"]["boolean_and_qps"]
+            / formats["v1"]["boolean_and_qps"], 3),
+        "boolean_or_speedup": round(
+            formats["v2"]["boolean_or_qps"]
+            / formats["v1"]["boolean_or_qps"], 3),
+        "cold_decode_speedup": round(
+            formats["v2"]["cold_decode"]["decodes_per_s"]
+            / formats["v1"]["cold_decode"]["decodes_per_s"], 3),
+    }
+
+    # the v2 contracts, against the recorded r05 numbers on this corpus:
+    # <= 70% of v1 bytes on disk, and two-term AND QPS >= 2x the r05
+    # serving baseline (same Zipf workload, same machine class)
+    assert v2b <= 0.70 * v1b, f"v2 {v2b}B > 70% of v1 {v1b}B"
+    baseline = {}
+    r05 = Path(__file__).resolve().parent.parent / "BENCH_SERVE_r05.json"
+    if r05.exists():
+        tail = json.loads(json.loads(r05.read_text())["tail"])
+        baseline = {
+            "boolean_and_qps": tail["batches"]["boolean_and_qps"],
+            "boolean_or_qps": tail["batches"]["boolean_or_qps"],
+            "artifact_bytes": tail["artifact_bytes"],
+        }
+        v2_and = formats["v2"]["boolean_and_qps"]
+        assert v2_and >= 2.0 * baseline["boolean_and_qps"], \
+            f"v2 AND {v2_and} < 2x r05 {baseline['boolean_and_qps']}"
+        ratios["boolean_and_vs_r05_baseline"] = round(
+            v2_and / baseline["boolean_and_qps"], 3)
+    line = {
+        "metric": "serve_v2_boolean_and_qps",
+        "value": formats["v2"]["boolean_and_qps"],
+        "unit": "queries/s",
+        "corpus_metric": corpus_metric,
+        "zipf_s": ZIPF_S,
+        "vocab": e1.vocab_size,
+        "block_size": e2.artifact.block_size,
+        "formats": formats,
+        "v2_vs_v1": ratios,
+        "baseline_r05": baseline,
+        "parity": {"checked_answers": parity_checked,
+                   "result": "byte-identical"},
+        "host_cores": os.cpu_count(),
+        "scratch": bench._scratch_backing(),
+    }
+    e1.close()
+    e2.close()
     if out_path:
         Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
     return line
@@ -771,6 +922,12 @@ def main(argv: list[str] | None = None) -> int:
                         "parity + zero-recompile assertions")
     p.add_argument("--out", default="BENCH_SERVE_DEVICE_r06.json",
                    help="where --device-ab writes its JSON report")
+    p.add_argument("--format-ab", action="store_true",
+                   help="artifact format v1-vs-v2 A/B: bytes on disk, "
+                        "boolean QPS, cold-decode latency, BM25 "
+                        "throughput, after a byte-parity sweep")
+    p.add_argument("--out-format", default="BENCH_SERVE_V2_r09.json",
+                   help="where --format-ab writes its JSON report")
     p.add_argument("--daemon", action="store_true",
                    help="with --open-loop: offer the Poisson arrivals "
                         "to a live `mri serve` subprocess (shed and "
@@ -793,6 +950,8 @@ def main(argv: list[str] | None = None) -> int:
         p.error("--daemon requires --open-loop RPS (or use --daemon-bench)")
     elif args.device_ab:
         line = _device_ab(args.out)
+    elif args.format_ab:
+        line = _format_ab(args.out_format)
     else:
         line = _closed_loop(args.engine, args.open_loop)
     print(json.dumps(line))
