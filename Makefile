@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-daemon capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-daemon bench-scrape capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -95,6 +95,13 @@ test-native-ubsan:
 test-daemon:
 	$(PY) -m pytest tests/ -q -m daemon
 
+# observability layer (obs/): metrics registry semantics, Prometheus
+# exposition parity with the legacy stats op, request tracing over the
+# wire, slow-query log, Chrome-trace build export; none are `slow`, so
+# the default `make test-fast` sweep runs them too
+test-obs:
+	$(PY) -m pytest tests/ -q -m obs
+
 bench:
 	$(PY) bench.py
 
@@ -130,6 +137,12 @@ bench-serve-v2:
 # 3 offered loads -> BENCH_DAEMON_r07.json
 bench-daemon:
 	$(PY) tools/bench_serve.py --daemon-bench
+
+# observability overhead gate: Prometheus-vs-stats counter parity on a
+# live daemon + the `metrics` op priced against the r09 serving
+# capacity (1 Hz scrape must cost <1%) -> BENCH_SCRAPE_r10.json
+bench-scrape:
+	$(PY) tools/bench_serve.py --scrape-check
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

@@ -105,6 +105,16 @@ serve mode (resident daemon; loads the artifact ONCE):
                                  = crash-safe hot reload of index.mri
                                  (a failed verification keeps the old
                                  artifact and counts reload_rejected)
+
+metrics mode (Prometheus text exposition; obs/ registry):
+  mri-tpu metrics DIR            open DIR's artifact, print the engine
+                                 registry in Prometheus text format
+  mri-tpu metrics HOST:PORT      ask a running serve daemon (the
+                                 'metrics' admin op) and print its text
+  mri-tpu serve DIR --listen-metrics PORT
+                                 daemon also serves the same text over
+                                 plain HTTP on 127.0.0.1:PORT (a scrape
+                                 endpoint; 0 = ephemeral)
 """
 
 
@@ -213,6 +223,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "index.manifest.json output manifest (per-file "
                         "adler32) after it; audit failures exit 2, never "
                         "silently wrong bytes")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome trace_event JSON timeline of the "
+                        "build here (reader/scan/reduce/merge spans; load "
+                        "in chrome://tracing or ui.perfetto.dev)")
     return p
 
 
@@ -363,6 +377,11 @@ def _serve_main(argv: list[str]) -> int:
                    help="arm the deterministic fault injector "
                         "(serve kinds: handler-crash/client-disconnect/"
                         "slow-client/reload-corrupt) — test/bench only")
+    p.add_argument("--listen-metrics", type=int, default=None,
+                   metavar="PORT",
+                   help="also serve Prometheus text metrics over plain "
+                        "HTTP on 127.0.0.1:PORT (0 = ephemeral; the "
+                        "chosen port is printed in the 'listening' line)")
     args = p.parse_args(argv)
 
     if args.fault_spec is not None:
@@ -384,11 +403,18 @@ def _serve_main(argv: list[str]) -> int:
     from .serve import ArtifactError
     from .serve.daemon import ServeDaemon
 
+    if args.listen_metrics is not None and not (
+            0 <= args.listen_metrics <= 65535):
+        print(f"error: --listen-metrics must be 0..65535, got "
+              f"{args.listen_metrics}", file=sys.stderr)
+        return 2
+
     try:
         daemon = ServeDaemon(args.index_dir, host, port,
                              engine=args.engine,
                              cache_terms=args.cache_terms,
-                             shards=args.shards)
+                             shards=args.shards,
+                             metrics_port=args.listen_metrics)
     except (ArtifactError, ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -422,10 +448,12 @@ def _serve_main(argv: list[str]) -> int:
         signal.signal(signal.SIGHUP, _on_hup)
 
     bound_host, bound_port = daemon.address
-    print(json.dumps({"event": "listening", "host": bound_host,
-                      "port": bound_port, "pid": os.getpid(),
-                      "engine": daemon._engine.engine_name}),
-          flush=True)
+    listening = {"event": "listening", "host": bound_host,
+                 "port": bound_port, "pid": os.getpid(),
+                 "engine": daemon._engine.engine_name}
+    if daemon.metrics_address is not None:
+        listening["metrics_port"] = daemon.metrics_address[1]
+    print(json.dumps(listening), flush=True)
     while not stop.is_set():
         stop.wait(0.2)
     rc = daemon.drain()
@@ -435,15 +463,81 @@ def _serve_main(argv: list[str]) -> int:
     return rc
 
 
+def _metrics_main(argv: list[str]) -> int:
+    """``mri-tpu metrics TARGET`` — Prometheus text exposition.
+
+    TARGET is either a running daemon's HOST:PORT (asks it via the
+    'metrics' admin op) or an --artifact output dir / index.mri path
+    (opens a throwaway engine and prints its registry)."""
+    import socket
+
+    p = argparse.ArgumentParser(
+        prog="mri-tpu metrics",
+        description="print Prometheus text-format metrics from a "
+                    "running serve daemon (HOST:PORT) or a built "
+                    "artifact (DIR)")
+    p.add_argument("target", help="serve daemon HOST:PORT, or the "
+                                  "output dir of an --artifact run")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="daemon connect/read timeout in seconds")
+    args = p.parse_args(argv)
+
+    host, _, port_s = args.target.rpartition(":")
+    is_addr = bool(host) and port_s.isdigit() and int(port_s) <= 65535
+    if is_addr and not os.path.exists(args.target):
+        try:
+            # mrilint: allow(fault-boundary) operator scrape RPC, not corpus I/O; OSError maps to exit 2 below
+            with socket.create_connection((host, int(port_s)),
+                                          timeout=args.timeout) as sock:
+                sock.sendall(b'{"op": "metrics", "id": 1}\n')
+                buf = b""
+                while not buf.endswith(b"\n"):
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+        except OSError as e:
+            print(f"error: cannot reach daemon at {args.target}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            resp = json.loads(buf)
+        except ValueError:
+            print(f"error: bad response from {args.target}",
+                  file=sys.stderr)
+            return 2
+        if not resp.get("ok"):
+            print(f"error: daemon refused metrics: "
+                  f"{resp.get('error', 'unknown')}", file=sys.stderr)
+            return 2
+        sys.stdout.write(resp.get("text", ""))
+        return 0
+
+    from .serve import ArtifactError, create_engine
+    try:
+        engine = create_engine(args.target, None)
+    except ArtifactError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        sys.stdout.write(engine.metrics.render_text())
+    finally:
+        engine.close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
-    # --verify DIR / query DIR / serve DIR are standalone modes (no
-    # reference positionals): pre-parse them so 'mri-tpu --verify out/'
-    # and 'mri-tpu query out/ word' work without dummy mapper counts.
+    # --verify DIR / query DIR / serve DIR / metrics TARGET are
+    # standalone modes (no reference positionals): pre-parse them so
+    # 'mri-tpu --verify out/' and 'mri-tpu query out/ word' work
+    # without dummy mapper counts.
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "query":
         return _query_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "metrics":
+        return _metrics_main(argv[1:])
     if "--verify" in argv:
         i = argv.index("--verify")
         if i + 1 >= len(argv):
@@ -506,6 +600,7 @@ def main(argv: list[str] | None = None) -> int:
             resume=args.resume,
             audit=args.audit,
             artifact=args.artifact,
+            trace_out=args.trace_out,
         )
         stats = build_index(manifest, config)
     except AuditError as e:

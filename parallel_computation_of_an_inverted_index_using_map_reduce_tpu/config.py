@@ -179,6 +179,12 @@ class IndexConfig:
     # Needs the merged postings on one host: incompatible with the
     # letter-ownership emit and the overlap plan's split emit.
     artifact: bool = False
+    # Chrome trace_event export (obs.chrometrace): write the run's
+    # per-stage timeline — reader windows, per-worker scans, reducer
+    # emit ranges, merge, artifact pack — to this file after the build,
+    # loadable in chrome://tracing / Perfetto.  Host pipeline only; the
+    # oracle and tpu backends write a valid but sparse trace.
+    trace_out: str | None = None
 
     def resolved_host_threads(self) -> int:
         """The map-phase thread count this run will actually use."""

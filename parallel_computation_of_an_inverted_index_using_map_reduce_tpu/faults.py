@@ -80,6 +80,7 @@ import signal
 import threading
 import time
 
+from .obs import metrics as obs_metrics
 from .utils import envknobs
 
 log = logging.getLogger("mri_tpu.faults")
@@ -451,6 +452,12 @@ class FaultInjector:
         n = self._fired.get(key, 0)
         if rule.times < 0 or n < rule.times:
             self._fired[key] = n + 1
+            # fault firings are process-global obs counters (the obs
+            # Counter has its own lock; safe under self._lock)
+            reg = obs_metrics.default_registry()
+            reg.counter("mri_faults_fired_total").inc()
+            kind = rule.kind.replace("-", "_")
+            reg.counter(f"mri_fault_{kind}_fired_total").inc()
             return True
         return False
 

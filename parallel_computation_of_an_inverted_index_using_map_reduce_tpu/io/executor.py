@@ -78,7 +78,8 @@ class PipelinedWindowReader:
                  watchdog_s: float | None = 30.0,
                  policy: "faults.RetryPolicy | None" = None,
                  report: "faults.DegradationReport | None" = None,
-                 worker: int | None = None):
+                 worker: int | None = None,
+                 trace=None):
         self._manifest = manifest
         # a shared StealQueue (duck-typed on pop_window) or a plan list
         self._queue = windows if hasattr(windows, "pop_window") else None
@@ -87,6 +88,10 @@ class PipelinedWindowReader:
         # charged to this worker id so a worker death can requeue
         # exactly its windows (scheduler.StealQueue.fail_worker)
         self._worker = worker
+        # optional obs.chrometrace.TraceEvents collector (--trace-out):
+        # the reader thread records one "read" span per window
+        self._trace = trace
+        self._trace_tid = 100 + (worker or 0)  # chrometrace.READER_BASE
         self._depth = max(int(depth), 1)
         self._watchdog_s = watchdog_s
         self.policy = policy if policy is not None else faults.default_policy()
@@ -151,7 +156,12 @@ class PipelinedWindowReader:
                 t0 = time.perf_counter()
                 read_window_into(self._manifest, lo, hi, arena,
                                  policy=self.policy, report=self.report)
-                self.read_busy_s += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self.read_busy_s += t1 - t0
+                if self._trace is not None:
+                    self._trace.span("read", t0, t1,
+                                     tid=self._trace_tid,
+                                     args={"window": wi})
                 # the consumer needs the global plan index to ack the
                 # lease (and the audit ledger keys on it)
                 arena.window_index = wi

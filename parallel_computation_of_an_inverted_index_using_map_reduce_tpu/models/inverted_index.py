@@ -37,6 +37,7 @@ from ..ops import keys as K
 from ..text import formatter
 from ..text.tokenizer import tokenize
 from ..utils.rounding import round_up as _round_up
+from ..obs import chrometrace
 from ..utils.timing import PhaseTimer
 from .oracle import oracle_index
 
@@ -98,7 +99,19 @@ class InvertedIndexModel:
         # the stats dict into the CLI (exit faults.EXIT_DEGRADED when
         # documents were skipped) and the bench JSON.
         report = faults.begin_run()
+        # Chrome trace_event export (--trace-out): one collector per
+        # run; the host pipeline records per-stage spans into it and
+        # the file is written once, after the run (non-cpu backends
+        # produce a valid but sparse trace).
+        trace = None
+        if self.config.trace_out:
+            trace = chrometrace.TraceEvents()
+            trace.name_thread(chrometrace.MAIN, "main")
+        self._trace = trace
         stats = self._run_dispatch(manifest, output_dir)
+        if trace is not None:
+            trace.write(self.config.trace_out)
+            stats["trace_out"] = self.config.trace_out
         if self.config.audit:
             # Output manifest AFTER emit (any backend): per-letter-file
             # digests so --verify can re-check the directory later.
@@ -234,20 +247,32 @@ class InvertedIndexModel:
         arenas = getattr(self, "_cpu_arenas", None)
         if arenas is not None and len(arenas) != self.config.io_prefetch + 1:
             arenas = None
+        trace = getattr(self, "_trace", None)
+        if trace is not None:
+            trace.name_thread(chrometrace.READER_BASE, "reader-0")
+            trace.name_thread(chrometrace.SCAN_BASE, "scan-worker-0")
         reader = PipelinedWindowReader(
             manifest, windows, depth=self.config.io_prefetch,
             byte_capacity=window_bytes + (window_bytes >> 2),
-            doc_capacity=max_docs, arenas=arenas)
+            doc_capacity=max_docs, arenas=arenas, trace=trace)
         self._cpu_arenas = reader.arenas
         stream = native.HostIndexStream()
         try:
             with reader, timer.phase("ingest_scan"):
                 for arena in reader:
                     buf, ends, ids = arena.feed_views()
+                    t0 = time.perf_counter()
                     stream.feed_arrays(buf, ends, ids)
+                    if trace is not None:
+                        trace.span("scan", t0, time.perf_counter(),
+                                   tid=chrometrace.SCAN_BASE,
+                                   args={"window": arena.window_index})
                     reader.recycle(arena)
             with timer.phase("finalize_emit"):
+                t0 = time.perf_counter()
                 stats = stream.finalize_emit(out_dir)
+                if trace is not None:
+                    trace.span("finalize_emit", t0, time.perf_counter())
         finally:
             stream.close()
             reader.close()
@@ -344,6 +369,7 @@ class InvertedIndexModel:
         # ``failed`` slot's stream is excluded from the merge.
         slots: list[dict] = []
         fail_lock = threading.Lock()
+        trace = getattr(self, "_trace", None)
 
         def make_slot(w: int, arenas=None) -> dict:
             rep = faults.DegradationReport()
@@ -353,12 +379,17 @@ class InvertedIndexModel:
                 "thread": None,
                 "stream": native.HostIndexStream(),
             }
+            if trace is not None:
+                trace.name_thread(chrometrace.READER_BASE + w,
+                                  f"reader-{w}")
+                trace.name_thread(chrometrace.SCAN_BASE + w,
+                                  f"scan-worker-{w}")
             # reader last: its thread starts pulling windows immediately
             slot["reader"] = PipelinedWindowReader(
                 manifest, queue, depth=depth,
                 byte_capacity=window_bytes + (window_bytes >> 2),
                 doc_capacity=max_docs, arenas=arenas,
-                policy=policy, report=rep, worker=w)
+                policy=policy, report=rep, worker=w, trace=trace)
             slots.append(slot)
             return slot
 
@@ -390,8 +421,13 @@ class InvertedIndexModel:
                         inj.on_worker_window(w, wi)
                         dropped = inj.on_scan_window(wi)
                     if not dropped:
+                        t0s = time.perf_counter()
                         buf, ends, ids = arena.feed_views()
                         stream.feed_arrays(buf, ends, ids)
+                        if trace is not None:
+                            trace.span("scan", t0s, time.perf_counter(),
+                                       tid=chrometrace.SCAN_BASE + w,
+                                       args={"window": wi})
                         if ledger is not None:
                             ledger.record(
                                 wi, worker=w, docs=int(arena.num_docs),
@@ -503,7 +539,10 @@ class InvertedIndexModel:
                                       missing_ok=lost_windows)
                 audit_s += time.perf_counter() - t0
             with timer.phase("finalize_emit"):
+                t0m = time.perf_counter()
                 merge = native.HostIndexMerge(live)
+                if trace is not None:
+                    trace.span("merge", t0m, time.perf_counter())
                 if cfg.audit:
                     t0 = time.perf_counter()
                     audit_mod.check_merge(merge, live)
@@ -521,7 +560,14 @@ class InvertedIndexModel:
                         emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
                     except BaseException as e:  # noqa: BLE001
                         emit_errors[r] = e
-                    emit_ms[r] = (time.perf_counter() - t0) * 1e3
+                    t1 = time.perf_counter()
+                    emit_ms[r] = (t1 - t0) * 1e3
+                    if trace is not None:
+                        trace.name_thread(chrometrace.REDUCE_BASE + r,
+                                          f"reduce-worker-{r}")
+                        trace.span("emit_range", t0, t1,
+                                   tid=chrometrace.REDUCE_BASE + r,
+                                   args={"letters": [lo, hi]})
 
                 reducers = [
                     threading.Thread(target=reduce_worker, args=(r, lo, hi),
@@ -558,10 +604,13 @@ class InvertedIndexModel:
                     t0 = time.perf_counter()
                     art_bytes = artifact_mod.build_from_merge(
                         artifact_mod.artifact_path(out_dir), merge)
+                    t1 = time.perf_counter()
+                    if trace is not None:
+                        trace.span("artifact_pack", t0, t1)
                     timer.count("artifact_bytes", int(art_bytes))
                     timer.count(
                         "artifact_build_ms",
-                        round((time.perf_counter() - t0) * 1e3, 3))
+                        round((t1 - t0) * 1e3, 3))
         finally:
             recovered = any(s["failed"] for s in slots)
             for slot in slots:

@@ -1,0 +1,20 @@
+"""Unified observability layer: metrics, tracing, trace export.
+
+- :mod:`.metrics` — thread-safe Counter/Gauge/Histogram + Registry
+  with Prometheus text exposition (stdlib-only, standalone-loadable).
+- :mod:`.timing` — OpTimer / PhaseTimer unified over the histogram.
+- :mod:`.tracing` — per-request trace ids, trace ring, slow-query log.
+- :mod:`.chrometrace` — Chrome ``trace_event`` export for builds.
+"""
+
+from .chrometrace import TraceEvents
+from .metrics import (Counter, Gauge, Histogram, KNOWN_METRICS, Registry,
+                      default_registry)
+from .timing import OpTimer, PhaseTimer
+from .tracing import TraceRing, gen_trace_id
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "KNOWN_METRICS", "OpTimer",
+    "PhaseTimer", "Registry", "TraceEvents", "TraceRing",
+    "default_registry", "gen_trace_id",
+]

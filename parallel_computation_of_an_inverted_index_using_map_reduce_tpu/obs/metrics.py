@@ -1,0 +1,369 @@
+"""Thread-safe metrics registry: counters, gauges, log-bucket histograms.
+
+The single telemetry substrate for the repo.  Every serve-plane tally
+(daemon admission counters, engine decode counters, cache hit/miss,
+per-op latency) is an object from this module; the legacy ``stats`` /
+``describe()`` dicts are views over it, and ``Registry.render_text()``
+exposes the same numbers in Prometheus text-exposition format.
+
+Deliberately stdlib-only and free of package-relative imports: the
+mrilint ``obs-metrics`` repo check file-loads this module standalone
+(exactly as ``readme_knobs`` loads ``envknobs``) to regenerate and
+drift-check the README metrics-name table from :data:`KNOWN_METRICS`.
+
+Registries are cheap instance objects, not process singletons: each
+daemon and each engine owns one, so two daemons in one test process
+never share counts and a hot reload starts the new engine's telemetry
+from zero (matching the historical ``describe()`` semantics).  The one
+process-global registry, :func:`default_registry`, exists only for
+truly process-wide events — fault-injection firings.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+
+
+class Counter:
+    """Monotonic (but resettable) counter with its own lock."""
+
+    __slots__ = ("name", "help", "_lock", "_n")
+
+    def __init__(self, name: str, help: str = ""):  # noqa: A002
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._n = 0  # guarded by: self._lock
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._n += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._n
+
+    def reset(self) -> None:
+        """Zero the counter.  Exists for the legacy ``cache.clear()``
+        and ``OpTimer.reset()`` contracts, which reset their tallies."""
+        with self._lock:
+            self._n = 0
+
+
+class Gauge:
+    """A value that goes up and down (queue depth, vocab size)."""
+
+    __slots__ = ("name", "help", "_lock", "_v")
+
+    def __init__(self, name: str, help: str = ""):  # noqa: A002
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._v = 0.0  # guarded by: self._lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+
+#: Raw samples retained per histogram for exact quantiles.  Past the
+#: cap the histogram stops retaining (buckets/sum/count stay exact,
+#: quantiles fall back to the retained prefix and are flagged).
+SAMPLE_CAP = 65536
+
+
+class Histogram:
+    """Fixed log-spaced buckets plus a capped raw-sample buffer.
+
+    Buckets are ``base * growth**i`` upper bounds (``le`` semantics,
+    like Prometheus); the defaults span 1 us .. ~68 s, which covers
+    every op latency in this repo.  While under :data:`SAMPLE_CAP`
+    observations, :meth:`quantile` is *exact* (numpy linear
+    interpolation over the raw samples), not a bucket estimate.
+    """
+
+    __slots__ = ("name", "help", "_lock", "_bounds", "_counts",
+                 "_count", "_sum", "_min", "_max", "_samples",
+                 "_truncated")
+
+    def __init__(self, name: str, help: str = "", *,  # noqa: A002
+                 base: float = 1e-6, growth: float = 2.0,
+                 nbuckets: int = 27):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._bounds = [base * growth ** i for i in range(nbuckets)]
+        # one slot per bound plus the +Inf overflow slot
+        self._counts = [0] * (nbuckets + 1)  # guarded by: self._lock
+        self._count = 0  # guarded by: self._lock
+        self._sum = 0.0  # guarded by: self._lock
+        self._min = math.inf  # guarded by: self._lock
+        self._max = -math.inf  # guarded by: self._lock
+        self._samples: list[float] = []  # guarded by: self._lock
+        self._truncated = False  # guarded by: self._lock
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if len(self._samples) < SAMPLE_CAP:
+                self._samples.append(v)
+            else:
+                self._truncated = True
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def bounds(self) -> list[float]:
+        return list(self._bounds)
+
+    def cumulative_counts(self) -> list[int]:
+        """Per-bound cumulative counts (observations <= bound), one
+        entry per bound plus the final +Inf total — the shape of the
+        Prometheus ``_bucket`` series."""
+        with self._lock:
+            out, acc = [], 0
+            for c in self._counts:
+                acc += c
+                out.append(acc)
+            return out
+
+    def quantile(self, p: float) -> float:
+        """p-th percentile (0..100), numpy ``linear`` interpolation.
+
+        Exact while the raw-sample buffer is complete; past
+        :data:`SAMPLE_CAP` it interpolates over the retained prefix.
+        """
+        with self._lock:
+            s = sorted(self._samples)
+        if not s:
+            return math.nan
+        pos = (len(s) - 1) * (float(p) / 100.0)
+        lo = int(math.floor(pos))
+        frac = pos - lo
+        hi = min(lo + 1, len(s) - 1)
+        return s[lo] * (1.0 - frac) + s[hi] * frac
+
+    @property
+    def exact(self) -> bool:
+        with self._lock:
+            return not self._truncated
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
+            self._samples = []
+            self._truncated = False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+            }
+
+
+#: Canonical metric documentation: (name, kind, meaning).  The README
+#: "Observability" table is generated from this tuple (and
+#: drift-checked by mrilint's ``obs-metrics`` repo check).  Names with
+#: ``<..>`` placeholders describe dynamically-created families.
+KNOWN_METRICS = (
+    # daemon admission / dispatch plane
+    ("mri_serve_requests_total", "counter",
+     "Data requests admitted past validation (the legacy `requests`)."),
+    ("mri_serve_responses_total", "counter",
+     "Response lines written back to clients."),
+    ("mri_serve_shed_total", "counter",
+     "Requests shed by admission control (pending queue full)."),
+    ("mri_serve_deadline_expired_total", "counter",
+     "Requests whose `deadline_ms` passed before dispatch."),
+    ("mri_serve_draining_rejected_total", "counter",
+     "Requests rejected because the daemon was draining."),
+    ("mri_serve_bad_request_total", "counter",
+     "Malformed lines and unknown ops."),
+    ("mri_serve_internal_errors_total", "counter",
+     "Requests that failed inside the engine."),
+    ("mri_serve_client_disconnects_total", "counter",
+     "Connections that dropped mid-write."),
+    ("mri_serve_slow_client_closes_total", "counter",
+     "Connections closed for not draining their response queue."),
+    ("mri_serve_reload_ok_total", "counter",
+     "Successful hot reloads (engine swapped)."),
+    ("mri_serve_reload_rejected_total", "counter",
+     "Hot reloads rejected; the old artifact kept serving."),
+    ("mri_serve_batches_total", "counter",
+     "Coalesced micro-batches dispatched to the engine."),
+    ("mri_serve_batched_requests_total", "counter",
+     "Requests executed inside those micro-batches."),
+    ("mri_serve_connections_total", "counter",
+     "Client connections accepted."),
+    ("mri_serve_queue_depth", "gauge",
+     "Pending-queue depth at scrape time."),
+    ("mri_serve_inflight", "gauge",
+     "Admitted-but-unanswered requests at scrape time."),
+    ("mri_serve_draining", "gauge",
+     "1 while the daemon is draining, else 0."),
+    ("mri_serve_request_seconds", "histogram",
+     "End-to-end data-request latency (admission to response enqueue)."),
+    ("mri_serve_queue_wait_seconds", "histogram",
+     "Time spent waiting in the pending queue before dispatch pop."),
+    # engine-side caches (per-engine registry)
+    ("mri_serve_cache_hits_total", "counter",
+     "Postings LRU cache hits."),
+    ("mri_serve_cache_misses_total", "counter",
+     "Postings LRU cache misses."),
+    ("mri_serve_cache_evictions_total", "counter",
+     "Postings LRU cache evictions."),
+    ("mri_serve_tf_cache_hits_total", "counter",
+     "Term-frequency LRU cache hits (BM25 path)."),
+    ("mri_serve_tf_cache_misses_total", "counter",
+     "Term-frequency LRU cache misses."),
+    ("mri_serve_tf_cache_evictions_total", "counter",
+     "Term-frequency LRU cache evictions."),
+    # engine decode plane
+    ("mri_engine_blocks_decoded_total", "counter",
+     "v2 posting blocks (v1: whole lists) bit-unpacked."),
+    ("mri_engine_blocks_skipped_total", "counter",
+     "v2 posting blocks skipped via the block-max table."),
+    ("mri_engine_bytes_decoded_total", "counter",
+     "Bytes materialized by posting decode."),
+    ("mri_engine_vocab_terms", "gauge",
+     "Vocabulary size of the loaded artifact."),
+    ("mri_engine_artifact_bytes", "gauge",
+     "On-disk size of the loaded artifact."),
+    ("mri_engine_op_<op>_seconds", "histogram",
+     "Per-op engine latency (df, postings, and, or, top_k, ...)."),
+    # fault injection (process-global default registry)
+    ("mri_faults_fired_total", "counter",
+     "Fault-injection rules fired, all kinds."),
+    ("mri_fault_<kind>_fired_total", "counter",
+     "Fault-injection firings of one kind (read_error, ...)."),
+)
+
+_HELP = {name: help for name, _kind, help in KNOWN_METRICS}
+
+
+def _fmt(v) -> str:
+    """Prometheus sample value: integers without a trailing .0."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Registry:
+    """Get-or-create home for named metrics plus the text renderer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}  # guarded by: self._lock
+
+    def _get(self, name: str, cls, help: str, **kw):  # noqa: A002
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help or _HELP.get(name, ""), **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered "
+                                f"as {type(m).__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:  # noqa: A002
+        return self._get(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:  # noqa: A002
+        return self._get(name, Gauge, help)
+
+    def histogram(self, name: str, help: str = "", **kw) -> Histogram:  # noqa: A002
+        return self._get(name, Histogram, help, **kw)
+
+    def metrics(self) -> list:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def render_text(self) -> str:
+        """Prometheus text exposition (``# TYPE``-annotated)."""
+        out = []
+        for m in self.metrics():
+            if isinstance(m, Counter):
+                if m.help:
+                    out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} counter")
+                out.append(f"{m.name} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                if m.help:
+                    out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} gauge")
+                out.append(f"{m.name} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                if m.help:
+                    out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} histogram")
+                cum = m.cumulative_counts()
+                for bound, c in zip(m.bounds, cum):
+                    out.append(f'{m.name}_bucket{{le="{repr(bound)}"}} {c}')
+                out.append(f'{m.name}_bucket{{le="+Inf"}} {cum[-1]}')
+                out.append(f"{m.name}_sum {_fmt(m.sum)}")
+                out.append(f"{m.name}_count {m.count}")
+        return "\n".join(out) + "\n" if out else ""
+
+    def as_dict(self) -> dict:
+        """Scalar view: counter/gauge values and histogram snapshots."""
+        out = {}
+        for m in self.metrics():
+            if isinstance(m, Histogram):
+                out[m.name] = m.snapshot()
+            else:
+                out[m.name] = m.value
+        return out
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    """The process-global registry (fault firings only — everything
+    serve-plane lives on per-daemon / per-engine registries)."""
+    return _default
+
+
+def markdown_table() -> str:
+    """The README metrics-name table, generated from KNOWN_METRICS."""
+    lines = ["| Metric | Type | Meaning |", "| --- | --- | --- |"]
+    for name, kind, help in KNOWN_METRICS:  # noqa: A001
+        lines.append(f"| `{name}` | {kind} | {help} |")
+    return "\n".join(lines)

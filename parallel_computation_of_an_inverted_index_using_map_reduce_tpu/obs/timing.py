@@ -1,0 +1,126 @@
+"""Op/phase timers unified over the obs histogram.
+
+Historically the repo had two near-duplicate aggregators: the serve
+engines' ``OpTimer`` (per-op call count + total seconds, previously in
+``serve/engine.py``) and the build pipeline's ``PhaseTimer``
+(``utils/timing.py``).  Both now record through
+:class:`~.metrics.Histogram`, so every timed op/phase gets a latency
+distribution (exact quantiles under the sample cap) for free, while
+the legacy ``stats()`` / ``report()`` dict shapes stay byte-identical.
+The old import paths remain as thin shims.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+
+from . import metrics
+
+
+class OpTimer:
+    """Per-op latency accounting for the serve engines.
+
+    ``stats()`` keeps the historical shape (``calls`` / ``total_ms`` /
+    ``avg_us`` per op, sorted by op name); when constructed with a
+    :class:`~.metrics.Registry`, each op's histogram is registered as
+    ``<prefix>_<op>_seconds`` and shows up in the Prometheus text.
+    """
+
+    def __init__(self, registry: metrics.Registry | None = None,
+                 prefix: str = "mri_engine_op"):
+        self._registry = registry if registry is not None \
+            else metrics.Registry()
+        self._prefix = prefix
+        self._hists: dict[str, metrics.Histogram] = {}
+
+    def _hist(self, op: str) -> metrics.Histogram:
+        h = self._hists.get(op)
+        if h is None:
+            h = self._registry.histogram(f"{self._prefix}_{op}_seconds")
+            self._hists[op] = h
+        return h
+
+    @contextmanager
+    def time(self, op: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._hist(op).observe(time.perf_counter() - t0)
+
+    def stats(self) -> dict:
+        out = {}
+        for op in sorted(self._hists):
+            h = self._hists[op]
+            calls, secs = h.count, h.sum
+            if not calls:
+                continue
+            out[op] = {
+                "calls": calls,
+                "total_ms": round(secs * 1e3, 3),
+                "avg_us": round(secs / calls * 1e6, 2),
+            }
+        return out
+
+    def quantile_ms(self, op: str, p: float) -> float:
+        """p-th percentile of one op's latency in ms (nan if unseen)."""
+        h = self._hists.get(op)
+        return h.quantile(p) * 1e3 if h is not None else float("nan")
+
+    def reset(self) -> None:
+        for h in self._hists.values():
+            h.reset()
+        self._hists.clear()
+
+
+class PhaseTimer:
+    """Wall-clock phase accounting for one build run.
+
+    ``self.phases`` stays a plain mutable dict (callers assign into it
+    for abort bookkeeping); each ``phase()`` observation additionally
+    lands in a histogram so repeated phases expose a distribution.
+    """
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+        self.counters: dict = {}
+        self._hists: dict[str, metrics.Histogram] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            h = self._hists.get(name)
+            if h is None:
+                h = metrics.Histogram(f"mri_build_phase_{name}_seconds")
+                self._hists[name] = h
+            h.observe(dt)
+
+    def count(self, name: str, value) -> None:
+        """Record a scalar alongside the timings (sets, not adds)."""
+        self.counters[name] = value
+
+    def histogram(self, name: str) -> metrics.Histogram | None:
+        return self._hists.get(name)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(self.phases.values())
+
+    def report(self) -> dict:
+        out = {
+            "phases_ms": {k: round(v * 1e3, 3)
+                          for k, v in self.phases.items()},
+            "total_ms": round(self.total_seconds * 1e3, 3),
+        }
+        out.update(self.counters)
+        return out
+
+    def dumps(self) -> str:
+        return json.dumps(self.report(), sort_keys=True)

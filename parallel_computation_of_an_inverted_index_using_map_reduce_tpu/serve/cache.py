@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from ..obs import metrics as obs_metrics
+
 _MISSING = object()
 
 
@@ -25,24 +27,42 @@ class LRUCache:
     the cache exists to skip.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, *,
+                 registry: obs_metrics.Registry | None = None,
+                 prefix: str = "mri_cache"):
         if capacity < 0:
             raise ValueError(f"cache capacity must be >= 0, got {capacity}")
         self.capacity = capacity
         self._data: OrderedDict = OrderedDict()  # guarded by: self._lock
         self._lock = threading.Lock()
-        self.hits = 0        # guarded by: self._lock
-        self.misses = 0      # guarded by: self._lock
-        self.evictions = 0   # guarded by: self._lock
+        # hit/miss/eviction tallies are obs counters (each with its own
+        # lock) so the engine's registry exposes them in the Prometheus
+        # text; ``registry=None`` keeps them private to this cache.
+        reg = registry if registry is not None else obs_metrics.Registry()
+        self._hits = reg.counter(f"{prefix}_hits_total")
+        self._misses = reg.counter(f"{prefix}_misses_total")
+        self._evictions = reg.counter(f"{prefix}_evictions_total")
+
+    @property
+    def hits(self) -> int:
+        return self._hits.value
+
+    @property
+    def misses(self) -> int:
+        return self._misses.value
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions.value
 
     def get(self, key, default=None):
         with self._lock:
             value = self._data.get(key, _MISSING)
             if value is _MISSING:
-                self.misses += 1
+                self._misses.inc()
                 return default
             self._data.move_to_end(key)
-            self.hits += 1
+            self._hits.inc()
             return value
 
     def put(self, key, value) -> None:
@@ -54,7 +74,7 @@ class LRUCache:
             self._data[key] = value
             if len(self._data) > self.capacity:
                 self._data.popitem(last=False)
-                self.evictions += 1
+                self._evictions.inc()
 
     def peek(self, key, default=None):
         """``get`` without recency promotion or hit/miss accounting —
@@ -75,18 +95,20 @@ class LRUCache:
     def clear(self) -> None:
         with self._lock:
             self._data.clear()
-            self.hits = 0
-            self.misses = 0
-            self.evictions = 0
+        self._hits.reset()
+        self._misses.reset()
+        self._evictions.reset()
 
     def stats(self) -> dict:
+        hits, misses = self._hits.value, self._misses.value
+        total = hits + misses
         with self._lock:
-            total = self.hits + self.misses
-            return {
-                "capacity": self.capacity,
-                "entries": len(self._data),
-                "hits": self.hits,
-                "misses": self.misses,
-                "evictions": self.evictions,
-                "hit_rate": round(self.hits / total, 4) if total else 0.0,
-            }
+            entries = len(self._data)
+        return {
+            "capacity": self.capacity,
+            "entries": entries,
+            "hits": hits,
+            "misses": misses,
+            "evictions": self._evictions.value,
+            "hit_rate": round(hits / total, 4) if total else 0.0,
+        }

@@ -53,12 +53,24 @@ Protocol — one JSON object per line, one response line per request::
     {"id": 6, "op": "stats"}        # admin: answered inline
     {"id": 7, "op": "healthz"}      # admin: answered inline
     {"id": 8, "op": "reload"}       # admin: swap to the new index.mri
+    {"id": 9, "op": "metrics"}      # admin: Prometheus text exposition
+    {"id": 10, "op": "trace", "n": 8}   # admin: recent request traces
 
 Success: ``{"id":1,"ok":true,"df":[5241,3]}``.  Failure:
 ``{"id":2,"error":"<kind>","detail":"..."}`` with kind one of
 ``overloaded`` / ``deadline_expired`` / ``draining`` /
 ``bad_request`` / ``internal`` / ``reload_rejected`` — every one
 counted in ``stats``.
+
+Observability: every tally is an ``obs.metrics`` counter on the
+daemon's registry; ``stats()["counters"]`` is a byte-compatible view
+over it and the ``metrics`` op (or ``--listen-metrics PORT``) renders
+the same numbers as ``# TYPE``-annotated Prometheus text.  Requests
+may carry a ``trace_id`` (auto-generated under ``MRI_OBS_ENABLE``)
+which is echoed on the response; each finished request records
+contiguous queue-wait → coalesce → engine spans into a bounded ring
+(the ``trace`` op) and requests slower than ``MRI_OBS_SLOW_MS`` emit
+one structured JSON line on the ``mri_tpu.obs`` logger.
 """
 
 from __future__ import annotations
@@ -73,6 +85,8 @@ import threading
 import time
 
 from .. import faults
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from ..utils import envknobs
 from .artifact import ArtifactError
 from .engine import create_engine
@@ -90,9 +104,28 @@ DRAIN_ENV = "MRI_SERVE_DRAIN_S"
 OUTBOUND_DEPTH = 1024
 
 DATA_OPS = ("df", "postings", "and", "or", "top_k")
-ADMIN_OPS = ("stats", "healthz", "reload")
+ADMIN_OPS = ("stats", "healthz", "reload", "metrics", "trace")
 
 _SENTINEL = object()
+
+#: legacy ``counters`` key -> Prometheus metric name, in the
+#: historical insertion order (``stats()["counters"]`` preserves it)
+_COUNTER_NAMES = (
+    ("requests", "mri_serve_requests_total"),
+    ("responses", "mri_serve_responses_total"),
+    ("shed", "mri_serve_shed_total"),
+    ("deadline_expired", "mri_serve_deadline_expired_total"),
+    ("draining_rejected", "mri_serve_draining_rejected_total"),
+    ("bad_request", "mri_serve_bad_request_total"),
+    ("internal_errors", "mri_serve_internal_errors_total"),
+    ("client_disconnects", "mri_serve_client_disconnects_total"),
+    ("slow_client_closes", "mri_serve_slow_client_closes_total"),
+    ("reload_ok", "mri_serve_reload_ok_total"),
+    ("reload_rejected", "mri_serve_reload_rejected_total"),
+    ("batches", "mri_serve_batches_total"),
+    ("batched_requests", "mri_serve_batched_requests_total"),
+    ("connections", "mri_serve_connections_total"),
+)
 
 
 class _Request:
@@ -101,10 +134,11 @@ class _Request:
     error — enforced by the ``done`` flag)."""
 
     __slots__ = ("conn", "rid", "op", "terms", "letter", "k", "score",
-                 "seq", "expires_at", "done")
+                 "seq", "expires_at", "done", "trace_id", "t_admit",
+                 "t_pop", "t_exec")
 
     def __init__(self, conn, rid, op, terms, letter, k, score, seq,
-                 expires_at):
+                 expires_at, trace_id=None, t_admit=0.0):
         self.conn = conn
         self.rid = rid
         self.op = op
@@ -115,6 +149,10 @@ class _Request:
         self.seq = seq
         self.expires_at = expires_at
         self.done = False
+        self.trace_id = trace_id
+        self.t_admit = t_admit  # monotonic admission timestamp
+        self.t_pop = None  # dispatcher popped it off the queue
+        self.t_exec = None  # batch reached the engine lock
 
 
 class _Conn:
@@ -196,7 +234,8 @@ class ServeDaemon:
                  coalesce_us: int | None = None,
                  queue_depth: int | None = None,
                  max_batch: int | None = None,
-                 drain_s: float | None = None):
+                 drain_s: float | None = None,
+                 metrics_port: int | None = None):
         self._path = path
         self._engine_choice = engine
         self._cache_terms = cache_terms
@@ -217,15 +256,23 @@ class ServeDaemon:
         self._queue: queue.Queue = queue.Queue(maxsize=self.queue_depth)
         self._inflight = 0  # admitted minus finished  # guarded by: self._count_lock
         self._seq = 0  # data-request ordinal (faults)  # guarded by: self._count_lock
-        self._counts = {  # guarded by: self._count_lock
-            "requests": 0, "responses": 0, "shed": 0,
-            "deadline_expired": 0, "draining_rejected": 0,
-            "bad_request": 0, "internal_errors": 0,
-            "client_disconnects": 0, "slow_client_closes": 0,
-            "reload_ok": 0, "reload_rejected": 0,
-            "batches": 0, "batched_requests": 0, "connections": 0,
-        }
+        # every tally is an obs counter on this per-daemon registry;
+        # _counts maps the legacy stats key to its counter object (the
+        # mapping itself is immutable after construction)
+        self.registry = obs_metrics.Registry()
+        self._counts = {key: self.registry.counter(name)
+                        for key, name in _COUNTER_NAMES}
+        self._g_queue_depth = self.registry.gauge("mri_serve_queue_depth")
+        self._g_inflight = self.registry.gauge("mri_serve_inflight")
+        self._g_draining = self.registry.gauge("mri_serve_draining")
+        self._h_request = \
+            self.registry.histogram("mri_serve_request_seconds")
+        self._h_queue_wait = \
+            self.registry.histogram("mri_serve_queue_wait_seconds")
         self._count_lock = threading.Lock()
+        self._obs_enabled = obs_tracing.enabled()
+        self._slow_ms = obs_tracing.slow_ms()
+        self._trace_ring = obs_tracing.TraceRing()
         self._conns: set[_Conn] = set()  # guarded by: self._conn_lock
         self._conn_lock = threading.Lock()
         self._draining = False
@@ -236,6 +283,9 @@ class ServeDaemon:
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._dispatcher: threading.Thread | None = None
+        self._metrics_port = metrics_port
+        self._metrics_listener: socket.socket | None = None
+        self._metrics_thread: threading.Thread | None = None
         self._host = host
         self._port = port
         self.final_stats: dict | None = None
@@ -258,6 +308,18 @@ class ServeDaemon:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="mri-serve-accept", daemon=True)
         self._accept_thread.start()
+        if self._metrics_port is not None:
+            ms = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ms.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ms.bind(("127.0.0.1", self._metrics_port))
+            ms.listen(8)
+            ms.settimeout(0.2)
+            self._metrics_listener = ms
+            self._metrics_port = ms.getsockname()[1]
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_loop, name="mri-serve-metrics",
+                daemon=True)
+            self._metrics_thread.start()
         # mrilint: allow(guarded-by) no reload can race start()
         log.info("serving %s on %s:%d (engine=%s coalesce_us=%d "
                  "queue_depth=%d max_batch=%d)", self._path, self._host,
@@ -272,9 +334,15 @@ class ServeDaemon:
     def draining(self) -> bool:
         return self._draining
 
+    @property
+    def metrics_address(self) -> tuple[str, int] | None:
+        """(host, port) of the HTTP scrape listener, when enabled."""
+        if self._metrics_listener is None:
+            return None
+        return "127.0.0.1", self._metrics_port
+
     def _count(self, key: str, n: int = 1) -> None:
-        with self._count_lock:
-            self._counts[key] += n
+        self._counts[key].inc(n)
 
     # -- accept / per-connection threads -------------------------------
 
@@ -363,8 +431,11 @@ class ServeDaemon:
             return
         rid = req.get("id")
         op = req.get("op")
+        tid = req.get("trace_id")
+        if tid is not None and not isinstance(tid, str):
+            tid = str(tid)
         if op in ADMIN_OPS:
-            self._handle_admin(conn, rid, op)
+            self._handle_admin(conn, rid, op, req)
             return
         err = self._validate(req, op)
         if err:
@@ -372,6 +443,8 @@ class ServeDaemon:
             payload = {"error": "bad_request", "detail": err}
             if rid is not None:
                 payload["id"] = rid
+            if tid is not None:
+                payload["trace_id"] = tid
             conn.enqueue(0, payload)
             return
         if self._draining:
@@ -380,18 +453,24 @@ class ServeDaemon:
                        "detail": "daemon is shutting down"}
             if rid is not None:
                 payload["id"] = rid
+            if tid is not None:
+                payload["trace_id"] = tid
             conn.enqueue(0, payload)
             return
+        if tid is None and self._obs_enabled:
+            tid = obs_tracing.gen_trace_id()
+        t_admit = time.monotonic()
+        self._counts["requests"].inc()
         with self._count_lock:
-            self._counts["requests"] += 1
             self._seq += 1
             seq = self._seq
         deadline_ms = req.get("deadline_ms")
-        expires_at = time.monotonic() + deadline_ms / 1e3 \
+        expires_at = t_admit + deadline_ms / 1e3 \
             if deadline_ms is not None else None
         item = _Request(conn, rid, op, req.get("terms"),
                         req.get("letter"), int(req.get("k") or 0),
-                        req.get("score") or "df", seq, expires_at)
+                        req.get("score") or "df", seq, expires_at,
+                        trace_id=tid, t_admit=t_admit)
         with conn.lock:
             conn.pending += 1
         try:
@@ -440,15 +519,23 @@ class ServeDaemon:
             return f"{op} needs terms=[str, ...], got {terms!r}"
         return None
 
-    def _handle_admin(self, conn: _Conn, rid, op: str) -> None:
-        """stats/healthz/reload answer inline from the reader thread —
-        they must work while the dispatcher is wedged in a batch."""
+    def _handle_admin(self, conn: _Conn, rid, op: str, req: dict) -> None:
+        """Admin ops answer inline from the reader thread — they must
+        work while the dispatcher is wedged in a batch."""
         if op == "healthz":
             payload = {"ok": True,
                        "status": "draining" if self._draining else "ok",
                        "queue_depth": self._queue.qsize()}
         elif op == "stats":
             payload = {"ok": True, "stats": self.stats()}
+        elif op == "metrics":
+            payload = {"ok": True, "text": self.render_metrics()}
+        elif op == "trace":
+            n = req.get("n")
+            n = n if isinstance(n, int) and not isinstance(n, bool) \
+                and n > 0 else 32
+            payload = {"ok": True,
+                       "traces": self._trace_ring.snapshot(n)}
         else:  # reload
             ok, detail = self.reload()
             if ok:
@@ -457,6 +544,9 @@ class ServeDaemon:
                 payload = {"error": "reload_rejected", "detail": detail}
         if rid is not None:
             payload["id"] = rid
+        tid = req.get("trace_id")
+        if tid is not None:
+            payload["trace_id"] = tid if isinstance(tid, str) else str(tid)
         conn.enqueue(0, payload)
 
     # -- dispatch ------------------------------------------------------
@@ -469,23 +559,28 @@ class ServeDaemon:
                 if self._dispatch_stop.is_set():
                     return
                 continue
+            first.t_pop = time.monotonic()
             batch = [first]
             if self.coalesce_us > 0 and self.max_batch > 1 \
                     and not self._draining:
-                until = time.monotonic() + self.coalesce_us / 1e6
+                until = first.t_pop + self.coalesce_us / 1e6
                 while len(batch) < self.max_batch:
                     rem = until - time.monotonic()
                     if rem <= 0:
                         break
                     try:
-                        batch.append(self._queue.get(timeout=rem))
+                        rider = self._queue.get(timeout=rem)
                     except queue.Empty:
                         break
+                    rider.t_pop = time.monotonic()
+                    batch.append(rider)
             while len(batch) < self.max_batch:  # free riders
                 try:
-                    batch.append(self._queue.get_nowait())
+                    rider = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                rider.t_pop = time.monotonic()
+                batch.append(rider)
             self._execute(batch)
 
     def _finish(self, item: _Request, payload: dict, *,
@@ -496,6 +591,8 @@ class ServeDaemon:
         item.done = True
         if item.rid is not None:
             payload.setdefault("id", item.rid)
+        if item.trace_id is not None:
+            payload.setdefault("trace_id", item.trace_id)
         item.conn.enqueue(item.seq, payload)
         with item.conn.lock:
             item.conn.pending -= 1
@@ -505,6 +602,48 @@ class ServeDaemon:
         if admitted:
             with self._count_lock:
                 self._inflight -= 1
+        self._record_trace(item, payload)
+
+    def _record_trace(self, item: _Request, payload: dict) -> None:
+        """Latency histograms + one trace record per finished request.
+        Off the response path's critical invariants — never raises."""
+        t_done = time.monotonic()
+        t0 = item.t_admit
+        self._h_request.observe(t_done - t0)
+        if item.t_pop is not None:
+            self._h_queue_wait.observe(item.t_pop - t0)
+        if not (self._obs_enabled and item.trace_id is not None):
+            return
+        spans = []
+
+        def add(name, a, b):
+            spans.append({"name": name,
+                          "start_ms": round((a - t0) * 1e3, 3),
+                          "dur_ms": round((b - a) * 1e3, 3)})
+
+        if item.t_pop is None:  # shed at admission or drain flush
+            add("admission", t0, t_done)
+        elif item.t_exec is None:  # popped, never reached the engine
+            add("queue_wait", t0, item.t_pop)
+            add("dispatch", item.t_pop, t_done)
+        else:
+            add("queue_wait", t0, item.t_pop)
+            add("coalesce", item.t_pop, item.t_exec)
+            add("engine", item.t_exec, t_done)
+        dur_ms = (t_done - t0) * 1e3
+        trace = {
+            "trace_id": item.trace_id,
+            "id": item.rid,
+            "op": item.op,
+            "seq": item.seq,
+            "status": "ok" if payload.get("ok")
+                      else payload.get("error", "error"),
+            "dur_ms": round(dur_ms, 3),
+            "spans": spans,
+        }
+        self._trace_ring.push(trace)
+        if 0 < self._slow_ms <= dur_ms:
+            obs_tracing.emit_slow(trace)
 
     def _execute(self, items: list[_Request]) -> None:
         inj = faults.active()
@@ -513,6 +652,8 @@ class ServeDaemon:
             # the last instant before dispatch — so stale work never
             # reaches the batch path no matter where the queue stalled
             now = time.monotonic()
+            for it in items:
+                it.t_exec = now
             live = []
             for it in items:
                 if it.expires_at is not None and now > it.expires_at:
@@ -638,8 +779,8 @@ class ServeDaemon:
     # -- stats ---------------------------------------------------------
 
     def stats(self) -> dict:
+        counters = {key: c.value for key, c in self._counts.items()}
         with self._count_lock:
-            counters = dict(self._counts)
             inflight = self._inflight
         # serialized against reload's swap+close via _reload_lock, NOT
         # the dispatch lock: stats must answer even while the
@@ -669,6 +810,56 @@ class ServeDaemon:
             },
         }
 
+    # -- metrics exposition --------------------------------------------
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition: the daemon's registry, the
+        current engine's registry, and the process-global registry
+        (fault firings).  Metric names are disjoint by prefix, so the
+        concatenation is a valid exposition."""
+        with self._count_lock:
+            self._g_inflight.set(self._inflight)
+        self._g_queue_depth.set(self._queue.qsize())
+        self._g_draining.set(1 if self._draining else 0)
+        parts = [self.registry.render_text()]
+        if not self._drained.is_set():
+            with self._reload_lock:
+                try:
+                    # mrilint: allow(guarded-by) serialized by _reload_lock
+                    parts.append(self._engine.metrics.render_text())
+                except Exception:  # racing a drain's engine close
+                    pass
+        parts.append(obs_metrics.default_registry().render_text())
+        return "".join(p for p in parts if p)
+
+    def _metrics_loop(self) -> None:
+        """Minimal HTTP/1.0 scrape endpoint on the loopback listener:
+        read (and ignore) the request, answer one 200 with the text
+        exposition, close.  Serial on purpose — scrapes are rare."""
+        assert self._metrics_listener is not None
+        while not self._draining:
+            try:
+                sock, _ = self._metrics_listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed by drain()
+            try:
+                sock.settimeout(1.0)
+                with contextlib.suppress(OSError):
+                    sock.recv(65536)  # request head, ignored
+                body = self.render_metrics().encode()
+                head = (b"HTTP/1.0 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4; "
+                        b"charset=utf-8\r\n"
+                        b"Content-Length: " + str(len(body)).encode()
+                        + b"\r\n\r\n")
+                with contextlib.suppress(OSError):
+                    sock.sendall(head + body)
+            finally:
+                with contextlib.suppress(OSError):
+                    sock.close()
+
     # -- drain ---------------------------------------------------------
 
     def drain(self) -> int:
@@ -690,8 +881,15 @@ class ServeDaemon:
                 self._listener.close()
             except OSError:
                 pass
+        if self._metrics_listener is not None:
+            try:
+                self._metrics_listener.close()
+            except OSError:
+                pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2.0)
+        if self._metrics_thread is not None:
+            self._metrics_thread.join(timeout=2.0)
         # finish in-flight work within the drain budget
         while time.monotonic() < deadline:
             with self._count_lock:

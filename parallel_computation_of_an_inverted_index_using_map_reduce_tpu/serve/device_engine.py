@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs import metrics as obs_metrics
 from ..parallel.compat import shard_map
 from ..parallel.mesh import SHARD_AXIS, make_mesh
 from ..utils import envknobs
@@ -412,8 +413,14 @@ class DeviceEngine:
         self._topk_fns: dict[int, object] = {}
         self._bm25_fns: dict[tuple, object] = {}
 
-        self._cache = LRUCache(cache_terms)  # idle on the device path
-        self._ops = OpTimer()
+        # per-engine obs registry: describe() stays a view over it and
+        # the daemon folds it into the Prometheus exposition
+        self.metrics = obs_metrics.Registry()
+        self.metrics.gauge("mri_engine_vocab_terms").set(self.vocab_size)
+        self.metrics.gauge("mri_engine_artifact_bytes").set(art.nbytes)
+        self._cache = LRUCache(cache_terms, registry=self.metrics,
+                               prefix="mri_serve_cache")  # idle on the device path
+        self._ops = OpTimer(registry=self.metrics)
 
     # -- shape bucketing ------------------------------------------------
 

@@ -13,46 +13,16 @@ is an O(k) slice of the artifact's ``df_order`` permutation.
 
 from __future__ import annotations
 
-import os
-import time
-from contextlib import contextmanager
-
 import numpy as np
 
 from . import artifact as artifact_mod
 from .cache import LRUCache
+from ..obs import metrics as obs_metrics
+# OpTimer's historical home is this module; the implementation moved to
+# obs.timing (unified with PhaseTimer over the obs histogram) and is
+# re-exported here so ``from .engine import OpTimer`` keeps working.
+from ..obs.timing import OpTimer  # noqa: F401
 from ..utils import envknobs
-
-
-class OpTimer:
-    """Per-op wall-time counters for ``--stats``: calls + total ms per
-    public query op, shared by both engine implementations."""
-
-    def __init__(self):
-        self._ops: dict[str, list] = {}
-
-    @contextmanager
-    def time(self, op: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            rec = self._ops.setdefault(op, [0, 0.0])
-            rec[0] += 1
-            rec[1] += time.perf_counter() - t0
-
-    def stats(self) -> dict:
-        out = {}
-        for op, (calls, secs) in sorted(self._ops.items()):
-            out[op] = {
-                "calls": calls,
-                "total_ms": round(secs * 1e3, 3),
-                "avg_us": round(secs * 1e6 / calls, 2) if calls else 0.0,
-            }
-        return out
-
-    def reset(self) -> None:
-        self._ops.clear()
 
 
 def _normalize(term) -> bytes:
@@ -110,9 +80,17 @@ class Engine:
         self._terms = terms
         self._keys = key8.view(">u8").ravel()
         self._df = art.df
-        self._cache = LRUCache(cache_terms)
-        self._tf_cache = LRUCache(cache_terms)
-        self._ops = OpTimer()
+        # every tally below lives on this per-engine obs registry: the
+        # legacy describe()/stats dicts are views over it, and the
+        # daemon folds it into the Prometheus exposition
+        self.metrics = obs_metrics.Registry()
+        self.metrics.gauge("mri_engine_vocab_terms").set(V)
+        self.metrics.gauge("mri_engine_artifact_bytes").set(art.nbytes)
+        self._cache = LRUCache(cache_terms, registry=self.metrics,
+                               prefix="mri_serve_cache")
+        self._tf_cache = LRUCache(cache_terms, registry=self.metrics,
+                                  prefix="mri_serve_tf_cache")
+        self._ops = OpTimer(registry=self.metrics)
         self._sdtype = f"S{width}"
         self._width = width
         # small-batch term-resolution memo: encoded query bytes ->
@@ -120,8 +98,12 @@ class Engine:
         # few terms over and over; a dict probe replaces the whole
         # searchsorted arm for them.
         self._memo: dict[bytes, int] = {}
-        self._decode = {"blocks_decoded": 0, "blocks_skipped": 0,
-                        "bytes_decoded": 0}
+        self._c_blocks_decoded = \
+            self.metrics.counter("mri_engine_blocks_decoded_total")
+        self._c_blocks_skipped = \
+            self.metrics.counter("mri_engine_blocks_skipped_total")
+        self._c_bytes_decoded = \
+            self.metrics.counter("mri_engine_bytes_decoded_total")
         self._bm25_cols = None  # lazy (doc_lens, ndocs, avgdl)
 
     # -- term resolution ------------------------------------------------
@@ -191,16 +173,15 @@ class Engine:
             return hit
         art = self.artifact
         decoded = art.decode_postings(idx)
-        dec = self._decode
         if art.version == artifact_mod.VERSION_V2:
             b0 = int(art.term_block_off[idx])
             b1 = int(art.term_block_off[idx + 1])
-            dec["blocks_decoded"] += b1 - b0
-            dec["bytes_decoded"] += \
-                int(art.blk_woff[b1] - art.blk_woff[b0]) * 4
+            self._c_blocks_decoded.inc(b1 - b0)
+            self._c_bytes_decoded.inc(
+                int(art.blk_woff[b1] - art.blk_woff[b0]) * 4)
         else:
-            dec["blocks_decoded"] += 1
-            dec["bytes_decoded"] += decoded.nbytes
+            self._c_blocks_decoded.inc()
+            self._c_bytes_decoded.inc(decoded.nbytes)
         decoded.setflags(write=False)
         self._cache.put(idx, decoded)
         return decoded
@@ -252,22 +233,21 @@ class Engine:
         block that could hold it; only those blocks are bit-unpacked.
         """
         art = self.artifact
-        dec = self._decode
         b0 = int(art.term_block_off[idx])
         b1 = int(art.term_block_off[idx + 1])
         blk = np.searchsorted(art.blk_max[b0:b1], acc)
         ok = blk < (b1 - b0)
         blk, cand = blk[ok], acc[ok]
         if not len(cand):
-            dec["blocks_skipped"] += b1 - b0
+            self._c_blocks_skipped.inc(b1 - b0)
             return cand
         need = np.unique(blk)
         ids, _ = art.decode_blocks(need + b0)
-        dec["blocks_decoded"] += len(need)
-        dec["blocks_skipped"] += (b1 - b0) - len(need)
-        dec["bytes_decoded"] += int(
+        self._c_blocks_decoded.inc(len(need))
+        self._c_blocks_skipped.inc((b1 - b0) - len(need))
+        self._c_bytes_decoded.inc(int(
             (art.blk_woff[need + b0 + 1]
-             - art.blk_woff[need + b0]).sum()) * 4
+             - art.blk_woff[need + b0]).sum()) * 4)
         # rows beyond a block's count repeat its last real doc id
         # (cumsum of zero deltas), so a plain membership test is exact.
         rows = ids[np.searchsorted(need, blk)]
@@ -362,7 +342,11 @@ class Engine:
 
     def decode_stats(self) -> dict:
         """Skip/decode counters — the gallop win, observable."""
-        return dict(self._decode)
+        return {
+            "blocks_decoded": self._c_blocks_decoded.value,
+            "blocks_skipped": self._c_blocks_skipped.value,
+            "bytes_decoded": self._c_bytes_decoded.value,
+        }
 
     def describe(self) -> dict:
         """Engine identity + counters for ``mri query --stats``."""

@@ -101,6 +101,7 @@ _SCOPE_TITLES = {
     "build": "Build / index pipeline",
     "faults": "Fault injection & retries",
     "serve": "Query serving",
+    "obs": "Observability",
     "bench": "Benchmarks",
     "test": "Test hooks",
 }
@@ -223,6 +224,22 @@ declare("MRI_SERVE_SCORE", str, "df",
         "Default top_k scoring mode when no --score flag is given: "
         "df (document frequency) or bm25 (ranked retrieval).",
         scope="serve", choices=("df", "bm25"))
+
+# -- observability ----------------------------------------------------
+declare("MRI_OBS_ENABLE", int, 1,
+        "Per-request tracing on the daemon: 1 auto-generates trace ids "
+        "and records spans into the trace ring, 0 disables recording "
+        "(client-provided trace ids are still echoed).",
+        scope="obs", choices=(0, 1))
+declare("MRI_OBS_TRACE_RING", int, 256,
+        "Capacity of the daemon's ring of recent request traces "
+        "(served by the `trace` admin op).",
+        scope="obs", minimum=1)
+declare("MRI_OBS_SLOW_MS", float, 0.0,
+        "Slow-query threshold in ms: requests at least this slow emit "
+        "one structured JSON line on the mri_tpu.obs logger; 0 "
+        "disables the slow log.",
+        scope="obs", minimum=0)
 
 # -- benchmarks -------------------------------------------------------
 declare("MRI_TPU_BENCH_ATTEMPTS", int, 3,

@@ -1,45 +1,14 @@
-"""Phase timing + structured run stats.
+"""Phase timing + structured run stats (shim).
 
 The reference's only observability is a handful of printfs (mapper
 ranges at main.c:327, "REDUCER" at main.c:141) and no timers at all
-(SURVEY.md §5).  Here every pipeline phase is timed and counted.
+(SURVEY.md §5).  The implementation now lives in ``obs.timing``,
+unified with the serve engines' OpTimer over the obs histogram; this
+module keeps the historical import path working.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import time
+from ..obs.timing import PhaseTimer
 
-
-class PhaseTimer:
-    """Accumulates wall-time per named phase and arbitrary counters."""
-
-    def __init__(self) -> None:
-        self.phases: dict[str, float] = {}
-        self.counters: dict[str, int | float] = {}
-
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases[name] = self.phases.get(name, 0.0) + (time.perf_counter() - t0)
-
-    def count(self, name: str, value) -> None:
-        self.counters[name] = value
-
-    @property
-    def total_seconds(self) -> float:
-        return sum(self.phases.values())
-
-    def report(self) -> dict:
-        return {
-            "phases_ms": {k: round(v * 1e3, 3) for k, v in self.phases.items()},
-            "total_ms": round(self.total_seconds * 1e3, 3),
-            **self.counters,
-        }
-
-    def dumps(self) -> str:
-        return json.dumps(self.report(), sort_keys=True)
+__all__ = ["PhaseTimer"]

@@ -1,0 +1,24 @@
+"""Fixture: hand-rolled counter-dict bumps (obs-metrics findings when
+the file sits under serve/ — the test overrides src.rel, mirroring the
+fault-boundary scoping test)."""
+
+
+class Handler:
+    def __init__(self):
+        self._counters = {"requests": 0, "shed": 0}
+        self._weights = {}
+
+    def on_request(self):
+        # the pre-obs idiom the checker exists to catch
+        self._counters["requests"] += 1
+
+    def on_shed(self, n):
+        self._counters["shed"] += n
+
+    def on_weight(self, key, w):
+        # variable key: not a counter-dict bump, stays silent
+        self._weights[key] += w
+
+    def on_tally(self):
+        # mrilint: allow(obs-metrics) bookkeeping dict, not a metric
+        self._counters["requests"] += 1

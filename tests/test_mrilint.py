@@ -25,6 +25,7 @@ from tools.mrilint.checks import (  # noqa: E402
     fault_boundary,
     guarded_by,
     lifecycle,
+    obs_metrics,
 )
 
 pytestmark = pytest.mark.lint
@@ -76,6 +77,42 @@ def test_fault_boundary_scopes_to_package():
     src.rel = f"{PACKAGE}/corpus/bad_fault.py"
     findings = fault_boundary.check(src)
     assert [f.key for f in findings] == ["open@read_raw"]
+
+
+def test_obs_metrics_scopes_to_serve_and_flags_dict_bumps():
+    src = Source(FIXTURES / "bad_obs.py")
+    assert obs_metrics.check(src) == []  # outside serve/: silent
+    src.rel = f"{PACKAGE}/serve/bad_obs.py"
+    findings = obs_metrics.check(src)
+    # constant-string keys flagged; the variable-key bump and the
+    # allow()-suppressed bump stay silent
+    assert sorted(f.key for f in findings) == [
+        "dict-counter@requests", "dict-counter@shed"]
+    assert "obs.metrics Counter" in findings[0].message
+
+
+def test_obs_metrics_readme_table_in_sync():
+    # the repo-level drift check: the committed README metrics table
+    # must match what --write-readme would generate
+    assert obs_metrics.check_repo(REPO_ROOT) == []
+
+
+def test_obs_metrics_repo_check_detects_drift(tmp_path):
+    pkg = tmp_path / PACKAGE / "obs"
+    pkg.mkdir(parents=True)
+    real = REPO_ROOT / PACKAGE / "obs" / "metrics.py"
+    (pkg / "metrics.py").write_text(real.read_text(encoding="utf-8"),
+                                    encoding="utf-8")
+    readme = tmp_path / "README.md"
+    readme.write_text("x\n<!-- obsmetrics:begin -->\nstale\n"
+                      "<!-- obsmetrics:end -->\ny\n", encoding="utf-8")
+    # the standalone loader caches by module name; force a fresh load
+    sys.modules.pop("mrilint_obs_metrics", None)
+    findings = obs_metrics.check_repo(tmp_path)
+    assert [f.key for f in findings] == ["drift"]
+    obs_metrics.write_readme(tmp_path)
+    assert obs_metrics.check_repo(tmp_path) == []
+    sys.modules.pop("mrilint_obs_metrics", None)
 
 
 def test_clean_fixture_passes_every_checker():

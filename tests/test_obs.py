@@ -1,0 +1,510 @@
+"""Observability layer suite (obs/): metrics registry semantics under
+thread pressure, histogram exactness against the numpy oracle, request
+tracing over the daemon wire protocol, the slow-query log, Prometheus
+exposition parity with the legacy ``stats`` op, the ``mri metrics``
+CLI, the plain-HTTP scrape listener, and Chrome-trace build export.
+
+Daemon-touching tests carry the ``daemon`` marker too, so the conftest
+leak guard holds them to the no-stray-sockets/threads contract.
+"""
+
+import json
+import logging
+import math
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from test_daemon import DOCS, Client, serving
+
+from test_serve import build_corpus, naive_index, write_manifest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    main as cli_main,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    metrics as obs_metrics,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    timing as obs_timing,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    tracing as obs_tracing,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    out = build_corpus(tmp_path_factory.mktemp("obs_corpus"), DOCS)
+    return out, naive_index(DOCS)
+
+
+# -- registry semantics ----------------------------------------------------
+
+def test_registry_get_or_create_and_kind_mismatch():
+    reg = obs_metrics.Registry()
+    c = reg.counter("x_total", "help text")
+    assert reg.counter("x_total") is c
+    assert c.help == "help text"
+    with pytest.raises(TypeError):
+        reg.gauge("x_total")
+    # well-known names pick up their canonical help automatically
+    r = reg.counter("mri_serve_requests_total")
+    assert "admitted" in r.help
+
+
+def test_counter_thread_hammer():
+    reg = obs_metrics.Registry()
+    c = reg.counter("hammer_total")
+    g = reg.gauge("hammer_gauge")
+    import threading
+
+    def work():
+        for _ in range(5000):
+            c.inc()
+            g.inc(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8 * 5000
+    assert g.value == 8 * 5000.0
+
+
+def test_histogram_buckets_match_numpy_oracle():
+    h = obs_metrics.Histogram("t_seconds")
+    rng = np.random.default_rng(7)
+    # log-uniform across the bucket span plus exact-boundary values
+    # (le semantics: a sample equal to a bound lands in that bucket)
+    samples = list(np.exp(rng.uniform(np.log(1e-7), np.log(100.0), 3000)))
+    samples += [h.bounds[0], h.bounds[5], h.bounds[-1], 1e9]
+    for v in samples:
+        h.observe(v)
+    arr = np.sort(np.asarray(samples))
+    cum = h.cumulative_counts()
+    for bound, got in zip(h.bounds, cum):
+        want = int(np.searchsorted(arr, bound, side="right"))
+        assert got == want, f"bucket le={bound}"
+    assert cum[-1] == len(samples) == h.count
+    assert h.sum == pytest.approx(float(np.sum(arr)))
+
+
+def test_histogram_quantiles_exact_vs_numpy():
+    h = obs_metrics.Histogram("q_seconds")
+    rng = np.random.default_rng(13)
+    samples = rng.gamma(2.0, 0.003, 5001)
+    for v in samples:
+        h.observe(v)
+    assert h.exact
+    for p in (0, 5, 50, 90, 99, 99.9, 100):
+        assert h.quantile(p) == pytest.approx(
+            float(np.percentile(samples, p)), rel=1e-12)
+
+
+def test_histogram_sample_cap_flags_truncation():
+    h = obs_metrics.Histogram("cap_seconds")
+    for i in range(obs_metrics.SAMPLE_CAP + 10):
+        h.observe(1e-5)
+    assert not h.exact
+    assert h.count == obs_metrics.SAMPLE_CAP + 10  # buckets stay exact
+    assert h.cumulative_counts()[-1] == h.count
+
+
+def test_render_text_prometheus_shape():
+    reg = obs_metrics.Registry()
+    reg.counter("a_total").inc(3)
+    reg.gauge("b_depth").set(2.5)
+    h = reg.histogram("c_seconds")
+    h.observe(1e-6)
+    h.observe(5.0)
+    text = reg.render_text()
+    assert "# TYPE a_total counter\na_total 3" in text
+    assert "# TYPE b_depth gauge\nb_depth 2.5" in text
+    assert "# TYPE c_seconds histogram" in text
+    assert 'c_seconds_bucket{le="+Inf"} 2' in text
+    assert "c_seconds_count 2" in text
+    # bucket series is cumulative-monotonic
+    buckets = [int(line.rsplit(" ", 1)[1])
+               for line in text.splitlines()
+               if line.startswith("c_seconds_bucket")]
+    assert buckets == sorted(buckets)
+    # every sample line parses as "name value" or 'name{le="..."} value'
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, _, v = line.rpartition(" ")
+        float(v)
+        assert name
+
+
+# -- timer shims -----------------------------------------------------------
+
+def test_optimer_shim_and_stats_shape():
+    # the historical import paths still resolve to the obs classes
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (  # noqa: E501
+        OpTimer,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.utils.timing import (  # noqa: E501
+        PhaseTimer,
+    )
+    assert OpTimer is obs_timing.OpTimer
+    assert PhaseTimer is obs_timing.PhaseTimer
+
+    t = OpTimer()
+    with t.time("df"):
+        pass
+    s = t.stats()
+    assert set(s) == {"df"}
+    assert set(s["df"]) == {"calls", "total_ms", "avg_us"}
+    assert s["df"]["calls"] == 1
+    assert not math.isnan(t.quantile_ms("df", 50))
+    t.reset()
+    assert t.stats() == {}
+
+    pt = PhaseTimer()
+    with pt.phase("scan"):
+        pass
+    pt.count("tokens", 42)
+    pt.phases["aborted_thing"] = 0.5  # direct assignment must keep working
+    rep = pt.report()
+    assert set(rep["phases_ms"]) == {"scan", "aborted_thing"}
+    assert rep["tokens"] == 42
+    assert json.loads(pt.dumps()) == json.loads(
+        json.dumps(rep, sort_keys=True))
+    assert pt.histogram("scan").count == 1
+
+
+# -- request tracing over the wire ----------------------------------------
+
+def _poll_traces(cli, n, want, timeout=5.0):
+    """Trace records land just after the response line — poll briefly."""
+    deadline = time.monotonic() + timeout
+    while True:
+        r = cli.rpc(op="trace", n=n)
+        assert r["ok"]
+        if len(r["traces"]) >= want or time.monotonic() > deadline:
+            return r["traces"]
+        time.sleep(0.01)
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_trace_id_echo_and_autogeneration(built):
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        r = cli.rpc(id=1, op="df", terms=["cat"], trace_id="my-trace-7")
+        assert r["ok"] and r["trace_id"] == "my-trace-7"
+        r = cli.rpc(id=2, op="df", terms=["dog"])
+        assert r["ok"]
+        assert len(r["trace_id"]) == 16
+        int(r["trace_id"], 16)  # hex
+        # admin ops echo a provided trace_id too
+        r = cli.rpc(id=3, op="healthz", trace_id="adm")
+        assert r["trace_id"] == "adm"
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_trace_op_spans_complete_and_contiguous(built):
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        for i in range(6):
+            r = cli.rpc(id=i, op="and", terms=["cat", "the"],
+                        trace_id=f"t{i}")
+            assert r["ok"]
+        traces = _poll_traces(cli, 32, 6)
+        assert len(traces) >= 6
+        # most-recent-first ordering
+        ids = [t["trace_id"] for t in traces if t["trace_id"].startswith("t")]
+        assert ids == sorted(ids, reverse=True)
+        for t in traces:
+            assert t["status"] == "ok"
+            assert t["op"] == "and"
+            names = [s["name"] for s in t["spans"]]
+            assert names == ["queue_wait", "coalesce", "engine"]
+            # spans start at admission and tile the request wall time
+            assert t["spans"][0]["start_ms"] == 0.0
+            for a, b in zip(t["spans"], t["spans"][1:]):
+                assert b["start_ms"] == pytest.approx(
+                    a["start_ms"] + a["dur_ms"], abs=2e-3)
+            last = t["spans"][-1]
+            assert t["dur_ms"] >= last["start_ms"] + last["dur_ms"] - 2e-3
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_trace_ring_capacity_and_n(built, monkeypatch):
+    monkeypatch.setenv("MRI_OBS_TRACE_RING", "3")
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        for i in range(8):
+            assert cli.rpc(id=i, op="df", terms=["cat"])["ok"]
+        traces = _poll_traces(cli, 32, 3)
+        assert len(traces) == 3
+        assert len(cli.rpc(op="trace", n=1)["traces"]) == 1
+        # a junk n falls back to the default window rather than erroring
+        r = cli.rpc(op="trace", n=0)
+        assert r["ok"] and len(r["traces"]) == 3
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_obs_disabled_skips_generation_but_echoes(built, monkeypatch):
+    monkeypatch.setenv("MRI_OBS_ENABLE", "0")
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        r = cli.rpc(id=1, op="df", terms=["cat"])
+        assert r["ok"] and "trace_id" not in r
+        r = cli.rpc(id=2, op="df", terms=["cat"], trace_id="still-echoed")
+        assert r["trace_id"] == "still-echoed"
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_slow_query_log_fires(built, monkeypatch, caplog):
+    monkeypatch.setenv("MRI_OBS_SLOW_MS", "0.000001")
+    out, _ = built
+    with caplog.at_level(logging.WARNING, logger="mri_tpu.obs"):
+        with serving(out) as d, Client(d) as cli:
+            assert cli.rpc(id=1, op="df", terms=["cat"],
+                           trace_id="slowone")["ok"]
+        # serving() drained: every _finish (and its slow-log emit) done
+    lines = [json.loads(rec.message) for rec in caplog.records
+             if rec.name == "mri_tpu.obs"]
+    mine = [ln for ln in lines if ln.get("trace_id") == "slowone"]
+    assert mine and mine[0]["event"] == "slow_query"
+    assert mine[0]["status"] == "ok"
+    assert [s["name"] for s in mine[0]["spans"]] \
+        == ["queue_wait", "coalesce", "engine"]
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_slow_query_log_quiet_by_default(built, caplog):
+    out, _ = built
+    with caplog.at_level(logging.WARNING, logger="mri_tpu.obs"):
+        with serving(out) as d, Client(d) as cli:
+            assert cli.rpc(id=1, op="df", terms=["cat"])["ok"]
+    assert not [r for r in caplog.records if r.name == "mri_tpu.obs"]
+
+
+# -- Prometheus exposition parity -----------------------------------------
+
+def _prom_scalars(text: str) -> dict:
+    vals = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#") or "{" in line.split(" ", 1)[0]:
+            continue
+        name, _, v = line.partition(" ")
+        vals[name] = float(v)
+    return vals
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_metrics_op_matches_stats_counters(built):
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        for i in range(5):
+            assert cli.rpc(id=i, op="df", terms=["cat"])["ok"]
+        # a bad request and a shed-free baseline for the error counters
+        assert cli.rpc(id=9, op="nope")["error"] == "bad_request"
+        stats = cli.rpc(op="stats")["stats"]
+        r = cli.rpc(op="metrics")
+        assert r["ok"]
+        vals = _prom_scalars(r["text"])
+        counters = stats["counters"]
+        for key in ("requests", "shed", "deadline_expired", "bad_request",
+                    "draining_rejected", "reload_ok", "reload_rejected"):
+            assert vals[f"mri_serve_{key}_total"] == counters[key], key
+        # engine + cache metrics ride along in the same exposition
+        assert "mri_engine_vocab_terms" in vals
+        assert "mri_serve_cache_hits_total" in vals
+        # latency histograms are exposed with _count matching traffic
+        assert "mri_serve_request_seconds_count" in vals
+        assert vals["mri_serve_request_seconds_count"] >= 5
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_engine_describe_unchanged_by_migration(built):
+    # the byte-compat contract: describe()/stats() keep their legacy
+    # shapes even though every number now lives in the obs registry
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        assert cli.rpc(id=1, op="df", terms=["cat"])["ok"]
+        stats = cli.rpc(op="stats")["stats"]
+        eng = stats["engine"]
+        assert {"hits", "misses", "evictions", "capacity", "entries"} \
+            <= set(eng["cache"])
+        assert {"blocks_decoded", "blocks_skipped", "bytes_decoded"} \
+            == set(eng["decode"])
+
+
+# -- scrape surfaces: CLI + HTTP listener ---------------------------------
+
+def test_metrics_cli_artifact_dir(built, capsys):
+    out, _ = built
+    assert cli_main(["metrics", str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "# TYPE mri_engine_vocab_terms gauge" in text
+    assert "# TYPE mri_serve_cache_hits_total counter" in text
+
+
+def test_metrics_cli_bad_dir(tmp_path, capsys):
+    assert cli_main(["metrics", str(tmp_path / "nope")]) == 2
+    assert "error" in capsys.readouterr().err
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_metrics_cli_against_daemon(built, capsys):
+    out, _ = built
+    with serving(out) as d, Client(d) as cli:
+        assert cli.rpc(id=1, op="df", terms=["cat"])["ok"]
+        host, port = d.address
+        assert cli_main(["metrics", f"{host}:{port}"]) == 0
+        text = capsys.readouterr().out
+        vals = _prom_scalars(text)
+        assert vals["mri_serve_requests_total"] == 1
+
+
+def test_metrics_cli_unreachable_addr(capsys):
+    # a closed port: connection refused -> one-line exit 2
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    assert cli_main(["metrics", f"127.0.0.1:{port}", "--timeout", "2"]) == 2
+    assert "error" in capsys.readouterr().err
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_http_scrape_listener(built):
+    out, _ = built
+    with serving(out, metrics_port=0) as d:
+        assert d.metrics_address is not None
+        with socket.create_connection(d.metrics_address, timeout=10) as s:
+            s.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+            buf = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        head, _, body = buf.partition(b"\r\n\r\n")
+        assert b"200 OK" in head
+        assert b"text/plain" in head
+        vals = _prom_scalars(body.decode())
+        assert "mri_serve_requests_total" in vals
+    # after drain the listener is gone
+    with pytest.raises(OSError):
+        socket.create_connection(d.metrics_address, timeout=1)
+
+
+# -- Chrome-trace build export --------------------------------------------
+
+def _build_with_trace(tmp_path, monkeypatch, capsys, *, mappers, reducers,
+                      window_bytes=96, artifact=True, extra=()):
+    ddir = tmp_path / "docs"
+    ddir.mkdir()
+    paths = []
+    for i, blob in enumerate(DOCS * 3):
+        p = ddir / f"d{i:04d}.txt"
+        p.write_bytes(blob)
+        paths.append(str(p))
+    listfile = tmp_path / "list.txt"
+    write_manifest(listfile, paths)
+    out = tmp_path / "out"
+    trace_path = tmp_path / "trace.json"
+    monkeypatch.setenv("MRI_CPU_WINDOW_BYTES", str(window_bytes))
+    argv = [str(mappers), str(reducers), str(listfile),
+            "--backend", "cpu", "--output-dir", str(out), "--stats",
+            "--trace-out", str(trace_path), *extra]
+    if artifact:
+        argv.append("--artifact")
+    assert cli_main(argv) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["trace_out"] == str(trace_path)
+    with open(trace_path, "r", encoding="utf-8") as f:
+        return stats, json.load(f)
+
+
+def _check_trace_doc(doc):
+    """Spans are well-formed and, per thread lane, non-overlapping."""
+    events = doc["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    named_tids = {e["tid"] for e in meta if e["name"] == "thread_name"}
+    by_tid = {}
+    for e in spans:
+        assert e["ts"] >= 0.0 and e["dur"] >= 0.0
+        assert e["tid"] in named_tids, f"unnamed lane {e['tid']}"
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: e["ts"])
+        for a, b in zip(evs, evs[1:]):
+            assert b["ts"] >= a["ts"] + a["dur"] - 0.01, \
+                f"overlap on tid {tid}: {a} / {b}"
+    assert doc["displayTimeUnit"] == "ms"
+    return spans
+
+
+def test_trace_out_parallel_build(tmp_path, monkeypatch, capsys):
+    stats, doc = _build_with_trace(tmp_path, monkeypatch, capsys,
+                                   mappers=2, reducers=3)
+    spans = _check_trace_doc(doc)
+    names = {}
+    for e in spans:
+        names[e["name"]] = names.get(e["name"], 0) + 1
+    windows = stats["io_windows"]
+    assert windows > 1, "window override did not take"
+    # one complete span per scheduled window, on both pipeline stages
+    assert names["scan"] == windows
+    assert names["read"] == windows
+    assert names["merge"] == 1
+    assert names["emit_range"] == stats["reduce_workers"]
+    assert names["artifact_pack"] == 1
+    # scan windows are labeled with their global plan index
+    scan_windows = sorted(e["args"]["window"] for e in spans
+                          if e["name"] == "scan")
+    assert scan_windows == list(range(1, windows + 1))
+
+
+def test_trace_out_pipelined_build(tmp_path, monkeypatch, capsys):
+    # the single-worker pipelined path needs --host-threads 1 (with
+    # mappers=1 the default would still spin min(cores, 8) workers)
+    # and no --artifact (which routes through the parallel reduce)
+    stats, doc = _build_with_trace(tmp_path, monkeypatch, capsys,
+                                   mappers=1, reducers=1, artifact=False,
+                                   extra=("--host-threads", "1"))
+    spans = _check_trace_doc(doc)
+    names = {e["name"] for e in spans}
+    windows = stats["io_windows"]
+    assert windows > 1
+    assert sum(1 for e in spans if e["name"] == "scan") == windows
+    assert "finalize_emit" in names
+
+
+def test_trace_out_absent_without_flag(tmp_path, capsys):
+    # no --trace-out: no trace file, no trace_out stats key
+    out = build_corpus(tmp_path, DOCS)
+    assert not list(tmp_path.rglob("trace.json"))
+    assert out.exists()

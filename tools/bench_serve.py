@@ -834,6 +834,123 @@ def _daemon_single_open_loop(rps: float) -> dict:
     }
 
 
+def _parse_prom_counters(text: str) -> dict:
+    """Un-labeled sample lines of a Prometheus text exposition ->
+    {name: value} (histogram bucket/label series are skipped)."""
+    vals: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, v = line.partition(" ")
+        if "{" in name:
+            continue
+        vals[name] = float(v)
+    return vals
+
+
+#: metrics-op RPCs in the scrape-latency probe
+SCRAPE_N = 200
+
+
+def _scrape_check(out_path: str | None) -> dict:
+    """`--scrape-check`: the observability surface must be free.
+
+    Drives a pipelined leg against a live daemon, then (a) asserts the
+    Prometheus exposition's counters exactly match the legacy `stats`
+    op, and (b) measures the `metrics` op's p50 and converts it into
+    the fraction of serving capacity a 1 Hz scraper would consume —
+    gated < 1% against the recorded r09 two-term AND QPS."""
+    import socket as _socket
+
+    _, corpus_metric = bench._manifest()
+    out_dir, _report = _build_index()
+    rng = np.random.default_rng(SEED)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+
+    engine = Engine(os.path.join(out_dir, "index.mri"))
+    terms = _zipf_terms(engine, DAEMON_PIPELINE_N, rng)
+    engine.close()
+
+    proc, addr = _spawn_daemon(out_dir)
+    try:
+        n = min(DAEMON_PIPELINE_N, 20_000)
+        pipelined = _daemon_pipelined_qps(
+            addr, _encode_requests(terms, n))
+        print(f"# pipelined: {pipelined}", file=sys.stderr, flush=True)
+
+        # quiescent now (every response received) — admission-time
+        # counters are frozen, so parity can demand exact equality
+        sock = _socket.create_connection(addr, timeout=60)
+        f = sock.makefile("rb")
+        try:
+            sock.sendall(b'{"id": 0, "op": "stats"}\n')
+            stats = json.loads(f.readline())
+            assert stats.get("ok"), stats
+            counters = stats["stats"]["counters"]
+
+            lat = np.empty(SCRAPE_N)
+            text = ""
+            for i in range(SCRAPE_N):
+                t0 = time.perf_counter()
+                sock.sendall(b'{"id": 1, "op": "metrics"}\n')
+                r = json.loads(f.readline())
+                lat[i] = time.perf_counter() - t0
+                assert r.get("ok"), r
+                text = r["text"]
+        finally:
+            f.close()
+            sock.close()
+
+        prom = _parse_prom_counters(text)
+        parity = {}
+        for key in ("requests", "shed", "deadline_expired",
+                    "bad_request", "draining_rejected"):
+            pv = prom.get(f"mri_serve_{key}_total")
+            assert pv == counters[key], \
+                f"{key}: prometheus {pv} != stats {counters[key]}"
+            parity[key] = int(counters[key])
+        final_counters = _stop_daemon(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    scrape_p50_s = float(np.percentile(lat, 50))
+    # a 1 Hz scraper occupies the wire/daemon for p50 seconds every
+    # second: that fraction of capacity, against the r09 gate QPS
+    gate_qps = 32012.1
+    r09 = Path(__file__).resolve().parent.parent / "BENCH_SERVE_V2_r09.json"
+    if r09.exists():
+        gate_qps = float(json.loads(r09.read_text())["value"])
+    overhead_pct = scrape_p50_s * 1.0 * 100.0
+    assert overhead_pct < 1.0, \
+        f"metrics op p50 {scrape_p50_s * 1e3:.2f}ms = {overhead_pct:.3f}% " \
+        f"of a 1 Hz scrape second (gate: <1%)"
+
+    line = {
+        "metric": "daemon_scrape_overhead_pct",
+        "value": round(overhead_pct, 4),
+        "unit": "% of serving capacity at 1 Hz scrape",
+        "corpus_metric": corpus_metric,
+        "zipf_s": ZIPF_S,
+        "scrape_p50_us": round(scrape_p50_s * 1e6, 1),
+        "scrape_p99_us": round(float(np.percentile(lat, 99)) * 1e6, 1),
+        "scrape_rpcs": SCRAPE_N,
+        "gate_qps_r09": gate_qps,
+        "queries_displaced_per_scrape": round(scrape_p50_s * gate_qps, 2),
+        "pipelined": pipelined,
+        "prometheus_vs_stats_parity": parity,
+        "daemon_counters": final_counters,
+        "host_cores": os.cpu_count(),
+        "scratch": bench._scratch_backing(),
+    }
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
 # -- default closed-loop host bench (the r05 shape, unchanged) ----------
 
 
@@ -940,9 +1057,18 @@ def main(argv: list[str] | None = None) -> int:
                         "capacity")
     p.add_argument("--out-daemon", default="BENCH_DAEMON_r07.json",
                    help="where --daemon-bench writes its JSON report")
+    p.add_argument("--scrape-check", action="store_true",
+                   help="observability overhead gate: Prometheus-vs-"
+                        "stats counter parity on a live daemon, then "
+                        "assert a 1 Hz `metrics` scrape costs <1% of "
+                        "the recorded r09 serving capacity")
+    p.add_argument("--out-scrape", default="BENCH_SCRAPE_r10.json",
+                   help="where --scrape-check writes its JSON report")
     args = p.parse_args(argv)
 
-    if args.daemon_bench:
+    if args.scrape_check:
+        line = _scrape_check(args.out_scrape)
+    elif args.daemon_bench:
         line = _daemon_bench(args.out_daemon)
     elif args.daemon and args.open_loop is not None:
         line = _daemon_single_open_loop(args.open_loop)

@@ -5,8 +5,12 @@ from . import (  # noqa: F401
     fault_boundary,
     guarded_by,
     lifecycle,
+    obs_metrics,
     readme_knobs,
 )
 
-#: per-file checkers, run in order (readme_knobs is repo-level, not here)
-CHECKERS = (guarded_by, env_knobs, exit_codes, lifecycle, fault_boundary)
+#: per-file checkers, run in order (readme_knobs is repo-level, not
+#: here; obs_metrics appears twice — its check() is per-file, its
+#: check_repo() runs with the repo-level pass)
+CHECKERS = (guarded_by, env_knobs, exit_codes, lifecycle, fault_boundary,
+            obs_metrics)

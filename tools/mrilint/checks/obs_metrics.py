@@ -1,0 +1,130 @@
+"""obs-metrics: serve-layer counters go through the obs registry.
+
+Two halves:
+
+* Per-file: a hand-rolled counter bump — ``something["key"] += n`` on a
+  constant string key — inside ``serve/`` is a finding.  The obs/
+  migration replaced every scattered counter dict with registry-backed
+  :class:`obs.metrics.Counter` objects (their own locks, Prometheus
+  names, one source of truth); a new dict-subscript increment is the
+  old idiom creeping back.  Suppress a legitimate non-metric tally with
+  ``# mrilint: allow(obs-metrics) reason``.
+
+* Repo-level: the README metrics table between
+  ``<!-- obsmetrics:begin -->`` and ``<!-- obsmetrics:end -->`` is
+  generated from ``obs/metrics.py``'s ``KNOWN_METRICS`` via
+  ``python -m tools.mrilint --write-readme``.  Hand edits or a new
+  metric without a regen show up as drift findings.
+
+Like readme_knobs, the registry module is loaded by file path so this
+never imports the package (and therefore never imports jax/numpy) —
+``obs/metrics.py`` is stdlib-only by contract for exactly this reason.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import sys
+from pathlib import Path
+
+from ..core import Finding, Source, PACKAGE
+
+RULE = "obs-metrics"
+
+_BEGIN = "<!-- obsmetrics:begin -->"
+_END = "<!-- obsmetrics:end -->"
+
+_SCOPE = PACKAGE + "/serve/"
+
+
+def _describe_target(node: ast.Subscript) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is best-effort
+        return "<subscript>"
+
+
+def check(src: Source) -> list[Finding]:
+    if not src.rel.startswith(_SCOPE):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.AugAssign):
+            continue
+        if not isinstance(node.op, ast.Add):
+            continue
+        target = node.target
+        if not isinstance(target, ast.Subscript):
+            continue
+        sl = target.slice
+        if not (isinstance(sl, ast.Constant) and isinstance(sl.value, str)):
+            continue
+        if src.allowed(node, RULE):
+            continue
+        what = _describe_target(target)
+        findings.append(Finding(
+            rule=RULE, path=src.rel, line=node.lineno,
+            key=f"dict-counter@{sl.value}",
+            message=(f"{what} += ... is a hand-rolled counter dict — "
+                     f"use an obs.metrics Counter (registry.counter("
+                     f"...).inc()) or suppress with a reason")))
+    return findings
+
+
+def _load_metrics(root: Path):
+    name = "mrilint_obs_metrics"
+    if name in sys.modules:
+        return sys.modules[name]
+    path = root / PACKAGE / "obs" / "metrics.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _expected_block(root: Path) -> str:
+    return _load_metrics(root).markdown_table().strip()
+
+
+def _split(readme_text: str):
+    """(prefix, current block, suffix) or None when markers absent."""
+    try:
+        head, rest = readme_text.split(_BEGIN, 1)
+        block, tail = rest.split(_END, 1)
+    except ValueError:
+        return None
+    return head, block.strip(), tail
+
+
+def check_repo(root: Path) -> list[Finding]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return [Finding(rule=RULE, path="README.md", line=1, key="missing",
+                        message="README.md not found")]
+    parts = _split(readme.read_text(encoding="utf-8"))
+    if parts is None:
+        return [Finding(
+            rule=RULE, path="README.md", line=1, key="markers",
+            message=(f"README.md lacks the {_BEGIN} / {_END} markers "
+                     f"for the generated metrics table"))]
+    _, block, _ = parts
+    if block != _expected_block(root):
+        return [Finding(
+            rule=RULE, path="README.md", line=1, key="drift",
+            message=("README metrics table is out of date — run "
+                     "`python -m tools.mrilint --write-readme`"))]
+    return []
+
+
+def write_readme(root: Path) -> None:
+    readme = root / "README.md"
+    parts = _split(readme.read_text(encoding="utf-8"))
+    if parts is None:
+        raise SystemExit(
+            f"mrilint: README.md lacks {_BEGIN} / {_END} markers — add "
+            f"them where the metrics table should live, then re-run")
+    head, _, tail = parts
+    readme.write_text(
+        f"{head}{_BEGIN}\n{_expected_block(root)}\n{_END}{tail}",
+        encoding="utf-8")

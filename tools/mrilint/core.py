@@ -190,8 +190,9 @@ def run_lint(files: list[Path]) -> list[Finding]:
 
 
 def run_repo_checks() -> list[Finding]:
-    from .checks import readme_knobs
-    return readme_knobs.check_repo(REPO_ROOT)
+    from .checks import obs_metrics, readme_knobs
+    return (readme_knobs.check_repo(REPO_ROOT)
+            + obs_metrics.check_repo(REPO_ROOT))
 
 
 def load_baseline(path: Path = BASELINE_PATH) -> Counter:
@@ -226,13 +227,15 @@ def main(argv=None) -> int:
                     help="prune fixed findings from the baseline "
                          "(shrink-only; never adds)")
     ap.add_argument("--write-readme", action="store_true",
-                    help="regenerate the README env-knob table")
+                    help="regenerate the README env-knob and metrics "
+                         "tables")
     args = ap.parse_args(argv)
 
     if args.write_readme:
-        from .checks import readme_knobs
+        from .checks import obs_metrics, readme_knobs
         readme_knobs.write_readme(REPO_ROOT)
-        print("mrilint: README env-knob table regenerated")
+        obs_metrics.write_readme(REPO_ROOT)
+        print("mrilint: README env-knob and metrics tables regenerated")
         return 0
 
     full_scope = not args.paths and not args.changed
