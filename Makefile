@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-daemon bench-scrape capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -130,6 +130,12 @@ bench-serve-device:
 # latency, BM25 throughput; byte-parity gated) -> BENCH_SERVE_V2_r09.json
 bench-serve-v2:
 	$(PY) tools/bench_serve.py --format-ab
+
+# ranked-query A/B on a v2.1 artifact: exhaustive vs Block-Max WAND vs
+# MaxScore at k=1/10/100 over the Zipf mix, byte-parity gated, with
+# cold-sweep block-skip ratios -> BENCH_RANKED_r11.json
+bench-serve-ranked:
+	$(PY) tools/bench_serve.py --ranked-ab
 
 # resident-daemon bench: coalesced pipelined capacity vs the batch-1
 # closed-loop baseline, plus an open-loop (Poisson) sweep reporting

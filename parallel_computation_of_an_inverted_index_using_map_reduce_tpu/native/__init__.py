@@ -294,7 +294,7 @@ def load():
         ]
         lib.mri_hidxm_export_v2_prepare.restype = ctypes.c_int32
         lib.mri_hidxm_export_v2_prepare.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
@@ -888,16 +888,20 @@ class HostIndexMerge:
         if rc != 0:
             raise RuntimeError(f"native artifact export failed (rc={rc})")
 
-    def export_v2_prepare(self, block_size: int) -> tuple[int, int, int]:
-        """Build the format-v2 export plan (block skip entries, packed
-        postings/tf words, doc lengths) and return the section sizes the
-        layout needs: ``(num_blocks, post_data_bytes, tf_data_bytes)``.
-        ``block_size`` must be a power of two >= 2."""
+    def export_v2_prepare(self, block_size: int,
+                          score_bits: int = 0) -> tuple[int, int, int]:
+        """Build the format-v2/v2.1 export plan (block skip entries,
+        packed postings/tf words, doc lengths, and — when ``score_bits``
+        is 8 or 16 — the saturated max-tf / min-doc-length columns) and
+        return the section sizes the layout needs: ``(num_blocks,
+        post_data_bytes, tf_data_bytes)``.  ``block_size`` must be a
+        power of two >= 2."""
         nb = ctypes.c_int64(0)
         pb = ctypes.c_int64(0)
         tb = ctypes.c_int64(0)
         rc = self._lib.mri_hidxm_export_v2_prepare(
             self._handle, ctypes.c_int32(block_size),
+            ctypes.c_int32(score_bits),
             ctypes.byref(nb), ctypes.byref(pb), ctypes.byref(tb))
         if rc == -2:
             raise MemoryError("native v2 export allocation failure")
@@ -906,15 +910,17 @@ class HostIndexMerge:
         return int(nb.value), int(pb.value), int(tb.value)
 
     def export_v2_payload(self, buf: np.ndarray, offsets: dict) -> None:
-        """Fill a format-v2 ``index.mri`` file buffer from the prepared
-        plan (:meth:`export_v2_prepare` first) and release the plan.
-        ``offsets`` maps every v2 payload section name to its absolute
-        byte offset in ``buf``."""
-        offs = np.array([offsets[name] for name in (
-            "letter_dir", "term_offsets", "term_blob", "df",
-            "blk_max", "blk_first", "blk_width", "blk_tf_width",
-            "post_data", "tf_data", "doc_lens", "df_order")],
-            dtype=np.int64)
+        """Fill a format-v2/v2.1 ``index.mri`` file buffer from the
+        prepared plan (:meth:`export_v2_prepare` first) and release the
+        plan.  ``offsets`` maps every payload section name to its
+        absolute byte offset in ``buf``; the v2.1 max-score sections
+        ride between ``blk_tf_width`` and ``post_data`` when present."""
+        names = ["letter_dir", "term_offsets", "term_blob", "df",
+                 "blk_max", "blk_first", "blk_width", "blk_tf_width",
+                 "post_data", "tf_data", "doc_lens", "df_order"]
+        if "blk_max_tf" in offsets:
+            names[8:8] = ["blk_max_tf", "blk_min_dl"]
+        offs = np.array([offsets[name] for name in names], dtype=np.int64)
         rc = self._lib.mri_hidxm_export_v2_payload(
             self._handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

@@ -2133,7 +2133,12 @@ struct HostMergeState {
   std::vector<uint8_t> v2_blk_width, v2_blk_tf_width;
   std::vector<uint32_t> v2_post_data, v2_tf_data;
   std::vector<int32_t> v2_doc_lens;
+  // v2.1 max-score columns as little-endian bytes (score_bits/8 per
+  // block): saturated max tf and min doc length — integers, so these
+  // bytes match the pure-Python packer bit for bit.
+  std::vector<uint8_t> v2_blk_max_tf, v2_blk_min_dl;
   int32_t v2_block_size = 0;
+  int32_t v2_score_bits = 0;
 };
 
 void* mri_hidxm_new(void* const* handles, int32_t num_handles,
@@ -2544,6 +2549,7 @@ int32_t mri_hidxm_export_payload(void* mh, uint8_t* base,
 // ---------------------------------------------------------------------------
 
 int32_t mri_hidxm_export_v2_prepare(void* mh, int32_t block_size,
+                                    int32_t score_bits,
                                     int64_t* num_blocks_out,
                                     int64_t* post_bytes_out,
                                     int64_t* tf_bytes_out) try {
@@ -2552,12 +2558,16 @@ int32_t mri_hidxm_export_v2_prepare(void* mh, int32_t block_size,
   const int32_t V = m.vocab;
   const int32_t B = block_size;
   if (B < 2 || B > (1 << 20) || (B & (B - 1)) != 0) return -1;
+  if (score_bits != 0 && score_bits != 8 && score_bits != 16) return -1;
   m.v2_block_size = B;
+  m.v2_score_bits = score_bits;
   m.v2_lex = LexOrderRadix(st, V);
   m.v2_blk_max.clear();
   m.v2_blk_first.clear();
   m.v2_blk_width.clear();
   m.v2_blk_tf_width.clear();
+  m.v2_blk_max_tf.clear();
+  m.v2_blk_min_dl.clear();
   m.v2_post_data.clear();
   m.v2_tf_data.clear();
 
@@ -2632,6 +2642,24 @@ int32_t mri_hidxm_export_v2_prepare(void* mh, int32_t block_size,
       const int wt = BitWidth(maxt);
       m.v2_blk_width.push_back(static_cast<uint8_t>(wd));
       m.v2_blk_tf_width.push_back(static_cast<uint8_t>(wt));
+      if (score_bits) {
+        // maxt holds max(tf - 1); the columns store saturated max tf
+        // and min doc length (same integer saturation as the Python
+        // packer — the engines derive the float bound at query time)
+        const uint32_t cap = (1u << score_bits) - 1;
+        uint32_t mind = UINT32_MAX;
+        for (int32_t j = 0; j < cnt; ++j)
+          mind = std::min(
+              mind, static_cast<uint32_t>(m.v2_doc_lens[dptr[b0 + j]]));
+        const uint32_t mt = std::min(maxt + 1, cap);
+        const uint32_t md = std::min(mind, cap);
+        m.v2_blk_max_tf.push_back(static_cast<uint8_t>(mt & 0xff));
+        m.v2_blk_min_dl.push_back(static_cast<uint8_t>(md & 0xff));
+        if (score_bits == 16) {
+          m.v2_blk_max_tf.push_back(static_cast<uint8_t>(mt >> 8));
+          m.v2_blk_min_dl.push_back(static_cast<uint8_t>(md >> 8));
+        }
+      }
       for (int32_t j = 1; j < cnt; ++j)
         pp.Push(static_cast<uint32_t>(dptr[b0 + j] - dptr[b0 + j - 1] - 1),
                 wd);
@@ -2652,22 +2680,27 @@ int32_t mri_hidxm_export_v2_prepare(void* mh, int32_t block_size,
   return -2;
 }
 
-// Fill the v2 payload sections.  `offs` holds 12 byte offsets into
+// Fill the v2/v2.1 payload sections.  `offs` holds byte offsets into
 // `base`, in fixed section order: letter_dir, term_offsets, term_blob,
-// df, blk_max, blk_first, blk_width, blk_tf_width, post_data, tf_data,
-// doc_lens, df_order.  Releases the prepare plan on success.
+// df, blk_max, blk_first, blk_width, blk_tf_width, [blk_max_tf,
+// blk_min_dl,] post_data, tf_data, doc_lens, df_order — 12 offsets for
+// a v2 plan (score_bits 0), 14 for a v2.1 plan.  Releases the prepare
+// plan on success.
 int32_t mri_hidxm_export_v2_payload(void* mh, uint8_t* base,
                                     const int64_t* offs,
                                     int32_t n_offs) try {
   HostMergeState& m = *static_cast<HostMergeState*>(mh);
   const StreamState& st = *m.st;
   const int32_t V = m.vocab;
-  if (n_offs != 12 || m.v2_block_size == 0) return -1;
+  if (m.v2_block_size == 0) return -1;
+  if (n_offs != (m.v2_score_bits ? 14 : 12)) return -1;
+  const int tail = m.v2_score_bits ? 10 : 8;  // post_data's slot
   int64_t* letter_dir = reinterpret_cast<int64_t*>(base + offs[0]);
   int64_t* term_offsets = reinterpret_cast<int64_t*>(base + offs[1]);
   uint8_t* term_blob = base + offs[2];
   int32_t* df = reinterpret_cast<int32_t*>(base + offs[3]);
-  int32_t* df_order = reinterpret_cast<int32_t*>(base + offs[11]);
+  int32_t* df_order =
+      reinterpret_cast<int32_t*>(base + offs[tail + 3]);
 
   for (int l = 0; l < 27; ++l) letter_dir[l] = m.letter_off[l];
   const uint8_t* arena = st.arena.data();
@@ -2688,9 +2721,13 @@ int32_t mri_hidxm_export_v2_payload(void* mh, uint8_t* base,
   copy_bytes(5, m.v2_blk_first.data(), m.v2_blk_first.size() * 4);
   copy_bytes(6, m.v2_blk_width.data(), m.v2_blk_width.size());
   copy_bytes(7, m.v2_blk_tf_width.data(), m.v2_blk_tf_width.size());
-  copy_bytes(8, m.v2_post_data.data(), m.v2_post_data.size() * 4);
-  copy_bytes(9, m.v2_tf_data.data(), m.v2_tf_data.size() * 4);
-  copy_bytes(10, m.v2_doc_lens.data(), m.v2_doc_lens.size() * 4);
+  if (m.v2_score_bits) {
+    copy_bytes(8, m.v2_blk_max_tf.data(), m.v2_blk_max_tf.size());
+    copy_bytes(9, m.v2_blk_min_dl.data(), m.v2_blk_min_dl.size());
+  }
+  copy_bytes(tail, m.v2_post_data.data(), m.v2_post_data.size() * 4);
+  copy_bytes(tail + 1, m.v2_tf_data.data(), m.v2_tf_data.size() * 4);
+  copy_bytes(tail + 2, m.v2_doc_lens.data(), m.v2_doc_lens.size() * 4);
   std::vector<int32_t> inv(std::max(V, 1));
   for (int32_t r = 0; r < V; ++r) inv[m.v2_lex[r]] = r;
   for (int32_t i = 0; i < V; ++i) df_order[i] = inv[m.emit_order[i]];
@@ -2700,10 +2737,13 @@ int32_t mri_hidxm_export_v2_payload(void* mh, uint8_t* base,
   std::vector<int32_t>().swap(m.v2_blk_first);
   std::vector<uint8_t>().swap(m.v2_blk_width);
   std::vector<uint8_t>().swap(m.v2_blk_tf_width);
+  std::vector<uint8_t>().swap(m.v2_blk_max_tf);
+  std::vector<uint8_t>().swap(m.v2_blk_min_dl);
   std::vector<uint32_t>().swap(m.v2_post_data);
   std::vector<uint32_t>().swap(m.v2_tf_data);
   std::vector<int32_t>().swap(m.v2_doc_lens);
   m.v2_block_size = 0;
+  m.v2_score_bits = 0;
   return 0;
 } catch (const std::bad_alloc&) {
   return -2;

@@ -266,6 +266,21 @@ KNOWN_METRICS = (
      "On-disk size of the loaded artifact."),
     ("mri_engine_op_<op>_seconds", "histogram",
      "Per-op engine latency (df, postings, and, or, top_k, ...)."),
+    # query planner (per-engine registry)
+    ("mri_planner_ranked_exhaustive_total", "counter",
+     "Ranked queries the planner scored exhaustively."),
+    ("mri_planner_ranked_bmw_total", "counter",
+     "Ranked queries evaluated with Block-Max WAND pruning."),
+    ("mri_planner_ranked_maxscore_total", "counter",
+     "Ranked queries evaluated with MaxScore pruning."),
+    ("mri_planner_and_gallop_total", "counter",
+     "AND intersection steps taken by the galloping-probe arm."),
+    ("mri_planner_and_merge_total", "counter",
+     "AND intersection steps taken by the linear-merge arm."),
+    ("mri_planner_blocks_scored_total", "counter",
+     "Posting blocks pruned ranked evaluation had to score."),
+    ("mri_planner_blocks_skipped_total", "counter",
+     "Posting blocks whose max-score bound kept them unscored."),
     # fault injection (process-global default registry)
     ("mri_faults_fired_total", "counter",
      "Fault-injection rules fired, all kinds."),

@@ -50,6 +50,12 @@ class OpTimer:
         finally:
             self._hist(op).observe(time.perf_counter() - t0)
 
+    def histogram(self, op: str) -> metrics.Histogram:
+        """The op's latency histogram, for callers that inline their
+        timing — a hot path observes directly instead of paying the
+        context-manager machinery per call."""
+        return self._hist(op)
+
     def stats(self) -> dict:
         out = {}
         for op in sorted(self._hists):

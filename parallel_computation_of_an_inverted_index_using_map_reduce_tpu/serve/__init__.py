@@ -16,8 +16,10 @@ and crash-safe hot reload.
 
 from .artifact import ARTIFACT_NAME, ArtifactError, load_artifact
 from .daemon import ServeDaemon
-from .engine import ENGINE_CHOICES, Engine, create_engine, resolve_engine
+from .engine import (ENGINE_CHOICES, AutoEngine, Engine,
+                     create_engine, resolve_engine)
 
-__all__ = ["ARTIFACT_NAME", "ArtifactError", "ENGINE_CHOICES", "Engine",
+__all__ = ["ARTIFACT_NAME", "ArtifactError", "ENGINE_CHOICES",
+           "AutoEngine", "Engine",
            "ServeDaemon", "create_engine", "load_artifact",
            "resolve_engine"]

@@ -74,6 +74,25 @@ and the payload becomes (same alignment discipline):
 Nothing else is stored: block counts per term derive from ``df``, and
 each block's byte offset derives from the width/count columns — the
 loader reconstructs both prefix sums vectorized at load time.
+
+Format v2.1 (version 3, the default) is v2 plus two per-block
+max-score columns for dynamic pruning (Block-Max WAND / MaxScore):
+
+      blk_max_tf    u8/u16[NB]  max tf in the block, saturated at
+                                2**score_bits - 1 (saturation means
+                                "assume the tf->inf BM25 limit")
+      blk_min_dl    u8/u16[NB]  min doc length in the block, saturated
+                                the same way (a saturated/short length
+                                only loosens the bound, never unsafe)
+
+inserted between ``blk_tf_width`` and ``post_data``.  ``score_bits``
+(8 or 16, ``$MRI_SERVE_SCORE_BITS``) lives in the v2 header's
+reserved0 slot, which v2 writers always zeroed.  Integer columns —
+not quantized floats — keep the native C++ exporter and the
+pure-Python packer bit-identical; the engines derive the float BM25
+upper bound ``idf * (k1+1) * mtf / (mtf + k1*(1-b+b*mdl/avgdl))``
+from them at query time.  v1 and v2 stay readable forever; engines
+fall back to exhaustive scoring when the columns are absent.
 """
 
 from __future__ import annotations
@@ -94,18 +113,22 @@ ARTIFACT_NAME = "index.mri"
 MAGIC = b"MRIIDX01"
 VERSION = 1
 VERSION_V2 = 2
+VERSION_V21 = 3
 HEADER_BYTES = 96
 _ALIGN = 16
 _HEADER_FMT = "<8sIIqqqqqI"  # ... + 32 reserved + u32 header_adler32
-_HEADER_V2_FMT = "<IIqqq"    # v2: packed into the 32 reserved bytes
+_HEADER_V2_FMT = "<IIqqq"    # v2+: packed into the 32 reserved bytes
 _HEADER_V2_OFF = struct.calcsize(_HEADER_FMT)  # 60
 
-#: Artifact format written by the builders (1 or 2; v1 stays readable
-#: forever) and the v2 postings block size (power of two >= 2).
+#: Artifact format written by the builders (1, 2 or 3; older versions
+#: stay readable forever), the v2+ postings block size (power of two
+#: >= 2), and the v2.1 max-score column width (8 or 16 bits).
 FORMAT_ENV = "MRI_SERVE_FORMAT"
 BLOCK_ENV = "MRI_SERVE_BLOCK_SIZE"
+SCORE_BITS_ENV = "MRI_SERVE_SCORE_BITS"
 
 DEFAULT_BLOCK_SIZE = 128
+DEFAULT_SCORE_BITS = 8
 
 
 class ArtifactError(RuntimeError):
@@ -141,9 +164,12 @@ def _layout(vocab: int, num_postings: int, blob_bytes: int):
 
 
 def _layout_v2(vocab: int, blob_bytes: int, num_blocks: int,
-               post_data_bytes: int, tf_data_bytes: int, max_doc_id: int):
-    """v2 section name -> (file offset, byte length), plus total size —
-    deterministic from the header scalars, like :func:`_layout`."""
+               post_data_bytes: int, tf_data_bytes: int, max_doc_id: int,
+               score_bits: int = 0):
+    """v2/v2.1 section name -> (file offset, byte length), plus total
+    size — deterministic from the header scalars, like :func:`_layout`.
+    ``score_bits`` 0 is plain v2; 8/16 inserts the v2.1 max-score
+    columns (every section offset before them is unchanged)."""
     sections = [
         ("letter_dir", 27 * 8),
         ("term_offsets", (vocab + 1) * 8),
@@ -158,6 +184,11 @@ def _layout_v2(vocab: int, blob_bytes: int, num_blocks: int,
         ("doc_lens", (max_doc_id + 1) * 4),
         ("df_order", vocab * 4),
     ]
+    if score_bits:
+        sections[8:8] = [
+            ("blk_max_tf", num_blocks * (score_bits // 8)),
+            ("blk_min_dl", num_blocks * (score_bits // 8)),
+        ]
     out: dict[str, tuple[int, int]] = {}
     cur = HEADER_BYTES
     for name, nbytes in sections:
@@ -169,11 +200,20 @@ def _layout_v2(vocab: int, blob_bytes: int, num_blocks: int,
 
 def resolve_format(fmt: int | None = None) -> int:
     """The artifact version the builders should write: the explicit
-    argument, else ``$MRI_SERVE_FORMAT`` (default 2)."""
+    argument, else ``$MRI_SERVE_FORMAT`` (default 3)."""
     fmt = int(envknobs.get(FORMAT_ENV) if fmt is None else fmt)
-    if fmt not in (VERSION, VERSION_V2):
+    if fmt not in (VERSION, VERSION_V2, VERSION_V21):
         raise ValueError(f"unsupported artifact format {fmt}")
     return fmt
+
+
+def resolve_score_bits(bits: int | None = None) -> int:
+    """The v2.1 max-score column width: the explicit argument, else
+    ``$MRI_SERVE_SCORE_BITS``.  Must be 8 or 16."""
+    b = int(envknobs.get(SCORE_BITS_ENV) if bits is None else bits)
+    if b not in (8, 16):
+        raise ValueError(f"{SCORE_BITS_ENV}={b} is not 8 or 16")
+    return b
 
 
 def resolve_block_size(block_size: int | None = None) -> int:
@@ -205,12 +245,13 @@ def pack(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
     the per-doc posting count — self-consistent BM25 stats for builders
     that never saw token-level frequencies.
     """
-    if resolve_format(fmt) == VERSION_V2:
+    fmt = resolve_format(fmt)
+    if fmt != VERSION:
         return pack_v2(
             path, term_blob=term_blob, term_offsets=term_offsets, df=df,
             post_offsets=post_offsets, postings=postings, df_order=df_order,
             max_doc_id=max_doc_id, width=width, tf=tf, doc_lens=doc_lens,
-            block_size=block_size)
+            block_size=block_size, fmt=fmt)
     path = Path(path)
     term_offsets = np.ascontiguousarray(term_offsets, dtype=np.int64)
     post_offsets = np.ascontiguousarray(post_offsets, dtype=np.int64)
@@ -263,8 +304,8 @@ def _header(*, width: int, vocab: int, num_postings: int, max_doc_id: int,
         payload_crc)
     if v2 is not None:
         header += struct.pack(
-            _HEADER_V2_FMT, v2["block_size"], 0, v2["num_blocks"],
-            v2["post_data_bytes"], v2["tf_data_bytes"])
+            _HEADER_V2_FMT, v2["block_size"], v2.get("score_bits", 0),
+            v2["num_blocks"], v2["post_data_bytes"], v2["tf_data_bytes"])
     header = header + b"\0" * (HEADER_BYTES - 4 - len(header))
     return header + struct.pack("<I", zlib.adler32(header))
 
@@ -310,16 +351,22 @@ def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
             df_order: np.ndarray, max_doc_id: int, width: int | None = None,
             tf: np.ndarray | None = None,
             doc_lens: np.ndarray | None = None,
-            block_size: int | None = None) -> int:
-    """Write a format-v2 artifact from lex-order ABSOLUTE postings (the
-    pure-Python packer — the cpu backend's merge handle has a one-pass
-    native equivalent in :func:`build_from_merge`).
+            block_size: int | None = None, fmt: int | None = None,
+            score_bits: int | None = None) -> int:
+    """Write a format-v2/v2.1 artifact from lex-order ABSOLUTE postings
+    (the pure-Python packer — the cpu backend's merge handle has a
+    one-pass native equivalent in :func:`build_from_merge`).
 
     ``tf`` aligns with ``postings`` (defaults to all-ones); ``doc_lens``
     defaults to each doc's tf sum, so scoring stays self-consistent for
-    builders without token-level data.
+    builders without token-level data.  ``fmt`` 3 (the default) adds
+    the per-block saturated max-tf / min-doc-length columns.
     """
     path = Path(path)
+    fmt = resolve_format(fmt)
+    if fmt == VERSION:
+        raise ValueError("pack_v2 writes formats 2 and 3, not 1")
+    bits = resolve_score_bits(score_bits) if fmt == VERSION_V21 else 0
     B = resolve_block_size(block_size)
     term_offsets = np.ascontiguousarray(term_offsets, dtype=np.int64)
     post_offsets = np.ascontiguousarray(post_offsets, dtype=np.int64)
@@ -350,8 +397,11 @@ def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
     blk_first: list[int] = []
     blk_width: list[int] = []
     blk_tf_width: list[int] = []
+    blk_max_tf: list[int] = []
+    blk_min_dl: list[int] = []
     post_parts: list[np.ndarray] = []
     tf_parts: list[np.ndarray] = []
+    cap = (1 << bits) - 1 if bits else 0
     for t in range(vocab):
         lo, hi = int(post_offsets[t]), int(post_offsets[t + 1])
         for b0 in range(lo, hi, B):
@@ -368,6 +418,11 @@ def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
             blk_tf_width.append(tw)
             post_parts.append(_pack_bits(deltas, w))
             tf_parts.append(_pack_bits(tfs - 1, tw))
+            if bits:
+                # saturated integer columns (never floats: the native
+                # exporter must reproduce these bytes exactly)
+                blk_max_tf.append(min(int(tfs.max()), cap))
+                blk_min_dl.append(min(int(doc_lens[docs].min()), cap))
     post_data = (np.concatenate(post_parts) if post_parts
                  else np.zeros(0, dtype=np.uint8))
     tf_data = (np.concatenate(tf_parts) if tf_parts
@@ -375,7 +430,8 @@ def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
     num_blocks = len(blk_max)
 
     layout, total = _layout_v2(vocab, blob_bytes, num_blocks,
-                               len(post_data), len(tf_data), max_doc_id)
+                               len(post_data), len(tf_data), max_doc_id,
+                               score_bits=bits)
     buf = np.zeros(total, dtype=np.uint8)
 
     def put(name: str, arr: np.ndarray) -> None:
@@ -393,6 +449,10 @@ def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
     put("blk_first", np.asarray(blk_first, dtype=np.int32))
     put("blk_width", np.asarray(blk_width, dtype=np.uint8))
     put("blk_tf_width", np.asarray(blk_tf_width, dtype=np.uint8))
+    if bits:
+        sdt = "<u1" if bits == 8 else "<u2"
+        put("blk_max_tf", np.asarray(blk_max_tf, dtype=sdt))
+        put("blk_min_dl", np.asarray(blk_min_dl, dtype=sdt))
     put("post_data", post_data)
     put("tf_data", tf_data)
     put("doc_lens", doc_lens)
@@ -400,8 +460,9 @@ def pack_v2(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
 
     return _write(path, buf, width=width, vocab=vocab,
                   num_postings=num_postings, max_doc_id=max_doc_id,
-                  blob_bytes=blob_bytes, version=VERSION_V2,
+                  blob_bytes=blob_bytes, version=fmt,
                   v2={"block_size": B, "num_blocks": num_blocks,
+                      "score_bits": bits,
                       "post_data_bytes": len(post_data),
                       "tf_data_bytes": len(tf_data)})
 
@@ -418,6 +479,7 @@ class Artifact:
     _VIEW_NAMES = ("letter_dir", "term_offsets", "term_blob", "df",
                    "post_offsets", "postings", "df_order",
                    "blk_max", "blk_first", "blk_width", "blk_tf_width",
+                   "blk_max_tf", "blk_min_dl",
                    "post_words", "tf_words", "doc_lens")
 
     def __init__(self, path: Path, mm: mmap.mmap, meta: dict,
@@ -435,10 +497,17 @@ class Artifact:
         # v2 derived block geometry (computed by the loader, vectorized)
         self.block_size = meta.get("block_size", 0)
         self.num_blocks = meta.get("num_blocks", 0)
+        self.score_bits = meta.get("score_bits", 0)
         self.term_block_off = meta.get("term_block_off")
         self.blk_cnt = meta.get("blk_cnt")
         self.blk_woff = meta.get("blk_woff")
         self.blk_tf_woff = meta.get("blk_tf_woff")
+
+    @property
+    def has_block_scores(self) -> bool:
+        """True when the v2.1 per-block max-score columns are present
+        (the planner's precondition for Block-Max WAND / MaxScore)."""
+        return self.blk_max_tf is not None
 
     def term(self, idx: int) -> bytes:
         lo, hi = self.term_offsets[idx], self.term_offsets[idx + 1]
@@ -503,6 +572,24 @@ class Artifact:
             deltas + 1, 0)
         np.cumsum(out, axis=1, out=out)
         return out.astype(np.int32), cnt
+
+    def decode_tf_blocks(self, sel: np.ndarray) -> tuple[np.ndarray,
+                                                         np.ndarray]:
+        """v2: per-doc term frequencies of the selected (global) block
+        indices, aligned row-for-row with :meth:`decode_blocks` — an
+        (len(sel), block_size) int64 matrix plus the per-block counts
+        (entries past ``cnt[i]`` are meaningless; mask like
+        ``decode_blocks``)."""
+        sel = np.asarray(sel, dtype=np.int64)
+        cnt = self.blk_cnt[sel].astype(np.int64)
+        tw = self.blk_tf_width[sel].astype(np.int64)
+        vals = self._gather_packed(sel, self.tf_words,
+                                   self.blk_tf_woff[sel], tw, cnt)
+        B = self.block_size
+        tfm = (vals + 1)[:, :B]
+        if tfm.shape[1] < B:
+            tfm = np.pad(tfm, ((0, 0), (0, B - tfm.shape[1])))
+        return tfm, cnt
 
     def decode_postings(self, idx: int) -> np.ndarray:
         """One term's absolute ascending doc ids (a fresh array)."""
@@ -602,22 +689,28 @@ def load_artifact(path: str | Path) -> Artifact:
         if magic != MAGIC:
             raise ArtifactError(
                 f"{path}: bad magic {magic!r} (not an index.mri)")
-        if version not in (VERSION, VERSION_V2):
+        if version not in (VERSION, VERSION_V2, VERSION_V21):
             raise ArtifactError(
                 f"{path}: unsupported artifact version {version} "
-                f"(this reader knows versions {VERSION} and {VERSION_V2})")
+                f"(this reader knows versions {VERSION}-{VERSION_V21})")
         v2 = None
-        if version == VERSION_V2:
-            (block_size, _res, num_blocks, post_data_bytes,
+        score_bits = 0
+        if version >= VERSION_V2:
+            (block_size, score_bits, num_blocks, post_data_bytes,
              tf_data_bytes) = struct.unpack_from(
                 _HEADER_V2_FMT, head, _HEADER_V2_OFF)
             if block_size < 2 or block_size & (block_size - 1):
                 raise ArtifactError(
                     f"{path}: invalid v2 block size {block_size}")
+            if version == VERSION_V2:
+                score_bits = 0  # v2 writers zeroed this slot
+            elif score_bits not in (8, 16):
+                raise ArtifactError(
+                    f"{path}: invalid v2.1 score_bits {score_bits}")
             v2 = (block_size, num_blocks, post_data_bytes, tf_data_bytes)
             layout, total = _layout_v2(
                 vocab, blob_bytes, num_blocks, post_data_bytes,
-                tf_data_bytes, max_doc_id)
+                tf_data_bytes, max_doc_id, score_bits=score_bits)
         else:
             layout, total = _layout(vocab, num_postings, blob_bytes)
         if total != size or payload_bytes != size - HEADER_BYTES:
@@ -634,6 +727,8 @@ def load_artifact(path: str | Path) -> Artifact:
                   "df_order": np.int32,
                   "blk_max": np.int32, "blk_first": np.int32,
                   "blk_width": np.uint8, "blk_tf_width": np.uint8,
+                  "blk_max_tf": "<u1" if score_bits == 8 else "<u2",
+                  "blk_min_dl": "<u1" if score_bits == 8 else "<u2",
                   "post_words": np.uint32, "tf_words": np.uint32,
                   "doc_lens": np.int32}
         names = {"post_data": "post_words", "tf_data": "tf_words"}
@@ -676,6 +771,7 @@ def load_artifact(path: str | Path) -> Artifact:
                     f"packed bytes, header says "
                     f"{post_data_bytes}/{tf_data_bytes}")
             meta.update(block_size=block_size, num_blocks=num_blocks,
+                        score_bits=score_bits,
                         term_block_off=term_block_off, blk_cnt=blk_cnt,
                         blk_woff=blk_woff, blk_tf_woff=blk_tf_woff)
         return Artifact(path, mm, meta, views)
@@ -791,7 +887,7 @@ def bm25_corpus(art: Artifact) -> tuple[np.ndarray, int, float]:
     pair counts 1 — the same tf=1 fallback the scorer uses).  Shared by
     both engines so their corpus statistics agree exactly.
     """
-    if art.version == VERSION_V2:
+    if art.version >= VERSION_V2:
         doc_lens = art.doc_lens.astype(np.float64)
     elif art.num_postings:
         flat = art.postings.astype(np.int64)
@@ -836,20 +932,24 @@ def build_from_merge(path, merge, *, fmt: int | None = None,
     v2 export (prepare sizes the packed streams, payload fills them).
     """
     vocab, width, num_pairs, blob_bytes, max_doc_id = merge.export_info()
-    if resolve_format(fmt) == VERSION_V2:
+    fmt = resolve_format(fmt)
+    if fmt >= VERSION_V2:
         block_size = resolve_block_size(block_size)
+        bits = resolve_score_bits() if fmt == VERSION_V21 else 0
         num_blocks, post_bytes, tf_bytes = \
-            merge.export_v2_prepare(block_size)
+            merge.export_v2_prepare(block_size, bits)
         layout, total = _layout_v2(vocab, blob_bytes, num_blocks,
-                                   post_bytes, tf_bytes, max_doc_id)
+                                   post_bytes, tf_bytes, max_doc_id,
+                                   score_bits=bits)
         buf = np.zeros(total, dtype=np.uint8)
         merge.export_v2_payload(
             buf, {n: off for n, (off, _) in layout.items()})
         return _write(path, buf, width=width, vocab=vocab,
                       num_postings=num_pairs, max_doc_id=max_doc_id,
-                      blob_bytes=blob_bytes, version=VERSION_V2,
+                      blob_bytes=blob_bytes, version=fmt,
                       v2={"block_size": block_size,
                           "num_blocks": num_blocks,
+                          "score_bits": bits,
                           "post_data_bytes": post_bytes,
                           "tf_data_bytes": tf_bytes})
     layout, total = _layout(vocab, num_pairs, blob_bytes)

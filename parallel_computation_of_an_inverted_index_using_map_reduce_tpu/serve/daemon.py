@@ -135,7 +135,7 @@ class _Request:
 
     __slots__ = ("conn", "rid", "op", "terms", "letter", "k", "score",
                  "seq", "expires_at", "done", "trace_id", "t_admit",
-                 "t_pop", "t_exec")
+                 "t_pop", "t_exec", "planner")
 
     def __init__(self, conn, rid, op, terms, letter, k, score, seq,
                  expires_at, trace_id=None, t_admit=0.0):
@@ -153,6 +153,7 @@ class _Request:
         self.t_admit = t_admit  # monotonic admission timestamp
         self.t_pop = None  # dispatcher popped it off the queue
         self.t_exec = None  # batch reached the engine lock
+        self.planner = None  # ranked queries: the planner's decision
 
 
 class _Conn:
@@ -630,6 +631,10 @@ class ServeDaemon:
             add("queue_wait", t0, item.t_pop)
             add("coalesce", item.t_pop, item.t_exec)
             add("engine", item.t_exec, t_done)
+            if item.planner is not None:
+                # label the engine span with the ranked plan so slow
+                # BM25 queries are attributable to their strategy
+                spans[-1]["planner"] = item.planner
         dur_ms = (t_done - t0) * 1e3
         trace = {
             "trace_id": item.trace_id,
@@ -729,6 +734,11 @@ class ServeDaemon:
                     elif it.op == "top_k" and it.score == "bm25":
                         top = eng.top_k_scored(
                             eng.encode_batch(it.terms), it.k)
+                        planner = getattr(eng, "planner", None)
+                        if planner is not None:
+                            # decision + pruning counters ride the trace
+                            # record so slow ranked queries attribute
+                            it.planner = planner.last_ranked
                         self._finish(it, {
                             "ok": True,
                             "docs": [[d, s] for d, s in top]})

@@ -45,6 +45,7 @@ import os
 import numpy as np
 
 from . import artifact as artifact_mod
+from . import planner as planner_mod
 from .cache import LRUCache
 from .engine import BM25_B, BM25_K1, OpTimer, encode_terms, letter_index
 
@@ -319,6 +320,46 @@ def _make_bm25_v2(width: int, k: int, block_size: int):
     return jax.jit(body)
 
 
+def _make_bm25_blocks(k: int, block_size: int):
+    """Jitted BM25 scatter-add over an (S, block_size) SURVIVOR-BLOCK
+    window instead of whole (T, width) term windows — the device form
+    of Block-Max pruning.  The host picks the surviving global block
+    ids (``bl``) from the v2.1 bound columns and pre-folds each block's
+    ``weight * idf`` into ``widf``; the kernel decodes exactly those
+    blocks (lane 0 reads the skip table's absolute ``blk_first``, other
+    lanes bit-extract deltas, one per-row cumsum), scores them, and
+    ``lax.top_k``s the dense column.  Padded rows carry ``cnt == 0``
+    and contribute nothing."""
+
+    def body(blk_first, blk_width, blk_woff, post_words,
+             blk_tf_width, blk_tf_woff, tf_words,
+             bl, cnt, widf, doc_lens, avgdl):
+        lane = jnp.arange(block_size, dtype=jnp.int32)
+        w = blk_width[bl][:, None]
+        off = jnp.maximum(lane - 1, 0)[None, :] * w
+        delta = _bit_window(post_words, blk_woff[bl][:, None]
+                            + (off >> 5), off, w) + 1
+        vals = jnp.where(lane[None, :] == 0,
+                         blk_first[bl][:, None], delta)
+        docs = jnp.cumsum(vals, axis=1, dtype=jnp.int32)
+        tw = blk_tf_width[bl][:, None]
+        toff = lane[None, :] * tw
+        tf = _bit_window(tf_words, blk_tf_woff[bl][:, None]
+                         + (toff >> 5), toff, tw) + 1
+        lane_ok = lane[None, :] < cnt[:, None]
+        tff = tf.astype(jnp.float32)
+        dl = doc_lens[jnp.where(lane_ok, docs, 0)]
+        denom = tff + BM25_K1 * (1.0 - BM25_B + BM25_B * dl / avgdl)
+        contrib = jnp.where(
+            lane_ok, widf[:, None] * tff * (BM25_K1 + 1.0) / denom, 0.0)
+        scores = jnp.zeros(doc_lens.shape[0], jnp.float32).at[
+            jnp.where(lane_ok, docs, 0).ravel()].add(contrib.ravel())
+        svals, ids = jax.lax.top_k(scores, k)
+        return ids, svals
+
+    return jax.jit(body)
+
+
 def _make_topk(k: int):
     def body(df_order, df, lo):
         pick = jax.lax.dynamic_slice(df_order, (lo,), (k,))
@@ -373,7 +414,7 @@ class DeviceEngine:
         self._d_df = put(cols["df"])
         self._d_df_order = put(cols["df_order"])
         self._fmt = cols["format"]
-        if self._fmt == artifact_mod.VERSION_V2:
+        if self._fmt >= artifact_mod.VERSION_V2:
             self._block_size = cols["block_size"]
             self._d_term_block_off = put(cols["term_block_off"])
             self._d_blk_first = put(cols["blk_first"])
@@ -412,6 +453,7 @@ class DeviceEngine:
         self._bool_fns: dict[tuple, object] = {}
         self._topk_fns: dict[int, object] = {}
         self._bm25_fns: dict[tuple, object] = {}
+        self._blocks_fns: dict[tuple, object] = {}
 
         # per-engine obs registry: describe() stays a view over it and
         # the daemon folds it into the Prometheus exposition
@@ -421,6 +463,13 @@ class DeviceEngine:
         self._cache = LRUCache(cache_terms, registry=self.metrics,
                                prefix="mri_serve_cache")  # idle on the device path
         self._ops = OpTimer(registry=self.metrics)
+        self.planner = planner_mod.Planner(self.metrics)
+        # host-side BM25 memos feeding the pruning plan: per-term f64
+        # contributions (theta bootstrap) and per-block upper bounds
+        self._bm25_host = None  # (doc_lens f64, ndocs, avgdl)
+        self._score_memo: dict[int, np.ndarray] = {}
+        self._bound_memo: dict[int, tuple] = {}
+        self._memo_cap = max(int(cache_terms), 1)
 
     # -- shape bucketing ------------------------------------------------
 
@@ -438,7 +487,7 @@ class DeviceEngine:
     def _decode_fn(self, width: int):
         fn = self._decode_fns.get(width)
         if fn is None:
-            if self._fmt == artifact_mod.VERSION_V2:
+            if self._fmt >= artifact_mod.VERSION_V2:
                 fn = _make_decode_v2(self._mesh, width, self._block_size)
             else:
                 fn = _make_decode(self._mesh, width)
@@ -553,7 +602,7 @@ class DeviceEngine:
     def _bool_fn(self, op: str, T: int, width: int):
         fn = self._bool_fns.get((op, T, width))
         if fn is None:
-            if self._fmt == artifact_mod.VERSION_V2:
+            if self._fmt >= artifact_mod.VERSION_V2:
                 fn = _make_bool_v2(op, width, self._block_size)
             else:
                 fn = _make_bool(op, width)
@@ -611,23 +660,150 @@ class DeviceEngine:
     def _bm25_fn(self, T: int, width: int, k: int):
         fn = self._bm25_fns.get((T, width, k))
         if fn is None:
-            if self._fmt == artifact_mod.VERSION_V2:
+            if self._fmt >= artifact_mod.VERSION_V2:
                 fn = _make_bm25_v2(width, k, self._block_size)
             else:
                 fn = _make_bm25(width, k)
             self._bm25_fns[(T, width, k)] = fn
         return fn
 
+    def _bm25_host_cols(self):
+        """Float64 host mirror of the corpus stats (theta bootstrap)."""
+        if self._bm25_host is None:
+            self._bm25_host = artifact_mod.bm25_corpus(self.artifact)
+        return self._bm25_host
+
+    def _term_contribs(self, i: int) -> np.ndarray:
+        """Term ``i``'s BM25 contributions, descending (f64, host)."""
+        hit = self._score_memo.get(i)
+        if hit is not None:
+            return hit
+        doc_lens, ndocs, avgdl = self._bm25_host_cols()
+        art = self.artifact
+        docs = art.decode_postings(i)
+        tf = art.decode_tf(i).astype(np.float64)
+        dfi = len(docs)
+        idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
+        denom = tf + BM25_K1 * (1.0 - BM25_B
+                                + BM25_B * doc_lens[docs] / avgdl)
+        srt = np.sort(idf * tf * (BM25_K1 + 1.0) / denom)[::-1]
+        if len(self._score_memo) >= self._memo_cap:
+            self._score_memo.clear()
+        self._score_memo[i] = srt
+        return srt
+
+    def _term_bounds(self, i: int) -> tuple:
+        """(per-block f64 upper bounds, their max, idf) for term i."""
+        hit = self._bound_memo.get(i)
+        if hit is not None:
+            return hit
+        doc_lens, ndocs, avgdl = self._bm25_host_cols()
+        dfi = int(self._h_df[i])
+        idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
+        ubs = planner_mod.block_upper_bounds(
+            self.artifact, i, idf, avgdl, BM25_K1, BM25_B)
+        if len(self._bound_memo) >= self._memo_cap:
+            self._bound_memo.clear()
+        self._bound_memo[i] = (
+            ubs, float(ubs.max()) if len(ubs) else 0.0, idf)
+        return self._bound_memo[i]
+
+    def _top_k_scored_pruned(self, occ: list[int], k: int, mode: str
+                             ) -> list[tuple[int, float]]:
+        """Block-survivor form of pruned ranked retrieval: the host
+        derives theta (the k-th best contribution of the strongest
+        term) and keeps only blocks whose bound plus every other term's
+        summed bounds clears it; the kernel decodes and scatter-adds
+        exactly those blocks.  Every true top-k doc's blocks all
+        survive (its total is a lower bound on every such test), so the
+        returned doc set matches exhaustive scoring; partially-covered
+        losers score strictly below the k-th best and cannot displace.
+        ``maxscore`` masks whole terms, ``bmw`` masks per block."""
+        art = self.artifact
+        doc_lens_d, (_ndocs32, avgdl32) = self._bm25_device()
+        D = int(doc_lens_d.shape[0])
+        weight: dict[int, int] = {}
+        for i in occ:
+            weight[i] = weight.get(i, 0) + 1
+        terms = [(i, w) + self._term_bounds(i)
+                 for i, w in weight.items()]
+        total = sum(w * umax for _i, w, _ubs, umax, _idf in terms)
+        theta = 0.0
+        for i, w, _ubs, _umax, _idf in terms:
+            srt = self._term_contribs(i)
+            if len(srt) >= k:
+                theta = max(theta, w * float(srt[k - 1]))
+        margin = planner_mod.DEVICE_MARGIN
+        bl_parts, widf_parts = [], []
+        nb_total = 0
+        for i, w, ubs, umax, idf in terms:
+            b0 = int(art.term_block_off[i])
+            nb = len(ubs)
+            nb_total += nb * w
+            rest = total - w * umax
+            if mode == "maxscore":
+                sel = np.arange(nb, dtype=np.int64) \
+                    if w * umax + rest >= theta * margin \
+                    else np.zeros(0, dtype=np.int64)
+            else:
+                sel = np.nonzero(w * ubs + rest >= theta * margin)[0]
+            if not len(sel):
+                continue
+            # one survivor row per query occurrence: the scatter-add
+            # then accumulates duplicates exactly like the exhaustive
+            # kernel's duplicated term rows
+            for _ in range(int(w)):
+                bl_parts.append(sel + b0)
+                widf_parts.append(
+                    np.full(len(sel), np.float32(idf), np.float32))
+        if not bl_parts:
+            self.planner.note_ranked(mode, 0, nb_total, 0)
+            return []
+        bl = np.concatenate(bl_parts).astype(np.int32)
+        widf = np.concatenate(widf_parts)
+        cnt = self.artifact.blk_cnt[bl].astype(np.int32)
+        S = len(bl)
+        Sp = max(_MIN_LANES, _next_pow2(S))
+        if Sp != S:
+            bl = np.concatenate([bl, np.zeros(Sp - S, np.int32)])
+            cnt = np.concatenate([cnt, np.zeros(Sp - S, np.int32)])
+            widf = np.concatenate([widf, np.zeros(Sp - S, np.float32)])
+        k_eff = min(max(k, 0), D)
+        fn = self._blocks_fns.get((Sp, k_eff))
+        if fn is None:
+            fn = self._blocks_fns[(Sp, k_eff)] = _make_bm25_blocks(
+                k_eff, self._block_size)
+        ids, vals = fn(self._d_blk_first, self._d_blk_width,
+                       self._d_blk_woff, self._d_post_words,
+                       self._d_blk_tf_width, self._d_blk_tf_woff,
+                       self._d_tf_words, bl, cnt, widf,
+                       doc_lens_d, avgdl32)
+        self.planner.note_ranked(mode, S, nb_total - S, 0)
+        ids, vals = np.asarray(ids), np.asarray(vals)
+        return [(int(d), float(s)) for d, s in zip(ids, vals)
+                if s > 0.0]
+
     def top_k_scored(self, batch, k: int) -> list[tuple[int, float]]:
         """BM25-ranked ``(doc_id, score)``, best first, ties by doc id —
         the device mirror of ``Engine.top_k_scored`` (float32 on
-        device, so scores agree with the host to ~1e-6 relative)."""
+        device, so scores agree with the host to ~1e-6 relative).  On a
+        v2.1 artifact the planner can swap the whole-term windows for a
+        survivor-block window (:meth:`_top_k_scored_pruned`)."""
         with self._ops.time("top_k_scored"):
             idx, found, dfv = self._resolve(batch)
             doc_lens, (ndocs, avgdl) = self._bm25_device()
             D = int(doc_lens.shape[0])
             if k <= 0 or D == 0 or not found.any():
+                if k > 0:
+                    self.planner.note_ranked("exhaustive", 0, 0, 0)
                 return []
+            occ = [int(i) for i, ok in zip(idx, found) if ok]
+            mode = self.planner.plan_ranked(
+                self.artifact, [int(d) for d, ok in zip(dfv, found)
+                                if ok], k)
+            if mode != "exhaustive":
+                return self._top_k_scored_pruned(occ, k, mode)
+            self.planner.note_ranked("exhaustive", 0, 0, 0)
             # duplicates accumulate (host parity): keep the full batch,
             # padded to a power of two with never-found zero lanes
             T = _next_pow2(len(idx))
@@ -639,7 +815,7 @@ class DeviceEngine:
             n = np.where(found, dfv, 0).astype(np.int32)
             width = self._tier(int(n.max()) if len(n) else 1)
             k_eff = min(max(k, 0), D)
-            if self._fmt == artifact_mod.VERSION_V2:
+            if self._fmt >= artifact_mod.VERSION_V2:
                 cols = self._decode_cols + (
                     self._d_blk_tf_width, self._d_blk_tf_woff,
                     self._d_tf_words)
@@ -670,7 +846,8 @@ class DeviceEngine:
         fns = ([self._lookup_fn] + list(self._decode_fns.values())
                + list(self._bool_fns.values())
                + list(self._topk_fns.values())
-               + list(self._bm25_fns.values()))
+               + list(self._bm25_fns.values())
+               + list(self._blocks_fns.values()))
         return {
             "jit_functions": len(fns),
             "jit_cache_entries": sum(f._cache_size() for f in fns),
@@ -684,6 +861,7 @@ class DeviceEngine:
             "artifact_bytes": self.artifact.nbytes,
             "cache": self.cache_stats(),
             "ops": self.op_stats(),
+            "planner": self.planner.describe(),
             "device": {
                 "platform": jax.default_backend(),
                 "shards": self._num_shards,
@@ -700,7 +878,7 @@ class DeviceEngine:
         self._d_df = self._d_post_offsets = self._d_postings = None
         self._d_df_order = self._d_doc_lens = None
         self._decode_cols = ()
-        if self._fmt == artifact_mod.VERSION_V2:
+        if self._fmt >= artifact_mod.VERSION_V2:
             self._d_term_block_off = self._d_blk_first = None
             self._d_blk_width = self._d_blk_woff = None
             self._d_post_words = self._d_blk_tf_width = None
@@ -709,6 +887,10 @@ class DeviceEngine:
         self._bool_fns.clear()
         self._topk_fns.clear()
         self._bm25_fns.clear()
+        self._blocks_fns.clear()
+        self._bm25_host = None
+        self._score_memo.clear()
+        self._bound_memo.clear()
         self.artifact.close()
 
     def __enter__(self):

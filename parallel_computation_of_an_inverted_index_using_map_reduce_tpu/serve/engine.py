@@ -13,9 +13,12 @@ is an O(k) slice of the artifact's ``df_order`` permutation.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from . import artifact as artifact_mod
+from . import planner as planner_mod
 from .cache import LRUCache
 from ..obs import metrics as obs_metrics
 # OpTimer's historical home is this module; the implementation moved to
@@ -44,6 +47,19 @@ def encode_terms(terms, width: int) -> np.ndarray:
     return np.array(
         [t if len(t) <= width else b"" for t in cleaned],
         dtype=f"S{width}")
+
+
+def _union_add(cand: np.ndarray, scores: np.ndarray,
+               docs: np.ndarray, add: np.ndarray
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """Merge a term's (docs, contributions) into the sorted candidate
+    accumulator.  Both doc arrays are ascending and internally unique,
+    so positional fancy-index adds are exact (no ``np.add.at``)."""
+    merged = np.union1d(cand, docs)
+    out = np.zeros(len(merged), dtype=np.float64)
+    out[np.searchsorted(merged, cand)] = scores
+    out[np.searchsorted(merged, docs)] += add
+    return merged, out
 
 
 def letter_index(letter) -> int:
@@ -105,6 +121,22 @@ class Engine:
         self._c_bytes_decoded = \
             self.metrics.counter("mri_engine_bytes_decoded_total")
         self._bm25_cols = None  # lazy (doc_lens, ndocs, avgdl)
+        self.planner = planner_mod.Planner(self.metrics)
+        # BM25 per-term memos keyed by lex index: contributions are
+        # query-independent (idf, tf and doc length are all properties
+        # of the term/corpus), so the pruned evaluators reuse them
+        # across a query stream instead of re-deriving per query.
+        self._score_memo: dict[int, tuple] = {}
+        self._bound_memo: dict[int, tuple] = {}
+        self._memo_cap = max(int(cache_terms), 1)
+        # ranked-path resolution memo: encoded batch bytes -> the occ
+        # list (present lex indices, occurrence order) — one dict probe
+        # replaces lookup + the zip/filter for repeated queries
+        self._occ_memo: dict[bytes, list] = {}
+        # inlined timing for the ranked hot path (the contextmanager
+        # form costs a couple of microseconds per call — real money at
+        # the QPS the lean small-query path runs at)
+        self._h_topk = self._ops.histogram("top_k_scored")
 
     # -- term resolution ------------------------------------------------
 
@@ -173,7 +205,7 @@ class Engine:
             return hit
         art = self.artifact
         decoded = art.decode_postings(idx)
-        if art.version == artifact_mod.VERSION_V2:
+        if art.version >= artifact_mod.VERSION_V2:
             b0 = int(art.term_block_off[idx])
             b1 = int(art.term_block_off[idx + 1])
             self._c_blocks_decoded.inc(b1 - b0)
@@ -267,13 +299,21 @@ class Engine:
             uniq = list(set(idx.tolist()))
             uniq.sort(key=lambda i: int(self._df[i]))
             acc = self.postings_by_index(uniq[0])
-            v2 = self.artifact.version == artifact_mod.VERSION_V2
+            v2 = self.artifact.version >= artifact_mod.VERSION_V2
             B = self.artifact.block_size
             for i in uniq[1:]:
                 if len(acc) == 0:
                     break
+                arm = self.planner.plan_and(len(acc), int(self._df[i]))
                 cached = self._cache.peek(i)
-                if cached is not None:
+                if arm == "merge":
+                    # merge only fires when the partner run is at most
+                    # ~2x the accumulator, so decoding it whole is
+                    # cheap even when uncached
+                    run = cached if cached is not None \
+                        else self.postings_by_index(i)
+                    acc = np.intersect1d(acc, run, assume_unique=True)
+                elif cached is not None:
                     acc = self._and_probe(acc, cached)
                 elif v2 and len(acc) * B < int(self._df[i]):
                     acc = self._and_skip(acc, i)
@@ -309,24 +349,379 @@ class Engine:
         first, ties broken by ascending doc id.  Absent terms contribute
         nothing; duplicated query terms accumulate twice (same as the
         scoring oracle).  Parameters: k1=BM25_K1, b=BM25_B; idf is the
-        Robertson-Sparck-Jones ``ln(1 + (N - df + 0.5)/(df + 0.5))``."""
-        with self._ops.time("top_k_scored"):
-            idx, found = self.lookup(batch)
-            doc_lens, ndocs, avgdl = self._bm25_corpus()
-            scores = np.zeros(len(doc_lens), dtype=np.float64)
-            k1, b = BM25_K1, BM25_B
-            for i, ok in zip(idx.tolist(), found.tolist()):
-                if not ok:
+        Robertson-Sparck-Jones ``ln(1 + (N - df + 0.5)/(df + 0.5))``.
+
+        The planner picks the evaluation: exhaustive scores every
+        posting; ``bmw``/``maxscore`` prune with the v2.1 per-block
+        max-score columns and return the same top-k byte-identically
+        (the pruned sums are re-accumulated in occurrence order, see
+        :meth:`_top_k_pruned`)."""
+        t0 = time.perf_counter()
+        try:
+            occ = None
+            key = batch.tobytes() if isinstance(batch, np.ndarray) \
+                else None
+            if key is not None:
+                occ = self._occ_memo.get(key)
+            if occ is None:
+                idx, found = self.lookup(batch)
+                occ = [i for i, ok in zip(idx.tolist(),
+                                          found.tolist()) if ok]
+                if key is not None:
+                    if len(self._occ_memo) > (1 << 16):
+                        self._occ_memo.clear()
+                    self._occ_memo[key] = occ
+            if occ and k > 0 and len(occ) <= 2:
+                out = self._top_k_small(occ, k)
+                if out is not None:
+                    return out
+            mode = self.planner.plan_ranked(
+                self.artifact, [int(self._df[i]) for i in occ], k)
+            if mode != "exhaustive":
+                return self._top_k_pruned(occ, k, mode)
+            out = self._top_k_exhaustive(occ, k)
+            self.planner.note_ranked("exhaustive", 0, 0, len(out))
+            return out
+        finally:
+            self._h_topk.observe(time.perf_counter() - t0)
+
+    def _top_k_small(self, occ: list[int], k: int):
+        """Lean 1-2 occurrence ranked path over memoized contributions.
+
+        The Zipf-head query mix is dominated by short queries whose
+        terms' contributions are already in ``_score_memo``; for those
+        this path replaces the general TAAT machinery with a handful of
+        numpy calls: dense-accumulate the memoized contributions (the
+        exhaustive float addition order, so scores stay byte-identical)
+        and, under bmw/maxscore, drop every doc provably below theta =
+        the best single-term k-th contribution BEFORE the selection
+        sort.  Returns None when a term isn't memoized yet or the
+        corpus is too large for a dense throwaway accumulator — the
+        general paths handle the query and fill the memo."""
+        memo = self._score_memo
+        h1 = memo.get(occ[0])
+        if h1 is None:
+            return None
+        docs1, c1, srt1 = h1
+        n1 = len(docs1)
+        art = self.artifact
+        planner = self.planner
+        margin = planner_mod.THETA_MARGIN
+        mode = planner.resolve_cached()
+        if len(occ) == 1 or occ[1] == occ[0]:
+            w = float(len(occ))
+            # same plan the general dispatch would make (dfs has one
+            # entry per occurrence, duplicates included)
+            if mode != "exhaustive" and art.has_block_scores \
+                    and k < n1 * len(occ):
+                if mode == "auto":
+                    mode = "bmw" if n1 > 4 * art.block_size \
+                        else "maxscore"
+                scores = c1 if w == 1.0 else w * c1
+                theta = w * float(srt1[k - 1]) if n1 >= k else 0.0
+                if theta > 0.0:
+                    keep = scores >= theta * margin
+                    cand, sc = docs1[keep], scores[keep]
+                else:
+                    cand, sc = docs1, scores
+                planner.note_ranked(mode, 0, 0, len(cand))
+                order = np.lexsort((cand, -sc))[:k]
+                top = cand[order]
+                return list(zip(top.tolist(), sc[order].tolist()))
+            out = self._top_k_exhaustive(occ, k)
+            planner.note_ranked("exhaustive", 0, 0, len(out))
+            return out
+        h2 = memo.get(occ[1])
+        if h2 is None:
+            return None
+        docs2, c2, srt2 = h2
+        n2 = len(docs2)
+        doc_lens, _, _ = self._bm25_corpus()
+        ndocs = len(doc_lens)
+        if ndocs > (1 << 16):
+            return None
+        if mode == "exhaustive" or not art.has_block_scores \
+                or k >= n1 + n2:
+            out = self._top_k_exhaustive(occ, k)
+            planner.note_ranked("exhaustive", 0, 0, len(out))
+            return out
+        if mode == "auto":
+            mode = "bmw" if max(n1, n2) > 4 * art.block_size \
+                else "maxscore"
+        scores = np.zeros(ndocs, dtype=np.float64)
+        scores[docs1] = c1
+        scores[docs2] += c2
+        theta = float(srt1[k - 1]) if n1 >= k else 0.0
+        if n2 >= k:
+            t2 = float(srt2[k - 1])
+            if t2 > theta:
+                theta = t2
+        if theta > 0.0:
+            cand = (scores >= theta * margin).nonzero()[0]
+        else:
+            cand = (scores > 0.0).nonzero()[0]
+        sc = scores[cand]
+        planner.note_ranked(mode, 0, 0, len(cand))
+        order = np.lexsort((cand, -sc))[:k]
+        top = cand[order]
+        return list(zip(top.tolist(), sc[order].tolist()))
+
+    def _top_k_exhaustive(self, occ: list[int], k: int
+                          ) -> list[tuple[int, float]]:
+        """Score every posting of every query term into a dense
+        accumulator — the reference evaluation the pruned paths must
+        reproduce byte-for-byte.  Per-term contributions come from
+        :meth:`_term_scores` (identical expression, memoized), added in
+        occurrence order exactly as the inline loop always did."""
+        doc_lens, ndocs, avgdl = self._bm25_corpus()
+        scores = np.zeros(len(doc_lens), dtype=np.float64)
+        for i in occ:
+            docs, contrib, _ = self._term_scores(i)
+            scores[docs] += contrib
+        cand = np.nonzero(scores > 0.0)[0]
+        top = cand[np.lexsort((cand, -scores[cand]))][:max(k, 0)]
+        return [(int(d), float(scores[d])) for d in top]
+
+    def _term_scores(self, i: int) -> tuple:
+        """``(docs, contrib, contrib_sorted_desc)`` for lex term ``i``.
+
+        ``contrib`` holds the term's BM25 contribution at each of its
+        docs, computed with exactly the exhaustive scorer's expression
+        so pruned partial sums stay elementwise bit-equal; the values
+        are query-independent, so they memoize per engine."""
+        hit = self._score_memo.get(i)
+        if hit is not None:
+            return hit
+        doc_lens, ndocs, avgdl = self._bm25_corpus()
+        k1, b = BM25_K1, BM25_B
+        # int64 up front: fancy indexing with int32 index arrays pays a
+        # per-query widening conversion that doubles its cost
+        docs = self.postings_by_index(i).astype(np.int64)
+        tf = self.tf_by_index(i).astype(np.float64)
+        dfi = len(docs)
+        idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
+        denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
+        contrib = idf * tf * (k1 + 1.0) / denom
+        docs.setflags(write=False)
+        contrib.setflags(write=False)
+        srt = np.sort(contrib)[::-1]
+        if len(self._score_memo) >= self._memo_cap:
+            self._score_memo.clear()
+        self._score_memo[i] = (docs, contrib, srt)
+        return self._score_memo[i]
+
+    def _term_bounds(self, i: int) -> tuple:
+        """``(per-block upper bounds, their max)`` for lex term ``i``
+        on a v2.1 artifact (float64, memoized)."""
+        hit = self._bound_memo.get(i)
+        if hit is not None:
+            return hit
+        doc_lens, ndocs, avgdl = self._bm25_corpus()
+        dfi = int(self._df[i])
+        idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
+        ubs = planner_mod.block_upper_bounds(
+            self.artifact, i, idf, avgdl, BM25_K1, BM25_B)
+        if len(self._bound_memo) >= self._memo_cap:
+            self._bound_memo.clear()
+        self._bound_memo[i] = (ubs, float(ubs.max()) if len(ubs)
+                               else 0.0)
+        return self._bound_memo[i]
+
+    def _decode_block_scores(self, i: int, need: np.ndarray, b0: int
+                             ) -> tuple[np.ndarray, np.ndarray]:
+        """Decode only blocks ``need`` (term-relative) of term ``i``
+        and score them: ``(docs ascending, contrib)`` — contributions
+        elementwise bit-equal to :meth:`_term_scores` values."""
+        art = self.artifact
+        sel = need + b0
+        ids, cnt = art.decode_blocks(sel)
+        tfm, _ = art.decode_tf_blocks(sel)
+        self._c_blocks_decoded.inc(len(need))
+        self._c_bytes_decoded.inc(int(
+            (art.blk_woff[sel + 1] - art.blk_woff[sel]).sum()) * 4)
+        mask = np.arange(ids.shape[1])[None, :] < cnt[:, None]
+        docs = ids[mask].astype(np.int64)
+        tf = tfm[mask].astype(np.float64)
+        doc_lens, ndocs, avgdl = self._bm25_corpus()
+        k1, b = BM25_K1, BM25_B
+        dfi = int(self._df[i])
+        idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
+        denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
+        return docs, idf * tf * (k1 + 1.0) / denom
+
+    def _top_k_pruned(self, occ: list[int], k: int, mode: str
+                      ) -> list[tuple[int, float]]:
+        """MaxScore / Block-Max WAND top-k over the v2.1 bound columns.
+
+        Terms are processed in descending weighted-upper-bound order.
+        While the remaining terms' summed bounds can still reach the
+        heap threshold theta, a term is *essential*: all its postings
+        are admitted as candidates.  Past that point a term can only
+        reorder docs already above threshold: candidates that provably
+        cannot reach theta are dropped, and (bmw) only blocks whose
+        quantized bound clears theta — or that hold a surviving
+        candidate — are decoded at all.  Theta is the running k-th best
+        partial score, monotonically nondecreasing, and every
+        comparison carries ``THETA_MARGIN`` slack so float
+        associativity can never prune a true top-k doc.  Survivor
+        scores are finally re-accumulated in the query's occurrence
+        order — the exhaustive addition order — which makes the
+        returned (doc, score) pairs byte-identical to exhaustive
+        evaluation.  (Queries with <= 2 scoring occurrences skip that
+        rescore: sums of one or two floats are order-independent.)"""
+        if k <= 0 or not occ:
+            self.planner.note_ranked(mode, 0, 0, 0)
+            return []
+        margin = planner_mod.THETA_MARGIN
+        art = self.artifact
+        weight: dict[int, int] = {}
+        for i in occ:
+            weight[i] = weight.get(i, 0) + 1
+        terms = []
+        for i, w in weight.items():
+            ubs, umax = self._term_bounds(i)
+            terms.append((i, float(w), float(w) * umax, ubs))
+        terms.sort(key=lambda t: (-t[2], t[0]))
+        n = len(terms)
+        suffix = [0.0] * (n + 1)
+        for p in range(n - 1, -1, -1):
+            suffix[p] = suffix[p + 1] + terms[p][2]
+        theta = 0.0
+        cand = scores = None  # ascending int64 docs + aligned partials
+        scored = skipped = 0
+        shift = art.block_size.bit_length() - 1
+        for pos, (i, w, wu, ubs) in enumerate(terms):
+            nb = len(ubs)
+            thr = theta * margin
+            if theta <= 0.0 or suffix[pos] >= thr:
+                # essential: admit every posting of this term
+                docs, contrib, srt = self._term_scores(i)
+                add = contrib if w == 1.0 else w * contrib
+                scored += nb
+                if cand is None:
+                    cand = docs  # int64 already, never mutated
+                    scores = np.array(add, dtype=np.float64)
+                    if len(srt) >= k:
+                        theta = w * float(srt[k - 1])
                     continue
-                docs = self.postings_by_index(i)
-                tf = self.tf_by_index(i).astype(np.float64)
-                dfi = len(docs)
-                idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
-                denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
-                scores[docs] += idf * tf * (k1 + 1.0) / denom
-            cand = np.nonzero(scores > 0.0)[0]
-            top = cand[np.lexsort((cand, -scores[cand]))][:max(k, 0)]
-            return [(int(d), float(scores[d])) for d in top]
+                cand, scores = _union_add(cand, scores, docs, add)
+            else:
+                # non-essential: drop hopeless candidates first
+                keep = scores + suffix[pos] >= thr
+                cand, scores = cand[keep], scores[keep]
+                cached = self._score_memo.get(i)
+                if cached is not None:
+                    docs, contrib, _ = cached
+                    pos2 = np.searchsorted(docs, cand)
+                    ok = pos2 < len(docs)
+                    ok[ok] = docs[pos2[ok]] == cand[ok]
+                    hitpos = pos2[ok]
+                    add = contrib[hitpos]
+                    if w != 1.0:
+                        add = w * add
+                    if mode == "bmw":
+                        # exact per-doc bounds are available for free:
+                        # admit any doc this term alone could still
+                        # push past theta
+                        live = w * contrib + suffix[pos + 1] >= thr \
+                            if w != 1.0 \
+                            else contrib + suffix[pos + 1] >= thr
+                        live[hitpos] = False
+                        new = np.nonzero(live)[0]
+                        if len(new):
+                            # admit at zero and let the probe below
+                            # add the contribution exactly once
+                            cand, scores = _union_add(
+                                cand, scores, docs[new],
+                                np.zeros(len(new)))
+                            pos2 = np.searchsorted(docs, cand)
+                            ok = pos2 < len(docs)
+                            ok[ok] = docs[pos2[ok]] == cand[ok]
+                            hitpos = pos2[ok]
+                            add = contrib[hitpos]
+                            if w != 1.0:
+                                add = w * add
+                    scores[ok] += add
+                    touched = len(np.unique(hitpos >> shift)) \
+                        if len(hitpos) else 0
+                    scored += touched
+                    skipped += nb - touched
+                else:
+                    b0 = int(art.term_block_off[i])
+                    blk = np.searchsorted(art.blk_max[b0:b0 + nb], cand)
+                    hitb = blk[blk < nb]
+                    if mode == "bmw":
+                        seed = np.nonzero(
+                            w * ubs + suffix[pos + 1] >= thr)[0]
+                        need = np.union1d(hitb, seed)
+                    else:
+                        need = np.unique(hitb)
+                    need = need.astype(np.int64)
+                    scored += len(need)
+                    skipped += nb - len(need)
+                    self._c_blocks_skipped.inc(nb - len(need))
+                    if len(need) >= nb:
+                        # no block escaped — decode the whole term
+                        # through the memoizing path instead (bit-equal
+                        # values), so later queries over this term take
+                        # the cached arm / the lean small-query path
+                        docs, contrib, _ = self._term_scores(i)
+                        cand, scores = _union_add(
+                            cand, scores, docs,
+                            contrib if w == 1.0 else w * contrib)
+                    elif len(need):
+                        docs, contrib = self._decode_block_scores(
+                            i, need, b0)
+                        # admitting every decoded doc (a superset of
+                        # the candidates) is safe: a doc first seen
+                        # here was provably below theta at every
+                        # earlier term, so it can only be pruned or
+                        # rescored exactly below the k-th best
+                        cand, scores = _union_add(
+                            cand, scores, docs,
+                            contrib if w == 1.0 else w * contrib)
+            if len(cand) >= k:
+                kth = float(np.partition(
+                    scores, len(scores) - k)[len(scores) - k])
+                if kth > theta:
+                    theta = kth
+        if len(occ) > 2:
+            if theta > 0.0:
+                keep = scores >= theta * margin
+                cand, scores = cand[keep], scores[keep]
+            scores = self._rescore(occ, cand)
+        self.planner.note_ranked(mode, scored, skipped, len(cand))
+        pos3 = scores > 0.0
+        cand, scores = cand[pos3], scores[pos3]
+        order = np.lexsort((cand, -scores))[:k]
+        return [(int(cand[j]), float(scores[j])) for j in order]
+
+    def _rescore(self, occ: list[int], cand: np.ndarray) -> np.ndarray:
+        """Re-accumulate the survivors' scores term-by-term in query
+        occurrence order — the exhaustive path's float addition order —
+        so a pruned 3+-term query returns byte-identical scores even
+        though its partial sums were built bound-first."""
+        art = self.artifact
+        out = np.zeros(len(cand), dtype=np.float64)
+        if not len(cand):
+            return out
+        for i in occ:
+            cached = self._score_memo.get(i)
+            if cached is not None:
+                docs, contrib, _ = cached
+            else:
+                b0 = int(art.term_block_off[i])
+                b1 = int(art.term_block_off[i + 1])
+                blk = np.searchsorted(art.blk_max[b0:b1], cand)
+                hitb = np.unique(blk[blk < (b1 - b0)]).astype(np.int64)
+                if not len(hitb):
+                    continue
+                docs, contrib = self._decode_block_scores(i, hitb, b0)
+            pos = np.searchsorted(docs, cand)
+            ok = pos < len(docs)
+            ok[ok] = docs[pos[ok]] == cand[ok]
+            out[ok] += contrib[pos[ok]]
+        return out
 
     # -- bookkeeping ----------------------------------------------------
 
@@ -358,12 +753,16 @@ class Engine:
             "cache": self.cache_stats(),
             "ops": self.op_stats(),
             "decode": self.decode_stats(),
+            "planner": self.planner.describe(),
         }
 
     def close(self) -> None:
         self._cache.clear()
         self._tf_cache.clear()
         self._memo.clear()
+        self._score_memo.clear()
+        self._bound_memo.clear()
+        self._occ_memo.clear()
         self._bm25_cols = None
         self._df = self._keys = self._terms = self._rows = None
         self.artifact.close()
@@ -375,12 +774,18 @@ class Engine:
         self.close()
 
 
-#: ``engine="auto"`` picks the device engine only when jax is importable
-#: AND its default backend is an accelerator — a JAX_PLATFORMS=cpu
-#: process (tier-1, most laptops) serves from the host engine unless
-#: the caller asks for ``device`` explicitly.
+#: ``engine="auto"`` routes by a measured batch-size crossover probe
+#: (:class:`AutoEngine`) instead of backend name: small batches always
+#: serve from the host engine; the first large batch races both
+#: engines once and the winner's threshold sticks for the process.
 ENGINE_CHOICES = ("host", "device", "auto")
 ENGINE_ENV = "MRI_SERVE_ENGINE"
+CROSSOVER_ENV = "MRI_SERVE_CROSSOVER"
+
+#: Batches below this never trigger the crossover probe — building the
+#: device engine (jit compiles included) is only worth racing when the
+#: batch is big enough that the device could plausibly win.
+PROBE_BATCH_MIN = 8192
 
 #: BM25 free parameters (README "Format v2": classic defaults).
 BM25_K1 = 1.2
@@ -400,29 +805,192 @@ def resolve_score(score: str | None = None) -> str:
 
 
 def resolve_engine(engine: str | None = None) -> str:
-    """``host``/``device``/``auto``(+ env override) -> concrete name."""
+    """``host``/``device``/``auto`` (+ env override), validated.
+    ``auto`` is a real backend now — the crossover router — and is
+    returned as itself rather than being resolved to a name here."""
     engine = engine or envknobs.get(ENGINE_ENV) or "auto"
     if engine not in ENGINE_CHOICES:
         raise ValueError(
             f"unknown engine {engine!r} (choices: {ENGINE_CHOICES})")
-    if engine != "auto":
-        return engine
-    try:
-        import jax
-        return "device" if jax.default_backend() != "cpu" else "host"
-    except Exception:
-        return "host"
+    return engine
+
+
+class AutoEngine:
+    """Crossover router over both engines.
+
+    Answers every query from the host engine until a batch at least
+    ``PROBE_BATCH_MIN`` wide arrives; the first such batch races the
+    host and device engines head-to-head and the measured winner fixes
+    the routing threshold for the engine's lifetime (``describe()``
+    records the probe).  ``$MRI_SERVE_CROSSOVER`` overrides the probe:
+    0 pins host, N>0 routes batches >= N to the device engine.  Only
+    the batch-shaped single-term ops (df/postings/lookup) route;
+    compound and ranked queries stay on the host engine, whose planner
+    owns the pruning machinery.
+    """
+
+    engine_name = "auto"
+
+    def __init__(self, path, cache_terms: int = 4096,
+                 shards: int | None = None):
+        self._host = Engine(path, cache_terms=cache_terms)
+        self._path = path
+        self._cache_terms = cache_terms
+        self._shards = shards
+        self._device = None
+        self._device_failed = False
+        cross = envknobs.get(CROSSOVER_ENV)
+        self._fixed = None if cross is None else max(int(cross), 0)
+        self._measured: int | None = None
+        self._probe: dict | None = None
+
+    # -- delegation -----------------------------------------------------
+
+    @property
+    def artifact(self):
+        return self._host.artifact
+
+    @property
+    def vocab_size(self):
+        return self._host.vocab_size
+
+    @property
+    def metrics(self):
+        return self._host.metrics
+
+    @property
+    def planner(self):
+        return self._host.planner
+
+    @property
+    def cache(self):
+        return self._host.cache
+
+    def __getattr__(self, name):
+        # everything not routing-sensitive answers from the host engine
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._host, name)
+
+    # -- routing --------------------------------------------------------
+
+    def _get_device(self):
+        if self._device is None and not self._device_failed:
+            try:
+                from .device_engine import DeviceEngine
+                self._device = DeviceEngine(
+                    self._path, cache_terms=self._cache_terms,
+                    shards=self._shards)
+            except Exception:
+                self._device_failed = True
+        return self._device
+
+    def _run_probe(self, batch) -> None:
+        """Race both engines on this batch, best-of-3 each, once."""
+        import time
+        dev = self._get_device()
+        if dev is None:
+            self._measured = 1 << 62
+            return
+        host_s = dev_s = float("inf")
+        for eng in (self._host, dev):
+            eng.df(batch)  # warm caches / compile
+        for _ in range(3):
+            t0 = time.perf_counter()
+            self._host.df(batch)
+            host_s = min(host_s, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            dev.df(batch)
+            dev_s = min(dev_s, time.perf_counter() - t0)
+        self._measured = len(batch) if dev_s < host_s else 1 << 62
+        self._probe = {
+            "batch": len(batch),
+            "host_s": host_s,
+            "device_s": dev_s,
+            "winner": "device" if dev_s < host_s else "host",
+        }
+
+    def _pick(self, batch):
+        n = len(batch)
+        if self._fixed is not None:
+            if self._fixed > 0 and n >= self._fixed:
+                dev = self._get_device()
+                if dev is not None:
+                    return dev
+            return self._host
+        if n < PROBE_BATCH_MIN or self._device_failed:
+            return self._host
+        if self._measured is None:
+            self._run_probe(batch)
+        if self._measured is not None and n >= self._measured:
+            dev = self._get_device()
+            if dev is not None:
+                return dev
+        return self._host
+
+    # -- query API ------------------------------------------------------
+
+    def encode_batch(self, terms):
+        return self._host.encode_batch(terms)
+
+    def lookup(self, batch):
+        return self._pick(batch).lookup(batch)
+
+    def df(self, batch):
+        return self._pick(batch).df(batch)
+
+    def postings(self, batch):
+        return self._pick(batch).postings(batch)
+
+    def query_and(self, batch):
+        return self._host.query_and(batch)
+
+    def query_or(self, batch):
+        return self._host.query_or(batch)
+
+    def top_k(self, letter, k):
+        return self._host.top_k(letter, k)
+
+    def top_k_scored(self, batch, k):
+        return self._host.top_k_scored(batch, k)
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def describe(self) -> dict:
+        d = self._host.describe()
+        d["engine"] = self.engine_name
+        d["auto"] = {
+            "crossover": (self._fixed if self._fixed is not None
+                          else self._measured),
+            "probe": self._probe,
+            "device_ready": self._device is not None,
+        }
+        return d
+
+    def close(self) -> None:
+        if self._device is not None:
+            self._device.close()
+            self._device = None
+        self._host.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def create_engine(path, engine: str | None = None, *,
                   cache_terms: int = 4096, shards: int | None = None):
     """Open ``path`` with the selected backend (:data:`ENGINE_CHOICES`).
 
-    Both engines answer the same API byte-identically; ``shards`` only
+    All engines answer the same API byte-identically; ``shards`` only
     applies to the device engine's batch-dimension mesh.
     """
     which = resolve_engine(engine)
     if which == "device":
         from .device_engine import DeviceEngine
         return DeviceEngine(path, cache_terms=cache_terms, shards=shards)
+    if which == "auto":
+        return AutoEngine(path, cache_terms=cache_terms, shards=shards)
     return Engine(path, cache_terms=cache_terms)

@@ -1,0 +1,154 @@
+"""Query planner: picks the evaluation strategy per query.
+
+Ranked (BM25 top-k) queries choose between exhaustive scoring and the
+two classic dynamic-pruning disciplines the v2.1 per-block max-score
+columns enable — **MaxScore** (terms whose summed upper bounds cannot
+reach the heap threshold stop admitting new candidates) and **Block-Max
+WAND** (whole posting blocks whose quantized upper bound cannot reach
+the threshold are never decoded).  AND queries choose between the
+galloping ``searchsorted`` probe and a linear sorted-set merge.  Every
+decision and the resulting block economy is counted on the engine's obs
+registry so ``describe()``/``mri query --stats``/the daemon ``stats``
+op expose what the planner actually did.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils import envknobs
+
+PLANNER_ENV = "MRI_SERVE_PLANNER"
+PLANNER_CHOICES = ("auto", "exhaustive", "bmw", "maxscore")
+
+#: Relative slack applied to every theta comparison on the host path.
+#: The pruned evaluators accumulate the same per-term float64
+#: contributions as the exhaustive scorer but in bound-sorted order;
+#: one part in 1e9 absorbs the worst-case associativity drift so a
+#: candidate sitting exactly on the threshold is never wrongly pruned.
+THETA_MARGIN = 1.0 - 1e-9
+
+#: Wider slack for the device path, whose scores are float32.
+DEVICE_MARGIN = 1.0 - 1e-5
+
+
+def resolve_planner(mode: str | None = None) -> str:
+    """Explicit mode, else ``$MRI_SERVE_PLANNER`` (default auto)."""
+    mode = mode or envknobs.get(PLANNER_ENV)
+    if mode not in PLANNER_CHOICES:
+        raise ValueError(
+            f"unknown planner {mode!r} (choices: {PLANNER_CHOICES})")
+    return mode
+
+
+def block_upper_bounds(art, idx: int, idf: float, avgdl: float,
+                       k1: float, b: float) -> np.ndarray:
+    """Per-block BM25 upper bounds for term ``idx`` (float64).
+
+    Derived from the stored quantized columns: ``blk_max_tf`` (max tf
+    in the block, saturating) and ``blk_min_dl`` (min doc length in the
+    block, saturating).  BM25's per-doc contribution is increasing in
+    tf and decreasing in doc length, so evaluating it at (max tf,
+    min dl) bounds every doc in the block from above.  A saturated
+    max-tf cell is taken to the tf→∞ limit ``idf*(k1+1)``; a saturated
+    min-dl cell only underestimates the length, which keeps the bound
+    on the safe (over-estimating) side.
+    """
+    b0 = int(art.term_block_off[idx])
+    b1 = int(art.term_block_off[idx + 1])
+    cap = (1 << art.score_bits) - 1
+    mtf = art.blk_max_tf[b0:b1].astype(np.float64)
+    mdl = art.blk_min_dl[b0:b1].astype(np.float64)
+    denom = mtf + k1 * (1.0 - b + b * mdl / avgdl)
+    ub = idf * mtf * (k1 + 1.0) / denom
+    return np.where(mtf >= cap, idf * (k1 + 1.0), ub)
+
+
+class Planner:
+    """Per-engine strategy picker + decision/economy counters.
+
+    All tallies live on the engine's obs registry (the repo-wide
+    no-hand-rolled-counters contract); ``last_ranked`` keeps the most
+    recent ranked decision for trace attribution.
+    """
+
+    def __init__(self, registry):
+        self._c_ranked = {
+            m: registry.counter(f"mri_planner_ranked_{m}_total")
+            for m in ("exhaustive", "bmw", "maxscore")}
+        self._c_and = {
+            m: registry.counter(f"mri_planner_and_{m}_total")
+            for m in ("gallop", "merge")}
+        self._c_scored = registry.counter(
+            "mri_planner_blocks_scored_total")
+        self._c_skipped = registry.counter(
+            "mri_planner_blocks_skipped_total")
+        self.last_ranked: dict | None = None
+        self._raw_mode: object = -1
+        self._resolved_mode = "auto"
+
+    def resolve_cached(self) -> str:
+        """:func:`resolve_planner` with the parsed value cached against
+        the raw environment string — the ranked hot path re-resolves
+        only when ``$MRI_SERVE_PLANNER`` actually changes."""
+        # mrilint: allow(env-knobs) raw-string cache key only; the
+        # parse still goes through the declared knob on change
+        raw = os.environ.get(PLANNER_ENV)
+        if raw != self._raw_mode:
+            self._resolved_mode = resolve_planner(None)
+            self._raw_mode = raw
+        return self._resolved_mode
+
+    def plan_ranked(self, art, dfs, k: int, mode: str | None = None) -> str:
+        """Pick the ranked strategy for a query with term dfs ``dfs``
+        and cutoff ``k``.  Pruning needs the v2.1 max-score columns and
+        a cutoff that can actually drop something; ``auto`` prefers
+        MaxScore on short posting lists (block skipping can't pay below
+        a handful of blocks per term) and Block-Max WAND on long ones.
+        """
+        mode = self.resolve_cached() if mode is None \
+            else resolve_planner(mode)
+        if not art.has_block_scores or k <= 0 or not dfs \
+                or k >= sum(dfs):
+            return "exhaustive"
+        if mode == "auto":
+            mode = "bmw" if max(dfs) > 4 * art.block_size else "maxscore"
+        return mode
+
+    def plan_and(self, n_acc: int, df: int) -> str:
+        """Gallop (probe the partner run at surviving candidates only)
+        vs merge (linear sorted-set intersection) for one AND step.
+        Galloping wins when the partner dwarfs the accumulator; a
+        linear merge is cache-friendlier when the runs are comparable.
+        """
+        mode = "merge" if df <= 2 * n_acc else "gallop"
+        self._c_and[mode].inc()
+        return mode
+
+    def note_ranked(self, mode: str, scored: int, skipped: int,
+                    candidates: int) -> None:
+        """Record one ranked query's decision + block economy."""
+        self._c_ranked[mode].inc()
+        if scored:
+            self._c_scored.inc(scored)
+        if skipped:
+            self._c_skipped.inc(skipped)
+        self.last_ranked = {
+            "mode": mode,
+            "blocks_scored": scored,
+            "blocks_skipped": skipped,
+            "candidates": candidates,
+        }
+
+    def describe(self) -> dict:
+        """Planner block for ``Engine.describe()``/daemon ``stats``."""
+        return {
+            "mode": envknobs.get(PLANNER_ENV),
+            "ranked": {m: c.value for m, c in self._c_ranked.items()},
+            "and": {m: c.value for m, c in self._c_and.items()},
+            "blocks_scored": self._c_scored.value,
+            "blocks_skipped": self._c_skipped.value,
+            "last_ranked": self.last_ranked,
+        }

@@ -213,17 +213,31 @@ declare("MRI_SERVE_DRAIN_S", float, 5.0,
         "Graceful-drain deadline after SIGTERM/SIGINT before inflight "
         "requests are abandoned.",
         scope="serve", minimum=0, exclusive=True)
-declare("MRI_SERVE_FORMAT", int, 2,
+declare("MRI_SERVE_FORMAT", int, 3,
         "Artifact format packed when no explicit version is requested: "
-        "1 (plain delta postings) or 2 (block-bitpacked + skip table).",
-        scope="serve", choices=(1, 2))
+        "1 (plain delta postings), 2 (block-bitpacked + skip table) or "
+        "3 (v2.1: adds the per-block max-score columns).",
+        scope="serve", choices=(1, 2, 3))
 declare("MRI_SERVE_BLOCK_SIZE", int, 128,
         "Format-v2 postings block size in doc ids (power of two).",
         scope="serve", minimum=2)
+declare("MRI_SERVE_SCORE_BITS", int, 8,
+        "v2.1 max-score column width in bits: 8 (saturating u8 max-tf "
+        "/ min-doclen) or 16.",
+        scope="serve", choices=(8, 16))
 declare("MRI_SERVE_SCORE", str, "df",
         "Default top_k scoring mode when no --score flag is given: "
         "df (document frequency) or bm25 (ranked retrieval).",
         scope="serve", choices=("df", "bm25"))
+declare("MRI_SERVE_PLANNER", str, "auto",
+        "Ranked-query planner: auto (df/k heuristic), exhaustive "
+        "(score every posting), bmw (Block-Max WAND) or maxscore.",
+        scope="serve", choices=("auto", "exhaustive", "bmw", "maxscore"))
+declare("MRI_SERVE_CROSSOVER", int, None,
+        "--engine auto host->device batch-size crossover: unset probes "
+        "it by measurement, 0 pins host, N>0 routes batches >= N to "
+        "the device engine.",
+        scope="serve")
 
 # -- observability ----------------------------------------------------
 declare("MRI_OBS_ENABLE", int, 1,

@@ -175,7 +175,7 @@ def test_daemon_stats_and_healthz(built):
         s = c.rpc(id=3, op="stats")["stats"]
         assert s["counters"]["requests"] == 1
         assert s["counters"]["shed"] == 0
-        assert s["engine"]["engine"] == "host"
+        assert s["engine"]["engine"] == "auto"
         assert s["engine"]["cache"]["hit_rate"] >= 0.0
         assert "df" in s["engine"]["ops"]
         assert s["config"]["queue_depth"] == daemon.queue_depth
@@ -595,6 +595,83 @@ def test_cli_sighup_reload_v1_to_v2_across_formats(tmp_path):
             assert s["engine"]["format"] == 2
             assert c.rpc(id=6, op="df", terms=["dog"])["df"] == \
                 [len(naive["dog"])]
+        proc.send_signal(signal.SIGTERM)
+        assert _reap(proc) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            _reap(proc)
+
+
+def test_cli_sighup_reload_v2_to_v21_under_scored_traffic(tmp_path):
+    """A live daemon serving plain v2 hot-swaps to a v2.1 build of the
+    same corpus on SIGHUP while BM25 queries keep flowing: ranked
+    answers are unchanged across the swap (same tf data, same float64
+    scoring), the planner flips from forced-exhaustive to pruning on
+    the new block-score columns, and a torn v2.1 push is rejected
+    without dropping the good v2.1 view."""
+    from test_format_v2 import build_corpus_fmt
+
+    (tmp_path / "v2").mkdir()
+    (tmp_path / "v21").mkdir()
+    out_v2 = build_corpus_fmt(tmp_path / "v2", DOCS, 2)
+    out_v21 = build_corpus_fmt(tmp_path / "v21", DOCS, 3)
+    art = artifact_path(out_v2)
+    v21_bytes = artifact_path(out_v21).read_bytes()
+
+    def push(data: bytes):
+        staged = art.with_suffix(".push")
+        staged.write_bytes(data)
+        os.replace(staged, art)
+
+    def scored(c, rid):
+        r = c.rpc(id=rid, op="top_k", score="bm25", k=2,
+                  terms=["cat", "dog"])
+        assert r["ok"]
+        return r["docs"]
+
+    proc, addr = _spawn_serve(out_v2)
+    try:
+        with Client(addr) as c:
+            s = c.rpc(id=1, op="stats")["stats"]
+            assert s["engine"]["format"] == 2
+            ref = scored(c, 2)
+            assert ref
+            # v2 has no block-score columns: ranked queries fall back
+            s = c.rpc(id=3, op="stats")["stats"]
+            pl = s["engine"]["planner"]["ranked"]
+            assert pl["exhaustive"] >= 1
+            assert pl["bmw"] == 0 and pl["maxscore"] == 0
+            push(v21_bytes)
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                # scored traffic keeps flowing while the reload lands,
+                # and every answer matches the pre-swap reference
+                assert scored(c, 4) == ref
+                s = c.rpc(id=5, op="stats")["stats"]
+                if s["counters"]["reload_ok"] == 1:
+                    break
+                time.sleep(0.05)
+            assert s["counters"]["reload_ok"] == 1
+            assert s["engine"]["format"] == 3
+            # the fresh engine's planner prunes on the v2.1 columns
+            assert scored(c, 6) == ref
+            s = c.rpc(id=7, op="stats")["stats"]
+            pl = s["engine"]["planner"]["ranked"]
+            assert pl["bmw"] + pl["maxscore"] >= 1
+            # torn v2.1 push: rejected, the good view keeps serving
+            push(v21_bytes[:200])
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                s = c.rpc(id=8, op="stats")["stats"]
+                if s["counters"]["reload_rejected"] == 1:
+                    break
+                time.sleep(0.05)
+            assert s["counters"]["reload_rejected"] == 1
+            assert s["engine"]["format"] == 3
+            assert scored(c, 9) == ref
         proc.send_signal(signal.SIGTERM)
         assert _reap(proc) == 0
     finally:

@@ -1,0 +1,388 @@
+"""Ranked-query planner suite: block-max pruning must be invisible.
+
+The v2.1 artifact stores per-block (max tf, min doc-length) columns;
+the planner uses them to skip blocks (BMW) or whole terms (MaxScore)
+during BM25 top-k.  Pruning is an optimization, never an answer
+change, so the core guarantee is byte-identity against exhaustive
+scoring — checked here on an adversarial corpus whose document
+frequencies straddle the 128-doc block boundary (1 / B-1 / B / B+1 /
+2B / 300) and whose tf spikes park the max-score block first, in the
+middle, and last within a term's posting list.
+
+Also covered: the pre-v2.1 graceful fallback (v1 and plain-v2
+artifacts answer exhaustively no matter what the planner knob says),
+the planner's mode-selection rules as units, the per-engine
+``bm25_corpus`` memo, the crossover ``auto`` engine's routing, and the
+daemon trace ring carrying planner labels on ranked spans.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from test_serve import build_corpus, naive_index
+from test_format_v2 import build_corpus_fmt, word
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    AutoEngine, Engine, create_engine, load_artifact,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    artifact as artifact_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    planner as planner_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.artifact import (
+    DEFAULT_BLOCK_SIZE, VERSION_V21, artifact_path,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.device_engine import (
+    DeviceEngine,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+    BM25_B, BM25_K1, CROSSOVER_ENV,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.planner import (
+    PLANNER_ENV, Planner, block_upper_bounds, resolve_planner,
+)
+
+pytestmark = pytest.mark.serve
+
+B = DEFAULT_BLOCK_SIZE
+N_DOCS = 300
+
+#: dfs that straddle the block boundary: single-doc, B-1, B, B+1, 2B,
+#: and a 3-block term with a partial tail.
+TARGET_DFS = (1, B - 1, B, B + 1, 2 * B, N_DOCS)
+
+#: tf-spiked terms (df = 300 each): the doc holding the spike decides
+#: which block carries the term's max score — first, middle, or last.
+SPIKES = {"first": 2, "mid": 160, "last": N_DOCS - 1}
+SPIKE_TF = 30
+
+
+def _df_term(j: int) -> str:
+    return word(j)
+
+
+def _spike_term(pos: str) -> str:
+    return word(500 + list(SPIKES).index(pos))
+
+
+def _adversarial_docs() -> list[bytes]:
+    docs = []
+    for d in range(N_DOCS):
+        toks = [_df_term(j) for j, df in enumerate(TARGET_DFS) if d < df]
+        for pos, spike_doc in SPIKES.items():
+            tf = SPIKE_TF if d == spike_doc else 1
+            toks += [_spike_term(pos)] * tf
+        # unique filler varies doc lengths so blk_min_dl is non-trivial
+        toks += [word(9000 + d)] * ((d * 7) % 13)
+        docs.append(" ".join(toks).encode())
+    return docs
+
+
+@pytest.fixture(scope="module")
+def adversarial_built(tmp_path_factory):
+    docs = _adversarial_docs()
+    out1 = build_corpus_fmt(tmp_path_factory.mktemp("pln_v1"), docs, 1)
+    out2 = build_corpus_fmt(tmp_path_factory.mktemp("pln_v2"), docs, 2)
+    out3 = build_corpus_fmt(tmp_path_factory.mktemp("pln_v21"), docs, 3)
+    return out1, out2, out3
+
+
+def _queries() -> list[list[str]]:
+    """Singles, pairs, duplicates, a triple, and a missing term —
+    every arm of the ranked path (lean small-query, essential-term,
+    block-survivor, rescore-on-3+-occurrences)."""
+    dfs = [_df_term(j) for j in range(len(TARGET_DFS))]
+    sp = [_spike_term(p) for p in SPIKES]
+    qs = [[t] for t in dfs + sp]
+    qs += [[sp[0], sp[2]], [sp[1], dfs[0]], [sp[2], dfs[3]],
+           [dfs[1], dfs[2]], [dfs[5], sp[0]], [sp[1], sp[1]],
+           [dfs[4], dfs[4]]]
+    qs += [[sp[0], sp[1], sp[2]], [dfs[5], sp[0], dfs[2]],
+           [sp[2], sp[2], sp[2]]]
+    qs += [["zzzzabsent"], ["zzzzabsent", sp[0]]]
+    return qs
+
+
+KS = (1, 5, B, 2 * N_DOCS)
+
+
+def _pinned(monkeypatch, mode: str):
+    monkeypatch.setenv(PLANNER_ENV, mode)
+
+
+@pytest.mark.parametrize("mode", ["bmw", "maxscore"])
+def test_pruned_modes_match_exhaustive_host(adversarial_built,
+                                            monkeypatch, mode):
+    """Warm engine: BMW and MaxScore answers are byte-identical to
+    exhaustive scoring — same docs, same float64 bits, same tie order
+    — at every k, across the boundary dfs and all spike positions."""
+    _, _, out3 = adversarial_built
+    with Engine(artifact_path(out3)) as eng:
+        assert eng.artifact.version == VERSION_V21
+        assert eng.artifact.has_block_scores
+        for q in _queries():
+            batch = eng.encode_batch(q)
+            for k in KS:
+                _pinned(monkeypatch, "exhaustive")
+                ref = eng.top_k_scored(batch, k)
+                _pinned(monkeypatch, mode)
+                assert eng.top_k_scored(batch, k) == ref, (q, k)
+        d = eng.planner.describe()
+        assert d["ranked"][mode] > 0
+        assert d["ranked"]["exhaustive"] > 0
+
+
+@pytest.mark.parametrize("mode", ["bmw", "maxscore"])
+def test_pruned_modes_match_exhaustive_cold_engine(adversarial_built,
+                                                   monkeypatch, mode):
+    """Cold engine per mode: nothing memoized, so the uncached
+    block-decode arm runs — answers still byte-identical."""
+    _, _, out3 = adversarial_built
+    refs = {}
+    _pinned(monkeypatch, "exhaustive")
+    with Engine(artifact_path(out3)) as eng:
+        for qi, q in enumerate(_queries()):
+            batch = eng.encode_batch(q)
+            for k in (1, 5, B):
+                refs[(qi, k)] = eng.top_k_scored(batch, k)
+    _pinned(monkeypatch, mode)
+    with Engine(artifact_path(out3)) as eng:
+        for qi, q in enumerate(_queries()):
+            batch = eng.encode_batch(q)
+            for k in (1, 5, B):
+                assert eng.top_k_scored(batch, k) == refs[(qi, k)], (q, k)
+
+
+def test_pruned_modes_match_exhaustive_device(adversarial_built,
+                                              monkeypatch):
+    """Device engine: the block-survivor scatter-add returns the same
+    ranking as the device's own exhaustive kernel.  Scores compare at
+    the float32 tolerance the device suite already uses (rel 1e-4):
+    the block-window and term-window kernels round differently."""
+    _, _, out3 = adversarial_built
+    dfs = [_df_term(j) for j in range(len(TARGET_DFS))]
+    sp = [_spike_term(p) for p in SPIKES]
+    queries = [[sp[0]], [sp[0], sp[2]], [sp[1], dfs[0]],
+               [dfs[5], sp[0]], [sp[1], sp[1]], [sp[0], sp[1], sp[2]]]
+    with DeviceEngine(artifact_path(out3)) as dev:
+        for q in queries:
+            batch = dev.encode_batch(q)
+            for k in (1, 10):
+                _pinned(monkeypatch, "exhaustive")
+                ref = dev.top_k_scored(batch, k)
+                for mode in ("bmw", "maxscore"):
+                    _pinned(monkeypatch, mode)
+                    got = dev.top_k_scored(batch, k)
+                    assert [d for d, _ in got] == \
+                        [d for d, _ in ref], (q, k, mode)
+                    for (_, gs), (_, rs) in zip(got, ref):
+                        assert gs == pytest.approx(rs, rel=1e-4), \
+                            (q, k, mode)
+        d = dev.planner.describe()
+        assert d["ranked"]["bmw"] > 0
+        assert d["ranked"]["maxscore"] > 0
+
+
+@pytest.mark.parametrize("fmt", [1, 2])
+def test_pre_v21_artifacts_fall_back_to_exhaustive(adversarial_built,
+                                                   monkeypatch, fmt):
+    """v1 and plain-v2 artifacts have no block-score columns: a forced
+    pruning mode silently answers exhaustively, with the fallback
+    visible in the planner counters."""
+    out = adversarial_built[fmt - 1]
+    q = [_spike_term("first"), _df_term(5)]
+    with Engine(artifact_path(out)) as eng:
+        assert not eng.artifact.has_block_scores
+        batch = eng.encode_batch(q)
+        _pinned(monkeypatch, "exhaustive")
+        ref = eng.top_k_scored(batch, 5)
+        _pinned(monkeypatch, "bmw")
+        assert eng.top_k_scored(batch, 5) == ref
+        d = eng.planner.describe()
+        assert d["ranked"]["bmw"] == 0
+        assert d["ranked"]["maxscore"] == 0
+        assert d["ranked"]["exhaustive"] >= 2
+        assert d["blocks_skipped"] == 0
+
+
+def test_block_upper_bounds_dominate_contributions(adversarial_built):
+    """Soundness of the stored bound: every document's actual BM25
+    contribution is <= its block's upper bound, for every term."""
+    _, _, out3 = adversarial_built
+    art = load_artifact(artifact_path(out3))
+    doc_lens, ndocs, avgdl = artifact_mod.bm25_corpus(art)
+    with Engine(artifact_path(out3)) as eng:
+        terms = [_df_term(j) for j in range(len(TARGET_DFS))] + \
+            [_spike_term(p) for p in SPIKES]
+        idx, found = eng.lookup(eng.encode_batch(terms))
+        assert found.all()
+        for i in idx.tolist():
+            docs, contrib, _srt = eng._term_scores(i)
+            dfi = len(docs)
+            idf = float(np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5)))
+            ubs = block_upper_bounds(art, i, idf, avgdl, BM25_K1, BM25_B)
+            for pos, c in enumerate(contrib):
+                assert c <= ubs[pos // art.block_size] * (1 + 1e-12)
+
+
+def test_resolve_planner_choices_and_validation(monkeypatch):
+    for m in ("auto", "exhaustive", "bmw", "maxscore"):
+        assert resolve_planner(m) == m
+    monkeypatch.setenv(PLANNER_ENV, "maxscore")
+    assert resolve_planner(None) == "maxscore"
+    monkeypatch.delenv(PLANNER_ENV)
+    assert resolve_planner(None) == "auto"
+    with pytest.raises(ValueError):
+        resolve_planner("wand")
+    monkeypatch.setenv(PLANNER_ENV, "nonsense")
+    with pytest.raises(ValueError):
+        resolve_planner(None)
+
+
+def test_plan_ranked_rules(adversarial_built, monkeypatch):
+    """Mode selection: exhaustive when pruning can't help (no block
+    scores / k covers everything / k<=0), else auto splits bmw vs
+    maxscore on whether any term spans >4 blocks."""
+    _, out2, out3 = adversarial_built
+    art2 = load_artifact(artifact_path(out2))
+    art3 = load_artifact(artifact_path(out3))
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs.metrics import (
+        Registry,
+    )
+    monkeypatch.delenv(PLANNER_ENV, raising=False)
+    p = Planner(Registry())
+    assert p.plan_ranked(art2, [500, 600], 10) == "exhaustive"
+    assert p.plan_ranked(art3, [500], 0) == "exhaustive"
+    assert p.plan_ranked(art3, [5, 7], 12) == "exhaustive"
+    # all dfs within 4 blocks -> maxscore; any longer term -> bmw
+    assert p.plan_ranked(art3, [4 * B, 10], 5) == "maxscore"
+    assert p.plan_ranked(art3, [4 * B + 1, 10], 5) == "bmw"
+    # explicit mode wins over auto
+    assert p.plan_ranked(art3, [4 * B + 1, 10], 5,
+                         mode="maxscore") == "maxscore"
+    monkeypatch.setenv(PLANNER_ENV, "bmw")
+    assert p.plan_ranked(art3, [10, 10], 5) == "bmw"
+
+
+def test_plan_and_threshold():
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs.metrics import (
+        Registry,
+    )
+    p = Planner(Registry())
+    assert p.plan_and(100, 200) == "merge"    # df <= 2 * n_acc
+    assert p.plan_and(100, 201) == "gallop"
+    d = p.describe()
+    assert d["and"] == {"merge": 1, "gallop": 1}
+
+
+def test_note_ranked_counters_and_last(monkeypatch):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs.metrics import (
+        Registry,
+    )
+    p = Planner(Registry())
+    p.note_ranked("bmw", scored=7, skipped=3, candidates=12)
+    p.note_ranked("exhaustive", 0, 0, 40)
+    d = p.describe()
+    assert d["ranked"]["bmw"] == 1 and d["ranked"]["exhaustive"] == 1
+    assert d["blocks_scored"] == 7 and d["blocks_skipped"] == 3
+    assert d["last_ranked"] == {"mode": "exhaustive", "blocks_scored": 0,
+                                "blocks_skipped": 0, "candidates": 40}
+
+
+def test_bm25_corpus_memoized_per_engine(tmp_path, monkeypatch):
+    """Satellite: a v1 artifact reconstructs doc lengths from postings
+    exactly once per engine, not once per scored query."""
+    docs = [b"cat sat", b"cat cat dog", b"dog ran far away"]
+    out = build_corpus_fmt(tmp_path, docs, 1)
+    calls = {"n": 0}
+    real = artifact_mod.bm25_corpus
+
+    def counting(art):
+        calls["n"] += 1
+        return real(art)
+
+    monkeypatch.setattr(artifact_mod, "bm25_corpus", counting)
+    with Engine(artifact_path(out)) as eng:
+        b = eng.encode_batch(["cat", "dog"])
+        first = eng.top_k_scored(b, 3)
+        assert first and eng.top_k_scored(b, 3) == first
+        eng.top_k_scored(eng.encode_batch(["far"]), 2)
+    assert calls["n"] == 1
+
+
+def test_auto_engine_is_default_and_serves_from_host(adversarial_built,
+                                                     monkeypatch):
+    _, _, out3 = adversarial_built
+    monkeypatch.delenv("MRI_SERVE_ENGINE", raising=False)
+    monkeypatch.delenv(CROSSOVER_ENV, raising=False)
+    with create_engine(artifact_path(out3)) as eng:
+        assert isinstance(eng, AutoEngine)
+        assert eng.engine_name == "auto"
+        d = eng.describe()
+        assert d["engine"] == "auto"
+        assert d["auto"]["device_ready"] is False
+        assert d["auto"]["probe"] is None
+        # small batches never probe: answered by the host engine
+        q = [_spike_term("first"), _df_term(2)]
+        with Engine(artifact_path(out3)) as host:
+            batch = eng.encode_batch(q)
+            assert eng.df(batch).tolist() == host.df(batch).tolist()
+            assert eng.top_k_scored(batch, 5) == \
+                host.top_k_scored(host.encode_batch(q), 5)
+        assert eng.describe()["auto"]["device_ready"] is False
+
+
+def test_auto_engine_crossover_pins(adversarial_built, monkeypatch):
+    """$MRI_SERVE_CROSSOVER: 0 pins host forever, N>0 routes batches
+    >= N to the device engine (answers stay identical)."""
+    _, _, out3 = adversarial_built
+    q = [_df_term(j) for j in range(4)] + [_spike_term("mid")]
+    monkeypatch.delenv("MRI_SERVE_ENGINE", raising=False)
+    monkeypatch.setenv(CROSSOVER_ENV, "0")
+    with create_engine(artifact_path(out3)) as eng:
+        assert eng.describe()["auto"]["crossover"] == 0
+        eng.df(eng.encode_batch(q))
+        assert eng.describe()["auto"]["device_ready"] is False
+    monkeypatch.setenv(CROSSOVER_ENV, "4")
+    with create_engine(artifact_path(out3)) as eng, \
+            Engine(artifact_path(out3)) as host:
+        batch = eng.encode_batch(q)
+        assert eng.df(batch).tolist() == host.df(batch).tolist()
+        assert eng.describe()["auto"]["device_ready"] is True
+        # below the threshold the host answers (no way to observe the
+        # routing directly, but the answers must agree regardless)
+        small = eng.encode_batch(q[:2])
+        assert eng.df(small).tolist() == host.df(small).tolist()
+
+
+@pytest.mark.daemon
+def test_trace_spans_carry_planner_for_ranked(tmp_path):
+    """Satellite: a bm25 top_k through the daemon leaves its planner
+    decision (mode + block counters) on the engine span in the trace
+    ring; unranked ops don't grow a planner label."""
+    from test_daemon import Client, serving
+    from test_obs import _poll_traces
+    docs = _adversarial_docs()[:40]
+    out = build_corpus_fmt(tmp_path, docs, 3)
+    q = [_spike_term("first"), _df_term(2)]
+    with serving(out) as d, Client(d) as cli:
+        r = cli.rpc(id=1, op="top_k", score="bm25", k=3, terms=q,
+                    trace_id="ranked-1")
+        assert r["ok"] and r["docs"]
+        r = cli.rpc(id=2, op="df", terms=q, trace_id="plain-1")
+        assert r["ok"]
+        traces = _poll_traces(cli, 16, 2)
+        by_id = {t["trace_id"]: t for t in traces}
+        eng_span = by_id["ranked-1"]["spans"][-1]
+        assert eng_span["name"] == "engine"
+        pl = eng_span["planner"]
+        assert pl["mode"] in ("exhaustive", "bmw", "maxscore")
+        assert pl["blocks_scored"] >= 0
+        assert pl["blocks_skipped"] >= 0
+        assert pl["candidates"] >= 1
+        assert "planner" not in by_id["plain-1"]["spans"][-1]

@@ -428,10 +428,12 @@ def test_artifact_layout_header_fields(smoke_built):
         assert art.nbytes == artifact_path(out).stat().st_size
         # sections are struct-aligned views over one mapping
         # (the postings sections differ by format version)
-        if art.version == 2:
+        if art.version >= 2:
             sections = (art.term_offsets, art.df, art.blk_max,
                         art.blk_first, art.post_words, art.tf_words,
                         art.doc_lens)
+            if art.has_block_scores:
+                sections += (art.blk_max_tf, art.blk_min_dl)
         else:
             sections = (art.term_offsets, art.df, art.post_offsets,
                         art.postings)

@@ -243,9 +243,11 @@ def test_device_batch_bucketing_shares_compiles(zipf_pair):
 # -- engine selection + stats surface -----------------------------------
 
 
-def test_resolve_engine_auto_cpu_is_host(monkeypatch):
-    # tier-1 runs under JAX_PLATFORMS=cpu: auto must serve host-side
-    assert resolve_engine("auto") == "host"
+def test_resolve_engine_auto_is_a_backend(monkeypatch):
+    # "auto" is the crossover router, a real backend of its own — it is
+    # returned verbatim, not resolved to a platform name here
+    assert resolve_engine("auto") == "auto"
+    assert resolve_engine(None) == "auto"
     assert resolve_engine("host") == "host"
     assert resolve_engine("device") == "device"
     with pytest.raises(ValueError):

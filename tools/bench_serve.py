@@ -25,6 +25,13 @@ Four modes, all printing ONE JSON line mirroring bench.py's shape:
                       throughput — gated on a byte-parity sweep across
                       every existing op; written to --out-format
                       (BENCH_SERVE_V2_r09.json, make bench-serve-v2)
+  --ranked-ab         ranked-query A/B over a v2.1 artifact:
+                      exhaustive vs Block-Max WAND vs MaxScore at
+                      k=1/10/100 on the Zipf mix, byte-parity gated,
+                      with cold-sweep block-skip ratios and the
+                      >= 3x-vs-r09 throughput contract on the default
+                      planner — written to --out-ranked
+                      (BENCH_RANKED_r11.json, make bench-serve-ranked)
   --daemon-bench      the resident-daemon sweep (make bench-daemon):
                       pipelined coalesced capacity + closed-loop rpc
                       floor vs the in-process batch-1 baseline, then an
@@ -439,6 +446,134 @@ def _format_ab(out_path: str | None) -> dict:
     }
     e1.close()
     e2.close()
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
+# -- ranked-query A/B (make bench-serve-ranked) -------------------------
+
+
+def _measure_ranked_qps(engine, enc, k: int) -> float:
+    """Best-of-3 closed-loop sweep QPS for one (engine, k) leg, after a
+    full warm sweep (term-contribution memos populated — the steady
+    state a Zipf stream converges to)."""
+    for b in enc:
+        engine.top_k_scored(b, k)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for b in enc:
+            engine.top_k_scored(b, k)
+        best = max(best, len(enc) / (time.perf_counter() - t0))
+    return round(best, 1)
+
+
+def _ranked_ab(out_path: str | None) -> dict:
+    """Exhaustive vs Block-Max WAND vs MaxScore over a v2.1 artifact on
+    the Zipf two-term mix at k=1/10/100 — byte-parity gated (identical
+    (doc, score) lists across all three, ties doc-ascending), with the
+    cold-sweep block-skip economy and the >= 3x-vs-r09 contract on the
+    default (auto) path."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.planner import (
+        PLANNER_ENV,
+    )
+
+    _, corpus_metric = bench._manifest()
+    out_dir, _ = _build_index_fmt(3)
+    art_path = os.path.join(out_dir, "index.mri")
+    eng = Engine(art_path)
+    assert eng.artifact.version == 3 and eng.artifact.has_block_scores
+    rng = np.random.default_rng(SEED)
+    terms = _zipf_terms(eng, LOOKUPS, rng)
+    pairs = [terms[i:i + 2] for i in range(0, 2000, 2)]
+    enc = [eng.encode_batch(p) for p in pairs]
+
+    MODES = ("exhaustive", "bmw", "maxscore")
+    KS = (1, 10, 100)
+    # mrilint: allow(env-knobs) pinned-mode sweep, saved and restored
+    old = os.environ.get(PLANNER_ENV)
+    parity_checked = 0
+    modes_out: dict = {}
+    try:
+        # parity first: every mode answers every query identically
+        for kk in KS:
+            refs = None
+            for mode in MODES:
+                os.environ[PLANNER_ENV] = mode
+                got = [eng.top_k_scored(b, kk) for b in enc]
+                if refs is None:
+                    refs = got
+                else:
+                    assert got == refs, \
+                        f"planner {mode} diverged from exhaustive " \
+                        f"at k={kk}"
+                    parity_checked += sum(len(r) for r in got)
+        for kk in KS:
+            row = {}
+            for mode in MODES:
+                os.environ[PLANNER_ENV] = mode
+                row[mode] = {"qps": _measure_ranked_qps(eng, enc, kk)}
+            modes_out[str(kk)] = row
+        os.environ[PLANNER_ENV] = "auto"
+        auto_qps = _measure_ranked_qps(eng, enc, 10)
+        # block economy: a fresh engine's first sweep pays the real
+        # block decodes, so its planner counters show what the bound
+        # columns actually skipped (warm sweeps answer from the
+        # term-contribution memos and decode nothing)
+        economy = {}
+        for mode in ("bmw", "maxscore"):
+            os.environ[PLANNER_ENV] = mode
+            cold = Engine(art_path)
+            cenc = [cold.encode_batch(p) for p in pairs]
+            for b in cenc:
+                cold.top_k_scored(b, 10)
+            d = cold.planner.describe()
+            scored, skipped = d["blocks_scored"], d["blocks_skipped"]
+            economy[mode] = {
+                "blocks_scored": scored,
+                "blocks_skipped": skipped,
+                "skip_ratio": round(
+                    skipped / max(1, scored + skipped), 4),
+            }
+            cold.close()
+    finally:
+        if old is None:
+            os.environ.pop(PLANNER_ENV, None)
+        else:
+            os.environ[PLANNER_ENV] = old
+
+    baseline = None
+    r09 = Path(__file__).resolve().parent.parent / "BENCH_SERVE_V2_r09.json"
+    if r09.exists():
+        baseline = json.loads(r09.read_text())[
+            "formats"]["v1"]["bm25_top10_qps"]
+        assert auto_qps >= 3.0 * baseline, \
+            f"ranked {auto_qps} qps < 3x r09 baseline {baseline}"
+    line = {
+        "metric": "serve_ranked_bm25_top10_qps",
+        "value": auto_qps,
+        "unit": "queries/s",
+        "bm25_top10_qps": auto_qps,
+        "corpus_metric": corpus_metric,
+        "zipf_s": ZIPF_S,
+        "vocab": eng.vocab_size,
+        "block_size": eng.artifact.block_size,
+        "score_bits": eng.artifact.score_bits,
+        "modes": modes_out,
+        "economy_cold_sweep": economy,
+        "baseline_r09_bm25_top10_qps": baseline,
+        "speedup_vs_r09": (round(auto_qps / baseline, 3)
+                           if baseline else None),
+        "parity": {"checked_answers": parity_checked,
+                   "result": "byte-identical"},
+        "host_cores": os.cpu_count(),
+        "scratch": bench._scratch_backing(),
+    }
+    eng.close()
     if out_path:
         Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
     return line
@@ -1045,6 +1180,13 @@ def main(argv: list[str] | None = None) -> int:
                         "throughput, after a byte-parity sweep")
     p.add_argument("--out-format", default="BENCH_SERVE_V2_r09.json",
                    help="where --format-ab writes its JSON report")
+    p.add_argument("--ranked-ab", action="store_true",
+                   help="ranked-query A/B on a v2.1 artifact: "
+                        "exhaustive vs Block-Max WAND vs MaxScore at "
+                        "k=1/10/100, byte-parity gated, cold-sweep "
+                        "block-skip ratios")
+    p.add_argument("--out-ranked", default="BENCH_RANKED_r11.json",
+                   help="where --ranked-ab writes its JSON report")
     p.add_argument("--daemon", action="store_true",
                    help="with --open-loop: offer the Poisson arrivals "
                         "to a live `mri serve` subprocess (shed and "
@@ -1078,6 +1220,8 @@ def main(argv: list[str] | None = None) -> int:
         line = _device_ab(args.out)
     elif args.format_ab:
         line = _format_ab(args.out_format)
+    elif args.ranked_ab:
+        line = _ranked_ab(args.out_ranked)
     else:
         line = _closed_loop(args.engine, args.open_loop)
     print(json.dumps(line))
