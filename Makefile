@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -102,6 +102,12 @@ test-daemon:
 test-obs:
 	$(PY) -m pytest tests/ -q -m obs
 
+# incremental indexing (segments/): manifest + tombstone integrity,
+# append/delete/compact lifecycle, multi-segment byte-identity vs a
+# from-scratch build, fault kinds, CLI + daemon admin surfaces
+test-segments:
+	$(PY) -m pytest tests/ -q -m segments
+
 bench:
 	$(PY) bench.py
 
@@ -149,6 +155,12 @@ bench-daemon:
 # capacity (1 Hz scrape must cost <1%) -> BENCH_SCRAPE_r10.json
 bench-scrape:
 	$(PY) tools/bench_serve.py --scrape-check
+
+# incremental-indexing A/B: append->visible refresh latency, query QPS
+# at 1/4/16 segments vs the single-artifact baseline (byte-parity
+# gated), and compaction cost -> BENCH_SEGMENTS_r12.json
+bench-segments:
+	$(PY) tools/bench_serve.py --segments-ab
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

@@ -205,34 +205,90 @@ def write_output_manifest(out_dir, extra: dict | None = None) -> dict:
     return doc
 
 
+def verify_segments(out_dir) -> list[str]:
+    """Re-hash every live segment artifact + tombstone file against
+    ``segments.manifest.json`` (whose own body checksum gates the walk).
+    Returns a problem list — empty when clean OR when the directory was
+    never segment-managed."""
+    from . import segments
+    from .serve import artifact as artifact_mod
+
+    out_dir = Path(out_dir)
+    try:
+        man = segments.load_manifest(out_dir)
+    except segments.SegmentError as e:
+        return [str(e)]
+    if man is None:
+        return []
+    problems: list[str] = []
+    for entry in man.entries:
+        sdir = segments.segment_dir(out_dir, entry.name)
+        art = sdir / artifact_mod.ARTIFACT_NAME
+        try:
+            crc, size = artifact_mod.checksum(art)
+        except OSError as e:
+            problems.append(f"{art}: {e}")
+        else:
+            if crc != entry.adler32 or size != entry.bytes:
+                problems.append(
+                    f"{art}: checksum mismatch (manifest "
+                    f"{entry.adler32}/{entry.bytes}B, on disk "
+                    f"{crc}/{size}B)")
+        if entry.tombstones is None:
+            continue
+        tpath = sdir / entry.tombstones
+        try:
+            data = tpath.read_bytes()
+        except OSError as e:
+            problems.append(f"{tpath}: {e}")
+            continue
+        crc = f"{zlib.adler32(data):08x}"
+        if crc != entry.tomb_adler32 or len(data) != entry.tomb_bytes:
+            problems.append(
+                f"{tpath}: checksum mismatch (manifest "
+                f"{entry.tomb_adler32}/{entry.tomb_bytes}B, on disk "
+                f"{crc}/{len(data)}B)")
+    return problems
+
+
 def verify_output_dir(out_dir) -> tuple[bool, list[str]]:
-    """Re-hash ``out_dir`` against its ``index.manifest.json``.
+    """Re-hash ``out_dir`` against its ``index.manifest.json`` and — for
+    a segment-managed directory — its ``segments.manifest.json``.
 
     Returns ``(ok, problems)`` — problems is a human-readable list of
     every mismatch/missing file (empty when ok).  Never raises on
     content mismatch; a missing/corrupt manifest is itself a problem.
+    A directory that is only segment-managed (appends into a dir that
+    never had an ``--audit`` batch build) skips the letter-file check.
     """
     out_dir = Path(out_dir)
     problems: list[str] = []
     mpath = out_dir / MANIFEST_NAME
-    try:
-        doc = json.loads(mpath.read_text(encoding="utf-8"))
-        expected = doc["files"]
-    except (OSError, ValueError, KeyError) as e:
-        return False, [f"{mpath}: unreadable manifest ({e})"]
-    try:
-        actual = letter_checksums(out_dir)
-    except OSError as e:
-        return False, [f"{out_dir}: {e}"]
-    for name, (crc, size) in actual.items():
-        want = expected.get(name)
-        if want is None:
-            problems.append(f"{name}: present but not in manifest")
-        elif want["adler32"] != crc or want["bytes"] != size:
-            problems.append(
-                f"{name}: checksum mismatch (manifest {want['adler32']}/"
-                f"{want['bytes']}B, on disk {crc}/{size}B)")
-    for name in expected:
-        if name not in actual:
-            problems.append(f"{name}: in manifest but missing on disk")
+    from .segments import is_segmented
+
+    seg_managed = is_segmented(out_dir)
+    if mpath.exists() or not seg_managed:
+        try:
+            doc = json.loads(mpath.read_text(encoding="utf-8"))
+            expected = doc["files"]
+        except (OSError, ValueError, KeyError) as e:
+            return False, [f"{mpath}: unreadable manifest ({e})"]
+        try:
+            actual = letter_checksums(out_dir)
+        except OSError as e:
+            return False, [f"{out_dir}: {e}"]
+        for name, (crc, size) in actual.items():
+            want = expected.get(name)
+            if want is None:
+                problems.append(f"{name}: present but not in manifest")
+            elif want["adler32"] != crc or want["bytes"] != size:
+                problems.append(
+                    f"{name}: checksum mismatch (manifest "
+                    f"{want['adler32']}/{want['bytes']}B, on disk "
+                    f"{crc}/{size}B)")
+        for name in expected:
+            if name not in actual:
+                problems.append(f"{name}: in manifest but missing on disk")
+    if seg_managed:
+        problems.extend(verify_segments(out_dir))
     return not problems, problems

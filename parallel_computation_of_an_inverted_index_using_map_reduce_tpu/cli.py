@@ -56,6 +56,13 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
   reload-corrupt                 serve daemon: next hot reload fails
                                  verification (old artifact keeps
                                  serving, 'reload_rejected' counted)
+  append-torn-manifest           segments: the staged manifest is torn
+                                 mid-publish — the append aborts and
+                                 the old generation keeps serving
+  compact-crash                  segments: crash after the merged
+                                 segment is built, before the swap
+  tombstone-corrupt              segments: staged tombstone bitmap
+                                 corrupted; the write is rejected
   chaos:seed=5:n=3               sample 3 faults deterministically
                                  (bounds: windows= workers= reducers=
                                  docs= reqs= kinds=a,b,c)
@@ -64,7 +71,27 @@ verify mode:
   mri-tpu --verify DIR           re-check DIR's letter files (and
                                  index.mri, when present) against its
                                  index.manifest.json (written by
-                                 --audit runs); exit 0 ok, 2 mismatch
+                                 --audit runs); a segment-managed DIR
+                                 additionally re-hashes every live
+                                 segment + tombstone file against
+                                 segments.manifest.json; exit 0 ok,
+                                 2 mismatch
+
+incremental indexing (live index; see README "Incremental indexing"):
+  mri-tpu append DIR --add F...  index new files as one immutable
+                                 segment and publish the next manifest
+                                 generation (first append seeds the
+                                 manifest from DIR's index.mri)
+  mri-tpu delete DIR --docs N... tombstone global doc ids (query-
+                                 invisible at once; space reclaimed at
+                                 compaction)
+  mri-tpu compact DIR            k-way merge the cheapest adjacent
+                                 segment run into one replacement
+                                 segment, dropping its tombstones
+  mri-tpu compact DIR --prune    also delete retired segment dirs no
+                                 longer referenced by the manifest
+                                 (only safe with no live readers on
+                                 older generations)
 
 query mode (the serving read path; needs an --artifact build):
   mri-tpu query DIR word...          df + postings per word (JSON lines)
@@ -526,6 +553,75 @@ def _metrics_main(argv: list[str]) -> int:
     return 0
 
 
+def _segments_main(cmd: str, argv: list[str]) -> int:
+    """``mri-tpu append|delete|compact DIR ...`` — incremental indexing.
+
+    Mutations are serialized under the segments lock and published by
+    atomic manifest swap: readers on the old generation are never
+    disturbed, and a failed mutation leaves the old manifest live."""
+    p = argparse.ArgumentParser(
+        prog=f"mri-tpu {cmd}",
+        description={
+            "append": "index new files as one immutable segment and "
+                      "publish the next manifest generation",
+            "delete": "tombstone global doc ids (query-invisible "
+                      "immediately; space reclaimed at compaction)",
+            "compact": "merge the cheapest adjacent segment run into "
+                       "one replacement segment, dropping tombstones",
+        }[cmd])
+    p.add_argument("index_dir", help="an --artifact output dir (first "
+                                     "append seeds segments/ from its "
+                                     "index.mri)")
+    if cmd == "append":
+        p.add_argument("--add", nargs="+", required=True, metavar="FILE",
+                       help="text files to index as the new segment")
+    elif cmd == "delete":
+        p.add_argument("--docs", nargs="+", required=True, type=int,
+                       metavar="ID", help="global doc ids to tombstone")
+    else:
+        p.add_argument("--force", action="store_true",
+                       help="compact even below the "
+                            "MRI_SEGMENT_COMPACT_TRIGGER segment count")
+        p.add_argument("--prune", action="store_true",
+                       help="after compacting, delete retired segment "
+                            "dirs no longer referenced by the manifest "
+                            "(unsafe while readers hold old generations)")
+    p.add_argument("--fault-spec", default=None,
+                   help="inject faults (see mri-tpu --help for grammar)")
+    args = p.parse_args(argv)
+
+    if args.fault_spec is not None:
+        try:
+            faults.install(args.fault_spec)
+        except faults.FaultSpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    from . import segments
+    try:
+        if cmd == "append":
+            missing = [f for f in args.add if not os.path.exists(f)]
+            if missing:
+                print(f"error: input files do not exist: {missing}",
+                      file=sys.stderr)
+                return 2
+            res = segments.append_files(args.index_dir, args.add)
+        elif cmd == "delete":
+            res = segments.delete_docs(args.index_dir, args.docs)
+        else:
+            res = segments.compact(args.index_dir, force=args.force)
+        print(json.dumps(res, sort_keys=True))
+        if cmd == "compact" and args.prune:
+            pruned = segments.prune_retired(args.index_dir)
+            print(json.dumps({"pruned": pruned}, sort_keys=True))
+    except segments.SegmentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except faults.InjectedCompactCrash as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     # --verify DIR / query DIR / serve DIR / metrics TARGET are
     # standalone modes (no reference positionals): pre-parse them so
@@ -538,6 +634,8 @@ def main(argv: list[str] | None = None) -> int:
         return _serve_main(argv[1:])
     if argv and argv[0] == "metrics":
         return _metrics_main(argv[1:])
+    if argv and argv[0] in ("append", "delete", "compact"):
+        return _segments_main(argv[0], argv[1:])
     if "--verify" in argv:
         i = argv.index("--verify")
         if i + 1 >= len(argv):

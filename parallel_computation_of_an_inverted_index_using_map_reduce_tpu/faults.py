@@ -35,6 +35,13 @@ Three layers live here:
         client-disconnect:req=2      serve daemon: peer gone at response 2
         slow-client:req=1:ms=200     serve daemon: response write stalls
         reload-corrupt               serve daemon: next hot reload fails
+        append-torn-manifest         segments: staged manifest torn
+                                     mid-publish (append aborts, old
+                                     generation keeps serving)
+        compact-crash                segments: crash after the merged
+                                     segment is built, before publish
+        tombstone-corrupt            segments: staged tombstone bitmap
+                                     corrupted (write rejected)
         chaos:seed=5:n=3             sample 3 faults from a seeded RNG
         seed=7                       RNG seed for ``p=`` rules
 
@@ -140,6 +147,22 @@ class InjectedReloadCorrupt(RuntimeError):
     sits below serve/ in the import graph.)"""
 
 
+class InjectedPublishTear(RuntimeError):
+    """Injected segment-manifest publish tear (``append-torn-manifest``
+    rule): the STAGED manifest was truncated and the rename must never
+    happen.  ``segments.manifest.save_manifest`` maps it to a
+    SegmentError so the mutation aborts and the previous generation
+    keeps serving.  (Plain RuntimeError — faults.py sits below
+    segments/ in the import graph.)"""
+
+
+class InjectedCompactCrash(RuntimeError):
+    """Injected mid-compaction crash (``compact-crash`` rule): fires
+    after the replacement segment is fully built but before the
+    generation swap, leaving the old generation serving plus an orphan
+    directory no manifest references — what a real crash leaves."""
+
+
 # -- injector ---------------------------------------------------------
 
 _READ_KINDS = ("read-error", "slow-read", "truncate")
@@ -147,6 +170,8 @@ _DEATH_KINDS = ("reader-death", "sigkill", "stream-crash", "ckpt-corrupt",
                 "worker-death", "reducer-death", "scan-error", "chaos")
 _SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
                 "handler-crash")
+_SEGMENT_KINDS = ("append-torn-manifest", "compact-crash",
+                  "tombstone-corrupt")
 
 #: What ``chaos:`` may sample by default — every kind the parallel host
 #: path recovers from in-run (sigkill is excluded: its story is the
@@ -161,6 +186,12 @@ CHAOS_KINDS = ("worker-death", "reducer-death", "scan-error",
 #: never fire.
 SERVE_CHAOS_KINDS = ("client-disconnect", "slow-client", "handler-crash",
                      "reload-corrupt")
+
+#: What ``chaos:kinds=...`` may name for segment soaks — the mutation
+#: crash points the generation-swap discipline absorbs (old generation
+#: keeps serving in every case).  Named-only for the same reason as the
+#: serve kinds: a build soak should never sample them.
+SEGMENT_CHAOS_KINDS = _SEGMENT_KINDS
 
 
 @dataclasses.dataclass
@@ -213,7 +244,8 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             raise FaultSpecError("seed=N must be a clause of its own")
         return None
     rule = _Rule(kind=head)
-    if head not in _READ_KINDS + _DEATH_KINDS + _SERVE_KINDS:
+    if head not in (_READ_KINDS + _DEATH_KINDS + _SERVE_KINDS
+                    + _SEGMENT_KINDS):
         raise FaultSpecError(f"unknown fault kind {head!r}")
     for field in parts[1:]:
         if field == "all":
@@ -268,12 +300,13 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         elif k == "kinds" and head == "chaos":
             kinds = tuple(s for s in v.split(",") if s)
             bad = [s for s in kinds
-                   if s not in CHAOS_KINDS + SERVE_CHAOS_KINDS]
+                   if s not in (CHAOS_KINDS + SERVE_CHAOS_KINDS
+                                + SEGMENT_CHAOS_KINDS)]
             if bad:
                 raise FaultSpecError(
                     f"chaos: kinds not samplable: {bad} "
                     f"(choose from "
-                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS)})")
+                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS)})")
             rule.kinds = kinds
         else:
             raise FaultSpecError(f"{head}: unknown key {k!r}")
@@ -339,6 +372,10 @@ def _sample_chaos(rule: _Rule) -> list[_Rule]:
         elif kind == "slow-client":
             out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs),
                              ms=float(rng.choice((20, 50, 100)))))
+        elif kind in _SEGMENT_KINDS:
+            # no ordinal to pick: each fires once, on the next matching
+            # segment mutation (times=1 default)
+            out.append(_Rule(kind=kind))
         else:  # reload-corrupt
             out.append(_Rule(kind="reload-corrupt"))
     return out
@@ -571,6 +608,59 @@ class FaultInjector:
         if delay:
             time.sleep(delay)
         return drop
+
+    def on_segment_publish(self, op: str, tmp_path: str) -> None:
+        """Fires in ``segments.manifest.save_manifest`` after the new
+        manifest generation is staged, before the rename.  The
+        ``append-torn-manifest`` rule truncates the STAGED file and
+        raises :class:`InjectedPublishTear`, so the swap never happens
+        and the previous generation keeps serving — the crash-
+        mid-publish the stage+rename discipline exists to survive."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "append-torn-manifest" or op != "append":
+                    continue
+                if self._fire_once(ri, rule):
+                    size = os.path.getsize(tmp_path)
+                    with open(tmp_path, "r+b") as f:
+                        f.truncate(max(size // 2, 1))
+                    log.warning("fault injection: tore staged segment "
+                                "manifest %s mid-publish", tmp_path)
+                    raise InjectedPublishTear(
+                        f"injected manifest tear publishing {op!r} "
+                        "(fault spec)")
+
+    def on_tombstone_write(self, tmp_path: str) -> None:
+        """Fires in ``segments.tombstones.save`` after the bitmap is
+        staged; the ``tombstone-corrupt`` rule flips a byte in place.
+        Does not raise — the writer's read-back verification must be
+        the thing that rejects the corrupted bytes before publish."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "tombstone-corrupt":
+                    continue
+                if self._fire_once(ri, rule):
+                    with open(tmp_path, "r+b") as f:
+                        data = f.read()
+                        at = max(len(data) // 2 - 1, 0)
+                        f.seek(at)
+                        f.write(bytes([data[at] ^ 0xFF]))
+                    log.warning("fault injection: corrupted staged "
+                                "tombstone bitmap %s", tmp_path)
+
+    def on_compact(self) -> None:
+        """Fires in the compactor after the replacement segment is
+        fully built, before the manifest swap; may raise
+        :class:`InjectedCompactCrash` — the mid-compaction death that
+        must leave the old generation serving (plus an orphan dir)."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "compact-crash":
+                    continue
+                if self._fire_once(ri, rule):
+                    raise InjectedCompactCrash(
+                        "injected compaction crash before publish "
+                        "(fault spec)")
 
     def on_reload(self) -> None:
         """Fires in the serve daemon's hot-reload path after the

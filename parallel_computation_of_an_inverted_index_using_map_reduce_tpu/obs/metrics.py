@@ -281,6 +281,19 @@ KNOWN_METRICS = (
      "Posting blocks pruned ranked evaluation had to score."),
     ("mri_planner_blocks_skipped_total", "counter",
      "Posting blocks whose max-score bound kept them unscored."),
+    # incremental indexing (segment-managed dirs; daemon + engine)
+    ("mri_segments_active", "gauge",
+     "Segments in the live manifest generation."),
+    ("mri_generation", "gauge",
+     "Generation number of the live segment manifest."),
+    ("mri_compactions_total", "counter",
+     "Segment compactions completed (runs merged + published)."),
+    ("mri_tombstoned_docs", "gauge",
+     "Documents masked by tombstone bitmaps in the live generation."),
+    ("mri_serve_mutations_total", "counter",
+     "Live mutations (append/delete/compact) applied by the daemon."),
+    ("mri_serve_mutation_rejected_total", "counter",
+     "Live mutations rejected; the old generation kept serving."),
     # fault injection (process-global default registry)
     ("mri_faults_fired_total", "counter",
      "Fault-injection rules fired, all kinds."),
@@ -365,6 +378,33 @@ class Registry:
             else:
                 out[m.name] = m.value
         return out
+
+
+def merge_expositions(parts) -> str:
+    """Concatenate text expositions, dropping later duplicate metric
+    families by name (first occurrence wins).  Several registries can
+    legitimately carry the same family — e.g. the serve daemon's own
+    registry and a multi-segment engine's both track
+    ``mri_generation`` — but one exposition must name each family
+    exactly once."""
+    seen: set[str] = set()
+    out: list[str] = []
+    for text in parts:
+        if not text:
+            continue
+        keep = True
+        for line in text.splitlines():
+            if line.startswith(("# HELP ", "# TYPE ")):
+                name = line.split(" ", 3)[2]
+                if line.startswith("# TYPE "):
+                    keep = name not in seen
+                    seen.add(name)
+                else:
+                    # HELP precedes TYPE: peek whether its family is new
+                    keep = name not in seen
+            if keep:
+                out.append(line)
+    return "\n".join(out) + "\n" if out else ""
 
 
 _default = Registry()

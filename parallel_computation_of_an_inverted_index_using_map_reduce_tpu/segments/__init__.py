@@ -1,0 +1,29 @@
+"""Incremental indexing: segment manifests, tombstones, compaction.
+
+The batch pipeline stays the segment builder; this package adds the
+live layer on top — generation-numbered manifests of immutable
+segments (:mod:`.manifest`), append/delete mutations (:mod:`.writer`),
+per-segment tombstone bitmaps (:mod:`.tombstones`), and background
+compaction (:mod:`.compactor`).  The query-side merge lives in
+``serve.multi_engine`` so serving has no hard dependency on the build
+stack.
+"""
+
+from .compactor import (compact, compact_to_limit, prune_retired,
+                        should_compact)
+from .manifest import (LOCK_NAME, MANIFEST_NAME, SEGMENTS_DIR,
+                       SegmentEntry, SegmentError, SegmentManifest,
+                       is_segmented, load_manifest, manifest_path,
+                       mutation_lock, save_manifest, segment_dir,
+                       segments_root)
+from .tombstones import empty_bitmap, tombstone_name
+from .writer import append_files, delete_docs
+
+__all__ = [
+    "LOCK_NAME", "MANIFEST_NAME", "SEGMENTS_DIR",
+    "SegmentEntry", "SegmentError", "SegmentManifest",
+    "append_files", "compact", "compact_to_limit", "delete_docs",
+    "empty_bitmap", "is_segmented", "load_manifest", "manifest_path",
+    "mutation_lock", "prune_retired", "save_manifest", "segment_dir",
+    "segments_root", "should_compact", "tombstone_name",
+]

@@ -230,6 +230,27 @@ def artifact_path(index_dir: str | Path) -> Path:
     return Path(index_dir) / ARTIFACT_NAME
 
 
+#: Present in a directory that the incremental-indexing layer manages
+#: (mirrors segments.manifest.MANIFEST_NAME; duplicated here so the
+#: serve stack can detect segmented directories without importing the
+#: build-side segments package).
+SEGMENTS_MANIFEST_NAME = "segments.manifest.json"
+
+
+def is_segment_managed(path) -> bool:
+    """Whether ``path`` is a directory whose live truth is a segment
+    manifest rather than its (possibly stale) root ``index.mri``.
+    Engines refuse to open such a directory as a single artifact.  A
+    path to the root ``index.mri`` file itself is equally stale, so it
+    is judged by its parent directory (segment artifacts live one level
+    down, under ``segments/``, and stay openable)."""
+    p = Path(path)
+    if p.is_dir():
+        return (p / SEGMENTS_MANIFEST_NAME).exists()
+    return (p.name == ARTIFACT_NAME
+            and (p.parent / SEGMENTS_MANIFEST_NAME).exists())
+
+
 def pack(path, *, term_blob: np.ndarray, term_offsets: np.ndarray,
          df: np.ndarray, post_offsets: np.ndarray, postings: np.ndarray,
          df_order: np.ndarray, max_doc_id: int, width: int | None = None,

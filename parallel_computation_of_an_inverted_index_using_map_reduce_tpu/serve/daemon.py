@@ -55,6 +55,18 @@ Protocol — one JSON object per line, one response line per request::
     {"id": 8, "op": "reload"}       # admin: swap to the new index.mri
     {"id": 9, "op": "metrics"}      # admin: Prometheus text exposition
     {"id": 10, "op": "trace", "n": 8}   # admin: recent request traces
+    {"id": 11, "op": "append", "files": ["d.txt"]}   # admin: live append
+    {"id": 12, "op": "delete", "docs": [7, 9]}       # admin: tombstone
+    {"id": 13, "op": "compact"}     # admin: merge a segment run
+
+Live mutations (the ``append``/``delete``/``compact`` ops) run on the
+reader thread under the reload lock — never the dispatcher — publish a
+new segment-manifest generation on disk, open a fresh engine over it,
+and swap under the dispatch lock exactly like a hot reload.  Any
+failure keeps the OLD generation serving and counts
+``mutation_rejected``.  Deletes batch per
+``MRI_SEGMENT_TOMBSTONE_FLUSH`` (a generation is published every N
+delete ops; a ``compact`` or drain flushes the remainder).
 
 Success: ``{"id":1,"ok":true,"df":[5241,3]}``.  Failure:
 ``{"id":2,"error":"<kind>","detail":"..."}`` with kind one of
@@ -104,7 +116,8 @@ DRAIN_ENV = "MRI_SERVE_DRAIN_S"
 OUTBOUND_DEPTH = 1024
 
 DATA_OPS = ("df", "postings", "and", "or", "top_k")
-ADMIN_OPS = ("stats", "healthz", "reload", "metrics", "trace")
+ADMIN_OPS = ("stats", "healthz", "reload", "metrics", "trace",
+             "append", "delete", "compact")
 
 _SENTINEL = object()
 
@@ -125,6 +138,8 @@ _COUNTER_NAMES = (
     ("batches", "mri_serve_batches_total"),
     ("batched_requests", "mri_serve_batched_requests_total"),
     ("connections", "mri_serve_connections_total"),
+    ("mutations", "mri_serve_mutations_total"),
+    ("mutation_rejected", "mri_serve_mutation_rejected_total"),
 )
 
 
@@ -287,6 +302,12 @@ class ServeDaemon:
         self._metrics_port = metrics_port
         self._metrics_listener: socket.socket | None = None
         self._metrics_thread: threading.Thread | None = None
+        # live-mutation state (segment-managed dirs); buffered delete
+        # ops flush every MRI_SEGMENT_TOMBSTONE_FLUSH ops (guarded by:
+        # self._reload_lock, like every mutation)
+        self._pending_deletes: list[int] = []
+        self._delete_ops = 0
+        self._tomb_flush = envknobs.get("MRI_SEGMENT_TOMBSTONE_FLUSH")
         self._host = host
         self._port = port
         self.final_stats: dict | None = None
@@ -537,6 +558,31 @@ class ServeDaemon:
                 and n > 0 else 32
             payload = {"ok": True,
                        "traces": self._trace_ring.snapshot(n)}
+        elif op in ("append", "delete", "compact"):
+            err = None
+            if op == "append":
+                files = req.get("files")
+                if not isinstance(files, list) or not files or \
+                        not all(isinstance(f, str) for f in files):
+                    err = f"append needs files=[str, ...], got {files!r}"
+            elif op == "delete":
+                docs = req.get("docs")
+                if not isinstance(docs, list) or not docs or \
+                        not all(isinstance(d, int)
+                                and not isinstance(d, bool)
+                                for d in docs):
+                    err = f"delete needs docs=[int, ...], got {docs!r}"
+            if err is not None:
+                self._count("bad_request")
+                payload = {"error": "bad_request", "detail": err}
+            else:
+                ok, out = self.mutate(op, files=req.get("files"),
+                                      docs=req.get("docs"),
+                                      force=bool(req.get("force", True)))
+                if ok:
+                    payload = {"ok": True, "result": out}
+                else:
+                    payload = {"error": "mutation_rejected", "detail": out}
         else:  # reload
             ok, detail = self.reload()
             if ok:
@@ -753,6 +799,104 @@ class ServeDaemon:
                     self._finish(it, {"error": "internal",
                                       "detail": str(e)})
 
+    # -- live mutations (segment-managed dirs) -------------------------
+
+    def _flush_deletes_locked(self):
+        """Publish every buffered delete op as ONE tombstone generation.
+        Caller holds ``_reload_lock``.  Returns the mutation result, or
+        None when the buffer was empty.  On failure the buffer is
+        dropped (the caller reports the rejection) so a poisoned flush
+        can never wedge later compactions."""
+        if not self._pending_deletes:
+            return None
+        from .. import segments
+        ids = sorted(set(self._pending_deletes))
+        self._pending_deletes = []
+        self._delete_ops = 0
+        return segments.delete_docs(self._path, ids,
+                                    registry=self.registry)
+
+    def mutate(self, op: str, *, files=None, docs=None,
+               force: bool = True) -> tuple[bool, dict | str]:
+        """Run one live-index mutation (``append`` / ``delete`` /
+        ``compact``) and swap in an engine over the new generation.
+
+        Runs on the caller's thread (a connection reader), serialized
+        with hot reloads under ``_reload_lock`` — never the dispatcher.
+        The mutation publishes its manifest generation atomically on
+        disk first; only then is a fresh engine opened and swapped under
+        the dispatch lock.  On ANY failure the old generation keeps
+        serving and the attempt is counted ``mutation_rejected``."""
+        from .. import segments
+        with self._reload_lock:
+            t0 = time.monotonic()
+            published = True
+            try:
+                if op == "append":
+                    res = segments.append_files(self._path, files,
+                                                registry=self.registry)
+                    auto = segments.compact_to_limit(
+                        self._path, registry=self.registry)
+                    if auto:
+                        res = dict(res, auto_compactions=len(auto),
+                                   segments=auto[-1]["segments"],
+                                   generation=auto[-1]["generation"])
+                elif op == "delete":
+                    man = segments.load_manifest(self._path)
+                    if man is None:
+                        raise segments.SegmentError(
+                            f"{self._path}: not segment-managed "
+                            "(append first)")
+                    bad = [d for d in docs if not any(
+                        e.doc_base < d <= e.doc_base + e.docs
+                        for e in man.entries)]
+                    if bad:
+                        raise segments.SegmentError(
+                            f"doc ids {bad} are outside every segment "
+                            f"(live span is 1..{man.doc_span})")
+                    self._pending_deletes.extend(docs)
+                    self._delete_ops += 1
+                    if self._delete_ops >= self._tomb_flush:
+                        res = self._flush_deletes_locked()
+                    else:
+                        published = False
+                        res = {"buffered": True,
+                               "pending_docs":
+                                   len(set(self._pending_deletes)),
+                               "pending_ops": self._delete_ops}
+                else:  # compact (flushes buffered deletes first, so the
+                    #    merge sees every tombstone it should drop)
+                    self._flush_deletes_locked()
+                    res = segments.compact(self._path, force=force,
+                                           registry=self.registry)
+                if published:
+                    new_engine = create_engine(
+                        self._path, self._engine_choice,
+                        cache_terms=self._cache_terms,
+                        shards=self._shards)
+            except (segments.SegmentError, ArtifactError, ValueError,
+                    OSError, faults.InjectedCompactCrash) as e:
+                self._count("mutation_rejected")
+                log.warning("%s rejected, old generation keeps "
+                            "serving: %s", op, e)
+                return False, str(e)
+            if published:
+                with self._engine_lock:
+                    old, self._engine = self._engine, new_engine
+                old.close()
+            self._count("mutations")
+            dur_ms = round((time.monotonic() - t0) * 1e3, 3)
+            if op == "compact" and self._obs_enabled:
+                self._trace_ring.push({
+                    "trace_id": obs_tracing.gen_trace_id(),
+                    "id": None, "op": "compact", "seq": 0,
+                    "status": "ok", "dur_ms": dur_ms,
+                    "spans": [{"name": "compact", "start_ms": 0.0,
+                               "dur_ms": dur_ms}],
+                })
+            log.info("%s: %s (%.1f ms)", op, json.dumps(res), dur_ms)
+            return True, res
+
     # -- hot reload ----------------------------------------------------
 
     def reload(self) -> tuple[bool, str]:
@@ -825,8 +969,9 @@ class ServeDaemon:
     def render_metrics(self) -> str:
         """Prometheus text exposition: the daemon's registry, the
         current engine's registry, and the process-global registry
-        (fault firings).  Metric names are disjoint by prefix, so the
-        concatenation is a valid exposition."""
+        (fault firings), merged with first-occurrence-wins dedup —
+        live mutations put segment gauges on the daemon registry that a
+        multi-segment engine also carries."""
         with self._count_lock:
             self._g_inflight.set(self._inflight)
         self._g_queue_depth.set(self._queue.qsize())
@@ -840,7 +985,7 @@ class ServeDaemon:
                 except Exception:  # racing a drain's engine close
                     pass
         parts.append(obs_metrics.default_registry().render_text())
-        return "".join(p for p in parts if p)
+        return obs_metrics.merge_expositions(parts)
 
     def _metrics_loop(self) -> None:
         """Minimal HTTP/1.0 scrape endpoint on the loopback listener:
@@ -941,6 +1086,12 @@ class ServeDaemon:
                 conn.writer.join(timeout=1.0)
         with self._conn_lock:
             self._conns.clear()
+        # buffered deletes must not die with the process
+        with self._reload_lock:
+            try:
+                self._flush_deletes_locked()
+            except Exception as e:
+                log.warning("drain: buffered delete flush failed: %s", e)
         self.final_stats = self.stats()
         with self._engine_lock:
             self._engine.close()

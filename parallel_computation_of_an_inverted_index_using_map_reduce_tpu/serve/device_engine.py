@@ -384,6 +384,11 @@ class DeviceEngine:
     def __init__(self, path, cache_terms: int = 4096,
                  shards: int | None = None,
                  decode_budget: int | None = None):
+        if artifact_mod.is_segment_managed(path):
+            raise artifact_mod.ArtifactError(
+                f"{path} is segment-managed (segments.manifest.json "
+                "present): the device engine serves single artifacts "
+                "only — use create_engine with host/auto")
         self.artifact = artifact_mod.load_artifact(path)
         art = self.artifact
         if art.max_doc_id >= int(_SENTINEL):

@@ -83,6 +83,12 @@ class Engine:
     engine_name = "host"
 
     def __init__(self, path, cache_terms: int = 4096):
+        if artifact_mod.is_segment_managed(path):
+            raise artifact_mod.ArtifactError(
+                f"{path} is segment-managed (segments.manifest.json "
+                "present): its root index.mri may be stale — open it "
+                "with serve.engine.create_engine, which routes to the "
+                "multi-segment engine")
         self.artifact = artifact_mod.load_artifact(path)
         art = self.artifact
         V, width = art.vocab, max(art.width, 1)
@@ -121,6 +127,12 @@ class Engine:
         self._c_bytes_decoded = \
             self.metrics.counter("mri_engine_bytes_decoded_total")
         self._bm25_cols = None  # lazy (doc_lens, ndocs, avgdl)
+        # corpus-stats override seam (multi-segment serving): when set,
+        # (ndocs, avgdl) and the per-term scoring df come from the
+        # GLOBAL live corpus instead of this artifact, so per-segment
+        # BM25 contributions stay bit-identical to a single-artifact
+        # build of the same live state
+        self._corpus_override = None  # (ndocs, avgdl, df_fn)
         self.planner = planner_mod.Planner(self.metrics)
         # BM25 per-term memos keyed by lex index: contributions are
         # query-independent (idf, tf and doc length are all properties
@@ -339,10 +351,41 @@ class Engine:
         """``(doc_lens, ndocs, avgdl)`` — v2 reads the packed doc-length
         column; v1 reconstructs lengths from the postings themselves
         (every stored pair counts 1: the no-tf fallback), lazily and
-        once."""
+        once.  Under a corpus override (multi-segment serving) the
+        doc-length column stays LOCAL (it is indexed by this artifact's
+        doc ids) while ndocs/avgdl are the injected global values."""
         if self._bm25_cols is None:
-            self._bm25_cols = artifact_mod.bm25_corpus(self.artifact)
+            cols = artifact_mod.bm25_corpus(self.artifact)
+            if self._corpus_override is not None:
+                ndocs, avgdl, _ = self._corpus_override
+                cols = (cols[0], ndocs, avgdl)
+            self._bm25_cols = cols
         return self._bm25_cols
+
+    def set_corpus_override(self, ndocs: int, avgdl: float,
+                            df_fn) -> None:
+        """Score this artifact as ONE SEGMENT of a larger live corpus.
+
+        ``ndocs``/``avgdl`` replace the artifact's own corpus stats and
+        ``df_fn(lex_idx) -> int`` supplies the global live document
+        frequency per local term, so every BM25 contribution this
+        engine computes equals — bit for bit — what a from-scratch
+        single-artifact build of the whole live corpus would compute
+        for the same (term, doc).  Clears every stats-dependent memo;
+        segment engines are per-generation immutable, so the multi-
+        segment engine calls this exactly once, right after opening."""
+        self._corpus_override = (int(ndocs), float(avgdl), df_fn)
+        self._bm25_cols = None
+        self._score_memo.clear()
+        self._bound_memo.clear()
+        self._occ_memo.clear()
+
+    def _scoring_df(self, i: int, dfi: int) -> int:
+        """The df that enters the idf term for lex index ``i``: the
+        local ``dfi`` normally, the global live df under an override."""
+        if self._corpus_override is not None:
+            return int(self._corpus_override[2](i))
+        return dfi
 
     def top_k_scored(self, batch, k: int) -> list[tuple[int, float]]:
         """BM25-ranked ``(doc_id, score)`` for the query terms, best
@@ -498,7 +541,7 @@ class Engine:
         # per-query widening conversion that doubles its cost
         docs = self.postings_by_index(i).astype(np.int64)
         tf = self.tf_by_index(i).astype(np.float64)
-        dfi = len(docs)
+        dfi = self._scoring_df(i, len(docs))
         idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
         denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
         contrib = idf * tf * (k1 + 1.0) / denom
@@ -517,7 +560,7 @@ class Engine:
         if hit is not None:
             return hit
         doc_lens, ndocs, avgdl = self._bm25_corpus()
-        dfi = int(self._df[i])
+        dfi = self._scoring_df(i, int(self._df[i]))
         idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
         ubs = planner_mod.block_upper_bounds(
             self.artifact, i, idf, avgdl, BM25_K1, BM25_B)
@@ -544,7 +587,7 @@ class Engine:
         tf = tfm[mask].astype(np.float64)
         doc_lens, ndocs, avgdl = self._bm25_corpus()
         k1, b = BM25_K1, BM25_B
-        dfi = int(self._df[i])
+        dfi = self._scoring_df(i, int(self._df[i]))
         idf = np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5))
         denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
         return docs, idf * tf * (k1 + 1.0) / denom
@@ -988,6 +1031,14 @@ def create_engine(path, engine: str | None = None, *,
     applies to the device engine's batch-dimension mesh.
     """
     which = resolve_engine(engine)
+    if artifact_mod.is_segment_managed(path):
+        if which == "device":
+            raise artifact_mod.ArtifactError(
+                f"{path} is segment-managed: the device engine serves "
+                "single artifacts only (use host or auto, which route "
+                "to the multi-segment engine)")
+        from .multi_engine import MultiSegmentEngine
+        return MultiSegmentEngine(path, cache_terms=cache_terms)
     if which == "device":
         from .device_engine import DeviceEngine
         return DeviceEngine(path, cache_terms=cache_terms, shards=shards)

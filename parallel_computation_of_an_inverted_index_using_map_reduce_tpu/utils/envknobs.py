@@ -238,6 +238,20 @@ declare("MRI_SERVE_CROSSOVER", int, None,
         "it by measurement, 0 pins host, N>0 routes batches >= N to "
         "the device engine.",
         scope="serve")
+declare("MRI_SEGMENT_COMPACT_TRIGGER", int, 4,
+        "Segment count at which compaction kicks in; also the width "
+        "of the adjacent merge window each round folds.",
+        scope="serve", minimum=2)
+declare("MRI_SEGMENT_MAX_SEGMENTS", int, 16,
+        "Hard segment-count backstop: the daemon auto-compacts after "
+        "an append while the live set exceeds it.",
+        scope="serve", minimum=1)
+declare("MRI_SEGMENT_TOMBSTONE_FLUSH", int, 1,
+        "Daemon delete batching: buffer delete ops and publish ONE "
+        "tombstone generation every N ops (N=1 publishes immediately; "
+        "a compact or drain flushes the remainder; CLI deletes always "
+        "publish).",
+        scope="serve", minimum=1)
 
 # -- observability ----------------------------------------------------
 declare("MRI_OBS_ENABLE", int, 1,

@@ -1,0 +1,587 @@
+"""Incremental indexing: segment manifests, live append/delete, and
+background compaction (`segments/`, serve/multi_engine.py).
+
+The load-bearing invariant is BYTE-IDENTITY: a multi-segment directory
+at any live state (after any append/delete/compact sequence) must
+answer df / postings / boolean / BM25 top-k exactly like a from-scratch
+single-artifact build of the same documents, with global doc ids
+remapped densely by rank.  BM25 scores are compared with ``==`` — the
+global-stats seam (summed doc-lens, count-nonzero ndocs, nonzero-mean
+avgdl, live global df injected per segment) is engineered to make the
+floats bitwise equal, not merely close.
+
+The rest of the file pins the lifecycle contract: atomic generation
+swap (torn manifests rejected whole), tombstone integrity, compaction
+preserving global ids while dropping tombstones, the three segment
+fault kinds leaving the old generation serving, engine routing guards,
+and the CLI + daemon admin surfaces.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import random
+
+import numpy as np
+import pytest
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+    segments,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.audit import (
+    verify_output_dir,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    main,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.segments import (
+    tombstones as tomb_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.artifact import (
+    ArtifactError, artifact_path, is_segment_managed,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+    Engine, create_engine,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.multi_engine import (
+    MultiSegmentEngine,
+)
+
+pytestmark = pytest.mark.segments
+
+
+# -- corpus helpers -----------------------------------------------------
+
+# pure-alphabetic vocabulary: the tokenizer strips digits, so suffixes
+# must be letters or distinct words would collapse to one term
+_WORDS = [f"{c}word{s}" for c in "abcgkpz" for s in "abcdef"]
+
+
+def make_docs(tmp_path, specs, prefix="doc"):
+    """One file per token list; returns (paths, token lists)."""
+    ddir = tmp_path / f"{prefix}-docs"
+    ddir.mkdir(exist_ok=True)
+    paths = []
+    for i, words in enumerate(specs):
+        p = ddir / f"{prefix}{i:04d}.txt"
+        p.write_text(" ".join(words) + "\n", encoding="ascii")
+        paths.append(str(p))
+    return paths, list(specs)
+
+
+def doc_specs(rng, n, tokens=(10, 25)):
+    return [[_WORDS[rng.randrange(len(_WORDS))]
+             for _ in range(rng.randrange(*tokens))] for _ in range(n)]
+
+
+def build_reference(tmp_path, token_lists, name="ref"):
+    """From-scratch single-artifact build of exactly these documents."""
+    paths, _ = make_docs(tmp_path, token_lists, prefix=name)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+    listfile = tmp_path / f"{name}-list.txt"
+    write_manifest(listfile, paths)
+    out = tmp_path / f"{name}-out"
+    assert main(["1", "1", str(listfile), "--backend", "cpu",
+                 "--output-dir", str(out), "--artifact"]) == 0
+    return out
+
+
+def assert_state_identical(idx_dir, truth: dict, tmp_path, tag=""):
+    """The acceptance-criteria check: multi-segment answers ==
+    from-scratch single-artifact answers for the same live docs."""
+    live = sorted(truth)
+    remap = {gid: i + 1 for i, gid in enumerate(live)}
+    ref = build_reference(tmp_path, [truth[g] for g in live],
+                          name=f"ref{tag}{len(live)}")
+    vocab = sorted({w for words in truth.values() for w in words})
+    with create_engine(str(idx_dir), None) as em, \
+            Engine(artifact_path(ref)) as er:
+        bm, br = em.encode_batch(vocab), er.encode_batch(vocab)
+        assert em.df(bm).tolist() == er.df(br).tolist()
+        for t, pm, pr in zip(vocab, em.postings(bm), er.postings(br)):
+            got = [] if pm is None else [remap[g] for g in pm.tolist()]
+            want = [] if pr is None else pr.tolist()
+            assert got == want, t
+        for pair in ([vocab[0], vocab[-1]], vocab[:2], vocab[-2:]):
+            for op in ("query_and", "query_or"):
+                got = [remap[g] for g in getattr(em, op)(
+                    em.encode_batch(pair)).tolist()]
+                assert got == getattr(er, op)(
+                    er.encode_batch(pair)).tolist(), (op, pair)
+        for q in ([vocab[0]], vocab[:3], [vocab[-1], vocab[1]]):
+            for k in (1, 3, 10, 100):
+                got = [(remap[g], s) for g, s in
+                       em.top_k_scored(em.encode_batch(q), k)]
+                want = er.top_k_scored(er.encode_batch(q), k)
+                assert got == want, (q, k)  # exact floats, exact order
+
+
+# -- manifest integrity -------------------------------------------------
+
+
+def test_manifest_round_trip(tmp_path):
+    e = segments.SegmentEntry(name="seg_1_0", doc_base=0, docs=4,
+                              adler32="0abc1234", bytes=512)
+    man = segments.SegmentManifest(generation=1, next_seg=1, entries=(e,))
+    segments.save_manifest(tmp_path, man, op="seed")
+    got = segments.load_manifest(tmp_path)
+    assert got == man
+    assert got.doc_span == 4
+    assert segments.is_segmented(tmp_path)
+    assert segments.load_manifest(tmp_path / "nowhere") is None
+
+
+def test_manifest_rejects_tampering(tmp_path):
+    e = segments.SegmentEntry(name="seg_1_0", doc_base=0, docs=4,
+                              adler32="0abc1234", bytes=512)
+    segments.save_manifest(
+        tmp_path, segments.SegmentManifest(1, 1, (e,)), op="seed")
+    path = segments.manifest_path(tmp_path)
+    doc = json.loads(path.read_text())
+    doc["generation"] = 9  # body edit without checksum update
+    path.write_text(json.dumps(doc))
+    with pytest.raises(segments.SegmentError, match="checksum"):
+        segments.load_manifest(tmp_path)
+    path.write_text(path.read_text()[: path.stat().st_size // 2])
+    with pytest.raises(segments.SegmentError, match="torn"):
+        segments.load_manifest(tmp_path)
+
+
+def test_manifest_rejects_overlapping_ranges(tmp_path):
+    es = (segments.SegmentEntry("a", 0, 5, "00", 1),
+          segments.SegmentEntry("b", 3, 5, "00", 1))
+    segments.save_manifest(
+        tmp_path, segments.SegmentManifest(1, 2, es), op="seed")
+    with pytest.raises(segments.SegmentError, match="overlap"):
+        segments.load_manifest(tmp_path)
+
+
+def test_tombstone_round_trip_and_corruption(tmp_path):
+    bits = np.zeros(37, dtype=bool)
+    bits[[0, 5, 36]] = True
+    p = tmp_path / "tombstones_3.bin"
+    crc, size = tomb_mod.save(p, bits)
+    assert p.stat().st_size == size
+    assert tomb_mod.load(p, ndocs=37).tolist() == bits.tolist()
+    raw = bytearray(p.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    with pytest.raises(segments.SegmentError):
+        tomb_mod.load(p, ndocs=37)
+
+
+# -- append / delete / compact lifecycle --------------------------------
+
+
+def test_append_seeds_from_batch_artifact(tmp_path):
+    rng = random.Random(3)
+    base = doc_specs(rng, 5)
+    out = build_reference(tmp_path, base, name="seed")
+    paths, extra = make_docs(tmp_path, doc_specs(rng, 3), prefix="extra")
+    res = segments.append_files(out, paths)
+    # the batch-built docs keep ids 1..5; appends continue at 6
+    assert res["doc_ids"] == [6, 7, 8]
+    assert res["generation"] == 2 and res["segments"] == 2
+    assert is_segment_managed(out)
+    truth = {i + 1: w for i, w in enumerate(base + extra)}
+    assert_state_identical(out, truth, tmp_path, tag="seed")
+    ok, problems = verify_output_dir(out)
+    assert ok, problems
+
+
+def test_append_delete_compact_byte_identity(tmp_path):
+    """The acceptance sequence: appends, deletes (incl. re-delete),
+    compaction — identical to from-scratch at every step."""
+    rng = random.Random(7)
+    idx = tmp_path / "idx"
+    truth: dict[int, list[str]] = {}
+    gid = 1
+    for batch in range(3):
+        specs = doc_specs(rng, 4)
+        paths, _ = make_docs(tmp_path, specs, prefix=f"b{batch}")
+        res = segments.append_files(idx, paths)
+        assert res["doc_base"] == gid - 1
+        for w in specs:
+            truth[gid] = w
+            gid += 1
+    assert_state_identical(idx, truth, tmp_path, tag="a")
+    res = segments.delete_docs(idx, [2, 7, 11])
+    assert res["newly_tombstoned"] == 3
+    for g in (2, 7, 11):
+        del truth[g]
+    assert_state_identical(idx, truth, tmp_path, tag="d")
+    # idempotent re-delete
+    assert segments.delete_docs(idx, [7])["newly_tombstoned"] == 0
+    res = segments.compact(idx, force=True)
+    assert res["compacted"] and res["tombstones_dropped"] == 3
+    man = segments.load_manifest(idx)
+    assert len(man.entries) < 3  # a run was folded
+    assert_state_identical(idx, truth, tmp_path, tag="c")
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+
+
+def test_block_boundary_dfs(tmp_path, monkeypatch):
+    """A term whose merged posting list spans several v2 blocks (tiny
+    block size) must keep exact df/ranking parity across segments and
+    through compaction — the skip-table seams are where off-by-ones
+    would live."""
+    monkeypatch.setenv("MRI_SERVE_BLOCK_SIZE", "8")
+    rng = random.Random(11)
+    idx = tmp_path / "idx"
+    truth, gid = {}, 1
+    for batch in range(3):
+        # every doc carries the common term -> 30 postings over
+        # block_size=8 spans 4 blocks; plus per-doc filler
+        specs = [["awordqq"] * (1 + int(rng.randrange(3)))
+                 + [_WORDS[rng.randrange(len(_WORDS))] for _ in range(6)]
+                 for _ in range(10)]
+        paths, _ = make_docs(tmp_path, specs, prefix=f"bb{batch}")
+        segments.append_files(idx, paths)
+        for w in specs:
+            truth[gid] = w
+            gid += 1
+    with create_engine(str(idx), None) as em:
+        assert em.df(em.encode_batch(["awordqq"])).tolist() == [30]
+    assert_state_identical(idx, truth, tmp_path, tag="bb")
+    segments.delete_docs(idx, [1, 8, 9, 16, 17, 24])  # block edges
+    for g in (1, 8, 9, 16, 17, 24):
+        del truth[g]
+    assert_state_identical(idx, truth, tmp_path, tag="bbd")
+    segments.compact(idx, force=True)
+    assert_state_identical(idx, truth, tmp_path, tag="bbc")
+
+
+def test_compact_preserves_global_ids(tmp_path):
+    rng = random.Random(19)
+    idx = tmp_path / "idx"
+    for batch in range(3):
+        paths, _ = make_docs(tmp_path, doc_specs(rng, 3),
+                             prefix=f"g{batch}")
+        segments.append_files(idx, paths)
+    segments.delete_docs(idx, [4])
+    before = segments.load_manifest(idx)
+    res = segments.compact(idx, force=True)
+    after = segments.load_manifest(idx)
+    assert after.generation == before.generation + 1
+    assert after.doc_span == before.doc_span  # ids never renumber
+    assert sum(e.tomb_count for e in after.entries) == 0
+    # next append continues past the preserved span
+    paths, _ = make_docs(tmp_path, doc_specs(rng, 2), prefix="g9")
+    assert segments.append_files(idx, paths)["doc_ids"] == [10, 11]
+    # retired inputs stay on disk for live readers until pruned
+    retired = set(res["inputs"])
+    names = {p.name for p in segments.segments_root(idx).iterdir()}
+    assert retired <= names
+    pruned = segments.prune_retired(idx)
+    assert retired <= set(pruned)
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+
+
+def test_compact_trigger_and_force(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRI_SEGMENT_COMPACT_TRIGGER", "4")
+    rng = random.Random(23)
+    idx = tmp_path / "idx"
+    for batch in range(2):
+        paths, _ = make_docs(tmp_path, doc_specs(rng, 2),
+                             prefix=f"t{batch}")
+        segments.append_files(idx, paths)
+    res = segments.compact(idx)  # 2 < trigger: no-op
+    assert not res["compacted"] and "trigger" in res["reason"]
+    assert segments.compact(idx, force=True)["compacted"]
+
+
+def test_delete_validation(tmp_path):
+    rng = random.Random(29)
+    idx = tmp_path / "idx"
+    paths, _ = make_docs(tmp_path, doc_specs(rng, 3), prefix="v")
+    segments.append_files(idx, paths)
+    with pytest.raises(segments.SegmentError, match="outside every"):
+        segments.delete_docs(idx, [99])
+    with pytest.raises(segments.SegmentError, match="at least one"):
+        segments.delete_docs(idx, [])
+
+
+# -- fault kinds: the old generation keeps serving ----------------------
+
+
+def _armed(kind):
+    faults.install(kind)
+    faults.begin_run()
+
+
+def test_append_torn_manifest_keeps_old_generation(tmp_path):
+    rng = random.Random(31)
+    idx = tmp_path / "idx"
+    paths, specs = make_docs(tmp_path, doc_specs(rng, 3), prefix="f0")
+    segments.append_files(idx, paths)
+    before = segments.load_manifest(idx)
+    truth = {i + 1: w for i, w in enumerate(specs)}
+    more, _ = make_docs(tmp_path, doc_specs(rng, 2), prefix="f1")
+    _armed("append-torn-manifest")
+    try:
+        with pytest.raises(segments.SegmentError, match="publish"):
+            segments.append_files(idx, more)
+    finally:
+        faults.install(None)
+    after = segments.load_manifest(idx)
+    assert after == before  # generation unchanged, byte-intact
+    names = {p.name for p in segments.segments_root(idx).iterdir()}
+    assert names == {e.name for e in before.entries}  # no orphans
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+    assert_state_identical(idx, truth, tmp_path, tag="torn")
+    # budget spent: the retry lands
+    assert segments.append_files(idx, more)["generation"] == 2
+
+
+def test_tombstone_corrupt_rejected(tmp_path):
+    rng = random.Random(37)
+    idx = tmp_path / "idx"
+    paths, _ = make_docs(tmp_path, doc_specs(rng, 3), prefix="tc")
+    segments.append_files(idx, paths)
+    before = segments.load_manifest(idx)
+    _armed("tombstone-corrupt")
+    try:
+        with pytest.raises(segments.SegmentError):
+            segments.delete_docs(idx, [1])
+    finally:
+        faults.install(None)
+    after = segments.load_manifest(idx)
+    assert after == before
+    assert sum(e.tomb_count for e in after.entries) == 0
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+    assert segments.delete_docs(idx, [1])["newly_tombstoned"] == 1
+
+
+def test_compact_crash_old_generation_intact(tmp_path):
+    rng = random.Random(41)
+    idx = tmp_path / "idx"
+    for batch in range(2):
+        paths, _ = make_docs(tmp_path, doc_specs(rng, 2),
+                             prefix=f"cc{batch}")
+        segments.append_files(idx, paths)
+    before = segments.load_manifest(idx)
+    _armed("compact-crash")
+    try:
+        with pytest.raises(faults.InjectedCompactCrash):
+            segments.compact(idx, force=True)
+    finally:
+        faults.install(None)
+    assert segments.load_manifest(idx) == before
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+    # crash left at worst an orphan build; the retry converges
+    res = segments.compact(idx, force=True)
+    assert res["compacted"]
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+
+
+# -- engine routing guards ----------------------------------------------
+
+
+def test_engine_guards_and_routing(tmp_path):
+    rng = random.Random(43)
+    base = doc_specs(rng, 4)
+    out = build_reference(tmp_path, base, name="guard")
+    paths, _ = make_docs(tmp_path, doc_specs(rng, 2), prefix="guard2")
+    segments.append_files(out, paths)
+    # the root index.mri is now STALE: single-artifact engines must
+    # refuse rather than silently serve the pre-append state
+    with pytest.raises(ArtifactError, match="segment-managed"):
+        Engine(artifact_path(out))
+    eng = create_engine(str(out), None)
+    try:
+        assert isinstance(eng, MultiSegmentEngine)
+        assert eng.engine_name == "multi"
+        d = eng.describe()
+        assert d["generation"] == 2 and len(d["segments"]) == 2
+    finally:
+        eng.close()
+    with pytest.raises(ArtifactError, match="device"):
+        create_engine(str(out), "device")
+
+
+def test_multi_engine_stats_parity(tmp_path):
+    """Global (ndocs, avgdl) from summed per-segment stats equals the
+    from-scratch corpus stats — the seam that makes BM25 bitwise
+    identical."""
+    rng = random.Random(47)
+    idx = tmp_path / "idx"
+    truth, gid = {}, 1
+    for batch in range(2):
+        specs = doc_specs(rng, 3)
+        paths, _ = make_docs(tmp_path, specs, prefix=f"s{batch}")
+        segments.append_files(idx, paths)
+        for w in specs:
+            truth[gid] = w
+            gid += 1
+    segments.delete_docs(idx, [3])
+    del truth[3]
+    ref = build_reference(tmp_path, [truth[g] for g in sorted(truth)])
+    with create_engine(str(idx), None) as em, \
+            Engine(artifact_path(ref)) as er:
+        ndocs, avgdl = em.bm25_stats()
+        from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.artifact import (
+            bm25_corpus, load_artifact,
+        )
+        with load_artifact(artifact_path(ref)) as art:
+            _dl, ref_ndocs, ref_avgdl = bm25_corpus(art)
+        assert ndocs == ref_ndocs
+        assert avgdl == ref_avgdl  # exact, not approx
+
+
+# -- CLI surface --------------------------------------------------------
+
+
+def test_cli_append_delete_compact_verify(tmp_path, capsys):
+    rng = random.Random(53)
+    paths, _ = make_docs(tmp_path, doc_specs(rng, 3), prefix="cli")
+    idx = tmp_path / "idx"
+    assert main(["append", str(idx), "--add", *paths]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["generation"] == 1 and out["doc_ids"] == [1, 2, 3]
+    assert main(["delete", str(idx), "--docs", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tombstoned_total"] == 1
+    more, _ = make_docs(tmp_path, doc_specs(rng, 2), prefix="cli2")
+    assert main(["append", str(idx), "--add", *more]) == 0
+    capsys.readouterr()
+    assert main(["compact", str(idx), "--force", "--prune"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(lines[0])["compacted"]
+    assert json.loads(lines[1])["pruned"]
+    assert main(["--verify", str(idx)]) == 0
+    capsys.readouterr()
+    # error surfaces: bad ids exit 2, armed fault exits 2
+    assert main(["delete", str(idx), "--docs", "99"]) == 2
+    assert main(["append", str(idx), "--add", paths[0],
+                 "--fault-spec", "append-torn-manifest"]) == 2
+    capsys.readouterr()
+    assert main(["--verify", str(idx)]) == 0
+
+
+def test_cli_query_routes_multi_segment(tmp_path, capsys):
+    rng = random.Random(59)
+    paths, specs = make_docs(tmp_path, doc_specs(rng, 3), prefix="q")
+    idx = tmp_path / "idx"
+    assert main(["append", str(idx), "--add", *paths]) == 0
+    capsys.readouterr()
+    term = specs[0][0]
+    assert main(["query", str(idx), term]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    want = sorted(i + 1 for i, w in enumerate(specs) if term in w)
+    assert out["term"] == term and out["postings"] == want
+
+
+# -- daemon admin surface -----------------------------------------------
+
+
+@pytest.mark.daemon
+def test_daemon_live_mutations(tmp_path):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.daemon import (
+        ServeDaemon,
+    )
+    rng = random.Random(61)
+    paths, specs = make_docs(tmp_path, doc_specs(rng, 3), prefix="d")
+    idx = tmp_path / "idx"
+    segments.append_files(idx, paths)
+    term = specs[0][0]
+    base_df = sum(term in w for w in specs)
+    d = ServeDaemon(str(idx), port=0)
+    d.start()
+    try:
+        sock = socket.create_connection(d.address)
+        f = sock.makefile("rwb")
+
+        def rpc(**kw):
+            f.write((json.dumps(kw) + "\n").encode())
+            f.flush()
+            return json.loads(f.readline())
+
+        try:
+            assert rpc(id=1, op="df", terms=[term])["df"] == [base_df]
+            more, mspecs = make_docs(tmp_path, [[term, "zz"]] * 2,
+                                     prefix="d2")
+            r = rpc(id=2, op="append", files=more)
+            assert r["ok"] and r["result"]["doc_ids"] == [4, 5]
+            # visible to queries on the SAME connection immediately
+            assert rpc(id=3, op="df", terms=[term])["df"] == [base_df + 2]
+            r = rpc(id=4, op="delete", docs=[4])
+            assert r["ok"] and r["result"]["tombstoned_total"] == 1
+            assert rpc(id=5, op="df", terms=[term])["df"] == [base_df + 1]
+            r = rpc(id=6, op="compact")
+            assert r["ok"] and r["result"]["compacted"]
+            assert rpc(id=7, op="df", terms=[term])["df"] == [base_df + 1]
+            # failure path: old generation keeps serving, counted
+            r = rpc(id=8, op="append", files=["/nope/missing.txt"])
+            assert r["error"] == "mutation_rejected"
+            assert rpc(id=9, op="df", terms=[term])["df"] == [base_df + 1]
+            st = rpc(id=10, op="stats")["stats"]
+            assert st["counters"]["mutations"] == 3
+            assert st["counters"]["mutation_rejected"] == 1
+            assert st["engine"]["generation"] >= 4
+            # exposition: segment gauges present, no duplicate families
+            text = rpc(id=11, op="metrics")["text"]
+            assert "mri_generation" in text
+            assert "mri_serve_mutations_total 3" in text
+            fams = [ln.split()[2] for ln in text.splitlines()
+                    if ln.startswith("# TYPE ")]
+            assert len(fams) == len(set(fams))
+            # malformed mutation requests are bad_request, not crashes
+            assert rpc(id=12, op="append")["error"] == "bad_request"
+            assert rpc(id=13, op="delete",
+                       docs=["x"])["error"] == "bad_request"
+        finally:
+            f.close()
+            sock.close()
+    finally:
+        d.drain()
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+
+
+@pytest.mark.daemon
+def test_daemon_tombstone_flush_batching(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRI_SEGMENT_TOMBSTONE_FLUSH", "3")
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.daemon import (
+        ServeDaemon,
+    )
+    rng = random.Random(67)
+    paths, _ = make_docs(tmp_path, doc_specs(rng, 6), prefix="fl")
+    idx = tmp_path / "idx"
+    segments.append_files(idx, paths)
+    d = ServeDaemon(str(idx), port=0)
+    d.start()
+    try:
+        sock = socket.create_connection(d.address)
+        f = sock.makefile("rwb")
+
+        def rpc(**kw):
+            f.write((json.dumps(kw) + "\n").encode())
+            f.flush()
+            return json.loads(f.readline())
+
+        try:
+            assert rpc(id=1, op="delete", docs=[1])["result"]["buffered"]
+            assert rpc(id=2, op="delete", docs=[2])["result"]["buffered"]
+            r = rpc(id=3, op="delete", docs=[3])  # third op: flush
+            assert r["result"]["deleted"] == [1, 2, 3]
+            gen_after_flush = r["result"]["generation"]
+            assert rpc(id=4, op="delete", docs=[4])["result"]["buffered"]
+        finally:
+            f.close()
+            sock.close()
+    finally:
+        d.drain()  # drain publishes the buffered remainder
+    man = segments.load_manifest(idx)
+    assert man.generation == gen_after_flush + 1
+    assert sum(e.tomb_count for e in man.entries) == 4

@@ -32,6 +32,14 @@ Four modes, all printing ONE JSON line mirroring bench.py's shape:
                       >= 3x-vs-r09 throughput contract on the default
                       planner — written to --out-ranked
                       (BENCH_RANKED_r11.json, make bench-serve-ranked)
+  --segments-ab       incremental-indexing A/B (make bench-segments):
+                      append->visible refresh latency on a live segment
+                      directory, query QPS at 1/4/16 segments vs the
+                      single-artifact baseline over the same docs
+                      (byte-parity gated: df/postings/boolean/BM25
+                      answers must be identical), and the cost of
+                      compacting the 16-segment run back to one —
+                      written to --out-segments (BENCH_SEGMENTS_r12.json)
   --daemon-bench      the resident-daemon sweep (make bench-daemon):
                       pipelined coalesced capacity + closed-loop rpc
                       floor vs the in-process batch-1 baseline, then an
@@ -1086,6 +1094,178 @@ def _scrape_check(out_path: str | None) -> dict:
     return line
 
 
+# -- incremental-indexing A/B (segments/ vs single artifact) ------------
+
+
+def _assert_segment_parity(base, multi, terms: list[str], rng) -> int:
+    """Exact-answer gate between the single-artifact baseline and a
+    multi-segment engine over the SAME docs appended in the same order:
+    global ids line up 1:1, so every answer — including BM25 floats —
+    must be equal, not close.  Returns the number of compared answers."""
+    checked = 0
+    for bsz in (1, 7, 64):
+        sample = [terms[int(i)] for i in
+                  rng.integers(0, len(terms), size=bsz)]
+        bb, bm = base.encode_batch(sample), multi.encode_batch(sample)
+        assert base.df(bb).tolist() == multi.df(bm).tolist(), bsz
+        for a, b in zip(base.postings(bb), multi.postings(bm)):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert np.array_equal(a, b)
+        checked += 2 * bsz
+    for _ in range(50):
+        pair = [terms[int(i)] for i in rng.integers(0, len(terms), size=2)]
+        bb, bm = base.encode_batch(pair), multi.encode_batch(pair)
+        assert base.query_and(bb).tolist() == multi.query_and(bm).tolist()
+        assert base.query_or(bb).tolist() == multi.query_or(bm).tolist()
+        for k in (1, 10, 100):
+            assert base.top_k_scored(bb, k) == multi.top_k_scored(bm, k)
+        checked += 5
+    return checked
+
+
+def _measure_refresh(paths: list[str], seed_docs: int,
+                     appends: int) -> dict:
+    """Append-to-visible latency: one doc per append against a live
+    segment directory, timed from the append call to a fresh engine
+    having answered a ranked query over the new generation."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        segments,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+        create_engine,
+    )
+
+    idx = os.path.join(bench._scratch_mkdtemp("bench_seg_live_"), "idx")
+    segments.append_files(idx, paths[:seed_docs])
+    lat = np.empty(appends)
+    for i in range(appends):
+        t0 = time.perf_counter()
+        segments.append_files(idx, [paths[seed_docs + i]])
+        eng = create_engine(idx, None)
+        d = eng.describe()
+        assert d["ndocs"] == seed_docs + i + 1, d
+        eng.top_k_scored(eng.encode_batch(["the"]), 10)
+        eng.close()
+        lat[i] = time.perf_counter() - t0
+    return {
+        "seed_docs": seed_docs,
+        "appends": appends,
+        "refresh_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "refresh_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+    }
+
+
+def _segments_ab(out_path: str | None) -> dict:
+    """`--segments-ab`: the incremental-indexing cost surface.
+
+    The same corpus is served four ways — the from-scratch single
+    artifact and segment directories built by 1, 4, and 16 appends —
+    and every segmented leg must answer byte-identically to the
+    baseline before its throughput counts.  Refresh latency and the
+    cost of compacting the 16-segment run close the loop: what a live
+    append costs, what the fan-out costs at query time, and what it
+    costs to pay the debt down."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        IndexConfig, InvertedIndexModel, segments,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+        create_engine,
+    )
+
+    manifest, corpus_metric = bench._manifest()
+    paths = list(manifest.paths)
+    rng = np.random.default_rng(SEED)
+
+    base_dir = bench._scratch_mkdtemp("bench_segab_base_")
+    report = InvertedIndexModel(IndexConfig(
+        backend="cpu", output_dir=base_dir, artifact=True)).run(manifest)
+    base = Engine(os.path.join(base_dir, "index.mri"))
+    terms = _zipf_terms(base, LOOKUPS, rng)
+
+    def leg(engine) -> dict:
+        res = _measure_batches(engine, terms, 32,
+                               max_batches=AB_MAX_BATCHES)
+        res.update(_measure_boolean(engine, terms))
+        res.update(_measure_bm25(engine, terms))
+        return res
+
+    legs = {"single_artifact": leg(base)}
+    parity_checked = 0
+    seg_dirs = {}
+    for k in (1, 4, 16):
+        idx = os.path.join(bench._scratch_mkdtemp(f"bench_segab{k}_"),
+                           "idx")
+        chunks = np.array_split(np.arange(len(paths)), k)
+        t0 = time.perf_counter()
+        for c in chunks:
+            segments.append_files(idx, [paths[int(i)] for i in c])
+        build_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        seg_dirs[k] = idx
+        with create_engine(idx, None) as em:
+            parity_checked += _assert_segment_parity(base, em, terms, rng)
+            legs[f"segments_{k}"] = dict(leg(em), append_build_ms=build_ms)
+        print(f"# segments_{k}: parity ok, {legs[f'segments_{k}']}",
+              file=sys.stderr, flush=True)
+
+    refresh = _measure_refresh(paths, seed_docs=min(40, len(paths) - 13),
+                               appends=12)
+
+    # pay the fan-out down: each compact k-way merges one run of
+    # segments, so drive it until a single segment remains
+    t0 = time.perf_counter()
+    rounds, compact_ms, merged_bytes = 0, 0.0, 0
+    while True:
+        cres = segments.compact(seg_dirs[16], force=True)
+        assert cres["compacted"], cres
+        rounds += 1
+        compact_ms += float(cres.get("compact_ms") or 0.0)
+        merged_bytes += int(cres.get("bytes") or 0)
+        if cres["segments"] == 1:
+            break
+    compact_wall_ms = round((time.perf_counter() - t0) * 1e3, 1)
+    with create_engine(seg_dirs[16], None) as em:
+        parity_checked += _assert_segment_parity(base, em, terms, rng)
+        compacted_leg = leg(em)
+
+    base_and = legs["single_artifact"]["boolean_and_qps"]
+    line = {
+        "metric": "segments_16_boolean_and_qps_vs_single",
+        "value": round(
+            legs["segments_16"]["boolean_and_qps"] / base_and, 4),
+        "unit": "x single-artifact AND QPS at 16 segments",
+        "corpus_metric": corpus_metric,
+        "docs": len(paths),
+        "zipf_s": ZIPF_S,
+        "vocab": base.vocab_size,
+        "parity_checked": parity_checked,
+        "legs": legs,
+        "refresh": refresh,
+        "compaction": {
+            "wall_ms": compact_wall_ms,
+            "compact_ms": round(compact_ms, 1),
+            "rounds": rounds,
+            "merged_bytes": merged_bytes,
+            "final_bytes": int(cres.get("bytes") or 0),
+            "after": compacted_leg,
+        },
+        "qps_vs_single": {
+            f"segments_{k}": round(
+                legs[f"segments_{k}"]["boolean_and_qps"] / base_and, 4)
+            for k in (1, 4, 16)},
+        "artifact_bytes_single": int(report.get("artifact_bytes", 0)),
+        "host_cores": os.cpu_count(),
+        "scratch": bench._scratch_backing(),
+    }
+    base.close()
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
 # -- default closed-loop host bench (the r05 shape, unchanged) ----------
 
 
@@ -1199,6 +1379,13 @@ def main(argv: list[str] | None = None) -> int:
                         "capacity")
     p.add_argument("--out-daemon", default="BENCH_DAEMON_r07.json",
                    help="where --daemon-bench writes its JSON report")
+    p.add_argument("--segments-ab", action="store_true",
+                   help="incremental-indexing A/B: append->visible "
+                        "refresh latency, QPS at 1/4/16 segments vs "
+                        "the single-artifact baseline (byte-parity "
+                        "gated), and compaction cost")
+    p.add_argument("--out-segments", default="BENCH_SEGMENTS_r12.json",
+                   help="where --segments-ab writes its JSON report")
     p.add_argument("--scrape-check", action="store_true",
                    help="observability overhead gate: Prometheus-vs-"
                         "stats counter parity on a live daemon, then "
@@ -1208,7 +1395,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="where --scrape-check writes its JSON report")
     args = p.parse_args(argv)
 
-    if args.scrape_check:
+    if args.segments_ab:
+        line = _segments_ab(args.out_segments)
+    elif args.scrape_check:
         line = _scrape_check(args.out_scrape)
     elif args.daemon_bench:
         line = _daemon_bench(args.out_daemon)

@@ -32,6 +32,21 @@ drains to exit 0, and nothing ever hangs past the deadline:
 
     python tools/chaos.py --daemon --trials 12 --seed-base 7000
     python tools/chaos.py --daemon --repro 7003
+
+``--segments`` soaks the incremental-indexing subsystem: each seeded
+trial drives a random append/delete/compact schedule against one index
+directory while reader threads concurrently open engines and query it,
+with segment fault kinds (``append-torn-manifest`` / ``compact-crash``
+/ ``tombstone-corrupt``) armed mid-schedule on half the trials.  The
+contract per trial: every mutation either publishes a new generation
+or rejects leaving the old one byte-intact (``--verify`` passes after
+EVERY op), concurrent readers never crash and always see an internally
+consistent generation, and the final live state answers df / postings
+/ boolean / BM25 top-k byte-identically to a from-scratch
+single-artifact build of the same documents:
+
+    python tools/chaos.py --segments --trials 24 --seed-base 9000
+    python tools/chaos.py --segments --repro 9007
 """
 
 from __future__ import annotations
@@ -563,6 +578,337 @@ def run_daemon_soak(work_dir: Path, trials: int, seed_base: int,
     }
 
 
+# -- segments soak ------------------------------------------------------
+#
+# The incremental-indexing contract under concurrent chaos: mutations
+# publish-or-reject atomically (every surviving generation byte-
+# auditable), readers racing the mutators never see a torn state, and
+# the end state is byte-identical to a from-scratch build.
+
+SEGMENT_FAULT_KINDS = ("append-torn-manifest", "compact-crash",
+                       "tombstone-corrupt")
+
+_SEG_LETTERS = "abcdeghknprs"
+# 40 pure-alpha suffixes: the tokenizer strips digits, so numeric
+# suffixes would collapse the whole vocabulary to one term per letter
+_SEG_SUFFIX = [a + b for a in "abcde" for b in "abcdefgh"]
+
+
+def _seg_write_docs(droot: Path, rng: random.Random, ids):
+    """One tiny text file per global doc id; returns (paths, tokens)."""
+    droot.mkdir(parents=True, exist_ok=True)
+    paths, toks = [], []
+    for gid in ids:
+        words = [f"{rng.choice(_SEG_LETTERS)}w{_SEG_SUFFIX[rng.randrange(40)]}"
+                 for _ in range(rng.randrange(15, 35))]
+        p = droot / f"doc{gid:04d}.txt"
+        p.write_text(" ".join(words) + "\n", encoding="ascii")
+        paths.append(str(p))
+        toks.append(words)
+    return paths, toks
+
+
+def _seg_reader_loop(idx: Path, stop: threading.Event, seed: int,
+                     errors: list):
+    """Concurrent reader: open an engine over whatever generation is
+    live, check df == len(postings) per probe term (a generation-
+    internal invariant no racing mutation may break), run one ranked
+    query, close.  Any exception or inconsistency fails the trial."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.segments import (  # noqa: E501
+        load_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (  # noqa: E501
+        create_engine,
+    )
+
+    rng = random.Random(seed)
+    while not stop.is_set():
+        try:
+            man = load_manifest(idx)
+            if man is None or not man.entries:
+                time.sleep(0.002)
+                continue
+            terms = [f"{rng.choice(_SEG_LETTERS)}w{_SEG_SUFFIX[rng.randrange(40)]}"
+                     for _ in range(4)]
+            eng = create_engine(str(idx), None)
+            try:
+                batch = eng.encode_batch(terms)
+                df = eng.df(batch).tolist()
+                posts = eng.postings(batch)
+                for t, d, p in zip(terms, df, posts):
+                    n = 0 if p is None else len(p)
+                    if d != n:
+                        errors.append(
+                            f"df/postings mismatch for {t!r}: df={d} "
+                            f"len(postings)={n} gen={man.generation}")
+                        return
+                eng.top_k_scored(eng.encode_batch(terms[:2]), 5)
+            finally:
+                eng.close()
+        except Exception as e:  # noqa: BLE001 — any reader crash fails
+            errors.append(f"reader: {type(e).__name__}: {e}")
+            return
+
+
+def _seg_final_parity(idx: Path, truth: dict, work: Path) -> str | None:
+    """The decisive check: the live multi-segment state must answer
+    byte-identically to a from-scratch single-artifact build of the
+    same documents (global ids remapped densely by rank)."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (  # noqa: E501
+        create_engine,
+    )
+
+    live = sorted(truth)
+    if not live:
+        return None
+    remap = {gid: i + 1 for i, gid in enumerate(live)}
+    ref_docs = work / "ref-docs"
+    ref_docs.mkdir(parents=True, exist_ok=True)
+    ref_paths = []
+    for gid in live:
+        p = ref_docs / f"ref{gid:04d}.txt"
+        p.write_text(" ".join(truth[gid]) + "\n", encoding="ascii")
+        ref_paths.append(str(p))
+    write_manifest(work / "ref-list.txt", ref_paths)
+    ref_out = work / "ref-out"
+    build_index(read_manifest(work / "ref-list.txt"),
+                IndexConfig(backend="cpu", num_mappers=1, num_reducers=1,
+                            artifact=True),
+                output_dir=ref_out)
+    vocab = sorted({w for words in truth.values() for w in words})
+    rng = random.Random(0xC0FFEE)
+    eng_m = create_engine(str(idx), None)
+    eng_r = create_engine(str(ref_out), None)
+    try:
+        batch_m = eng_m.encode_batch(vocab)
+        batch_r = eng_r.encode_batch(vocab)
+        df_m = eng_m.df(batch_m).tolist()
+        df_r = eng_r.df(batch_r).tolist()
+        if df_m != df_r:
+            bad = [(t, a, b) for t, a, b in zip(vocab, df_m, df_r)
+                   if a != b][:3]
+            return f"df mismatch vs from-scratch build: {bad}"
+        posts_m = eng_m.postings(batch_m)
+        posts_r = eng_r.postings(batch_r)
+        for t, pm, pr in zip(vocab, posts_m, posts_r):
+            got = [] if pm is None else [remap[g] for g in pm.tolist()]
+            want = [] if pr is None else pr.tolist()
+            if got != want:
+                return (f"postings mismatch for {t!r}: got {got[:6]} "
+                        f"want {want[:6]}")
+        for _ in range(8):
+            pair = rng.sample(vocab, min(2, len(vocab)))
+            for op in ("query_and", "query_or"):
+                got = [remap[g] for g in getattr(eng_m, op)(
+                    eng_m.encode_batch(pair)).tolist()]
+                want = getattr(eng_r, op)(
+                    eng_r.encode_batch(pair)).tolist()
+                if got != want:
+                    return f"{op} mismatch for {pair}: {got} != {want}"
+        for _ in range(8):
+            q = rng.sample(vocab, min(rng.randrange(1, 4), len(vocab)))
+            k = rng.choice((1, 3, 10))
+            got = [(remap[g], s) for g, s in
+                   eng_m.top_k_scored(eng_m.encode_batch(q), k)]
+            want = eng_r.top_k_scored(eng_r.encode_batch(q), k)
+            if got != want:
+                return (f"bm25 top-{k} mismatch for {q}: "
+                        f"{got} != {want}")
+    finally:
+        eng_m.close()
+        eng_r.close()
+    return None
+
+
+def run_segments_trial(work_dir: Path, seed: int,
+                       deadline_s: float = 120.0) -> dict:
+    """One seeded segments trial; ``ok`` False only on a contract
+    violation (hang, reader crash/inconsistency, failed byte-audit,
+    generation regression, or end-state divergence)."""
+    verdict = {"seed": seed, "ok": False, "outcome": "?"}
+    box: dict = {}
+
+    def target():
+        try:
+            box["result"] = _segments_schedule(work_dir, seed, verdict)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            box["error"] = e
+        finally:
+            faults.install(None)
+
+    t0 = time.monotonic()
+    th = threading.Thread(target=target, daemon=True,
+                          name=f"chaos-seg-{seed}")
+    th.start()
+    th.join(deadline_s)
+    verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+    if th.is_alive():
+        verdict["outcome"] = "HANG"
+        return verdict
+    if "error" in box:
+        e = box["error"]
+        verdict["outcome"] = f"error:{type(e).__name__}"
+        verdict["error"] = "".join(
+            traceback.format_exception_only(type(e), e)).strip()
+        return verdict
+    err = box["result"]
+    if err:
+        verdict["outcome"] = "violation"
+        verdict["error"] = err
+        return verdict
+    verdict["outcome"] = "clean"
+    verdict["ok"] = True
+    return verdict
+
+
+def _segments_schedule(work_dir: Path, seed: int,
+                       verdict: dict) -> str | None:
+    """The trial body: random mutation schedule + concurrent readers +
+    per-op byte-audit + final from-scratch parity.  Returns an error
+    string on the first contract violation, else None."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (  # noqa: E501
+        segments,
+    )
+
+    rng = random.Random(seed)
+    work = work_dir / f"seg-{seed}"
+    idx = work / "idx"
+    work.mkdir(parents=True, exist_ok=True)
+    fault_kind = rng.choice(SEGMENT_FAULT_KINDS) \
+        if rng.random() < 0.5 else None
+    verdict["fault"] = fault_kind
+    truth: dict[int, list[str]] = {}
+    next_gid = 1
+    generation = 0
+    ops_log = []
+    stop = threading.Event()
+    reader_errors: list[str] = []
+    readers = [threading.Thread(
+        target=_seg_reader_loop, args=(idx, stop, seed + 100 + i,
+                                       reader_errors),
+        daemon=True, name=f"chaos-seg-read-{seed}-{i}")
+        for i in range(2)]
+
+    def audit(tag: str) -> str | None:
+        man = segments.load_manifest(idx)
+        if man is None:
+            return None
+        nonlocal generation
+        if man.generation < generation:
+            return (f"{tag}: generation regressed "
+                    f"{generation} -> {man.generation}")
+        generation = man.generation
+        ok, problems = verify_output_dir(idx)
+        if not ok:
+            return f"{tag}: --verify failed: {problems[:3]}"
+        return None
+
+    try:
+        n_ops = rng.randrange(7, 11)
+        fault_at = rng.randrange(1, n_ops) if fault_kind else -1
+        for step in range(n_ops):
+            if step == 1:
+                for r in readers:
+                    r.start()
+            armed = step == fault_at
+            if armed:
+                faults.install(fault_kind)
+                faults.begin_run()
+            # first op must append; afterwards weight toward appends so
+            # delete/compact always have something to chew on
+            roll = 0.0 if step == 0 else rng.random()
+            try:
+                if roll < 0.5 or not truth:
+                    ids = list(range(next_gid,
+                                     next_gid + rng.randrange(2, 5)))
+                    paths, toks = _seg_write_docs(work / "docs", rng, ids)
+                    segments.append_files(idx, paths)
+                    for gid, words in zip(ids, toks):
+                        truth[gid] = words
+                    next_gid = ids[-1] + 1
+                    ops_log.append(("append", len(ids)))
+                elif roll < 0.8:
+                    victims = rng.sample(sorted(truth),
+                                         min(rng.randrange(1, 4),
+                                             len(truth)))
+                    segments.delete_docs(idx, victims)
+                    for gid in victims:
+                        del truth[gid]
+                    ops_log.append(("delete", len(victims)))
+                else:
+                    res = segments.compact(idx, force=True)
+                    ops_log.append(("compact",
+                                    res.get("compacted", False)))
+            except (segments.SegmentError,
+                    faults.InjectedCompactCrash) as e:
+                if not armed:
+                    return f"op {step} failed without a fault armed: {e}"
+                ops_log.append((f"faulted:{fault_kind}", 0))
+                # the old generation must still be byte-intact, and the
+                # NEXT attempt (budget spent) must succeed — prove the
+                # subsystem recovers, not merely survives
+                faults.install(None)
+                err = audit(f"post-fault step {step}")
+                if err:
+                    return err
+                continue
+            finally:
+                if armed:
+                    faults.install(None)
+            err = audit(f"step {step} ({ops_log[-1][0]})")
+            if err:
+                return err
+            if reader_errors:
+                return reader_errors[0]
+        # settle: one forced compaction then a final audit + parity
+        if len(segments.load_manifest(idx).entries) >= 2 \
+                and rng.random() < 0.5:
+            segments.compact(idx, force=True)
+            ops_log.append(("compact-final", True))
+        err = audit("final")
+        if err:
+            return err
+    finally:
+        stop.set()
+        for r in readers:
+            if r.is_alive():
+                r.join(timeout=30.0)
+        faults.install(None)
+    verdict["ops"] = ["{}:{}".format(*o) for o in ops_log]
+    verdict["generation"] = generation
+    verdict["live_docs"] = len(truth)
+    if reader_errors:
+        return reader_errors[0]
+    if any(r.is_alive() for r in readers):
+        return "reader thread failed to stop (wedged engine open?)"
+    return _seg_final_parity(idx, truth, work)
+
+
+def run_segments_soak(work_dir: Path, trials: int, seed_base: int,
+                      deadline_s: float = 120.0,
+                      verbose: bool = True) -> dict:
+    """``trials`` seeded segments trials; every one must honor the
+    publish-or-reject + byte-identity contract."""
+    work_dir.mkdir(parents=True, exist_ok=True)
+    results = []
+    for t in range(trials):
+        v = run_segments_trial(work_dir, seed_base + t,
+                               deadline_s=deadline_s)
+        results.append(v)
+        if verbose:
+            print(json.dumps(v, sort_keys=True), flush=True)
+        if v["outcome"] == "HANG":
+            break
+    failures = [v for v in results if not v["ok"]]
+    return {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "faulted_trials": sum(v.get("fault") is not None
+                              for v in results),
+        "failures": failures,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos soak: seeded fault schedules vs the (K, M) "
@@ -582,6 +928,12 @@ def main(argv=None) -> int:
                     help="soak the resident serve daemon instead of the "
                          "build pipeline (scenarios: "
                          + ", ".join(DAEMON_SCENARIOS) + ")")
+    ap.add_argument("--segments", action="store_true",
+                    help="soak the incremental-indexing subsystem: "
+                         "concurrent append/delete/compact/query "
+                         "schedules with segment fault kinds armed "
+                         "mid-trial, per-op --verify byte-audit, and a "
+                         "final from-scratch parity check")
     args = ap.parse_args(argv)
     if args.work_dir is None:
         import tempfile
@@ -589,6 +941,17 @@ def main(argv=None) -> int:
         work = Path(tempfile.mkdtemp(prefix="mri-chaos-"))
     else:
         work = Path(args.work_dir)
+    if args.segments:
+        if args.repro is not None:
+            work.mkdir(parents=True, exist_ok=True)
+            v = run_segments_trial(work, args.repro,
+                                   deadline_s=args.deadline)
+            print(json.dumps(v, sort_keys=True))
+            return 0 if v["ok"] else 1
+        summary = run_segments_soak(work, args.trials, args.seed_base,
+                                    deadline_s=args.deadline)
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if not summary["failures"] else 1
     if args.daemon:
         if args.repro is not None:
             t = args.repro - args.seed_base
