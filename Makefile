@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -107,6 +107,13 @@ test-obs:
 # from-scratch build, fault kinds, CLI + daemon admin surfaces
 test-segments:
 	$(PY) -m pytest tests/ -q -m segments
+
+# query-cost attribution suite: per-request EXPLAIN reports vs registry
+# counter parity (host/device/multi-segment), daemon explain + flight
+# recorder dumps, OpenMetrics exemplars, trace-coverage checker; none
+# are `slow`, so the default `make test-fast` sweep runs them too
+test-attrib: lint
+	$(PY) -m pytest tests/ -q -m attrib
 
 bench:
 	$(PY) bench.py

@@ -296,6 +296,13 @@ def _query_main(argv: list[str]) -> int:
                    help="print an engine stats JSON line last (engine/"
                         "shard info, cache hit/miss/eviction counters, "
                         "per-op timing)")
+    p.add_argument("--explain", action="store_true",
+                   help="print a per-request cost report JSON line "
+                        "after the answers: per-term df and resolution "
+                        "path, planner decision with its theta "
+                        "progression, blocks scored/skipped, bytes "
+                        "decoded, cache hits/misses (per segment on a "
+                        "segment-managed dir)")
     # intermixed: ``query DIR --op and the dog`` must not feed "the dog"
     # back into --op's greedy positional scan.
     args = p.parse_intermixed_args(argv)
@@ -339,7 +346,21 @@ def _query_main(argv: list[str]) -> int:
     except ArtifactError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.explain:
+        from .obs import attribution as obs_attrib
+        if ranked:
+            explain_op = "top_k_scored"
+        elif args.top_k is not None:
+            explain_op = "top_k"
+        elif args.op is not None:
+            explain_op = f"query_{args.op}"
+        else:
+            explain_op = "df+postings"
+        explain_cm = obs_attrib.collect(explain_op)
+    else:
+        explain_cm = None
     try:
+        coll = explain_cm.__enter__() if explain_cm is not None else None
         if ranked:
             top = engine.top_k_scored(engine.encode_batch(terms),
                                       args.top_k)
@@ -367,12 +388,18 @@ def _query_main(argv: list[str]) -> int:
                 print(json.dumps({
                     "term": term, "found": ids is not None, "df": d,
                     "postings": ids.tolist() if ids is not None else []}))
+        if coll is not None:
+            explain_cm.__exit__(None, None, None)
+            explain_cm = None
+            print(json.dumps({"explain": coll.report()}))
         if args.stats:
             print(json.dumps(engine.describe()))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:
+        if explain_cm is not None:
+            explain_cm.__exit__(None, None, None)
         engine.close()
     return 0
 
@@ -469,10 +496,17 @@ def _serve_main(argv: list[str]) -> int:
         threading.Thread(target=daemon.reload, name="mri-serve-reload",
                          daemon=True).start()
 
+    def _on_quit(signum, frame):
+        # SIGQUIT = dump the flight recorder and keep serving: the
+        # file write runs on a throwaway thread, off the signal frame
+        threading.Thread(target=daemon.dump_flight, args=("sigquit",),
+                         name="mri-serve-flight", daemon=True).start()
+
     if threading.current_thread() is threading.main_thread():
         signal.signal(signal.SIGTERM, _on_stop_signal)
         signal.signal(signal.SIGINT, _on_stop_signal)
         signal.signal(signal.SIGHUP, _on_hup)
+        signal.signal(signal.SIGQUIT, _on_quit)
 
     bound_host, bound_port = daemon.address
     listening = {"event": "listening", "host": bound_host,
@@ -481,9 +515,15 @@ def _serve_main(argv: list[str]) -> int:
     if daemon.metrics_address is not None:
         listening["metrics_port"] = daemon.metrics_address[1]
     print(json.dumps(listening), flush=True)
-    while not stop.is_set():
-        stop.wait(0.2)
-    rc = daemon.drain()
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+        rc = daemon.drain()
+    except Exception:
+        # unexpected serve crash: preserve the black box before the
+        # traceback takes the process down
+        daemon.dump_flight("crash")
+        raise
     print(json.dumps({"event": "drained",
                       "counters": daemon.final_stats["counters"]},
                      sort_keys=True), flush=True)
@@ -550,6 +590,68 @@ def _metrics_main(argv: list[str]) -> int:
         sys.stdout.write(engine.metrics.render_text())
     finally:
         engine.close()
+    return 0
+
+
+def _flightdump_main(argv: list[str]) -> int:
+    """``mri-tpu flightdump HOST:PORT`` — pull a running daemon's
+    flight recorder (last N completed request cost-reports + slow
+    offenders) as one JSON document, without waiting for a crash."""
+    import socket
+
+    p = argparse.ArgumentParser(
+        prog="mri-tpu flightdump",
+        description="dump a running serve daemon's flight recorder "
+                    "(bounded ring of recent request cost-reports, "
+                    "MRI_OBS_FLIGHT_RING) as one JSON document")
+    p.add_argument("target", metavar="HOST:PORT",
+                   help="a running serve daemon's protocol address")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the dump to this file (stdout "
+                        "always gets the JSON)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="daemon connect/read timeout in seconds")
+    args = p.parse_args(argv)
+
+    host, _, port_s = args.target.rpartition(":")
+    if not (host and port_s.isdigit() and int(port_s) <= 65535):
+        print(f"error: target must be HOST:PORT, got {args.target!r}",
+              file=sys.stderr)
+        return 2
+    try:
+        # mrilint: allow(fault-boundary) operator RPC, not corpus I/O; OSError maps to exit 2 below
+        with socket.create_connection((host, int(port_s)),
+                                      timeout=args.timeout) as sock:
+            sock.sendall(b'{"op": "flightdump", "id": 1}\n')
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+    except OSError as e:
+        print(f"error: cannot reach daemon at {args.target}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        resp = json.loads(buf)
+    except ValueError:
+        print(f"error: bad response from {args.target}", file=sys.stderr)
+        return 2
+    if not resp.get("ok"):
+        print(f"error: daemon refused flightdump: "
+              f"{resp.get('error', 'unknown')}", file=sys.stderr)
+        return 2
+    text = json.dumps(resp.get("flight", {}), sort_keys=True)
+    print(text)
+    if args.out is not None:
+        try:
+            # mrilint: allow(fault-boundary) operator-chosen output file; OSError maps to exit 2 below
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     return 0
 
 
@@ -634,6 +736,8 @@ def main(argv: list[str] | None = None) -> int:
         return _serve_main(argv[1:])
     if argv and argv[0] == "metrics":
         return _metrics_main(argv[1:])
+    if argv and argv[0] == "flightdump":
+        return _flightdump_main(argv[1:])
     if argv and argv[0] in ("append", "delete", "compact"):
         return _segments_main(argv[0], argv[1:])
     if "--verify" in argv:

@@ -4,6 +4,8 @@
   with Prometheus text exposition (stdlib-only, standalone-loadable).
 - :mod:`.timing` — OpTimer / PhaseTimer unified over the histogram.
 - :mod:`.tracing` — per-request trace ids, trace ring, slow-query log.
+- :mod:`.attribution` — request-scoped cost collector (the EXPLAIN
+  surface) and the crash-dump flight recorder.
 - :mod:`.chrometrace` — Chrome ``trace_event`` export for builds.
 """
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 
 
 class Counter:
@@ -96,7 +97,7 @@ class Histogram:
 
     __slots__ = ("name", "help", "_lock", "_bounds", "_counts",
                  "_count", "_sum", "_min", "_max", "_samples",
-                 "_truncated")
+                 "_truncated", "_exemplars")
 
     def __init__(self, name: str, help: str = "", *,  # noqa: A002
                  base: float = 1e-6, growth: float = 2.0,
@@ -113,8 +114,15 @@ class Histogram:
         self._max = -math.inf  # guarded by: self._lock
         self._samples: list[float] = []  # guarded by: self._lock
         self._truncated = False  # guarded by: self._lock
+        # per-bucket (trace_id, value, unix_ts) of a recent
+        # representative observation; allocated on first exemplar so
+        # exemplar-free histograms pay nothing
+        self._exemplars: list | None = None  # guarded by: self._lock
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        """Record ``v``; ``exemplar`` optionally attaches a trace id as
+        the bucket's OpenMetrics exemplar (last writer wins, which
+        keeps each bucket's exemplar recent)."""
         v = float(v)
         i = bisect.bisect_left(self._bounds, v)
         with self._lock:
@@ -129,6 +137,10 @@ class Histogram:
                 self._samples.append(v)
             else:
                 self._truncated = True
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * len(self._counts)
+                self._exemplars[i] = (str(exemplar), v, time.time())
 
     @property
     def count(self) -> int:
@@ -176,6 +188,14 @@ class Histogram:
         with self._lock:
             return not self._truncated
 
+    def exemplars(self) -> list:
+        """Per-bucket exemplar snapshot (one slot per bound plus +Inf);
+        ``None`` slots have never seen an exemplar."""
+        with self._lock:
+            if self._exemplars is None:
+                return [None] * len(self._counts)
+            return list(self._exemplars)
+
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * len(self._counts)
@@ -185,6 +205,7 @@ class Histogram:
             self._max = -math.inf
             self._samples = []
             self._truncated = False
+            self._exemplars = None
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -312,6 +333,14 @@ def _fmt(v) -> str:
     return repr(f)
 
 
+def _exemplar_suffix(ex) -> str:
+    """OpenMetrics exemplar suffix for one bucket line ('' when none)."""
+    if ex is None:
+        return ""
+    trace_id, v, ts = ex
+    return f' # {{trace_id="{trace_id}"}} {_fmt(v)} {ts:.3f}'
+
+
 class Registry:
     """Get-or-create home for named metrics plus the text renderer."""
 
@@ -343,8 +372,17 @@ class Registry:
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]
 
-    def render_text(self) -> str:
-        """Prometheus text exposition (``# TYPE``-annotated)."""
+    def render_text(self, *, exemplars: bool = False) -> str:
+        """Prometheus text exposition (``# TYPE``-annotated).
+
+        With ``exemplars=True``, histogram bucket lines that have seen
+        an exemplar carry an OpenMetrics exemplar suffix —
+        ``... # {trace_id="<id>"} <value> <unix_ts>`` — linking the
+        bucket to a recent representative request in the trace ring.
+        Plain-Prometheus scrapers that split on whitespace and skip
+        ``{``-labelled names are unaffected (the suffix sits after the
+        sample value).
+        """
         out = []
         for m in self.metrics():
             if isinstance(m, Counter):
@@ -362,9 +400,14 @@ class Registry:
                     out.append(f"# HELP {m.name} {m.help}")
                 out.append(f"# TYPE {m.name} histogram")
                 cum = m.cumulative_counts()
-                for bound, c in zip(m.bounds, cum):
-                    out.append(f'{m.name}_bucket{{le="{repr(bound)}"}} {c}')
-                out.append(f'{m.name}_bucket{{le="+Inf"}} {cum[-1]}')
+                exm = m.exemplars() if exemplars else [None] * (
+                    len(cum) + 1)
+                for bound, c, ex in zip(m.bounds, cum, exm):
+                    line = f'{m.name}_bucket{{le="{repr(bound)}"}} {c}'
+                    out.append(line + _exemplar_suffix(ex))
+                out.append(f'{m.name}_bucket{{le="+Inf"}} {cum[-1]}'
+                           + _exemplar_suffix(exm[len(cum) - 1]
+                                              if exemplars else None))
                 out.append(f"{m.name}_sum {_fmt(m.sum)}")
                 out.append(f"{m.name}_count {m.count}")
         return "\n".join(out) + "\n" if out else ""

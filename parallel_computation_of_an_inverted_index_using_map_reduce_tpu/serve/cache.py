@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from ..obs import attribution as obs_attrib
 from ..obs import metrics as obs_metrics
 
 _MISSING = object()
@@ -39,6 +40,7 @@ class LRUCache:
         # lock) so the engine's registry exposes them in the Prometheus
         # text; ``registry=None`` keeps them private to this cache.
         reg = registry if registry is not None else obs_metrics.Registry()
+        self._prefix = prefix
         self._hits = reg.counter(f"{prefix}_hits_total")
         self._misses = reg.counter(f"{prefix}_misses_total")
         self._evictions = reg.counter(f"{prefix}_evictions_total")
@@ -56,14 +58,21 @@ class LRUCache:
         return self._evictions.value
 
     def get(self, key, default=None):
+        # the attribution feed sits beside the counter inc it mirrors:
+        # the per-request cache tally can never drift from the registry
+        coll = obs_attrib.active()
         with self._lock:
             value = self._data.get(key, _MISSING)
             if value is _MISSING:
                 self._misses.inc()
+                if coll is not None:
+                    coll.cache_event(key, False, self._prefix)
                 return default
             self._data.move_to_end(key)
             self._hits.inc()
-            return value
+        if coll is not None:
+            coll.cache_event(key, True, self._prefix)
+        return value
 
     def put(self, key, value) -> None:
         with self._lock:

@@ -58,6 +58,9 @@ Protocol — one JSON object per line, one response line per request::
     {"id": 11, "op": "append", "files": ["d.txt"]}   # admin: live append
     {"id": 12, "op": "delete", "docs": [7, 9]}       # admin: tombstone
     {"id": 13, "op": "compact"}     # admin: merge a segment run
+    {"id": 14, "op": "flightdump"}  # admin: flight-recorder contents
+    {"id": 15, "op": "top_k", "score": "bm25", "k": 3,
+               "terms": ["big", "cat"], "explain": true}  # cost report
 
 Live mutations (the ``append``/``delete``/``compact`` ops) run on the
 reader thread under the reload lock — never the dispatcher — publish a
@@ -83,6 +86,19 @@ which is echoed on the response; each finished request records
 contiguous queue-wait → coalesce → engine spans into a bounded ring
 (the ``trace`` op) and requests slower than ``MRI_OBS_SLOW_MS`` emit
 one structured JSON line on the ``mri_tpu.obs`` logger.
+
+Cost attribution: a data request carrying ``"explain": true`` runs
+SOLO (outside the coalesced df/postings groups, so its costs are its
+own) under an :mod:`..obs.attribution` collector, and the response
+carries an ``explain`` object — per-term resolution, planner decision
+with its θ progression, blocks scored/skipped, bytes decoded, cache
+hits, per-stage µs.  Every completed request (explain or not) also
+lands in the :class:`..obs.attribution.FlightRecorder` — a bounded
+ring (``MRI_OBS_FLIGHT_RING``) dumped as one JSON file on dispatcher
+crash, abnormal drain, the CLI's SIGQUIT, or on demand through the
+``flightdump`` admin op.  Latency histograms attach OpenMetrics
+exemplars (``MRI_OBS_EXEMPLARS``) so a scrape's slow bucket links back
+to a concrete trace_id in the ring.
 """
 
 from __future__ import annotations
@@ -97,6 +113,7 @@ import threading
 import time
 
 from .. import faults
+from ..obs import attribution as obs_attrib
 from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
 from ..utils import envknobs
@@ -117,7 +134,7 @@ OUTBOUND_DEPTH = 1024
 
 DATA_OPS = ("df", "postings", "and", "or", "top_k")
 ADMIN_OPS = ("stats", "healthz", "reload", "metrics", "trace",
-             "append", "delete", "compact")
+             "append", "delete", "compact", "flightdump")
 
 _SENTINEL = object()
 
@@ -150,10 +167,10 @@ class _Request:
 
     __slots__ = ("conn", "rid", "op", "terms", "letter", "k", "score",
                  "seq", "expires_at", "done", "trace_id", "t_admit",
-                 "t_pop", "t_exec", "planner")
+                 "t_pop", "t_exec", "planner", "explain", "attrib")
 
     def __init__(self, conn, rid, op, terms, letter, k, score, seq,
-                 expires_at, trace_id=None, t_admit=0.0):
+                 expires_at, trace_id=None, t_admit=0.0, explain=False):
         self.conn = conn
         self.rid = rid
         self.op = op
@@ -169,6 +186,8 @@ class _Request:
         self.t_pop = None  # dispatcher popped it off the queue
         self.t_exec = None  # batch reached the engine lock
         self.planner = None  # ranked queries: the planner's decision
+        self.explain = explain  # run solo under a cost collector
+        self.attrib = None  # the collector, once the request executed
 
 
 class _Conn:
@@ -289,6 +308,9 @@ class ServeDaemon:
         self._obs_enabled = obs_tracing.enabled()
         self._slow_ms = obs_tracing.slow_ms()
         self._trace_ring = obs_tracing.TraceRing()
+        self._exemplars = obs_attrib.exemplars_enabled()
+        self._flight = obs_attrib.FlightRecorder(
+            slow_threshold_ms=self._slow_ms)
         self._conns: set[_Conn] = set()  # guarded by: self._conn_lock
         self._conn_lock = threading.Lock()
         self._draining = False
@@ -492,7 +514,8 @@ class ServeDaemon:
         item = _Request(conn, rid, op, req.get("terms"),
                         req.get("letter"), int(req.get("k") or 0),
                         req.get("score") or "df", seq, expires_at,
-                        trace_id=tid, t_admit=t_admit)
+                        trace_id=tid, t_admit=t_admit,
+                        explain=bool(req.get("explain", False)))
         with conn.lock:
             conn.pending += 1
         try:
@@ -516,6 +539,9 @@ class ServeDaemon:
         if dl is not None and (not isinstance(dl, (int, float))
                                or isinstance(dl, bool) or dl <= 0):
             return f"deadline_ms must be a positive number, got {dl!r}"
+        ex = req.get("explain")
+        if ex is not None and not isinstance(ex, bool):
+            return f"explain must be a boolean, got {ex!r}"
         if op == "top_k":
             score = req.get("score") or "df"
             if score not in ("df", "bm25"):
@@ -544,6 +570,9 @@ class ServeDaemon:
     def _handle_admin(self, conn: _Conn, rid, op: str, req: dict) -> None:
         """Admin ops answer inline from the reader thread — they must
         work while the dispatcher is wedged in a batch."""
+        # mrilint: allow(trace) stats healthz metrics trace flightdump —
+        # read-only introspection ops: answered inline from state the
+        # trace ring already covers, no engine or generation change
         if op == "healthz":
             payload = {"ok": True,
                        "status": "draining" if self._draining else "ok",
@@ -558,6 +587,11 @@ class ServeDaemon:
                 and n > 0 else 32
             payload = {"ok": True,
                        "traces": self._trace_ring.snapshot(n)}
+        elif op == "flightdump":
+            payload = {"ok": True, "flight": self._flight.dump("admin")}
+            where = req.get("write_to")
+            if isinstance(where, str) and where:
+                payload["path"] = self._flight.dump_to_file(where, "admin")
         elif op in ("append", "delete", "compact"):
             err = None
             if op == "append":
@@ -584,7 +618,10 @@ class ServeDaemon:
                 else:
                     payload = {"error": "mutation_rejected", "detail": out}
         else:  # reload
+            t0 = time.monotonic()
             ok, detail = self.reload()
+            self._admin_trace("reload", t0,
+                              status="ok" if ok else "reload_rejected")
             if ok:
                 payload = {"ok": True, "reloaded": True}
             else:
@@ -599,6 +636,16 @@ class ServeDaemon:
     # -- dispatch ------------------------------------------------------
 
     def _dispatch_loop(self) -> None:
+        """Crash boundary for the dispatcher thread: an exception
+        escaping the batch loop takes the serving plane down, so the
+        flight recorder is dumped first — the black box survives."""
+        try:
+            self._dispatch_inner()
+        except BaseException:
+            self.dump_flight("crash")
+            raise
+
+    def _dispatch_inner(self) -> None:
         while True:
             try:
                 first = self._queue.get(timeout=0.02)
@@ -651,15 +698,40 @@ class ServeDaemon:
                 self._inflight -= 1
         self._record_trace(item, payload)
 
+    def _admin_trace(self, op: str, t0: float, *, status: str = "ok",
+                     generation=None) -> None:
+        """One trace-ring span for an admin op that changed daemon
+        state.  Mutation ops (append/delete/compact) stamp the manifest
+        ``generation`` they produced on the record AND its span, so a
+        ring snapshot shows which generation each query span ran
+        against.  Never raises."""
+        if not self._obs_enabled:
+            return
+        dur_ms = round((time.monotonic() - t0) * 1e3, 3)
+        span = {"name": op, "start_ms": 0.0, "dur_ms": dur_ms}
+        trace = {
+            "trace_id": obs_tracing.gen_trace_id(),
+            "id": None, "op": op, "seq": 0,
+            "status": status, "dur_ms": dur_ms,
+            "spans": [span],
+        }
+        if generation is not None:
+            trace["generation"] = int(generation)
+            span["generation"] = int(generation)
+        self._trace_ring.push(trace)
+
     def _record_trace(self, item: _Request, payload: dict) -> None:
         """Latency histograms + one trace record per finished request.
         Off the response path's critical invariants — never raises."""
         t_done = time.monotonic()
         t0 = item.t_admit
-        self._h_request.observe(t_done - t0)
+        self._h_request.observe(
+            t_done - t0,
+            exemplar=item.trace_id if self._exemplars else None)
         if item.t_pop is not None:
             self._h_queue_wait.observe(item.t_pop - t0)
-        if not (self._obs_enabled and item.trace_id is not None):
+        want_trace = self._obs_enabled and item.trace_id is not None
+        if not (want_trace or self._flight.enabled):
             return
         spans = []
 
@@ -692,9 +764,14 @@ class ServeDaemon:
             "dur_ms": round(dur_ms, 3),
             "spans": spans,
         }
-        self._trace_ring.push(trace)
-        if 0 < self._slow_ms <= dur_ms:
-            obs_tracing.emit_slow(trace)
+        if want_trace:
+            self._trace_ring.push(trace)
+            if 0 < self._slow_ms <= dur_ms:
+                obs_tracing.emit_slow(trace)
+        if self._flight.enabled:
+            self._flight.record(
+                trace, item.attrib.report()
+                if item.attrib is not None else None)
 
     def _execute(self, items: list[_Request]) -> None:
         inj = faults.active()
@@ -731,9 +808,12 @@ class ServeDaemon:
                         continue
                 ready.append(it)
             # coalesced groups: one vectorized engine call answers every
-            # df (resp. postings) request in the batch
+            # df (resp. postings) request in the batch.  Explain
+            # requests are excluded — they run solo below, so the cost
+            # report charges them for their own work only.
             for op in ("df", "postings"):
-                group = [it for it in ready if it.op == op]
+                group = [it for it in ready
+                         if it.op == op and not it.explain]
                 if not group:
                     continue
                 try:
@@ -769,35 +849,56 @@ class ServeDaemon:
                 if it.done:
                     continue
                 try:
-                    if it.op == "and":
-                        docs = eng.query_and(eng.encode_batch(it.terms))
-                        self._finish(it, {"ok": True,
-                                          "docs": docs.tolist()})
-                    elif it.op == "or":
-                        docs = eng.query_or(eng.encode_batch(it.terms))
-                        self._finish(it, {"ok": True,
-                                          "docs": docs.tolist()})
-                    elif it.op == "top_k" and it.score == "bm25":
-                        top = eng.top_k_scored(
-                            eng.encode_batch(it.terms), it.k)
-                        planner = getattr(eng, "planner", None)
-                        if planner is not None:
-                            # decision + pruning counters ride the trace
-                            # record so slow ranked queries attribute
-                            it.planner = planner.last_ranked
-                        self._finish(it, {
-                            "ok": True,
-                            "docs": [[d, s] for d, s in top]})
-                    else:  # top_k by df
-                        top = eng.top_k(it.letter, it.k)
-                        self._finish(it, {
-                            "ok": True,
-                            "top": [[t.decode("ascii", "replace"), int(d)]
-                                    for t, d in top]})
+                    if it.explain:
+                        with obs_attrib.collect(it.op) as coll:
+                            t_eng = time.monotonic()
+                            payload = self._exec_one(eng, it)
+                        coll.stage("queue",
+                                   (it.t_pop - it.t_admit) * 1e6)
+                        coll.stage("coalesce",
+                                   (it.t_exec - it.t_pop) * 1e6)
+                        coll.stage("engine",
+                                   (time.monotonic() - t_eng) * 1e6)
+                        it.attrib = coll
+                        payload["explain"] = coll.report()
+                    else:
+                        payload = self._exec_one(eng, it)
+                    self._finish(it, payload)
                 except Exception as e:
                     self._count("internal_errors")
                     self._finish(it, {"error": "internal",
                                       "detail": str(e)})
+
+    def _exec_one(self, eng, it: _Request) -> dict:
+        """One data request against the engine; returns the ok payload.
+        df/postings normally ride the coalesced group path — they land
+        here solo when the request asked for an explain report."""
+        if it.op == "df":
+            out = eng.df(eng.encode_batch(it.terms))
+            return {"ok": True, "df": out.tolist()}
+        if it.op == "postings":
+            runs = eng.postings(eng.encode_batch(it.terms))
+            return {"ok": True,
+                    "postings": [r.tolist() if r is not None else None
+                                 for r in runs]}
+        if it.op == "and":
+            docs = eng.query_and(eng.encode_batch(it.terms))
+            return {"ok": True, "docs": docs.tolist()}
+        if it.op == "or":
+            docs = eng.query_or(eng.encode_batch(it.terms))
+            return {"ok": True, "docs": docs.tolist()}
+        if it.op == "top_k" and it.score == "bm25":
+            top = eng.top_k_scored(eng.encode_batch(it.terms), it.k)
+            planner = getattr(eng, "planner", None)
+            if planner is not None:
+                # decision + pruning counters ride the trace record so
+                # slow ranked queries are attributable to their strategy
+                it.planner = planner.last_ranked
+            return {"ok": True, "docs": [[d, s] for d, s in top]}
+        top = eng.top_k(it.letter, it.k)  # top_k by df
+        return {"ok": True,
+                "top": [[t.decode("ascii", "replace"), int(d)]
+                        for t, d in top]}
 
     # -- live mutations (segment-managed dirs) -------------------------
 
@@ -877,6 +978,7 @@ class ServeDaemon:
             except (segments.SegmentError, ArtifactError, ValueError,
                     OSError, faults.InjectedCompactCrash) as e:
                 self._count("mutation_rejected")
+                self._admin_trace(op, t0, status="mutation_rejected")
                 log.warning("%s rejected, old generation keeps "
                             "serving: %s", op, e)
                 return False, str(e)
@@ -886,14 +988,11 @@ class ServeDaemon:
                 old.close()
             self._count("mutations")
             dur_ms = round((time.monotonic() - t0) * 1e3, 3)
-            if op == "compact" and self._obs_enabled:
-                self._trace_ring.push({
-                    "trace_id": obs_tracing.gen_trace_id(),
-                    "id": None, "op": "compact", "seq": 0,
-                    "status": "ok", "dur_ms": dur_ms,
-                    "spans": [{"name": "compact", "start_ms": 0.0,
-                               "dur_ms": dur_ms}],
-                })
+            # mrilint: allow(trace) append delete compact — every
+            # mutation op lands here; the span carries the generation it
+            # produced (buffered deletes: no publish, no generation yet)
+            gen = res.get("generation") if isinstance(res, dict) else None
+            self._admin_trace(op, t0, generation=gen)
             log.info("%s: %s (%.1f ms)", op, json.dumps(res), dur_ms)
             return True, res
 
@@ -964,6 +1063,18 @@ class ServeDaemon:
             },
         }
 
+    # -- flight recorder -----------------------------------------------
+
+    @property
+    def flight(self) -> obs_attrib.FlightRecorder:
+        return self._flight
+
+    def dump_flight(self, reason: str) -> str | None:
+        """Write the flight recorder next to the served artifact as
+        ``flight-<pid>-<reason>.json``; returns the path or ``None``.
+        Crash-path safe — never raises."""
+        return self._flight.dump_to_file(str(self._path), reason)
+
     # -- metrics exposition --------------------------------------------
 
     def render_metrics(self) -> str:
@@ -976,7 +1087,7 @@ class ServeDaemon:
             self._g_inflight.set(self._inflight)
         self._g_queue_depth.set(self._queue.qsize())
         self._g_draining.set(1 if self._draining else 0)
-        parts = [self.registry.render_text()]
+        parts = [self.registry.render_text(exemplars=self._exemplars)]
         if not self._drained.is_set():
             with self._reload_lock:
                 try:
@@ -1057,6 +1168,7 @@ class ServeDaemon:
             self._dispatcher.join(timeout=max(2.0, self.drain_s))
         # budget expired with work still queued: flush it as counted,
         # well-formed errors — drain never silently drops a request
+        flushed = 0
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -1066,6 +1178,11 @@ class ServeDaemon:
             self._finish(item, {"error": "draining",
                                 "detail": "daemon drained before "
                                           "dispatch"})
+            flushed += 1
+        if flushed:
+            # abnormal drain — the budget expired with work queued;
+            # dump the flight recorder so the backlog is diagnosable
+            self.dump_flight("drain-flush")
         # unblock every reader (idle keep-alive clients never EOF on
         # their own), let writers flush, then force-close stragglers
         with self._conn_lock:

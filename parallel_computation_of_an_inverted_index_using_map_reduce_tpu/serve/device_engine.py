@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs import attribution as obs_attrib
 from ..obs import metrics as obs_metrics
 from ..parallel.compat import shard_map
 from ..parallel.mesh import SHARD_AXIS, make_mesh
@@ -468,6 +469,15 @@ class DeviceEngine:
         self._cache = LRUCache(cache_terms, registry=self.metrics,
                                prefix="mri_serve_cache")  # idle on the device path
         self._ops = OpTimer(registry=self.metrics)
+        # decode-plane counters, host-engine names: the device decodes
+        # inside jitted kernels, so the tallies are computed host-side
+        # from the artifact's block/offset columns per resolved term
+        self._c_blocks_decoded = \
+            self.metrics.counter("mri_engine_blocks_decoded_total")
+        self._c_blocks_skipped = \
+            self.metrics.counter("mri_engine_blocks_skipped_total")
+        self._c_bytes_decoded = \
+            self.metrics.counter("mri_engine_bytes_decoded_total")
         self.planner = planner_mod.Planner(self.metrics)
         # host-side BM25 memos feeding the pruning plan: per-term f64
         # contributions (theta bootstrap) and per-block upper bounds
@@ -533,11 +543,19 @@ class DeviceEngine:
         idx, found, dfv = self._lookup_fn(
             self._d_key_hi, self._d_key_lo, self._d_rows, self._d_df,
             q_hi, q_lo, rows)
-        return (np.asarray(idx)[:B], np.asarray(found)[:B],
-                np.asarray(dfv)[:B])
+        idx = np.asarray(idx)[:B]
+        found = np.asarray(found)[:B]
+        dfv = np.asarray(dfv)[:B]
+        coll = obs_attrib.active()
+        if coll is not None:
+            for t, i, ok, d in zip(q.tolist(), idx.tolist(),
+                                   found.tolist(), dfv.tolist()):
+                coll.term(t, int(i), bool(ok), int(d), "device")
+        return idx, found, dfv
 
     def lookup(self, batch):
         """Host-API parity: (lex idx, found) per query."""
+        # mrilint: allow(trace) resolution is attributed in _resolve
         idx, found, _ = self._resolve(batch)
         return idx.astype(np.int64), found
 
@@ -547,6 +565,31 @@ class DeviceEngine:
         with self._ops.time("df"):
             _, _, dfv = self._resolve(batch)
             return dfv.astype(np.int64)
+
+    def _note_decode(self, uidx) -> None:
+        """Count one decode pass over terms ``uidx`` (host-side mirror
+        of the kernels' work: block/byte spans from the artifact's
+        offset columns) on the registry and the attribution collector.
+        The feed sits beside the counter incs, so per-request reports
+        can never drift from the registry (the parity gate)."""
+        uidx = np.asarray(uidx, dtype=np.int64)
+        if not len(uidx):
+            return
+        art = self.artifact
+        if self._fmt >= artifact_mod.VERSION_V2:
+            b0 = art.term_block_off[uidx]
+            b1 = art.term_block_off[uidx + 1]
+            blocks = int((b1 - b0).sum())
+            nbytes = int((art.blk_woff[b1]
+                          - art.blk_woff[b0]).sum()) * 4
+        else:
+            blocks = len(uidx)
+            nbytes = int(self._h_df[uidx].sum()) * 4
+        self._c_blocks_decoded.inc(blocks)
+        self._c_bytes_decoded.inc(nbytes)
+        coll = obs_attrib.active()
+        if coll is not None:
+            coll.decoded(blocks, nbytes)
 
     def _decode_batch(self, idx, n, width):
         """Chunked (len(idx), width) sentinel-padded decode, bucketed so
@@ -581,6 +624,7 @@ class DeviceEngine:
                 return []
             if not found.any():
                 return [None] * B
+            self._note_decode(idx[found])
             width = self._tier(int(dfv.max()))
             win = self._decode_batch(idx, np.where(found, dfv, 0), width)
             return [win[i, :dfv[i]] if found[i] else None
@@ -619,6 +663,7 @@ class DeviceEngine:
         two (AND repeats the first run — intersection-neutral; OR pads
         empty runs — union-neutral), call the (op, T, W) kernel, slice
         the count."""
+        self._note_decode(uidx)
         n = self._h_df[uidx].astype(np.int32)
         T = _next_pow2(len(uidx))
         if T != len(uidx):
@@ -738,6 +783,9 @@ class DeviceEngine:
             srt = self._term_contribs(i)
             if len(srt) >= k:
                 theta = max(theta, w * float(srt[k - 1]))
+        coll = obs_attrib.active()
+        if coll is not None:
+            coll.theta(theta)
         margin = planner_mod.DEVICE_MARGIN
         bl_parts, widf_parts = [], []
         nb_total = 0
@@ -762,12 +810,23 @@ class DeviceEngine:
                 widf_parts.append(
                     np.full(len(sel), np.float32(idf), np.float32))
         if not bl_parts:
+            self._c_blocks_skipped.inc(nb_total)
+            if coll is not None:
+                coll.skipped(nb_total)
             self.planner.note_ranked(mode, 0, nb_total, 0)
             return []
         bl = np.concatenate(bl_parts).astype(np.int32)
         widf = np.concatenate(widf_parts)
         cnt = self.artifact.blk_cnt[bl].astype(np.int32)
         S = len(bl)
+        nbytes = int((art.blk_woff[bl.astype(np.int64) + 1]
+                      - art.blk_woff[bl]).sum()) * 4
+        self._c_blocks_decoded.inc(S)
+        self._c_blocks_skipped.inc(nb_total - S)
+        self._c_bytes_decoded.inc(nbytes)
+        if coll is not None:
+            coll.decoded(S, nbytes)
+            coll.skipped(nb_total - S)
         Sp = max(_MIN_LANES, _next_pow2(S))
         if Sp != S:
             bl = np.concatenate([bl, np.zeros(Sp - S, np.int32)])
@@ -809,6 +868,7 @@ class DeviceEngine:
             if mode != "exhaustive":
                 return self._top_k_scored_pruned(occ, k, mode)
             self.planner.note_ranked("exhaustive", 0, 0, 0)
+            self._note_decode(np.asarray(occ))
             # duplicates accumulate (host parity): keep the full batch,
             # padded to a power of two with never-found zero lanes
             T = _next_pow2(len(idx))

@@ -20,6 +20,7 @@ import numpy as np
 from . import artifact as artifact_mod
 from . import planner as planner_mod
 from .cache import LRUCache
+from ..obs import attribution as obs_attrib
 from ..obs import metrics as obs_metrics
 # OpTimer's historical home is this module; the implementation moved to
 # obs.timing (unified with PhaseTimer over the obs histogram) and is
@@ -170,12 +171,17 @@ class Engine:
                     np.zeros(len(q), dtype=bool))
         n = len(q)
         memo = self._memo
+        # one ContextVar.get per lookup: the entire disabled-path cost
+        # of per-term attribution
+        coll = obs_attrib.active()
         if 0 < n <= 8:
             hits = [memo.get(t) for t in q.tolist()]
             if None not in hits:
                 at = np.array(hits, dtype=np.int64)
                 found = at >= 0
                 at[~found] = 0
+                if coll is not None:
+                    self._feed_terms(coll, q, at, found, "memo")
                 return at, found
         # S -> S8 cast pads (width < 8) or truncates (width > 8) to the
         # 8-byte prefix; big-endian u64 view preserves lex order.
@@ -197,7 +203,14 @@ class Engine:
                 memo.clear()
             for t, i, ok in zip(q.tolist(), at.tolist(), found.tolist()):
                 memo[t] = i if ok else -1
+        if coll is not None:
+            self._feed_terms(coll, q, at, found, "bisect")
         return at, found
+
+    def _feed_terms(self, coll, q, at, found, path: str) -> None:
+        """Per-term attribution entries for one resolved batch."""
+        for t, i, ok in zip(q.tolist(), at.tolist(), found.tolist()):
+            coll.term(t, i, ok, int(self._df[i]) if ok else 0, path)
 
     # -- single-term answers --------------------------------------------
 
@@ -217,15 +230,20 @@ class Engine:
             return hit
         art = self.artifact
         decoded = art.decode_postings(idx)
+        coll = obs_attrib.active()
         if art.version >= artifact_mod.VERSION_V2:
             b0 = int(art.term_block_off[idx])
             b1 = int(art.term_block_off[idx + 1])
+            nbytes = int(art.blk_woff[b1] - art.blk_woff[b0]) * 4
             self._c_blocks_decoded.inc(b1 - b0)
-            self._c_bytes_decoded.inc(
-                int(art.blk_woff[b1] - art.blk_woff[b0]) * 4)
+            self._c_bytes_decoded.inc(nbytes)
+            if coll is not None:
+                coll.decoded(b1 - b0, nbytes)
         else:
             self._c_blocks_decoded.inc()
             self._c_bytes_decoded.inc(decoded.nbytes)
+            if coll is not None:
+                coll.decoded(1, decoded.nbytes)
         decoded.setflags(write=False)
         self._cache.put(idx, decoded)
         return decoded
@@ -282,16 +300,22 @@ class Engine:
         blk = np.searchsorted(art.blk_max[b0:b1], acc)
         ok = blk < (b1 - b0)
         blk, cand = blk[ok], acc[ok]
+        coll = obs_attrib.active()
         if not len(cand):
             self._c_blocks_skipped.inc(b1 - b0)
+            if coll is not None:
+                coll.skipped(b1 - b0)
             return cand
         need = np.unique(blk)
         ids, _ = art.decode_blocks(need + b0)
+        nbytes = int((art.blk_woff[need + b0 + 1]
+                      - art.blk_woff[need + b0]).sum()) * 4
         self._c_blocks_decoded.inc(len(need))
         self._c_blocks_skipped.inc((b1 - b0) - len(need))
-        self._c_bytes_decoded.inc(int(
-            (art.blk_woff[need + b0 + 1]
-             - art.blk_woff[need + b0]).sum()) * 4)
+        self._c_bytes_decoded.inc(nbytes)
+        if coll is not None:
+            coll.decoded(len(need), nbytes)
+            coll.skipped((b1 - b0) - len(need))
         # rows beyond a block's count repeat its last real doc id
         # (cumsum of zero deltas), so a plain membership test is exact.
         rows = ids[np.searchsorted(need, blk)]
@@ -401,6 +425,7 @@ class Engine:
         :meth:`_top_k_pruned`)."""
         t0 = time.perf_counter()
         try:
+            coll = obs_attrib.active()
             occ = None
             key = batch.tobytes() if isinstance(batch, np.ndarray) \
                 else None
@@ -414,21 +439,26 @@ class Engine:
                     if len(self._occ_memo) > (1 << 16):
                         self._occ_memo.clear()
                     self._occ_memo[key] = occ
+            elif coll is not None:
+                art = self.artifact
+                for i in occ:
+                    coll.term(art.term(i), i, True,
+                              int(self._df[i]), "cache")
             if occ and k > 0 and len(occ) <= 2:
-                out = self._top_k_small(occ, k)
+                out = self._top_k_small(occ, k, coll)
                 if out is not None:
                     return out
             mode = self.planner.plan_ranked(
                 self.artifact, [int(self._df[i]) for i in occ], k)
             if mode != "exhaustive":
-                return self._top_k_pruned(occ, k, mode)
+                return self._top_k_pruned(occ, k, mode, coll)
             out = self._top_k_exhaustive(occ, k)
             self.planner.note_ranked("exhaustive", 0, 0, len(out))
             return out
         finally:
             self._h_topk.observe(time.perf_counter() - t0)
 
-    def _top_k_small(self, occ: list[int], k: int):
+    def _top_k_small(self, occ: list[int], k: int, coll=None):
         """Lean 1-2 occurrence ranked path over memoized contributions.
 
         The Zipf-head query mix is dominated by short queries whose
@@ -462,6 +492,8 @@ class Engine:
                         else "maxscore"
                 scores = c1 if w == 1.0 else w * c1
                 theta = w * float(srt1[k - 1]) if n1 >= k else 0.0
+                if coll is not None:
+                    coll.theta(theta)
                 if theta > 0.0:
                     keep = scores >= theta * margin
                     cand, sc = docs1[keep], scores[keep]
@@ -499,6 +531,8 @@ class Engine:
             t2 = float(srt2[k - 1])
             if t2 > theta:
                 theta = t2
+        if coll is not None:
+            coll.theta(theta)
         if theta > 0.0:
             cand = (scores >= theta * margin).nonzero()[0]
         else:
@@ -579,9 +613,12 @@ class Engine:
         sel = need + b0
         ids, cnt = art.decode_blocks(sel)
         tfm, _ = art.decode_tf_blocks(sel)
+        nbytes = int((art.blk_woff[sel + 1] - art.blk_woff[sel]).sum()) * 4
         self._c_blocks_decoded.inc(len(need))
-        self._c_bytes_decoded.inc(int(
-            (art.blk_woff[sel + 1] - art.blk_woff[sel]).sum()) * 4)
+        self._c_bytes_decoded.inc(nbytes)
+        coll = obs_attrib.active()
+        if coll is not None:
+            coll.decoded(len(need), nbytes)
         mask = np.arange(ids.shape[1])[None, :] < cnt[:, None]
         docs = ids[mask].astype(np.int64)
         tf = tfm[mask].astype(np.float64)
@@ -592,8 +629,8 @@ class Engine:
         denom = tf + k1 * (1.0 - b + b * doc_lens[docs] / avgdl)
         return docs, idf * tf * (k1 + 1.0) / denom
 
-    def _top_k_pruned(self, occ: list[int], k: int, mode: str
-                      ) -> list[tuple[int, float]]:
+    def _top_k_pruned(self, occ: list[int], k: int, mode: str,
+                      coll=None) -> list[tuple[int, float]]:
         """MaxScore / Block-Max WAND top-k over the v2.1 bound columns.
 
         Terms are processed in descending weighted-upper-bound order.
@@ -646,6 +683,8 @@ class Engine:
                     scores = np.array(add, dtype=np.float64)
                     if len(srt) >= k:
                         theta = w * float(srt[k - 1])
+                        if coll is not None:
+                            coll.theta(theta)
                     continue
                 cand, scores = _union_add(cand, scores, docs, add)
             else:
@@ -703,6 +742,8 @@ class Engine:
                     scored += len(need)
                     skipped += nb - len(need)
                     self._c_blocks_skipped.inc(nb - len(need))
+                    if coll is not None:
+                        coll.skipped(nb - len(need))
                     if len(need) >= nb:
                         # no block escaped — decode the whole term
                         # through the memoizing path instead (bit-equal
@@ -728,6 +769,8 @@ class Engine:
                     scores, len(scores) - k)[len(scores) - k])
                 if kth > theta:
                     theta = kth
+                    if coll is not None:
+                        coll.theta(theta)
         if len(occ) > 2:
             if theta > 0.0:
                 keep = scores >= theta * margin
@@ -973,28 +1016,39 @@ class AutoEngine:
 
     # -- query API ------------------------------------------------------
 
+    # Every op below is pure routing: the chosen backend times the op
+    # and feeds the attribution collector itself, so a second span here
+    # would double-count.
+
     def encode_batch(self, terms):
         return self._host.encode_batch(terms)
 
     def lookup(self, batch):
+        # mrilint: allow(trace) delegation; routed engine attributes
         return self._pick(batch).lookup(batch)
 
     def df(self, batch):
+        # mrilint: allow(trace) delegation; routed engine attributes
         return self._pick(batch).df(batch)
 
     def postings(self, batch):
+        # mrilint: allow(trace) delegation; routed engine attributes
         return self._pick(batch).postings(batch)
 
     def query_and(self, batch):
+        # mrilint: allow(trace) delegation; host engine attributes
         return self._host.query_and(batch)
 
     def query_or(self, batch):
+        # mrilint: allow(trace) delegation; host engine attributes
         return self._host.query_or(batch)
 
     def top_k(self, letter, k):
+        # mrilint: allow(trace) delegation; host engine attributes
         return self._host.top_k(letter, k)
 
     def top_k_scored(self, batch, k):
+        # mrilint: allow(trace) delegation; host engine attributes
         return self._host.top_k_scored(batch, k)
 
     # -- bookkeeping ----------------------------------------------------

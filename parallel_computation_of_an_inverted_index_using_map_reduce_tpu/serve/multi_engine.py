@@ -42,6 +42,7 @@ import numpy as np
 
 from . import artifact as artifact_mod
 from . import engine as engine_mod
+from ..obs import attribution as obs_attrib
 from ..obs import metrics as obs_metrics
 from ..segments import manifest as seg_manifest
 from ..segments import tombstones as tomb_mod
@@ -193,6 +194,17 @@ class MultiSegmentEngine:
             q[long] = b""
         return q
 
+    @staticmethod
+    def _seg_attrib(coll, seg: _Segment):
+        """Install a per-segment child collector around one segment-
+        engine call (``None`` when attribution is off); the caller
+        uninstalls the returned token in a ``finally``.  The segment
+        engine's own feed sites then land in the child, giving the
+        explain report its per-segment breakdown."""
+        if coll is None:
+            return None
+        return obs_attrib.install(coll.child(seg.entry.name))
+
     # -- term resolution --------------------------------------------------
 
     @property
@@ -218,14 +230,20 @@ class MultiSegmentEngine:
         with self._ops.time("df"):
             q = np.asarray(batch, dtype=self._sdtype)
             out = np.zeros(len(q), dtype=np.int64)
+            coll = obs_attrib.active()
             for s in self._segs:
-                sq = self._seg_batch(s, q)
-                idx, found = s.engine.lookup(sq)
-                if s.bits is None:
-                    out += np.where(found, s.engine._df[idx], 0)
-                else:
-                    for j in np.nonzero(found)[0]:
-                        out[j] += s.live_df(int(idx[j]))
+                token = self._seg_attrib(coll, s)
+                try:
+                    sq = self._seg_batch(s, q)
+                    idx, found = s.engine.lookup(sq)
+                    if s.bits is None:
+                        out += np.where(found, s.engine._df[idx], 0)
+                    else:
+                        for j in np.nonzero(found)[0]:
+                            out[j] += s.live_df(int(idx[j]))
+                finally:
+                    if token is not None:
+                        obs_attrib.uninstall(token)
             return out
 
     def postings(self, batch) -> list[np.ndarray | None]:
@@ -235,15 +253,21 @@ class MultiSegmentEngine:
         with self._ops.time("postings"):
             q = np.asarray(batch, dtype=self._sdtype)
             parts: list[list[np.ndarray]] = [[] for _ in q]
+            coll = obs_attrib.active()
             for s in self._segs:
-                sq = self._seg_batch(s, q)
-                idx, found = s.engine.lookup(sq)
-                for j in np.nonzero(found)[0]:
-                    docs = s.live_locals(
-                        s.engine.postings_by_index(int(idx[j])))
-                    if len(docs):
-                        parts[j].append(
-                            docs.astype(np.int64) + s.doc_base)
+                token = self._seg_attrib(coll, s)
+                try:
+                    sq = self._seg_batch(s, q)
+                    idx, found = s.engine.lookup(sq)
+                    for j in np.nonzero(found)[0]:
+                        docs = s.live_locals(
+                            s.engine.postings_by_index(int(idx[j])))
+                        if len(docs):
+                            parts[j].append(
+                                docs.astype(np.int64) + s.doc_base)
+                finally:
+                    if token is not None:
+                        obs_attrib.uninstall(token)
             return [np.concatenate(p).astype(np.int32) if p else None
                     for p in parts]
 
@@ -258,8 +282,14 @@ class MultiSegmentEngine:
         with self._ops.time("and"):
             q = np.asarray(batch, dtype=self._sdtype)
             outs = []
+            coll = obs_attrib.active()
             for s in self._segs:
-                res = s.engine.query_and(self._seg_batch(s, q))
+                token = self._seg_attrib(coll, s)
+                try:
+                    res = s.engine.query_and(self._seg_batch(s, q))
+                finally:
+                    if token is not None:
+                        obs_attrib.uninstall(token)
                 res = s.live_locals(res)
                 if len(res):
                     outs.append(res.astype(np.int64) + s.doc_base)
@@ -272,8 +302,14 @@ class MultiSegmentEngine:
         with self._ops.time("or"):
             q = np.asarray(batch, dtype=self._sdtype)
             outs = []
+            coll = obs_attrib.active()
             for s in self._segs:
-                res = s.engine.query_or(self._seg_batch(s, q))
+                token = self._seg_attrib(coll, s)
+                try:
+                    res = s.engine.query_or(self._seg_batch(s, q))
+                finally:
+                    if token is not None:
+                        obs_attrib.uninstall(token)
                 res = s.live_locals(res)
                 if len(res):
                     outs.append(res.astype(np.int64) + s.doc_base)
@@ -292,15 +328,21 @@ class MultiSegmentEngine:
         hi_b = bytes([ord("a") + letter + 1])
         with self._ops.time("top_k"):
             tally: dict[bytes, int] = {}
+            coll = obs_attrib.active()
             for s in self._segs:
-                terms = s.engine._terms
-                lo = int(np.searchsorted(terms, np.bytes_(lo_b)))
-                hi = int(np.searchsorted(terms, np.bytes_(hi_b)))
-                for i in range(lo, hi):
-                    d = s.live_df(i)
-                    if d:
-                        t = s.engine.artifact.term(i)
-                        tally[t] = tally.get(t, 0) + d
+                token = self._seg_attrib(coll, s)
+                try:
+                    terms = s.engine._terms
+                    lo = int(np.searchsorted(terms, np.bytes_(lo_b)))
+                    hi = int(np.searchsorted(terms, np.bytes_(hi_b)))
+                    for i in range(lo, hi):
+                        d = s.live_df(i)
+                        if d:
+                            t = s.engine.artifact.term(i)
+                            tally[t] = tally.get(t, 0) + d
+                finally:
+                    if token is not None:
+                        obs_attrib.uninstall(token)
             order = sorted(tally.items(), key=lambda kv: (-kv[1], kv[0]))
             return [(t, d) for t, d in order[:max(k, 0)]]
 
@@ -320,9 +362,16 @@ class MultiSegmentEngine:
             if k <= 0:
                 return []
             per_seg: list[list[tuple[float, int]]] = []
+            coll = obs_attrib.active()
             for s in self._segs:
                 k2 = k + s.entry.tomb_count
-                res = s.engine.top_k_scored(self._seg_batch(s, q), k2)
+                token = self._seg_attrib(coll, s)
+                try:
+                    res = s.engine.top_k_scored(
+                        self._seg_batch(s, q), k2)
+                finally:
+                    if token is not None:
+                        obs_attrib.uninstall(token)
                 if s.bits is not None:
                     res = [(d, sc) for d, sc in res
                            if not s.bits[d - 1]][:k]

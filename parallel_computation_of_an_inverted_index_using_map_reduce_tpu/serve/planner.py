@@ -18,6 +18,7 @@ import os
 
 import numpy as np
 
+from ..obs import attribution as obs_attrib
 from ..utils import envknobs
 
 PLANNER_ENV = "MRI_SERVE_PLANNER"
@@ -125,6 +126,9 @@ class Planner:
         """
         mode = "merge" if df <= 2 * n_acc else "gallop"
         self._c_and[mode].inc()
+        coll = obs_attrib.active()
+        if coll is not None:
+            coll.and_arm(mode)
         return mode
 
     def note_ranked(self, mode: str, scored: int, skipped: int,
@@ -135,6 +139,9 @@ class Planner:
             self._c_scored.inc(scored)
         if skipped:
             self._c_skipped.inc(skipped)
+        coll = obs_attrib.active()
+        if coll is not None:
+            coll.ranked(mode, scored, skipped, candidates)
         self.last_ranked = {
             "mode": mode,
             "blocks_scored": scored,

@@ -268,6 +268,17 @@ declare("MRI_OBS_SLOW_MS", float, 0.0,
         "one structured JSON line on the mri_tpu.obs logger; 0 "
         "disables the slow log.",
         scope="obs", minimum=0)
+declare("MRI_OBS_FLIGHT_RING", int, 64,
+        "Capacity of the daemon's flight recorder (last N completed "
+        "request cost-reports + slow offenders, dumped as one JSON "
+        "file on SIGQUIT, crash, abnormal drain, or the `flightdump` "
+        "admin op); 0 disables the recorder.",
+        scope="obs", minimum=0)
+declare("MRI_OBS_EXEMPLARS", int, 1,
+        "OpenMetrics exemplars on the daemon's latency histograms: 1 "
+        "attaches the trace_id of a recent bucket-representative "
+        "request to each bucket line in the scrape text, 0 omits them.",
+        scope="obs", choices=(0, 1))
 
 # -- benchmarks -------------------------------------------------------
 declare("MRI_TPU_BENCH_ATTEMPTS", int, 3,

@@ -7,10 +7,11 @@ from . import (  # noqa: F401
     lifecycle,
     obs_metrics,
     readme_knobs,
+    trace_coverage,
 )
 
 #: per-file checkers, run in order (readme_knobs is repo-level, not
 #: here; obs_metrics appears twice — its check() is per-file, its
 #: check_repo() runs with the repo-level pass)
 CHECKERS = (guarded_by, env_knobs, exit_codes, lifecycle, fault_boundary,
-            obs_metrics)
+            obs_metrics, trace_coverage)

@@ -1,0 +1,145 @@
+"""trace-coverage: query and admin ops must be observable.
+
+The attribution layer (``obs/attribution.py``) only answers "why was
+THIS query slow?" if every op on the request path actually feeds it —
+a new engine op or daemon admin op that forgets the wiring silently
+produces cost reports with holes.  Two rules pin the contract:
+
+* Engine ops: every public query method (``ENGINE_OPS``) on a
+  ``*Engine`` class in ``serve/{engine,device_engine,multi_engine}.py``
+  must, in its body, time itself on the obs registry (``_ops.time`` /
+  ``.observe(``) or feed the attribution collector (``obs_attrib`` /
+  ``active(``) — or carry a reasoned ``# mrilint: allow(trace)`` line
+  inside the body (pure-delegation wrappers like AutoEngine).
+
+* Daemon admin ops: every string in ``serve/daemon.py``'s
+  ``ADMIN_OPS`` tuple must either appear as the literal first argument
+  of a ``self._admin_trace(...)`` call, or be named on a
+  ``# mrilint: allow(trace)`` pragma line (read-only ops; dynamically
+  dispatched mutation ops list themselves on the pragma beside the
+  ``_admin_trace(op, ...)`` call that covers them).
+
+Both rules are line-number-free in their baseline keys, so moving code
+never churns the baseline; the baseline itself stays shrink-only.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Source, PACKAGE
+
+RULE = "trace-coverage"
+
+_ENGINE_FILES = {
+    f"{PACKAGE}/serve/engine.py",
+    f"{PACKAGE}/serve/device_engine.py",
+    f"{PACKAGE}/serve/multi_engine.py",
+}
+_DAEMON_FILE = f"{PACKAGE}/serve/daemon.py"
+
+#: the public query surface every engine flavor exposes
+ENGINE_OPS = ("lookup", "df", "postings", "query_and", "query_or",
+              "top_k", "top_k_scored")
+
+#: body substrings that prove the op is observable: an OpTimer span,
+#: a histogram observation, or an attribution-collector feed
+_OBSERVABLE = ("_ops.time", ".observe(", "obs_attrib", "active(")
+
+_ALLOW_TRACE_RE = re.compile(r"#\s*mrilint:\s*allow\(trace\)(.*)$")
+
+
+def _body_text(src: Source, func: ast.FunctionDef) -> str:
+    return "\n".join(src.lines[func.lineno - 1:func.end_lineno])
+
+
+def _body_has_allow(src: Source, func: ast.FunctionDef) -> bool:
+    return any(_ALLOW_TRACE_RE.search(line)
+               for line in src.lines[func.lineno - 1:func.end_lineno])
+
+
+def _check_engines(src: Source) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Engine")):
+            continue
+        for item in node.body:
+            if not (isinstance(item, ast.FunctionDef)
+                    and item.name in ENGINE_OPS):
+                continue
+            body = _body_text(src, item)
+            if any(tok in body for tok in _OBSERVABLE):
+                continue
+            if _body_has_allow(src, item):
+                continue
+            findings.append(Finding(
+                rule=RULE, path=src.rel, line=item.lineno,
+                key=f"engine-op@{node.name}.{item.name}",
+                message=(f"{node.name}.{item.name} is a public engine "
+                         f"op with no obs span (_ops.time/.observe) and "
+                         f"no attribution feed — wire it or suppress "
+                         f"with a reasoned # mrilint: allow(trace)")))
+    return findings
+
+
+def _admin_ops(src: Source) -> list[tuple[str, int]]:
+    """The ADMIN_OPS tuple's string literals, with their line."""
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "ADMIN_OPS"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return [(el.value, el.lineno) for el in node.value.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)]
+    return []
+
+
+def _traced_literals(src: Source) -> set[str]:
+    """Ops passed as a literal first argument to ``_admin_trace``."""
+    out: set[str] = set()
+    for node in ast.walk(src.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_admin_trace"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.add(node.args[0].value)
+    return out
+
+
+def _pragma_named(src: Source) -> set[str]:
+    """Ops named on an ``allow(trace)`` pragma line's trailing text."""
+    out: set[str] = set()
+    for line in src.lines:
+        m = _ALLOW_TRACE_RE.search(line)
+        if m:
+            out.update(re.findall(r"[a-z_]+", m.group(1)))
+    return out
+
+
+def _check_daemon(src: Source) -> list[Finding]:
+    ops = _admin_ops(src)
+    if not ops:
+        return []
+    covered = _traced_literals(src) | _pragma_named(src)
+    return [
+        Finding(
+            rule=RULE, path=src.rel, line=line,
+            key=f"admin-op@{op}",
+            message=(f"admin op {op!r} neither reaches "
+                     f"self._admin_trace({op!r}, ...) nor is named on a "
+                     f"# mrilint: allow(trace) pragma — every admin op "
+                     f"must leave a span in the trace ring"))
+        for op, line in ops if op not in covered
+    ]
+
+
+def check(src: Source) -> list[Finding]:
+    if src.rel in _ENGINE_FILES:
+        return _check_engines(src)
+    if src.rel == _DAEMON_FILE:
+        return _check_daemon(src)
+    return []
