@@ -6,16 +6,18 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments bench-slo bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
 	          assert native.available(), 'native build failed'; print('native runtime built')"
 
 # repo-contract static analysis (tools/mrilint): exit 0 means clean
-# against the checked-in shrink-only baseline
+# against the checked-in shrink-only baseline; the bench-history check
+# keeps the README "Bench trajectory" table in sync with BENCH_*.json
 lint:
 	$(PY) -m tools.mrilint
+	$(PY) tools/bench_history.py --check
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -168,6 +170,17 @@ bench-scrape:
 # gated), and compaction cost -> BENCH_SEGMENTS_r12.json
 bench-segments:
 	$(PY) tools/bench_serve.py --segments-ab
+
+# operational-health overhead gate: rolling-windows sampler tick + a
+# 1 Hz `slo` poll priced in-run (<1% of a serving second), with `mri
+# top --once --json` parity vs the raw ops -> BENCH_SLO_r14.json
+bench-slo:
+	$(PY) tools/bench_serve.py --slo-check
+
+# print the cross-round BENCH_*.json trajectory table (ratios against
+# each round's own baseline); `--write` regenerates the README block
+bench-history:
+	$(PY) tools/bench_history.py
 
 # full on-chip capture (run when the tunnel is up); round-parameterized
 # (tools/capture.sh R OUT) — assembles AND commits its artifacts

@@ -56,6 +56,10 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
   reload-corrupt                 serve daemon: next hot reload fails
                                  verification (old artifact keeps
                                  serving, 'reload_rejected' counted)
+  dispatcher-hang:ms=500         serve daemon: the dispatch loop wedges
+                                 for ms on its next batch (proves the
+                                 watchdog: stall event + flight dump +
+                                 healthz readiness flip)
   append-torn-manifest           segments: the staged manifest is torn
                                  mid-publish — the append aborts and
                                  the old generation keeps serving
@@ -142,6 +146,19 @@ metrics mode (Prometheus text exposition; obs/ registry):
                                  daemon also serves the same text over
                                  plain HTTP on 127.0.0.1:PORT (a scrape
                                  endpoint; 0 = ephemeral)
+
+top mode (operational health; see README "Operational health"):
+  mri-tpu top HOST:PORT          live dashboard over a running daemon's
+                                 stats/slo/healthz admin ops: rolling
+                                 qps + latency quantiles (10s/1m/5m),
+                                 SLO ratios and burn rates, readiness
+                                 with reasons; redraws every --interval
+                                 seconds, Ctrl-C exits 0
+  mri-tpu top HOST:PORT --once --json   one machine-readable sample
+                                 (scripting and parity checks)
+  mri-tpu top DIR                one static engine metrics snapshot of
+                                 a built artifact (nothing rolls
+                                 without a daemon)
 """
 
 
@@ -430,13 +447,21 @@ def _serve_main(argv: list[str]) -> int:
     p.add_argument("--fault-spec", default=None,
                    help="arm the deterministic fault injector "
                         "(serve kinds: handler-crash/client-disconnect/"
-                        "slow-client/reload-corrupt) — test/bench only")
+                        "slow-client/reload-corrupt/dispatcher-hang) "
+                        "— test/bench only")
     p.add_argument("--listen-metrics", type=int, default=None,
                    metavar="PORT",
                    help="also serve Prometheus text metrics over plain "
                         "HTTP on 127.0.0.1:PORT (0 = ephemeral; the "
                         "chosen port is printed in the 'listening' line)")
     args = p.parse_args(argv)
+
+    # the daemon is the one long-lived process: route every mri_tpu.*
+    # logger through the structured obs funnel (MRI_OBS_LOG_FORMAT).
+    # NOT done for in-process embedding (ServeDaemon.start()) — a host
+    # application owns its own logging tree.
+    from .obs import logging as obs_logging
+    obs_logging.configure()
 
     if args.fault_spec is not None:
         try:
@@ -655,6 +680,175 @@ def _flightdump_main(argv: list[str]) -> int:
     return 0
 
 
+def _top_sample(addr: tuple, timeout: float) -> dict:
+    """One dashboard poll: ``healthz`` + ``stats`` + ``slo`` pipelined
+    over a single daemon connection, matched back up by request id."""
+    import socket
+
+    reqs = (b'{"op":"healthz","id":1}\n'
+            b'{"op":"stats","id":2}\n'
+            b'{"op":"slo","id":3}\n')
+    by_id: dict = {}
+    # mrilint: allow(fault-boundary) operator dashboard RPC, not corpus I/O; callers map OSError to exit 2
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        sock.sendall(reqs)
+        # mrilint: allow(fault-boundary) response framing on the same operator RPC
+        f = sock.makefile("rb")
+        try:
+            for _ in range(3):
+                line = f.readline()
+                if not line:
+                    break
+                resp = json.loads(line)
+                by_id[resp.get("id")] = resp
+        finally:
+            f.close()
+    health = dict(by_id.get(1, {}))
+    health.pop("id", None)
+    return {
+        "healthz": health,
+        "stats": by_id.get(2, {}).get("stats", {}),
+        "slo": by_id.get(3, {}).get("slo", {}),
+    }
+
+
+def _top_num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+def _top_render(target: str, sample: dict) -> str:
+    """One plain-text dashboard frame over a poll's sample."""
+    h = sample.get("healthz") or {}
+    st = sample.get("stats") or {}
+    slo = sample.get("slo") or {}
+    ready = "ready" if h.get("ready") else "NOT READY"
+    reasons = ",".join(h.get("reasons") or []) or "-"
+    counters = st.get("counters") or {}
+    lines = [
+        f"mri top — {target} — {ready} ({h.get('status', '?')})",
+        f"queue_depth={st.get('queue_depth', h.get('queue_depth', 0))}"
+        f"  inflight={st.get('inflight', 0)}"
+        f"  connections={st.get('connections', 0)}"
+        f"  reasons={reasons}",
+        "",
+        f"{'window':<8}{'qps':>12}{'shed/s':>10}{'err/s':>10}"
+        f"{'p50 ms':>10}{'p99 ms':>10}",
+    ]
+    rolling = st.get("rolling") or {}
+    for label in ("10s", "1m", "5m"):
+        w = rolling.get(label) or {}
+        lines.append(f"{label:<8}{_top_num(w.get('qps')):>12}"
+                     f"{_top_num(w.get('shed_per_s')):>10}"
+                     f"{_top_num(w.get('error_per_s')):>10}"
+                     f"{_top_num(w.get('p50_ms')):>10}"
+                     f"{_top_num(w.get('p99_ms')):>10}")
+    for name in sorted(slo):
+        entry = slo[name] or {}
+        head = f"slo {name} (target {entry.get('target')}"
+        if entry.get("threshold_ms") is not None:
+            head += f", <= {entry['threshold_ms']} ms"
+        lines.append("")
+        lines.append(head + ")")
+        lines.append(f"  {'window':<8}{'ratio':>12}{'burn':>10}"
+                     f"{'events':>10}")
+        for label in ("10s", "1m", "5m"):
+            pt = (entry.get("windows") or {}).get(label) or {}
+            lines.append(f"  {label:<8}"
+                         f"{_top_num(pt.get('ratio')):>12}"
+                         f"{_top_num(pt.get('burn')):>10}"
+                         f"{_top_num(pt.get('total')):>10}")
+    lines.append("")
+    nonzero = "  ".join(f"{k}={v}" for k, v in counters.items() if v)
+    lines.append("counters: " + (nonzero or "-"))
+    return "\n".join(lines) + "\n"
+
+
+def _top_static(args) -> int:
+    """``mri-tpu top DIR`` — one static engine metrics snapshot of a
+    built artifact.  Nothing rolls without a daemon, so there is no
+    live refresh in this mode."""
+    from .serve import ArtifactError, create_engine
+    try:
+        engine = create_engine(args.target, None)
+    except ArtifactError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        desc = engine.describe()
+        text = engine.metrics.render_text()
+    finally:
+        engine.close()
+    if args.as_json:
+        print(json.dumps({"engine": desc, "metrics_text": text},
+                         sort_keys=True))
+    else:
+        print(f"mri top — {args.target} (static artifact snapshot)")
+        print(json.dumps(desc, sort_keys=True))
+        sys.stdout.write(text)
+    return 0
+
+
+def _top_main(argv: list[str]) -> int:
+    """``mri-tpu top TARGET`` — the live operational-health dashboard.
+
+    HOST:PORT polls a running daemon's ``stats``/``slo``/``healthz``
+    admin ops and redraws every ``--interval`` seconds (Ctrl-C exits
+    0); ``--once --json`` prints one machine-readable sample — the
+    mode scripts and the parity test consume.  DIR prints one static
+    engine snapshot."""
+    import time as time_mod
+
+    p = argparse.ArgumentParser(
+        prog="mri-tpu top",
+        description="live operational-health dashboard for a running "
+                    "serve daemon (HOST:PORT — rolling rates, latency "
+                    "quantiles, SLO burn, readiness) or one static "
+                    "metrics snapshot of a built artifact (DIR)")
+    p.add_argument("target", help="serve daemon HOST:PORT, or the "
+                                  "output dir of an --artifact run")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="refresh period in seconds (live mode)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (no screen clear)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (implies --once)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="daemon connect/read timeout in seconds")
+    args = p.parse_args(argv)
+    once = args.once or args.as_json
+
+    host, _, port_s = args.target.rpartition(":")
+    is_addr = bool(host) and port_s.isdigit() and int(port_s) <= 65535
+    if not is_addr or os.path.exists(args.target):
+        return _top_static(args)
+
+    addr = (host, int(port_s))
+    try:
+        while True:
+            try:
+                sample = _top_sample(addr, args.timeout)
+            except (OSError, ValueError) as e:
+                print(f"error: cannot poll daemon at {args.target}: "
+                      f"{e}", file=sys.stderr)
+                return 2
+            if args.as_json:
+                print(json.dumps(sample, sort_keys=True))
+            else:
+                if not once:
+                    sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                sys.stdout.write(_top_render(args.target, sample))
+                sys.stdout.flush()
+            if once:
+                return 0
+            time_mod.sleep(max(0.05, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def _segments_main(cmd: str, argv: list[str]) -> int:
     """``mri-tpu append|delete|compact DIR ...`` — incremental indexing.
 
@@ -738,6 +932,8 @@ def main(argv: list[str] | None = None) -> int:
         return _metrics_main(argv[1:])
     if argv and argv[0] == "flightdump":
         return _flightdump_main(argv[1:])
+    if argv and argv[0] == "top":
+        return _top_main(argv[1:])
     if argv and argv[0] in ("append", "delete", "compact"):
         return _segments_main(argv[0], argv[1:])
     if "--verify" in argv:

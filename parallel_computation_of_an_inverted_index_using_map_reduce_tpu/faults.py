@@ -35,6 +35,9 @@ Three layers live here:
         client-disconnect:req=2      serve daemon: peer gone at response 2
         slow-client:req=1:ms=200     serve daemon: response write stalls
         reload-corrupt               serve daemon: next hot reload fails
+        dispatcher-hang:ms=500       serve daemon: dispatch loop wedges
+                                     for ms on its next batch (the
+                                     watchdog-stall proof)
         append-torn-manifest         segments: staged manifest torn
                                      mid-publish (append aborts, old
                                      generation keeps serving)
@@ -169,7 +172,7 @@ _READ_KINDS = ("read-error", "slow-read", "truncate")
 _DEATH_KINDS = ("reader-death", "sigkill", "stream-crash", "ckpt-corrupt",
                 "worker-death", "reducer-death", "scan-error", "chaos")
 _SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
-                "handler-crash")
+                "handler-crash", "dispatcher-hang")
 _SEGMENT_KINDS = ("append-torn-manifest", "compact-crash",
                   "tombstone-corrupt")
 
@@ -322,6 +325,8 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         raise FaultSpecError(f"{head} needs req=N (1-based)")
     if rule.kind == "slow-client" and rule.ms <= 0:
         rule.ms = 50.0
+    if rule.kind == "dispatcher-hang" and rule.ms <= 0:
+        rule.ms = 500.0
     if rule.kind == "chaos":
         if rule.n < 1:
             raise FaultSpecError("chaos needs n=K (faults to sample)")
@@ -608,6 +613,24 @@ class FaultInjector:
         if delay:
             time.sleep(delay)
         return drop
+
+    def on_dispatch_batch(self) -> None:
+        """Fires in the serve daemon's dispatcher thread as it picks up
+        a batch.  An armed ``dispatcher-hang`` rule sleeps ``ms`` here
+        — outside the injector lock, mirroring ``slow-client`` — so
+        the single dispatch thread wedges with requests queued behind
+        it while admin ops keep answering from the reader threads:
+        exactly the failure shape the watchdog exists to detect.
+        One-shot by default (``times=1``), like the other serve kinds."""
+        delay = 0.0
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "dispatcher-hang":
+                    continue
+                if self._fire_once(ri, rule):
+                    delay = max(delay, rule.ms / 1e3)
+        if delay:
+            time.sleep(delay)
 
     def on_segment_publish(self, op: str, tmp_path: str) -> None:
         """Fires in ``segments.manifest.save_manifest`` after the new

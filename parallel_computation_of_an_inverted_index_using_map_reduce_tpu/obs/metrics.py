@@ -315,6 +315,22 @@ KNOWN_METRICS = (
      "Live mutations (append/delete/compact) applied by the daemon."),
     ("mri_serve_mutation_rejected_total", "counter",
      "Live mutations rejected; the old generation kept serving."),
+    # operational health (rolling SLIs, SLOs, watchdog; daemon registry)
+    ("mri_slo_<slo>_ratio_<window>", "gauge",
+     "Rolling good-event ratio of one SLO (availability, latency) "
+     "over one window (10s, 1m, 5m); 1 when the window saw no events."),
+    ("mri_slo_<slo>_burn_<window>", "gauge",
+     "SLO burn rate over one window: error-rate / error-budget, where "
+     "the budget is 1 - MRI_OBS_SLO_TARGET; above 1 the daemon burns "
+     "its budget faster than the objective allows."),
+    ("mri_watchdog_stalls_total", "counter",
+     "Watchdog-detected stalls: a monitored daemon thread's heartbeat "
+     "aged past MRI_OBS_STALL_MS."),
+    ("mri_watchdog_heartbeat_age_seconds", "gauge",
+     "Age of the oldest monitored-thread heartbeat at scrape time."),
+    ("mri_obs_log_dropped_total", "counter",
+     "Structured log records dropped by the per-event rate limiter "
+     "(MRI_OBS_LOG_RATE_LIMIT)."),
     # fault injection (process-global default registry)
     ("mri_faults_fired_total", "counter",
      "Fault-injection rules fired, all kinds."),

@@ -11,13 +11,13 @@ via the ``trace`` admin op, and requests slower than
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import threading
 from collections import deque
 
 from ..utils import envknobs
+from . import logging as obs_logging
 
 ENABLE_ENV = "MRI_OBS_ENABLE"
 RING_ENV = "MRI_OBS_TRACE_RING"
@@ -72,9 +72,7 @@ class TraceRing:
 
 
 def emit_slow(trace: dict) -> None:
-    """One structured JSON line for a slow request.  Never raises."""
-    try:
-        slow_log.warning("%s", json.dumps(
-            {"event": "slow_query", **trace}, separators=(",", ":")))
-    except Exception:
-        pass
+    """One structured JSON line for a slow request — routed through
+    the unified obs logging funnel (rate-limited).  Never raises."""
+    obs_logging.emit(slow_log, "slow_query", level=logging.WARNING,
+                     **trace)

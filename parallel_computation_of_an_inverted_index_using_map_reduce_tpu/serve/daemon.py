@@ -114,8 +114,12 @@ import time
 
 from .. import faults
 from ..obs import attribution as obs_attrib
+from ..obs import logging as obs_logging
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import tracing as obs_tracing
+from ..obs import watchdog as obs_watchdog
+from ..obs import windows as obs_windows
 from ..utils import envknobs
 from .artifact import ArtifactError
 from .engine import create_engine
@@ -134,7 +138,9 @@ OUTBOUND_DEPTH = 1024
 
 DATA_OPS = ("df", "postings", "and", "or", "top_k")
 ADMIN_OPS = ("stats", "healthz", "reload", "metrics", "trace",
-             "append", "delete", "compact", "flightdump")
+             "append", "delete", "compact", "flightdump", "slo")
+
+OVERLOAD_ENV = "MRI_OBS_OVERLOAD_SHED_RATE"
 
 _SENTINEL = object()
 
@@ -311,6 +317,19 @@ class ServeDaemon:
         self._exemplars = obs_attrib.exemplars_enabled()
         self._flight = obs_attrib.FlightRecorder(
             slow_threshold_ms=self._slow_ms)
+        # operational health: rolling SLIs sampled off this registry,
+        # SLO math over them, and the stall watchdog.  The sampler
+        # diffs cumulative state — zero new hot-path feed sites.
+        self._rolling = obs_windows.RollingWindows(
+            self.registry,
+            counters=[name for _key, name in _COUNTER_NAMES],
+            histograms=("mri_serve_request_seconds",))
+        self._slo = obs_slo.SLOTracker(self._rolling)
+        self._watchdog = obs_watchdog.Watchdog(
+            on_stall=self._on_stall, on_recover=self._on_recover,
+            registry=self.registry)
+        self._overload_shed_rate = envknobs.get(OVERLOAD_ENV)
+        self._reloading = False
         self._conns: set[_Conn] = set()  # guarded by: self._conn_lock
         self._conn_lock = threading.Lock()
         self._draining = False
@@ -345,6 +364,10 @@ class ServeDaemon:
         ls.settimeout(0.2)
         self._listener = ls
         self._host, self._port = ls.getsockname()[:2]
+        self._watchdog.register("dispatcher")
+        self._watchdog.register("accept")
+        self._rolling.start()
+        self._watchdog.start()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="mri-serve-dispatch",
             daemon=True)
@@ -388,11 +411,44 @@ class ServeDaemon:
     def _count(self, key: str, n: int = 1) -> None:
         self._counts[key].inc(n)
 
+    # -- operational health --------------------------------------------
+
+    def _ready_reasons(self) -> list:
+        """Why the daemon is NOT ready to serve right now ([] = ready).
+        Ordered: the first reason becomes the legacy ``status``."""
+        reasons = []
+        if self._draining:
+            reasons.append("draining")
+        if self._reloading:
+            reasons.append("reloading")
+        if self._watchdog.stalled():
+            reasons.append("stalled")
+        limit = self._overload_shed_rate
+        if limit > 0:
+            counts = self._rolling.counts(10.0)
+            shed = counts.get("mri_serve_shed_total", 0)
+            attempts = shed + counts.get("mri_serve_requests_total", 0)
+            if attempts > 0 and shed / attempts >= limit:
+                reasons.append("overloaded")
+        return reasons
+
+    def _on_stall(self, name: str, age_ms: float) -> None:
+        """Watchdog callback (monitor thread), once per stall episode:
+        one structured event + a flight-recorder dump to autopsy."""
+        obs_logging.emit(log, "stall", level=logging.WARNING,
+                         thread=name, age_ms=round(age_ms, 1),
+                         stall_ms=self._watchdog.stall_ms)
+        self.dump_flight("stall")
+
+    def _on_recover(self, name: str) -> None:
+        obs_logging.emit(log, "stall_recovered", thread=name)
+
     # -- accept / per-connection threads -------------------------------
 
     def _accept_loop(self) -> None:
         assert self._listener is not None
         while not self._draining:
+            self._watchdog.beat("accept")
             try:
                 sock, addr = self._listener.accept()
             except socket.timeout:
@@ -570,13 +626,22 @@ class ServeDaemon:
     def _handle_admin(self, conn: _Conn, rid, op: str, req: dict) -> None:
         """Admin ops answer inline from the reader thread — they must
         work while the dispatcher is wedged in a batch."""
-        # mrilint: allow(trace) stats healthz metrics trace flightdump —
-        # read-only introspection ops: answered inline from state the
+        # mrilint: allow(trace) stats healthz slo metrics trace flightdump
+        # — read-only introspection ops: answered inline from state the
         # trace ring already covers, no engine or generation change
         if op == "healthz":
+            # liveness vs readiness: ``ok`` stays unconditionally True
+            # for old clients (the process answered — it is alive);
+            # ``ready``/``reasons`` carry the serving verdict
+            reasons = self._ready_reasons()
             payload = {"ok": True,
-                       "status": "draining" if self._draining else "ok",
+                       "live": True,
+                       "ready": not reasons,
+                       "reasons": reasons,
+                       "status": reasons[0] if reasons else "ok",
                        "queue_depth": self._queue.qsize()}
+        elif op == "slo":
+            payload = {"ok": True, "slo": self._slo.report()}
         elif op == "stats":
             payload = {"ok": True, "stats": self.stats()}
         elif op == "metrics":
@@ -647,12 +712,18 @@ class ServeDaemon:
 
     def _dispatch_inner(self) -> None:
         while True:
+            # heartbeat every iteration INCLUDING the idle path: an
+            # empty queue is quiet, not stalled
+            self._watchdog.beat("dispatcher")
             try:
                 first = self._queue.get(timeout=0.02)
             except queue.Empty:
                 if self._dispatch_stop.is_set():
                     return
                 continue
+            inj = faults.active()
+            if inj is not None:
+                inj.on_dispatch_batch()
             first.t_pop = time.monotonic()
             batch = [first]
             if self.coalesce_us > 0 and self.max_batch > 1 \
@@ -1006,28 +1077,33 @@ class ServeDaemon:
         the CLI's SIGHUP thread), off the dispatcher; only the O(1)
         swap itself holds the dispatch lock."""
         with self._reload_lock:
-            inj = faults.active()
-            new_engine = None
+            self._reloading = True  # healthz readiness: "reloading"
             try:
-                new_engine = create_engine(
-                    self._path, self._engine_choice,
-                    cache_terms=self._cache_terms, shards=self._shards)
-                if inj is not None:
-                    inj.on_reload()
-            except (ArtifactError, ValueError, OSError,
-                    faults.InjectedReloadCorrupt) as e:
-                if new_engine is not None:
-                    new_engine.close()
-                self._count("reload_rejected")
-                log.warning("hot reload rejected, keeping current "
-                            "artifact: %s", e)
-                return False, str(e)
-            with self._engine_lock:
-                old, self._engine = self._engine, new_engine
-            old.close()
-            self._count("reload_ok")
-            log.info("hot reload: swapped in %s", self._path)
-            return True, ""
+                inj = faults.active()
+                new_engine = None
+                try:
+                    new_engine = create_engine(
+                        self._path, self._engine_choice,
+                        cache_terms=self._cache_terms,
+                        shards=self._shards)
+                    if inj is not None:
+                        inj.on_reload()
+                except (ArtifactError, ValueError, OSError,
+                        faults.InjectedReloadCorrupt) as e:
+                    if new_engine is not None:
+                        new_engine.close()
+                    self._count("reload_rejected")
+                    log.warning("hot reload rejected, keeping current "
+                                "artifact: %s", e)
+                    return False, str(e)
+                with self._engine_lock:
+                    old, self._engine = self._engine, new_engine
+                old.close()
+                self._count("reload_ok")
+                log.info("hot reload: swapped in %s", self._path)
+                return True, ""
+            finally:
+                self._reloading = False
 
     # -- stats ---------------------------------------------------------
 
@@ -1055,6 +1131,8 @@ class ServeDaemon:
             "connections": connections,
             "counters": counters,
             "engine": engine,
+            "rolling": self._rolling_stats(),
+            "slo": self._slo.report(),
             "config": {
                 "coalesce_us": self.coalesce_us,
                 "queue_depth": self.queue_depth,
@@ -1062,6 +1140,29 @@ class ServeDaemon:
                 "drain_s": self.drain_s,
             },
         }
+
+    def _rolling_stats(self) -> dict:
+        """Per-window rates + latency quantiles for ``stats()``."""
+        out = {}
+        roll = self._rolling
+        for label, span in obs_windows.WINDOWS:
+            p50 = roll.quantile("mri_serve_request_seconds", span, 50.0)
+            p99 = roll.quantile("mri_serve_request_seconds", span, 99.0)
+            out[label] = {
+                "qps": round(
+                    roll.rate("mri_serve_requests_total", span), 3),
+                "shed_per_s": round(
+                    roll.rate("mri_serve_shed_total", span), 3),
+                "deadline_per_s": round(roll.rate(
+                    "mri_serve_deadline_expired_total", span), 3),
+                "error_per_s": round(roll.rate(
+                    "mri_serve_internal_errors_total", span), 3),
+                "p50_ms": round(p50 * 1e3, 3) if p50 is not None
+                          else None,
+                "p99_ms": round(p99 * 1e3, 3) if p99 is not None
+                          else None,
+            }
+        return out
 
     # -- flight recorder -----------------------------------------------
 
@@ -1087,6 +1188,9 @@ class ServeDaemon:
             self._g_inflight.set(self._inflight)
         self._g_queue_depth.set(self._queue.qsize())
         self._g_draining.set(1 if self._draining else 0)
+        self._slo.set_gauges(self.registry)
+        self.registry.gauge("mri_watchdog_heartbeat_age_seconds").set(
+            round(self._watchdog.max_age_s(), 6))
         parts = [self.registry.render_text(exemplars=self._exemplars)]
         if not self._drained.is_set():
             with self._reload_lock:
@@ -1141,6 +1245,11 @@ class ServeDaemon:
             self._drained.wait()
             return 0
         self._draining = True
+        # health machinery goes first: a drain wedging a loop must not
+        # fire spurious stall dumps, and the leak guard wants these
+        # threads gone with the rest
+        self._watchdog.stop()
+        self._rolling.stop()
         deadline = time.monotonic() + self.drain_s
         if self._listener is not None:
             try:

@@ -279,6 +279,43 @@ declare("MRI_OBS_EXEMPLARS", int, 1,
         "attaches the trace_id of a recent bucket-representative "
         "request to each bucket line in the scrape text, 0 omits them.",
         scope="obs", choices=(0, 1))
+declare("MRI_OBS_SAMPLE_MS", int, 1000,
+        "Rolling-window sampler period in ms: how often the daemon "
+        "snapshot-diffs the cumulative registry into per-period "
+        "buckets (the 10s/1m/5m SLI windows are built from them).",
+        scope="obs", minimum=10)
+declare("MRI_OBS_SLO_LATENCY_MS", float, 50.0,
+        "Latency SLO threshold in ms: the latency SLI is the fraction "
+        "of data requests answered at least this fast.",
+        scope="obs", minimum=0.001)
+declare("MRI_OBS_SLO_TARGET", float, 0.999,
+        "SLO objective (good-event fraction) shared by the "
+        "availability and latency SLOs; burn rate over a window is "
+        "error-rate / (1 - target).",
+        scope="obs", minimum=0.0)
+declare("MRI_OBS_STALL_MS", float, 5000.0,
+        "Watchdog stall threshold in ms: a monitored daemon thread "
+        "(dispatcher, accept) whose heartbeat ages past this is "
+        "declared stalled — counted, logged, flight-dumped, and "
+        "surfaced as `healthz` readiness `stalled`; 0 disables the "
+        "watchdog.",
+        scope="obs", minimum=0)
+declare("MRI_OBS_OVERLOAD_SHED_RATE", float, 0.5,
+        "healthz readiness threshold: the daemon reports `overloaded` "
+        "while the shed fraction (sheds / admission attempts) over "
+        "the rolling 10s window exceeds this.",
+        scope="obs", minimum=0.0)
+declare("MRI_OBS_LOG_FORMAT", str, "text",
+        "Runtime log rendering for mri_tpu.* loggers once "
+        "obs.logging.configure() has run (the serve daemon does): "
+        "text keeps classic `LEVEL logger: message` lines, json emits "
+        "one structured JSON object per line.",
+        scope="obs", choices=("text", "json"))
+declare("MRI_OBS_LOG_RATE_LIMIT", int, 200,
+        "Per-(logger, event) structured-log rate limit in records/s; "
+        "excess records are dropped and counted in "
+        "mri_obs_log_dropped_total. 0 disables the limiter.",
+        scope="obs", minimum=0)
 
 # -- benchmarks -------------------------------------------------------
 declare("MRI_TPU_BENCH_ATTEMPTS", int, 3,

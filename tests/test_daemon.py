@@ -768,22 +768,25 @@ def _assert_daemon_contract(summary):
 @pytest.mark.chaos
 def test_daemon_chaos_scenario_cycle_fast(tmp_path, chaos):
     """One seeded trial per serve scenario (overload burst, SIGTERM
-    mid-request, corrupt reload, client disconnect) against a real
-    subprocess daemon — the tier-1 smoke for the --daemon soak."""
-    summary = chaos.run_daemon_soak(tmp_path, trials=4, seed_base=7000,
+    mid-request, corrupt reload, client disconnect, watchdog stall)
+    against a real subprocess daemon — the tier-1 smoke for the
+    --daemon soak."""
+    n = len(chaos.DAEMON_SCENARIOS)
+    summary = chaos.run_daemon_soak(tmp_path, trials=n, seed_base=7000,
                                     deadline_s=60.0, verbose=False)
     _assert_daemon_contract(summary)
-    assert summary["trials"] == 4
+    assert summary["trials"] == n
     assert all(n == 1 for n in summary["by_scenario"].values())
 
 
 @pytest.mark.chaos
 @pytest.mark.slow
 def test_daemon_chaos_soak(tmp_path, chaos):
-    """The acceptance soak: 16 seeded trials, 4 per scenario — zero
+    """The acceptance soak: 4 seeded trials per scenario — zero
     hangs, zero lost or duplicated responses, every drain exits 0."""
-    summary = chaos.run_daemon_soak(tmp_path, trials=16, seed_base=7200,
+    n = 4 * len(chaos.DAEMON_SCENARIOS)
+    summary = chaos.run_daemon_soak(tmp_path, trials=n, seed_base=7200,
                                     deadline_s=60.0, verbose=False)
     _assert_daemon_contract(summary)
-    assert summary["trials"] == 16
+    assert summary["trials"] == n
     assert all(n == 4 for n in summary["by_scenario"].values())

@@ -91,6 +91,19 @@ def test_obs_metrics_scopes_to_serve_and_flags_dict_bumps():
     assert "obs.metrics Counter" in findings[0].message
 
 
+def test_obs_metrics_flags_stream_writes_in_serve_and_obs():
+    src = Source(FIXTURES / "bad_obs_print.py")
+    assert obs_metrics.check(src) == []  # outside the scope: silent
+    for scope in ("serve", "obs"):
+        src.rel = f"{PACKAGE}/{scope}/bad_obs_print.py"
+        findings = obs_metrics.check(src)
+        # bare print + sys.stderr.write flagged; the allow()-suppressed
+        # protocol print and the logger call stay silent
+        assert sorted(f.key for f in findings) == [
+            "print@report", "stderr-write@warn"]
+        assert "obs.logging.emit" in findings[0].message
+
+
 def test_obs_metrics_readme_table_in_sync():
     # the repo-level drift check: the committed README metrics table
     # must match what --write-readme would generate

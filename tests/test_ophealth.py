@@ -1,0 +1,618 @@
+"""Operational-health layer suite (obs/windows, obs/slo, obs/watchdog,
+obs/logging, the daemon surfaces, and `mri top`).
+
+Four layers:
+
+* unit math — RollingWindows over a fake clock (rates, expiry,
+  windowed quantiles / threshold fractions), SLOTracker burn rates,
+  and Watchdog episode semantics with a manual monitor pass;
+* structured logging — the emit() funnel's text/json rendering and
+  the per-event rate limiter (drops counted, never silent);
+* daemon surfaces — the `slo` admin op, the rolling/slo stats blocks,
+  liveness-vs-readiness healthz, mri_slo_*/mri_watchdog_* gauges in
+  the scrape, and trace/slow-log/windows under concurrent churn;
+* the contract — a subprocess daemon with an injected dispatcher hang
+  must flip readiness to `stalled` within 2x MRI_OBS_STALL_MS, dump a
+  flight-<pid>-stall.json, recover, and still drain to exit 0; and
+  `mri top --once --json` must agree with the raw stats/slo ops.
+"""
+
+import io
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from conftest import REPO_ROOT
+
+from test_daemon import DOCS, Client, serving
+
+from test_serve import build_corpus
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    main as cli_main,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    logging as obs_logging,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    metrics as obs_metrics,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    slo as obs_slo,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    watchdog as obs_watchdog,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    windows as obs_windows,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    return build_corpus(tmp_path_factory.mktemp("ophealth_corpus"), DOCS)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _windows(reg, clock, **kw):
+    kw.setdefault("counters", ["c"])
+    kw.setdefault("histograms", ["h"])
+    return obs_windows.RollingWindows(reg, period_s=1.0, clock=clock, **kw)
+
+
+# -- RollingWindows math ---------------------------------------------------
+
+
+def test_windows_counter_rates_and_age_clamp():
+    reg, clock = obs_metrics.Registry(), FakeClock()
+    rw = _windows(reg, clock)
+    reg.counter("c").inc(5)
+    clock.advance(1.0)
+    rw.sample()
+    assert rw.counts(10.0)["c"] == 5
+    # span clamps to process age: 1s old, so the "10s" rate is 5/s
+    assert rw.rate("c", 10.0) == pytest.approx(5.0)
+    # 9 more idle ticks: same 5 events over a full 10s window now
+    for _ in range(9):
+        clock.advance(1.0)
+        rw.sample()
+    assert rw.rate("c", 10.0) == pytest.approx(0.5)
+
+
+def test_windows_buckets_expire():
+    reg, clock = obs_metrics.Registry(), FakeClock()
+    rw = _windows(reg, clock)
+    reg.counter("c").inc(7)
+    clock.advance(1.0)
+    rw.sample()
+    assert rw.counts(10.0)["c"] == 7
+    # idle-tick past the 10s horizon: the burst ages out of the window
+    for _ in range(12):
+        clock.advance(1.0)
+        rw.sample()
+    assert rw.counts(10.0)["c"] == 0
+    # ... but the 1m window still sees it
+    assert rw.counts(60.0)["c"] == 7
+
+
+def test_windows_quantile_and_good_fraction():
+    reg, clock = obs_metrics.Registry(), FakeClock()
+    rw = _windows(reg, clock)
+    h = reg.histogram("h")
+    for _ in range(8):
+        h.observe(0.001)   # in the (512us, 1024us] bucket
+    for _ in range(2):
+        h.observe(1.0)     # far above any sane threshold
+    clock.advance(1.0)
+    rw.sample()
+    assert rw.hist_count("h", 10.0) == 10
+    q = rw.quantile("h", 10.0, 50)
+    assert 0.000512 <= q <= 0.001024  # interpolated inside the bucket
+    # 50ms threshold: the 8 fast obs are good, the 2 slow are not
+    assert rw.good_fraction("h", 10.0, 0.05) == pytest.approx(0.8)
+    # no samples in the window -> None, never a fake 0
+    assert rw.quantile("h", 10.0, 99) is not None
+    for _ in range(12):
+        clock.advance(1.0)
+        rw.sample()
+    assert rw.quantile("h", 10.0, 99) is None
+    assert rw.good_fraction("h", 10.0, 0.05) is None
+
+
+def test_windows_sampler_thread_lifecycle():
+    reg = obs_metrics.Registry()
+    rw = obs_windows.RollingWindows(reg, counters=["c"], period_s=0.01)
+    reg.counter("c").inc(3)
+    rw.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while rw.counts(10.0)["c"] < 3:
+            assert time.monotonic() < deadline, "sampler never ticked"
+            time.sleep(0.005)
+    finally:
+        rw.stop()
+    assert not [t for t in threading.enumerate()
+                if t.name == "mri-obs-sampler"]
+
+
+# -- SLOTracker ------------------------------------------------------------
+
+
+def _slo_windows(reg, clock):
+    names = [obs_slo._TOTAL, *obs_slo._BAD]
+    return obs_windows.RollingWindows(
+        reg, counters=names, histograms=[obs_slo._LATENCY_HIST],
+        period_s=1.0, clock=clock)
+
+
+def test_slo_availability_burn_math():
+    reg, clock = obs_metrics.Registry(), FakeClock()
+    rw = _slo_windows(reg, clock)
+    tracker = obs_slo.SLOTracker(rw, slos=(obs_slo.SLO("availability",
+                                                       0.999),))
+    # idle: a quiet daemon is not failing
+    idle = tracker.report()["availability"]["windows"]["10s"]
+    assert idle["ratio"] == 1.0 and idle["burn"] == 0.0
+    # 95 admitted + 5 shed: 100 admission attempts, 5 bad
+    reg.counter(obs_slo._TOTAL).inc(95)
+    reg.counter("mri_serve_shed_total").inc(5)
+    clock.advance(1.0)
+    rw.sample()
+    pt = tracker.report()["availability"]["windows"]["10s"]
+    assert pt["total"] == 100 and pt["bad"] == 5
+    assert pt["ratio"] == pytest.approx(0.95)
+    # burn = (1 - 0.95) / (1 - 0.999): 50x the sustainable error rate
+    assert pt["burn"] == pytest.approx(50.0)
+
+
+def test_slo_latency_burn_and_gauges():
+    reg, clock = obs_metrics.Registry(), FakeClock()
+    rw = _slo_windows(reg, clock)
+    tracker = obs_slo.SLOTracker(
+        rw, slos=(obs_slo.SLO("latency", 0.99, threshold_ms=50.0),))
+    h = reg.histogram(obs_slo._LATENCY_HIST)
+    for _ in range(8):
+        h.observe(0.001)
+    for _ in range(2):
+        h.observe(1.0)
+    clock.advance(1.0)
+    rw.sample()
+    pt = tracker.report()["latency"]["windows"]["10s"]
+    assert pt["total"] == 10
+    assert pt["ratio"] == pytest.approx(0.8)
+    assert pt["burn"] == pytest.approx(0.2 / 0.01)
+    tracker.set_gauges(reg)
+    text = reg.render_text()
+    assert "mri_slo_latency_ratio_10s 0.8" in text
+    assert "mri_slo_latency_burn_1m" in text
+
+
+def test_default_slos_read_knobs(monkeypatch):
+    monkeypatch.setenv("MRI_OBS_SLO_TARGET", "0.95")
+    monkeypatch.setenv("MRI_OBS_SLO_LATENCY_MS", "12.5")
+    avail, lat = obs_slo.default_slos()
+    assert avail.target == lat.target == 0.95
+    assert avail.threshold_ms is None and lat.threshold_ms == 12.5
+    assert avail.budget() == pytest.approx(0.05)
+
+
+# -- Watchdog --------------------------------------------------------------
+
+
+def test_watchdog_fires_once_per_episode_and_recovers():
+    clock = FakeClock()
+    reg = obs_metrics.Registry()
+    stalls, recoveries = [], []
+    wd = obs_watchdog.Watchdog(
+        100.0, on_stall=lambda n, age: stalls.append((n, age)),
+        on_recover=recoveries.append, registry=reg, clock=clock)
+    wd.register("dispatcher")
+    assert wd.check() == [] and wd.stalled() == []
+    clock.advance(0.2)  # 200ms > the 100ms threshold
+    assert wd.check() == ["dispatcher"]
+    assert len(stalls) == 1 and stalls[0][0] == "dispatcher"
+    assert stalls[0][1] == pytest.approx(200.0)
+    # still stalled: no re-fire within the same episode
+    clock.advance(0.2)
+    assert wd.check() == ["dispatcher"] and len(stalls) == 1
+    assert reg.counter(obs_watchdog.STALLS_TOTAL).value == 1
+    # heartbeat resumes: recovery fires, a new episode can fire again
+    wd.beat("dispatcher")
+    assert wd.check() == []
+    assert recoveries == ["dispatcher"]
+    clock.advance(0.2)
+    assert wd.check() == ["dispatcher"] and len(stalls) == 2
+    assert reg.counter(obs_watchdog.STALLS_TOTAL).value == 2
+
+
+def test_watchdog_zero_threshold_disables():
+    wd = obs_watchdog.Watchdog(0.0)
+    assert not wd.enabled
+    wd.start()
+    assert wd._thread is None  # start() is a no-op when disabled
+    wd.register("x")
+    assert wd.check() == []
+
+
+def test_watchdog_ages_and_callback_exceptions_swallowed():
+    clock = FakeClock()
+    wd = obs_watchdog.Watchdog(
+        50.0, on_stall=lambda n, a: 1 / 0, clock=clock)
+    wd.register("a")
+    clock.advance(0.1)
+    assert wd.ages_ms()["a"] == pytest.approx(100.0)
+    assert wd.max_age_s() == pytest.approx(0.1)
+    assert wd.check() == ["a"]  # the ZeroDivisionError never escapes
+
+
+# -- structured logging ----------------------------------------------------
+
+
+@pytest.fixture
+def _fresh_logging():
+    yield
+    obs_logging.reset()
+
+
+def test_emit_text_format(_fresh_logging):
+    stream = io.StringIO()
+    obs_logging.configure(stream)
+    log = logging.getLogger("mri_tpu.test_text")
+    obs_logging.emit(log, "hello", level=logging.WARNING, a=1, b="x")
+    line = stream.getvalue().strip()
+    assert line.startswith("WARNING mri_tpu.test_text: ")
+    payload = json.loads(line.split(": ", 1)[1])
+    assert payload == {"event": "hello", "a": 1, "b": "x"}
+
+
+def test_emit_json_format(monkeypatch, _fresh_logging):
+    monkeypatch.setenv("MRI_OBS_LOG_FORMAT", "json")
+    stream = io.StringIO()
+    obs_logging.configure(stream)
+    log = logging.getLogger("mri_tpu.test_json")
+    obs_logging.emit(log, "hello", a=1)
+    rec = json.loads(stream.getvalue().strip())
+    assert rec["event"] == "hello" and rec["a"] == 1
+    assert rec["level"] == "INFO" and rec["logger"] == "mri_tpu.test_json"
+    assert isinstance(rec["ts"], float)
+    # reconfigure back to text swaps the formatter without stacking
+    monkeypatch.setenv("MRI_OBS_LOG_FORMAT", "text")
+    obs_logging.configure(stream)
+    root = logging.getLogger(obs_logging.ROOT_LOGGER)
+    assert sum(1 for h in root.handlers
+               if getattr(h, "_mri_obs_handler", False)) == 1
+
+
+def test_emit_rate_limit_counts_drops(monkeypatch, _fresh_logging):
+    monkeypatch.setenv("MRI_OBS_LOG_RATE_LIMIT", "1")
+    stream = io.StringIO()
+    obs_logging.configure(stream)
+    log = logging.getLogger("mri_tpu.test_rate")
+    dropped = obs_metrics.default_registry().counter(
+        "mri_obs_log_dropped_total")
+    before = dropped.value
+    for i in range(50):
+        obs_logging.emit(log, "burst", i=i)
+    lines = [ln for ln in stream.getvalue().splitlines() if ln]
+    # 1/sec allowed; the loop may straddle one second boundary
+    assert 1 <= len(lines) <= 2
+    assert dropped.value - before == 50 - len(lines)
+    # a different event key has its own bucket
+    obs_logging.emit(log, "other")
+    assert "other" in stream.getvalue()
+
+
+# -- daemon surfaces -------------------------------------------------------
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_daemon_slo_op_and_stats_blocks(built):
+    with serving(built) as d, Client(d) as cli:
+        for i in range(4):
+            assert cli.rpc(id=i, op="df", terms=["cat"])["ok"]
+        r = cli.rpc(op="slo")
+        assert r["ok"]
+        assert set(r["slo"]) == {"availability", "latency"}
+        for entry in r["slo"].values():
+            assert set(entry["windows"]) == {"10s", "1m", "5m"}
+            for pt in entry["windows"].values():
+                assert 0.0 <= pt["ratio"] <= 1.0 and pt["burn"] >= 0.0
+        assert r["slo"]["latency"]["threshold_ms"] == \
+            obs_slo.slo_latency_ms()
+        st = cli.rpc(op="stats")["stats"]
+        assert set(st["rolling"]) == {"10s", "1m", "5m"}
+        for w in st["rolling"].values():
+            assert {"qps", "shed_per_s", "error_per_s",
+                    "p50_ms", "p99_ms"} <= set(w)
+        assert set(st["slo"]) == {"availability", "latency"}
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_daemon_healthz_liveness_vs_readiness(built):
+    with serving(built) as d, Client(d) as cli:
+        h = cli.rpc(op="healthz")
+        assert h["ok"] is True and h["live"] is True
+        assert h["ready"] is True and h["reasons"] == []
+        assert h["status"] == "ok"
+        # a reload in flight flips readiness, never liveness
+        d._reloading = True
+        try:
+            h = cli.rpc(op="healthz")
+            assert h["ok"] is True and h["live"] is True
+            assert h["ready"] is False and h["reasons"] == ["reloading"]
+            assert h["status"] == "reloading"
+        finally:
+            d._reloading = False
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_daemon_scrape_has_health_gauges(built):
+    with serving(built) as d, Client(d) as cli:
+        assert cli.rpc(id=1, op="df", terms=["cat"])["ok"]
+        text = cli.rpc(op="metrics")["text"]
+        for name in ("mri_slo_availability_ratio_10s",
+                     "mri_slo_availability_burn_5m",
+                     "mri_slo_latency_ratio_1m",
+                     "mri_watchdog_heartbeat_age_seconds"):
+            assert f"\n{name} " in text, name
+        vals = {}
+        for line in text.splitlines():
+            if line and not line.startswith("#") \
+                    and "{" not in line.split(" ", 1)[0]:
+                name, _, v = line.partition(" ")
+                vals[name] = float(v)
+        assert 0.0 <= vals["mri_slo_availability_ratio_10s"] <= 1.0
+        # live heartbeats: well under the 5s default stall threshold
+        assert vals["mri_watchdog_heartbeat_age_seconds"] < 5.0
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_daemon_obs_surfaces_under_concurrent_churn(built):
+    """Trace ring + slow-query log + windows sampler while queries,
+    hot reloads and scrapes all run concurrently: every response is
+    answered, the final exposition has no duplicate families, and the
+    rolling stats stay well-formed."""
+    errors = []
+    with serving(built) as d:
+        stop = threading.Event()
+
+        def hammer(wid):
+            try:
+                with Client(d) as cli:
+                    i = 0
+                    while not stop.is_set():
+                        r = cli.rpc(id=i, op="df", terms=["cat"],
+                                    trace_id=f"w{wid}-{i}")
+                        assert r["ok"], r
+                        i += 1
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def scraper():
+            try:
+                with Client(d) as cli:
+                    while not stop.is_set():
+                        assert cli.rpc(op="metrics")["ok"]
+                        assert cli.rpc(op="trace", n=8)["ok"]
+                        assert cli.rpc(op="stats")["ok"]
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(w,))
+                   for w in range(2)] + [threading.Thread(target=scraper)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 1.5
+        reloads = 0
+        while time.monotonic() < deadline:
+            ok, _msg = d.reload()
+            assert ok
+            reloads += 1
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive()
+        assert not errors, errors
+        assert reloads >= 3
+        with Client(d) as cli:
+            text = cli.rpc(op="metrics")["text"]
+            fams = [ln.split()[2] for ln in text.splitlines()
+                    if ln.startswith("# TYPE ")]
+            assert len(fams) == len(set(fams))
+            traces = cli.rpc(op="trace", n=16)["traces"]
+            assert traces and all(t["status"] == "ok" for t in traces)
+            st = cli.rpc(op="stats")["stats"]
+            assert st["counters"]["requests"] > 0
+            assert st["counters"]["internal_errors"] == 0
+            assert st["counters"]["reload_ok"] == reloads
+            for w in st["rolling"].values():
+                assert w["qps"] >= 0.0
+
+
+# -- mri top ---------------------------------------------------------------
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_top_once_json_parity_with_raw_ops(built, capsys):
+    with serving(built) as d, Client(d) as cli:
+        for i in range(3):
+            assert cli.rpc(id=i, op="df", terms=["dog"])["ok"]
+        stats = cli.rpc(op="stats")["stats"]
+        slo = cli.rpc(op="slo")["slo"]
+        host, port = d.address
+        assert cli_main(["top", f"{host}:{port}", "--once",
+                         "--json"]) == 0
+        sample = json.loads(capsys.readouterr().out)
+        # admission counters are frozen on the quiescent daemon;
+        # responses/connections move with every admin RPC (including
+        # top's own poll), so those are gated monotone, not exact
+        top_counters = dict(sample["stats"]["counters"])
+        want = dict(stats["counters"])
+        for key in ("responses", "connections"):
+            assert top_counters.pop(key) >= want.pop(key)
+        assert top_counters == want
+        h = sample["healthz"]
+        assert h["ok"] and h["live"] and h["ready"]
+        assert set(sample["slo"]) == set(slo)
+        for name, entry in sample["slo"].items():
+            assert entry["target"] == slo[name]["target"]
+            assert set(entry["windows"]) == {"10s", "1m", "5m"}
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+def test_top_plain_frame_renders(built, capsys):
+    with serving(built) as d, Client(d) as cli:
+        assert cli.rpc(id=1, op="df", terms=["cat"])["ok"]
+        host, port = d.address
+        assert cli_main(["top", f"{host}:{port}", "--once"]) == 0
+        frame = capsys.readouterr().out
+        assert "ready" in frame
+        assert "slo availability" in frame and "slo latency" in frame
+        for label in ("10s", "1m", "5m"):
+            assert label in frame
+
+
+def test_top_static_dir_mode(built, capsys):
+    assert cli_main(["top", str(built), "--once", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "engine" in doc
+    assert "mri_engine_vocab_terms" in doc["metrics_text"]
+
+
+def test_top_unreachable_addr_exit_2(capsys):
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    assert cli_main(["top", f"127.0.0.1:{port}", "--once",
+                     "--timeout", "2"]) == 2
+    assert "error" in capsys.readouterr().err
+
+
+# -- the stall contract (subprocess) ---------------------------------------
+
+
+def _spawn_serve(out, *extra, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT), JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(out), "--listen", "127.0.0.1:0", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=str(REPO_ROOT), text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise AssertionError(
+            f"daemon died on startup: {proc.stderr.read()}")
+    ready = json.loads(line)
+    assert ready["event"] == "listening"
+    return proc, (ready["host"], ready["port"])
+
+
+@pytest.mark.daemon
+@pytest.mark.serve
+@pytest.mark.faults
+def test_cli_dispatcher_hang_flips_readiness_and_dumps(tmp_path):
+    """The acceptance contract: an injected dispatcher hang must flip
+    healthz readiness to `stalled` within 2x MRI_OBS_STALL_MS, bump
+    mri_watchdog_stalls_total, drop a flight-<pid>-stall.json next to
+    the artifact, recover when the hang ends, and still drain to 0."""
+    stall_ms, hang_ms = 400.0, 2000.0
+    out = build_corpus(tmp_path, DOCS)
+    proc, addr = _spawn_serve(
+        out, "--fault-spec", f"dispatcher-hang:ms={hang_ms:.0f}",
+        env_extra={"MRI_OBS_STALL_MS": str(stall_ms)})
+    try:
+        with Client(addr) as trigger, Client(addr) as probe:
+            # healthz answers inline from the reader thread, so the
+            # probe keeps working while the dispatcher is wedged
+            trigger.send(id=1, op="df", terms=["cat"])
+            t0 = time.monotonic()
+            flip = None
+            deadline = t0 + 2 * stall_ms / 1e3 + 2.0
+            while time.monotonic() < deadline:
+                h = probe.rpc(op="healthz")
+                assert h["ok"] is True, h  # liveness never flips
+                if not h["ready"] and "stalled" in h["reasons"]:
+                    flip = (time.monotonic() - t0) * 1e3
+                    break
+                time.sleep(0.02)
+            assert flip is not None, "readiness never flipped to stalled"
+            assert flip <= 2 * stall_ms + 2000.0
+
+            vals = {}
+            for line in probe.rpc(op="metrics")["text"].splitlines():
+                if line and not line.startswith("#"):
+                    name, _, v = line.partition(" ")
+                    if "{" not in name:
+                        vals[name] = float(v)
+            assert vals["mri_watchdog_stalls_total"] >= 1
+
+            dump = out / f"flight-{proc.pid}-stall.json"
+            for _ in range(100):  # the dump is written off-thread
+                if dump.exists():
+                    break
+                time.sleep(0.05)
+            doc = json.loads(dump.read_text(encoding="utf-8"))
+            assert doc, "stall flight dump is empty"
+
+            # the hang ends: the wedged request answers, health recovers
+            r = trigger.recv()
+            assert r["ok"] and r["id"] == 1
+            deadline = time.monotonic() + hang_ms / 1e3 + 5.0
+            while time.monotonic() < deadline:
+                h = probe.rpc(op="healthz")
+                if h["ready"]:
+                    break
+                time.sleep(0.05)
+            assert h["ready"] and "stalled" not in h["reasons"]
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        proc.stdout.close()
+        proc.stderr.close()

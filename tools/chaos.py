@@ -23,12 +23,15 @@ no worker/reducer recovery layer by design (nothing to take over for) —
 its trials sample only the read-level kinds the retry policy handles.
 
 ``--daemon`` switches to the serve-side soak: seeded trials thrown at a
-REAL ``mri serve`` subprocess, cycled over four scenarios (overload
+REAL ``mri serve`` subprocess, cycled over five scenarios (overload
 burst, SIGTERM mid-request, corrupt hot reload, abrupt client
-disconnect).  The contract mirrors the build-side one: every admitted
-request is answered exactly once (ok or a counted error kind), a
-surviving client always gets oracle-correct answers, SIGTERM always
-drains to exit 0, and nothing ever hangs past the deadline:
+disconnect, fault-armed dispatcher hang — the watchdog leg: healthz
+readiness must flip to 'stalled' within 2x MRI_OBS_STALL_MS, a
+flight-recorder stall dump must appear, and the daemon must recover).
+The contract mirrors the build-side one: every admitted request is
+answered exactly once (ok or a counted error kind), a surviving client
+always gets oracle-correct answers, SIGTERM always drains to exit 0,
+and nothing ever hangs past the deadline:
 
     python tools/chaos.py --daemon --trials 12 --seed-base 7000
     python tools/chaos.py --daemon --repro 7003
@@ -247,7 +250,14 @@ def run_soak(work_dir: Path, trials: int, seed_base: int,
 # oracle-correct answers, SIGTERM drains to exit 0, never a hang.
 
 DAEMON_SCENARIOS = ("overload", "sigterm-mid-request",
-                    "reload-corrupt", "client-disconnect")
+                    "reload-corrupt", "client-disconnect",
+                    "watchdog-stall")
+
+#: watchdog-stall knobs: the armed dispatcher hang must comfortably
+#: outlast the stall threshold, and the threshold is short so the
+#: trial's healthz flip budget (2x stall) stays well under a second
+_WATCHDOG_STALL_MS = 300
+_WATCHDOG_HANG_MS = 1500
 
 #: Error kinds a client may legitimately see under chaos — anything
 #: else (or a missing/duplicate response) fails the trial.
@@ -487,6 +497,63 @@ def _scenario_client_disconnect(addr, oracle, rng, verdict):
     return _parity_probe(addr, oracle, rng)
 
 
+def _scenario_watchdog_stall(addr, oracle, rng, verdict, proc, out_dir):
+    """Fault-armed dispatcher hang mid-soak: healthz readiness flips
+    to 'stalled' within the 2x MRI_OBS_STALL_MS contract bound, a
+    flight-recorder stall dump appears next to the artifact, the
+    watchdog counter lands in the exposition, and the daemon recovers
+    to oracle-correct serving once the hang clears."""
+    stall_s = _WATCHDOG_STALL_MS / 1e3
+    c = _ChaosClient(addr)
+    probe = _ChaosClient(addr)
+    try:
+        # the armed dispatcher-hang fires on the next popped batch
+        t_trigger = time.monotonic()
+        c.send(id="hang", op="df", terms=["chaosterm"])
+        # admin ops answer inline from reader threads: healthz keeps
+        # working while the dispatcher is wedged — that is the point
+        flip_deadline = t_trigger + 2 * stall_s + 2.0
+        flipped_at = None
+        while time.monotonic() < flip_deadline:
+            h = probe.rpc(id="h", op="healthz")
+            if not h.get("ready", True) \
+                    and "stalled" in h.get("reasons", ()):
+                flipped_at = time.monotonic()
+                break
+            time.sleep(0.02)
+        if flipped_at is None:
+            return "healthz never flipped to stalled during the hang"
+        verdict["flip_ms"] = round((flipped_at - t_trigger) * 1e3, 1)
+        if h.get("ok") is not True:
+            return f"liveness must survive a stall, got {h}"
+        # the wedged request is answered once the hang clears
+        r = c.recv()
+        if r is None or not r.get("ok"):
+            return f"hung request never answered ok: {r}"
+        # recovery: heartbeats resume, readiness comes back
+        recover_deadline = time.monotonic() + _WATCHDOG_HANG_MS / 1e3 + 5
+        while time.monotonic() < recover_deadline:
+            h = probe.rpc(id="h2", op="healthz")
+            if h.get("ready"):
+                break
+            time.sleep(0.05)
+        if not h.get("ready"):
+            return f"readiness never recovered after the hang: {h}"
+        dump = out_dir / f"flight-{proc.pid}-stall.json"
+        if not dump.exists():
+            return f"stall dump {dump.name} never written"
+        json.loads(dump.read_text(encoding="utf-8"))  # parseable
+        text = probe.rpc(id="m", op="metrics").get("text", "")
+        fired = [ln for ln in text.splitlines()
+                 if ln.startswith("mri_watchdog_stalls_total ")]
+        if not fired or float(fired[0].split()[1]) < 1:
+            return f"mri_watchdog_stalls_total not bumped: {fired}"
+    finally:
+        probe.close()
+        c.close()
+    return _parity_probe(addr, oracle, rng)
+
+
 def run_daemon_trial(out_dir: Path, oracle: dict, seed: int,
                      scenario: str, deadline_s: float = 60.0) -> dict:
     """One seeded serve-side trial; ``ok`` False only on a contract
@@ -501,6 +568,10 @@ def run_daemon_trial(out_dir: Path, oracle: dict, seed: int,
                      "MRI_SERVE_COALESCE_US": "0"}
     elif scenario == "reload-corrupt":
         extra = ["--fault-spec", "reload-corrupt"]
+    elif scenario == "watchdog-stall":
+        extra = ["--fault-spec",
+                 f"dispatcher-hang:ms={_WATCHDOG_HANG_MS}"]
+        env_extra = {"MRI_OBS_STALL_MS": str(_WATCHDOG_STALL_MS)}
     t0 = time.monotonic()
     try:
         proc, addr = _spawn_daemon(out_dir, *extra, env_extra=env_extra)
@@ -519,6 +590,9 @@ def run_daemon_trial(out_dir: Path, oracle: dict, seed: int,
                     addr, oracle, rng, verdict, proc)
             elif scenario == "client-disconnect":
                 err = _scenario_client_disconnect(addr, oracle, rng, verdict)
+            elif scenario == "watchdog-stall":
+                err = _scenario_watchdog_stall(
+                    addr, oracle, rng, verdict, proc, out_dir)
             else:
                 raise ValueError(f"unknown scenario {scenario!r}")
         except (OSError, RuntimeError, ValueError, KeyError) as e:

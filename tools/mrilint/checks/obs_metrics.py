@@ -2,13 +2,21 @@
 
 Two halves:
 
-* Per-file: a hand-rolled counter bump — ``something["key"] += n`` on a
-  constant string key — inside ``serve/`` is a finding.  The obs/
-  migration replaced every scattered counter dict with registry-backed
-  :class:`obs.metrics.Counter` objects (their own locks, Prometheus
-  names, one source of truth); a new dict-subscript increment is the
-  old idiom creeping back.  Suppress a legitimate non-metric tally with
-  ``# mrilint: allow(obs-metrics) reason``.
+* Per-file, inside ``serve/`` and ``obs/``:
+
+  - a hand-rolled counter bump — ``something["key"] += n`` on a
+    constant string key — is a finding.  The obs/ migration replaced
+    every scattered counter dict with registry-backed
+    :class:`obs.metrics.Counter` objects (their own locks, Prometheus
+    names, one source of truth); a new dict-subscript increment is the
+    old idiom creeping back.
+  - a bare ``print()`` / ``sys.stderr.write`` / ``sys.stdout.write``
+    is a finding: daemon-side output goes through the structured
+    ``obs/logging.py`` funnel (or the protocol), never ad-hoc stream
+    writes that bypass format, rate limiting and the scrape surface.
+
+  Suppress a legitimate exception (a non-metric tally, a
+  wire-protocol write) with ``# mrilint: allow(obs-metrics) reason``.
 
 * Repo-level: the README metrics table between
   ``<!-- obsmetrics:begin -->`` and ``<!-- obsmetrics:end -->`` is
@@ -34,7 +42,7 @@ RULE = "obs-metrics"
 _BEGIN = "<!-- obsmetrics:begin -->"
 _END = "<!-- obsmetrics:end -->"
 
-_SCOPE = PACKAGE + "/serve/"
+_SCOPE = (PACKAGE + "/serve/", PACKAGE + "/obs/")
 
 
 def _describe_target(node: ast.Subscript) -> str:
@@ -44,11 +52,40 @@ def _describe_target(node: ast.Subscript) -> str:
         return "<subscript>"
 
 
+def _stream_write(node: ast.Call) -> str | None:
+    """'print' / 'stderr-write' / 'stdout-write' when the call is an
+    ad-hoc stream write, else None."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "print":
+        return "print"
+    if (isinstance(func, ast.Attribute) and func.attr == "write"
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr in ("stderr", "stdout")
+            and isinstance(func.value.value, ast.Name)
+            and func.value.value.id == "sys"):
+        return f"{func.value.attr}-write"
+    return None
+
+
 def check(src: Source) -> list[Finding]:
     if not src.rel.startswith(_SCOPE):
         return []
     findings: list[Finding] = []
     for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            kind = _stream_write(node)
+            if kind is None or src.allowed(node, RULE):
+                continue
+            fn = src.enclosing_function(node)
+            where = fn.name if fn is not None else "<module>"
+            findings.append(Finding(
+                rule=RULE, path=src.rel, line=node.lineno,
+                key=f"{kind}@{where}",
+                message=(f"bare {kind.replace('-', '.')}() in the "
+                         f"serving/obs plane — route output through "
+                         f"obs.logging.emit (structured, rate-limited) "
+                         f"or suppress with a reason")))
+            continue
         if not isinstance(node, ast.AugAssign):
             continue
         if not isinstance(node.op, ast.Add):
