@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments bench-slo bench-history capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments bench-slo bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -44,6 +44,12 @@ test-faults:
 # byte-identity matrix, letter-partitioned reduce)
 test-parallel:
 	$(PY) -m pytest tests/ -q -m parallel_host
+
+# out-of-core build suite: spill container integrity, shard-merge
+# algebra, (shards, budget, K, M) byte-identity matrix, quarantine /
+# takeover degradation, SIGKILL-at-spill-boundary resume
+test-spill:
+	$(PY) -m pytest tests/ -q -m spill
 
 # chaos suite: the fast matrix cycle runs in tier-1 (`chaos and not
 # slow`); this target adds the full 50+-trial seeded soak
@@ -129,6 +135,12 @@ bench-scale:
 # same corpus, with the per-worker stage split (prints a JSON line)
 bench-sweep:
 	$(PY) bench.py --sweep
+
+# out-of-core build bench: spill-tier wall vs the in-memory parallel
+# build on a >= 20x-budget Zipf corpus, byte-parity + peak-memory
+# gated -> BENCH_BUILD_OOC_r15.json
+bench-build-ooc:
+	$(PY) tools/bench_build_ooc.py
 
 # query-serving QPS/latency bench against the packed artifact (Zipf
 # workload, batch sizes 1/32/1024; prints a JSON line) — see

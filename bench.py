@@ -594,8 +594,14 @@ def _host_stage_split(report: dict) -> dict:
     ``stage_*_ms`` counters; the one-shot fallback only knows its two
     coarse phases (load ≈ read, index_emit ≈ tokenize+emit fused)."""
     if "stage_read_ms" in report:
-        return {k: round(float(report[f"stage_{k}_ms"]), 2)
-                for k in ("read", "tokenize", "emit")}
+        split = {k: round(float(report[f"stage_{k}_ms"]), 2)
+                 for k in ("read", "tokenize", "emit")}
+        # out-of-core runs carry the term-hash shard balance (postings
+        # per shard + max/mean skew) so the split shows WHERE the
+        # reduce-side work landed, not just how long it took
+        if "build_shards" in report:
+            split["build_shards"] = report["build_shards"]
+        return split
     phases = report.get("phases_ms", {})
     split = {}
     if "load" in phases:

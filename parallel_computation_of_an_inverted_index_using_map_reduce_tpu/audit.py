@@ -170,6 +170,26 @@ def check_merge(merge, streams) -> None:
             f"[{lo}, {hi}] of the worker vocabularies")
 
 
+def check_spill(run_pairs: int, merged_pairs: int, run_vocab_hi: int,
+                merged_vocab: int) -> None:
+    """Spill-tier merge invariants (``--audit``, out-of-core path).
+
+    The disk tier's analogue of :func:`check_merge`: every (term, doc)
+    pair written to a verified run must come back out of the per-shard
+    k-way merge exactly once (per-term ascending order and pair
+    uniqueness are enforced inside the merge itself), and the merged
+    vocabulary can't exceed the sum of the runs' vocabularies.
+    """
+    if run_pairs != merged_pairs:
+        raise AuditError(
+            f"audit: shard merge folded {merged_pairs} (term, doc) "
+            f"pairs but the spill runs hold {run_pairs}")
+    if merged_vocab > run_vocab_hi:
+        raise AuditError(
+            f"audit: merged vocab {merged_vocab} exceeds the sum "
+            f"{run_vocab_hi} of the spill runs' vocabularies")
+
+
 def letter_checksums(out_dir) -> dict[str, tuple[str, int]]:
     """``{filename: (adler32_hex, size_bytes)}`` for a.txt..z.txt, plus
     the ``index.mri`` serving artifact when the run packed one — a torn

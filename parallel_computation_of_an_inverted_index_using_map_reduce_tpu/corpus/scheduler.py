@@ -285,6 +285,21 @@ def shard_balance_stats(manifest: Manifest, plan: ShardPlan) -> dict:
         [sum(manifest.sizes[i] for i in shard) for shard in plan.shards])
 
 
+def term_shard_balance(postings_per_shard: list[int]) -> dict:
+    """Postings per term-hash shard + skew ratio (max/mean) — the
+    out-of-core build's balance report, directly comparable against the
+    reference's 26-letter split (pass per-letter postings counts to see
+    why hash sharding wins: Zipf mass concentrates on a few letters but
+    spreads evenly under the term hash)."""
+    loads = [int(n) for n in postings_per_shard]
+    mean = sum(loads) / len(loads) if loads else 0.0
+    return {
+        "shards": len(loads),
+        "postings_per_shard": loads,
+        "max_over_mean": round(max(loads) / mean, 3) if mean else 0.0,
+    }
+
+
 def window_balance_stats(manifest: Manifest, windows) -> dict:
     """Balance stats for contiguous ``[lo, hi)`` ranges (the pipelined
     upload windows) — same metric as :func:`shard_balance_stats`."""

@@ -170,7 +170,8 @@ class InjectedCompactCrash(RuntimeError):
 
 _READ_KINDS = ("read-error", "slow-read", "truncate")
 _DEATH_KINDS = ("reader-death", "sigkill", "stream-crash", "ckpt-corrupt",
-                "worker-death", "reducer-death", "scan-error", "chaos")
+                "worker-death", "reducer-death", "scan-error",
+                "spill-corrupt", "merge-crash", "chaos")
 _SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
                 "handler-crash", "dispatcher-hang")
 _SEGMENT_KINDS = ("append-torn-manifest", "compact-crash",
@@ -196,6 +197,12 @@ SERVE_CHAOS_KINDS = ("client-disconnect", "slow-client", "handler-crash",
 #: serve kinds: a build soak should never sample them.
 SEGMENT_CHAOS_KINDS = _SEGMENT_KINDS
 
+#: What ``chaos:kinds=...`` may name for spill-armed build soaks —
+#: the out-of-core tier's fault points (torn run file, dead shard
+#: merger).  Named-only: they can only fire when
+#: ``MRI_BUILD_SPILL_BYTES`` routes the build through the spill tier.
+SPILL_CHAOS_KINDS = ("spill-corrupt", "merge-crash")
+
 
 @dataclasses.dataclass
 class _Rule:
@@ -209,6 +216,8 @@ class _Rule:
     window: int = 0             # reader-death / sigkill / stream-crash /
                                 # worker-death / scan-error (0 = any)
     save: int = 0               # ckpt-corrupt
+    spill: int = 0              # spill-corrupt: 1-based run-file ordinal
+    shard: int | None = None    # merge-crash (None = any shard)
     worker: int | None = None   # worker-death (None = any worker)
     reducer: int | None = None  # reducer-death (None = any reducer)
     silent: int = 0             # scan-error: 1 = drop window, no raise
@@ -278,6 +287,10 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             rule.window = _parse_int(head, k, v)
         elif k == "save":
             rule.save = _parse_int(head, k, v)
+        elif k == "spill":
+            rule.spill = _parse_int(head, k, v)
+        elif k == "shard":
+            rule.shard = _parse_int(head, k, v)
         elif k == "worker":
             rule.worker = _parse_int(head, k, v)
         elif k == "reducer":
@@ -304,12 +317,12 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             kinds = tuple(s for s in v.split(",") if s)
             bad = [s for s in kinds
                    if s not in (CHAOS_KINDS + SERVE_CHAOS_KINDS
-                                + SEGMENT_CHAOS_KINDS)]
+                                + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS)]
             if bad:
                 raise FaultSpecError(
                     f"chaos: kinds not samplable: {bad} "
                     f"(choose from "
-                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS)})")
+                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS)})")
             rule.kinds = kinds
         else:
             raise FaultSpecError(f"{head}: unknown key {k!r}")
@@ -318,6 +331,8 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         raise FaultSpecError(f"{head} needs window=N (1-based)")
     if rule.kind == "ckpt-corrupt" and rule.save < 1:
         raise FaultSpecError("ckpt-corrupt needs save=N (1-based)")
+    if rule.kind == "spill-corrupt" and rule.spill < 1:
+        raise FaultSpecError("spill-corrupt needs spill=N (1-based)")
     if rule.kind == "scan-error" and rule.window < 1:
         raise FaultSpecError("scan-error needs window=N (1-based)")
     if rule.kind in ("client-disconnect", "slow-client", "handler-crash") \
@@ -377,6 +392,14 @@ def _sample_chaos(rule: _Rule) -> list[_Rule]:
         elif kind == "slow-client":
             out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs),
                              ms=float(rng.choice((20, 50, 100)))))
+        elif kind == "spill-corrupt":
+            # early run ordinals: tiny-budget soaks write a handful of
+            # runs per worker, so the Nth file must exist to be torn
+            out.append(_Rule(kind=kind, spill=rng.randint(1, 3)))
+        elif kind == "merge-crash":
+            # any-shard: fires on whichever merger reaches it first,
+            # so the takeover is guaranteed to be exercised
+            out.append(_Rule(kind=kind))
         elif kind in _SEGMENT_KINDS:
             # no ordinal to pick: each fires once, on the next matching
             # segment mutation (times=1 default)
@@ -409,6 +432,7 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._fired: dict[tuple[int, int], int] = {}
         self._saves = 0
+        self._spills = 0
 
     def _matches(self, rule: _Rule, index: int) -> bool:
         if rule.doc is not None and index != rule.doc:
@@ -571,6 +595,42 @@ class FaultInjector:
                     f.truncate(max(size // 3, 1))
                 log.warning("fault injection: corrupted checkpoint "
                             "%s (save #%d)", path, saves)
+
+    def on_spill_written(self, path: str) -> None:
+        """Fires after every atomic spill-run write (build/spill.py);
+        the Nth run file process-wide may have a byte flipped in place,
+        simulating the torn run the reduce-side checksum walk must
+        quarantine instead of merging."""
+        with self._lock:
+            self._spills += 1
+            spills = self._spills
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "spill-corrupt" or rule.spill != spills:
+                    continue
+                if self._fire_once(ri, rule):
+                    with open(path, "r+b") as f:
+                        data = f.read()
+                        at = max(len(data) // 2 - 1, 0)
+                        f.seek(at)
+                        f.write(bytes([data[at] ^ 0xFF]))
+                    log.warning("fault injection: corrupted spill run "
+                                "%s (run file #%d)", path, spills)
+
+    def on_shard_merge(self, shard: int) -> None:
+        """Fires in a reduce worker before it k-way-merges term-hash
+        shard ``shard`` (0-based); may raise — the dead shard merger
+        whose shards the main thread re-merges (the runs on disk are
+        read-only inputs, so re-merge is idempotent)."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "merge-crash":
+                    continue
+                if rule.shard is not None and rule.shard != shard:
+                    continue
+                if self._fire_once(ri, rule):
+                    raise RuntimeError(
+                        f"injected shard-merge crash: shard {shard} "
+                        "(fault spec)")
 
     def on_serve_request(self, req: int) -> None:
         """Fires in the serve daemon as data request ``req`` (1-based

@@ -188,6 +188,14 @@ class InvertedIndexModel:
             return {**stats, **timer.report()}
         threads = self.config.resolved_host_threads()
         timer.count("host_threads", threads)
+        if envknobs.get("MRI_BUILD_SPILL_BYTES") is not None:
+            # Out-of-core route: scan workers spill term-hash-sharded
+            # postings runs at the MRI_BUILD_SPILL_BYTES budget and the
+            # reduce becomes a per-shard streaming k-way merge over the
+            # run files.  Takes the parallel topology even at K = M = 1
+            # so every (K, M, shards, budget) point shares one path —
+            # and stays byte-identical to the in-memory merge.
+            return self._run_cpu_parallel(manifest, out_dir, timer, threads)
         if self.config.artifact:
             # The serving artifact packs straight off the merge state's
             # columnar export (no letter-file round-trip), so --artifact
@@ -216,6 +224,15 @@ class InvertedIndexModel:
     # read-ahead has something to hide behind) while staying resident in
     # L2/L3 for the scan that immediately follows the fill.
     _CPU_WINDOW_BYTES = 2 << 20
+
+    # Spill-budget cost model (MRI_BUILD_SPILL_BYTES): estimated native
+    # scan-state footprint is pairs * 16 + vocab * 56 — a (term, doc)
+    # pair holds a packed 8-byte id plus tf and flatten scratch; a
+    # local term holds its arena bytes, offset/length entries, combiner
+    # row, and hash slot.  An estimate, not an accounting: the budget
+    # bounds worker postings memory to within a small constant factor.
+    _SPILL_PAIR_BYTES = 16
+    _SPILL_TERM_BYTES = 56
 
     def _cpu_window_bytes(self) -> int:
         # MRI_CPU_WINDOW_BYTES forces tiny windows from a subprocess —
@@ -330,7 +347,45 @@ class InvertedIndexModel:
         from ..io.reader import plan_byte_windows
 
         cfg = self.config
+        spill_budget = envknobs.get("MRI_BUILD_SPILL_BYTES")
+        spill_mode = spill_budget is not None
+        num_shards = envknobs.get("MRI_BUILD_SHARDS")
+        sdir = None
+        if spill_mode:
+            from ..build import spill as spill_mod
+            from ..obs import metrics as obs_metrics
+
+            # a SIGKILLed spill build leaves only a stale .spill-<pid>
+            # dir behind; sweep those before arming our own
+            spill_mod.clean_stale_dirs(out_dir)
+            sdir = spill_mod.spill_dir(out_dir)
+            os.makedirs(sdir, exist_ok=True)
+            reg = obs_metrics.default_registry()
+            ctr_spill_flushes = reg.counter(
+                "mri_build_spill_flushes_total",
+                help="Spill-run flushes across all scan workers")
+            ctr_spill_bytes = reg.counter(
+                "mri_build_spill_bytes_total",
+                help="Bytes written to spill run files")
+        elif cfg.num_reducers > 26:
+            # letter-partitioned reduce: the reference's degenerate
+            # R > 26 arithmetic leaves reducers beyond the alphabet
+            # with empty ranges (documented conformance contract) —
+            # say so instead of clamping silently
+            log.warning(
+                "num_reducers=%d exceeds the 26 letter partitions; "
+                "reducers past the alphabet get empty ranges (set "
+                "MRI_BUILD_SPILL_BYTES to partition by term-hash "
+                "shard, where every reducer gets real work)",
+                cfg.num_reducers)
         window_bytes = self._cpu_window_bytes()
+        if spill_mode:
+            # the budget check runs at window boundaries, so a window
+            # must be a small fraction of the budget or one window's
+            # intake overshoots it before the first check; floor at
+            # 4 KiB so toy budgets don't degenerate to per-doc windows
+            window_bytes = min(window_bytes,
+                               max(spill_budget >> 4, 1 << 12))
         windows = plan_byte_windows(manifest, window_bytes)
         max_docs = max((hi - lo for lo, hi in windows), default=1)
         K = max(1, num_workers)
@@ -378,6 +433,10 @@ class InvertedIndexModel:
                 "fatal": None, "failed": False, "leaked": False,
                 "thread": None,
                 "stream": native.HostIndexStream(),
+                # spill-mode state: completed run files, window ranges
+                # fed since the last flush, and the footprint watermark
+                "runs": [], "run_windows": [], "docs": 0,
+                "scan_ms_acc": 0.0, "partial_ms_acc": 0.0, "peak_est": 0,
             }
             if trace is not None:
                 trace.name_thread(chrometrace.READER_BASE + w,
@@ -407,9 +466,55 @@ class InvertedIndexModel:
                     ledger.discard_worker(slot["id"])
                 run_report.record_worker_recovery(
                     windows_requeued=len(requeued))
+                # spill mode: the dead worker's run files cover the
+                # same windows fail_worker just requeued — delete them
+                # so the rescan (by a survivor with its own runs) can't
+                # double-merge those documents
+                stale_runs = [run["path"] for run in slot["runs"]]
+                slot["runs"] = []
+                slot["run_windows"] = []
+            for path in stale_runs:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
             log.warning(
                 "scan worker %d died (%s); requeued %d window(s) %s for "
                 "rescan", slot["id"], reason, len(requeued), requeued)
+
+        def flush_run(slot: dict, final: bool = False):
+            """Spill the worker's scan state as one checksummed run
+            file; unless ``final``, hand back a fresh native stream
+            (the memory release that bounds the worker's footprint)."""
+            stream, w = slot["stream"], slot["id"]
+            t0 = time.perf_counter()
+            p = stream.partial()
+            slot["scan_ms_acc"] += p["scan_ms"]
+            slot["partial_ms_acc"] += p["partial_ms"]
+            pack = stream.runpack(num_shards)
+            path, nbytes = spill_mod.write_run(
+                sdir, w, len(slot["runs"]), pack, slot["run_windows"])
+            t1 = time.perf_counter()
+            slot["runs"].append({
+                "path": path, "windows": list(slot["run_windows"]),
+                "pairs": pack["pairs"], "vocab": pack["vocab"],
+                "width": pack["width"], "docs": len(pack["doc_ids"]),
+                "max_doc_id": pack["max_doc_id"],
+                "raw_tokens": pack["raw_tokens"], "bytes": nbytes,
+            })
+            slot["run_windows"] = []
+            ctr_spill_flushes.inc()
+            ctr_spill_bytes.inc(nbytes)
+            if trace is not None:
+                trace.span("spill_flush", t0, t1,
+                           tid=chrometrace.SCAN_BASE + w,
+                           args={"run": len(slot["runs"]) - 1,
+                                 "pairs": pack["pairs"],
+                                 "bytes": int(nbytes)})
+            if not final:
+                stream.close()
+                slot["stream"] = native.HostIndexStream()
+            return slot["stream"]
 
         def scan_worker(slot: dict) -> None:
             w, reader, stream = slot["id"], slot["reader"], slot["stream"]
@@ -434,11 +539,48 @@ class InvertedIndexModel:
                                 nbytes=int(arena.used_bytes),
                                 checksum=audit_mod.window_checksum(
                                     buf, ends, ids))
+                        if spill_mode:
+                            # wi is the 1-based global plan index
+                            lo_d, hi_d = windows[wi - 1]
+                            slot["run_windows"].append((wi, lo_d, hi_d))
+                            slot["docs"] += int(arena.num_docs)
                     queue.ack(wi, worker=w)
                     reader.recycle(arena)
-                # flatten this worker's postings runs here, inside the
-                # map phase's parallelism, not at the serial join
-                slot["partial"] = stream.partial()
+                    if spill_mode and slot["run_windows"]:
+                        # documented cost model for the native scan
+                        # state: ~16 B per deduped (term, doc) pair
+                        # (packed id + tf) and ~56 B per local term
+                        # (arena bytes + offset/len + combiner row +
+                        # hash slot) — the budget trip point
+                        info = stream.info()
+                        est = (info["pairs"] * self._SPILL_PAIR_BYTES
+                               + info["vocab"] * self._SPILL_TERM_BYTES)
+                        if est > slot["peak_est"]:
+                            slot["peak_est"] = est
+                        # trip at half budget: the NEXT window's intake
+                        # lands on top of the current estimate before
+                        # the next boundary check, so the headroom is
+                        # what keeps the true peak under the budget
+                        if est >= spill_budget // 2:
+                            stream = flush_run(slot)
+                if spill_mode and slot["runs"]:
+                    # this worker tripped the budget mid-scan, so its
+                    # tail postings must spill too (the reduce k-way
+                    # merges this worker entirely from disk)
+                    if slot["run_windows"]:
+                        flush_run(slot, final=True)
+                    slot["partial"] = {
+                        "scan_ms": slot["scan_ms_acc"],
+                        "partial_ms": slot["partial_ms_acc"],
+                    }
+                else:
+                    # flatten this worker's postings runs here, inside
+                    # the map phase's parallelism, not at the serial
+                    # join.  A spill-armed worker that never tripped
+                    # the budget lands here too: its state stays in
+                    # memory until the join decides whether ANY worker
+                    # spilled (the zero-spill fast path).
+                    slot["partial"] = stream.partial()
             except (KeyboardInterrupt, SystemExit) as e:
                 # not a worker fault: requeue for bookkeeping but carry
                 # the exception out of the scan phase
@@ -526,91 +668,135 @@ class InvertedIndexModel:
             for slot in slots:
                 if slot["fatal"] is not None:
                     raise slot["fatal"]
-            live = [s["stream"] for s in slots if not s["failed"]]
-            if not live:
-                # every worker died: merge one empty stream so the
-                # reduce still writes the 26 (empty) letter files and
-                # the degraded report carries the whole story
-                empty_stream = native.HostIndexStream()
-                live = [empty_stream]
+            if spill_mode:
+                if not any(s["runs"] for s in slots if not s["failed"]):
+                    # zero-spill fast path: no worker ever tripped the
+                    # budget, so nothing left memory — reduce through
+                    # the in-memory native merge exactly like the
+                    # unset-knob build (within noise of its wall clock)
+                    timer.count("spill", {
+                        "budget_bytes": int(spill_budget),
+                        "runs": 0, "runs_quarantined": 0, "flushes": 0,
+                        "bytes_spilled": 0,
+                        "peak_worker_est_bytes": max(
+                            (s["peak_est"] for s in slots), default=0),
+                    })
+                    spill_mod.remove_dir(sdir)
+                    spill_mode = False
+                else:
+                    # mixed case: flush the workers that never tripped
+                    # the budget (their partial() already ran in the
+                    # map phase, so runpack here is pure copy-out)
+                    for slot in slots:
+                        if not slot["failed"] and slot["run_windows"]:
+                            flush_run(slot, final=True)
+            live = []
+            if not spill_mode:
+                live = [s["stream"] for s in slots if not s["failed"]]
+                if not live:
+                    # every worker died: merge one empty stream so the
+                    # reduce still writes the 26 (empty) letter files
+                    # and the degraded report carries the whole story
+                    empty_stream = native.HostIndexStream()
+                    live = [empty_stream]
             if ledger is not None:
                 t0 = time.perf_counter()
                 ledger.check_complete(len(windows),
                                       missing_ok=lost_windows)
                 audit_s += time.perf_counter() - t0
-            with timer.phase("finalize_emit"):
-                t0m = time.perf_counter()
-                merge = native.HostIndexMerge(live)
-                if trace is not None:
-                    trace.span("merge", t0m, time.perf_counter())
-                if cfg.audit:
-                    t0 = time.perf_counter()
-                    audit_mod.check_merge(merge, live)
-                    audit_s += time.perf_counter() - t0
-                ranges = plan_letter_ranges(cfg.num_reducers)
-                emit_ms = [0.0] * len(ranges)
-                emit_bytes = [0] * len(ranges)
-                emit_errors: list[BaseException | None] = [None] * len(ranges)
+            if spill_mode:
+                with timer.phase("finalize_emit"):
+                    red = self._spill_reduce(
+                        manifest, out_dir, timer, slots, run_report,
+                        inj, trace, sdir, num_shards)
+                mstats = red["mstats"]
+                emit_ms = red["emit_ms"]
+                emit_bytes = red["emit_bytes"]
+                audit_s += red["audit_s"]
+                timer.count("build_shards", red["build_shards"])
+                timer.count("spill", {
+                    "budget_bytes": int(spill_budget),
+                    "runs": red["runs_merged"],
+                    "runs_quarantined": red["runs_quarantined"],
+                    "flushes": sum(len(s["runs"]) for s in slots),
+                    "bytes_spilled": red["bytes_spilled"],
+                    "peak_worker_est_bytes": max(
+                        (s["peak_est"] for s in slots), default=0),
+                })
+            else:
+                with timer.phase("finalize_emit"):
+                    t0m = time.perf_counter()
+                    merge = native.HostIndexMerge(live)
+                    if trace is not None:
+                        trace.span("merge", t0m, time.perf_counter())
+                    if cfg.audit:
+                        t0 = time.perf_counter()
+                        audit_mod.check_merge(merge, live)
+                        audit_s += time.perf_counter() - t0
+                    ranges = plan_letter_ranges(cfg.num_reducers)
+                    emit_ms = [0.0] * len(ranges)
+                    emit_bytes = [0] * len(ranges)
+                    emit_errors: list[BaseException | None] = [None] * len(ranges)
 
-                def reduce_worker(r: int, lo: int, hi: int) -> None:
-                    t0 = time.perf_counter()
-                    try:
-                        if inj is not None:
-                            inj.on_reducer(r)
+                    def reduce_worker(r: int, lo: int, hi: int) -> None:
+                        t0 = time.perf_counter()
+                        try:
+                            if inj is not None:
+                                inj.on_reducer(r)
+                            emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
+                        except BaseException as e:  # noqa: BLE001
+                            emit_errors[r] = e
+                        t1 = time.perf_counter()
+                        emit_ms[r] = (t1 - t0) * 1e3
+                        if trace is not None:
+                            trace.name_thread(chrometrace.REDUCE_BASE + r,
+                                              f"reduce-worker-{r}")
+                            trace.span("emit_range", t0, t1,
+                                       tid=chrometrace.REDUCE_BASE + r,
+                                       args={"letters": [lo, hi]})
+
+                    reducers = [
+                        threading.Thread(target=reduce_worker, args=(r, lo, hi),
+                                         name=f"reduce-worker-{r}")
+                        for r, (lo, hi) in list(enumerate(ranges))[1:]
+                    ]
+                    for t in reducers:
+                        t.start()
+                    reduce_worker(0, *ranges[0])
+                    for t in reducers:
+                        t.join()
+                    # Reducer takeover: emit_range is read-only on the
+                    # merge state and atomic per letter file, so a dead
+                    # reducer's range is simply re-emitted here.  A second
+                    # failure on the SAME range is a real I/O problem and
+                    # raises (exit 2).
+                    for r, err in enumerate(emit_errors):
+                        if err is None:
+                            continue
+                        lo, hi = ranges[r]
+                        log.warning(
+                            "reduce worker %d died (%s: %s); re-emitting "
+                            "letters [%d, %d) on the main thread",
+                            r, type(err).__name__, err, lo, hi)
+                        t0 = time.perf_counter()
                         emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
-                    except BaseException as e:  # noqa: BLE001
-                        emit_errors[r] = e
-                    t1 = time.perf_counter()
-                    emit_ms[r] = (t1 - t0) * 1e3
-                    if trace is not None:
-                        trace.name_thread(chrometrace.REDUCE_BASE + r,
-                                          f"reduce-worker-{r}")
-                        trace.span("emit_range", t0, t1,
-                                   tid=chrometrace.REDUCE_BASE + r,
-                                   args={"letters": [lo, hi]})
+                        emit_ms[r] += (time.perf_counter() - t0) * 1e3
+                        run_report.record_reducer_takeover()
+                        emit_errors[r] = None
+                    mstats = merge.stats()
+                    if cfg.artifact:
+                        from ..serve import artifact as artifact_mod
 
-                reducers = [
-                    threading.Thread(target=reduce_worker, args=(r, lo, hi),
-                                     name=f"reduce-worker-{r}")
-                    for r, (lo, hi) in list(enumerate(ranges))[1:]
-                ]
-                for t in reducers:
-                    t.start()
-                reduce_worker(0, *ranges[0])
-                for t in reducers:
-                    t.join()
-                # Reducer takeover: emit_range is read-only on the
-                # merge state and atomic per letter file, so a dead
-                # reducer's range is simply re-emitted here.  A second
-                # failure on the SAME range is a real I/O problem and
-                # raises (exit 2).
-                for r, err in enumerate(emit_errors):
-                    if err is None:
-                        continue
-                    lo, hi = ranges[r]
-                    log.warning(
-                        "reduce worker %d died (%s: %s); re-emitting "
-                        "letters [%d, %d) on the main thread",
-                        r, type(err).__name__, err, lo, hi)
-                    t0 = time.perf_counter()
-                    emit_bytes[r] = merge.emit_range(lo, hi, out_dir)
-                    emit_ms[r] += (time.perf_counter() - t0) * 1e3
-                    run_report.record_reducer_takeover()
-                    emit_errors[r] = None
-                mstats = merge.stats()
-                if cfg.artifact:
-                    from ..serve import artifact as artifact_mod
-
-                    t0 = time.perf_counter()
-                    art_bytes = artifact_mod.build_from_merge(
-                        artifact_mod.artifact_path(out_dir), merge)
-                    t1 = time.perf_counter()
-                    if trace is not None:
-                        trace.span("artifact_pack", t0, t1)
-                    timer.count("artifact_bytes", int(art_bytes))
-                    timer.count(
-                        "artifact_build_ms",
-                        round((t1 - t0) * 1e3, 3))
+                        t0 = time.perf_counter()
+                        art_bytes = artifact_mod.build_from_merge(
+                            artifact_mod.artifact_path(out_dir), merge)
+                        t1 = time.perf_counter()
+                        if trace is not None:
+                            trace.span("artifact_pack", t0, t1)
+                        timer.count("artifact_bytes", int(art_bytes))
+                        timer.count(
+                            "artifact_build_ms",
+                            round((t1 - t0) * 1e3, 3))
         finally:
             recovered = any(s["failed"] for s in slots)
             for slot in slots:
@@ -632,7 +818,7 @@ class InvertedIndexModel:
             if key != "merge_ms":
                 timer.count(key, value)
         timer.count("bytes_written", int(sum(emit_bytes)))
-        timer.count("reduce_workers", len(ranges))
+        timer.count("reduce_workers", len(emit_ms))
         timer.count("io_windows", len(windows))
         timer.count("io_prefetch", cfg.io_prefetch)
         if cfg.audit:
@@ -658,6 +844,345 @@ class InvertedIndexModel:
                     round(sum(s["reader"].consume_wait_s
                               for s in slots) * 1e3, 3))
         return timer.report()
+
+    def _spill_reduce(self, manifest, out_dir, timer, slots, run_report,
+                      inj, trace, sdir, num_shards) -> dict:
+        """Disk-tier reduce for the out-of-core build.
+
+        Input: the scan phase's checksummed run files (term-hash-sharded
+        sorted postings runs, one or more per surviving worker).  Three
+        phases, all bounded by O(corpus / shards) memory:
+
+        1. **verify** — full checksum walk over every run; a torn or
+           bit-flipped run is quarantined and its windows' documents
+           become recorded skips (degraded exit 3 — same contract as a
+           spent respawn budget, never silent corruption).
+        2. **shard merge** — M reduce workers own shards round-robin
+           (``shard % M``) and k-way-merge every run's slice of each
+           shard into one lex-sorted shard file.  No 26-partition cap:
+           every reducer gets real work at any M.
+        3. **letter emit** — letters are likewise owned round-robin;
+           each is assembled from the shard files' letter slices and
+           rendered through the same native emit as the in-memory path,
+           so the letter files are byte-identical at every (mappers,
+           reducers, shards, budget) point.
+
+        Worker deaths in 2 and 3 degrade to main-thread takeover
+        (shard/letter writes are atomic and idempotent), mirroring the
+        in-memory reducer-takeover contract.
+        """
+        import threading
+
+        from .. import audit as audit_mod
+        from .. import native
+        from ..build import ooc
+        from ..build import spill as spill_mod
+        from ..corpus import scheduler
+        from ..obs import metrics as obs_metrics
+
+        cfg = self.config
+        M = max(1, cfg.num_reducers)
+        reg = obs_metrics.default_registry()
+        ctr_quarantined = reg.counter(
+            "mri_build_spill_runs_quarantined_total",
+            help="Spill runs that failed their checksum walk")
+        ctr_merge_shards = reg.counter(
+            "mri_build_merge_shards_total",
+            help="Term-hash shards merged from spill runs")
+        ctr_merge_runs = reg.counter(
+            "mri_build_merge_runs_total",
+            help="Spill runs consumed by shard merges")
+        ctr_merge_pairs = reg.counter(
+            "mri_build_merge_pairs_total",
+            help="(term, doc) pairs produced by shard merges")
+        ctr_merge_takeovers = reg.counter(
+            "mri_build_merge_takeovers_total",
+            help="Reduce workers whose shards/letters were re-done on "
+                 "the main thread")
+
+        # -- 1. verify every run up front; quarantine + skip on damage
+        all_runs = [run for slot in slots if not slot["failed"]
+                    for run in slot["runs"]]
+        good_runs = []
+        quarantined = 0
+        for run in all_runs:
+            try:
+                spill_mod.verify_file(run["path"])
+            except (spill_mod.SpillError, OSError) as e:
+                quarantined += 1
+                ctr_quarantined.inc()
+                try:
+                    spill_mod.quarantine(run["path"])
+                except OSError:
+                    pass
+                log.error("spill run %s failed verification (%s); its "
+                          "windows' documents are skipped",
+                          os.path.basename(str(run["path"])), e)
+                for wi, lo, hi in run["windows"]:
+                    for i in range(lo, hi):
+                        run_report.record_skip(
+                            doc_id=manifest.doc_id(i),
+                            path=manifest.paths[i],
+                            reason=f"window {wi} lost to corrupt spill "
+                                   f"run {os.path.basename(str(run['path']))}"
+                                   f" ({e})")
+            else:
+                good_runs.append(run)
+        run_paths = [run["path"] for run in good_runs]
+        width_g = max([run["width"] for run in good_runs] + [1])
+        max_doc_id = max((run["max_doc_id"] for run in good_runs),
+                         default=0)
+        audit_s = 0.0
+
+        # -- 2. per-shard k-way merge, shards owned round-robin by M
+        # workers; each worker opens its own SpillFile handles (the
+        # readers seek, so a shared handle would race)
+        shard_pairs = [0] * num_shards
+        shard_vocab = [0] * num_shards
+        shard_done = [False] * num_shards
+        merge_errors: list[BaseException | None] = [None] * M
+        merge_ms = [0.0] * M
+
+        def merge_worker(r: int) -> None:
+            readers = []
+            t_w0 = time.perf_counter()
+            try:
+                if trace is not None:
+                    trace.name_thread(chrometrace.REDUCE_BASE + r,
+                                      f"reduce-worker-{r}")
+                readers = [spill_mod.SpillFile(p) for p in run_paths]
+                for s in range(r, num_shards, M):
+                    t0 = time.perf_counter()
+                    if inj is not None:
+                        inj.on_shard_merge(s)
+                    merged = ooc.merge_shard(readers, s, width_g)
+                    spill_mod.write_shard(sdir, s, merged)
+                    shard_pairs[s] = int(merged["postings"].shape[0])
+                    shard_vocab[s] = int(merged["df"].shape[0])
+                    shard_done[s] = True
+                    if trace is not None:
+                        trace.span("shard_merge", t0, time.perf_counter(),
+                                   tid=chrometrace.REDUCE_BASE + r,
+                                   args={"shard": s,
+                                         "postings": shard_pairs[s]})
+            except BaseException as e:  # noqa: BLE001 — recovery path
+                merge_errors[r] = e
+            finally:
+                for f in readers:
+                    f.close()
+            merge_ms[r] += (time.perf_counter() - t_w0) * 1e3
+
+        t_merge0 = time.perf_counter()
+        threads = [threading.Thread(target=merge_worker, args=(r,),
+                                    name=f"reduce-worker-{r}")
+                   for r in range(1, M)]
+        for t in threads:
+            t.start()
+        merge_worker(0)
+        for t in threads:
+            t.join()
+        # Takeover: shard files are atomic and the merge inputs are
+        # read-only run files, so a dead worker's shards are simply
+        # re-merged here (injection hooks deliberately not re-fired —
+        # same rule as the in-memory reducer takeover).
+        for r, err in enumerate(merge_errors):
+            if err is None:
+                continue
+            todo = [s for s in range(r, num_shards, M)
+                    if not shard_done[s]]
+            log.warning(
+                "shard-merge worker %d died (%s: %s); re-merging "
+                "shard(s) %s on the main thread",
+                r, type(err).__name__, err, todo)
+            t0 = time.perf_counter()
+            readers = [spill_mod.SpillFile(p) for p in run_paths]
+            try:
+                for s in todo:
+                    merged = ooc.merge_shard(readers, s, width_g)
+                    spill_mod.write_shard(sdir, s, merged)
+                    shard_pairs[s] = int(merged["postings"].shape[0])
+                    shard_vocab[s] = int(merged["df"].shape[0])
+                    shard_done[s] = True
+            finally:
+                for f in readers:
+                    f.close()
+            merge_ms[r] += (time.perf_counter() - t0) * 1e3
+            run_report.record_reducer_takeover()
+            ctr_merge_takeovers.inc()
+            merge_errors[r] = None
+        merge_wall_ms = (time.perf_counter() - t_merge0) * 1e3
+        ctr_merge_shards.inc(num_shards)
+        ctr_merge_runs.inc(len(good_runs))
+        ctr_merge_pairs.inc(sum(shard_pairs))
+
+        if cfg.audit:
+            t0 = time.perf_counter()
+            audit_mod.check_spill(
+                sum(run["pairs"] for run in good_runs),
+                sum(shard_pairs),
+                sum(run["vocab"] for run in good_runs),
+                sum(shard_vocab))
+            audit_s += time.perf_counter() - t0
+
+        # -- 3. letter emit off the merged shard files, letters owned
+        # round-robin; native emit keeps the bytes identical to the
+        # in-memory path (empty letters still write their files)
+        shard_paths = [spill_mod.shard_path(sdir, s)
+                       for s in range(num_shards)]
+        emit_ms = [0.0] * M
+        emit_bytes = [0] * M
+        emit_errors: list[BaseException | None] = [None] * M
+        letter_done = [False] * ooc.ALPHABET_SIZE
+
+        def emit_letter(files, letter: int) -> int:
+            parts = [p for p in (ooc.letter_slice(f, letter, width_g)
+                                 for f in files) if p is not None]
+            if not parts:
+                return native.emit_native(
+                    out_dir, np.zeros(0, dtype="S1"),
+                    np.zeros(0, dtype=np.int64),
+                    np.zeros(0, dtype=np.int64),
+                    np.zeros(1, dtype=np.int64),
+                    np.zeros(0, dtype=np.int32),
+                    letter_range=(letter, letter + 1), idx_bounds=(0, 0))
+            cat = ooc.concat_letter(parts)
+            order = ooc.emit_order(cat["df"])
+            return native.emit_native(
+                out_dir, cat["terms"], order, cat["df"], cat["offsets"],
+                cat["postings"], letter_range=(letter, letter + 1),
+                idx_bounds=(0, int(order.shape[0])))
+
+        def emit_worker(r: int) -> None:
+            files = []
+            t_w0 = time.perf_counter()
+            try:
+                if inj is not None:
+                    inj.on_reducer(r)
+                files = [spill_mod.SpillFile(p) for p in shard_paths]
+                for letter in range(r, ooc.ALPHABET_SIZE, M):
+                    t0 = time.perf_counter()
+                    emit_bytes[r] += emit_letter(files, letter)
+                    letter_done[letter] = True
+                    if trace is not None:
+                        trace.span("emit_letter", t0, time.perf_counter(),
+                                   tid=chrometrace.REDUCE_BASE + r,
+                                   args={"letter": letter})
+            except BaseException as e:  # noqa: BLE001 — recovery path
+                emit_errors[r] = e
+            finally:
+                for f in files:
+                    f.close()
+            emit_ms[r] += (time.perf_counter() - t_w0) * 1e3
+
+        threads = [threading.Thread(target=emit_worker, args=(r,),
+                                    name=f"reduce-worker-{r}")
+                   for r in range(1, M)]
+        for t in threads:
+            t.start()
+        emit_worker(0)
+        for t in threads:
+            t.join()
+        for r, err in enumerate(emit_errors):
+            if err is None:
+                continue
+            todo = [letter for letter in range(r, ooc.ALPHABET_SIZE, M)
+                    if not letter_done[letter]]
+            log.warning(
+                "letter-emit worker %d died (%s: %s); re-emitting "
+                "letter(s) %s on the main thread",
+                r, type(err).__name__, err, todo)
+            t0 = time.perf_counter()
+            files = [spill_mod.SpillFile(p) for p in shard_paths]
+            try:
+                for letter in todo:
+                    emit_bytes[r] += emit_letter(files, letter)
+                    letter_done[letter] = True
+            finally:
+                for f in files:
+                    f.close()
+            emit_ms[r] += (time.perf_counter() - t0) * 1e3
+            run_report.record_reducer_takeover()
+            ctr_merge_takeovers.inc()
+            emit_errors[r] = None
+
+        # -- artifact: whole-index lex assembly off the shard files
+        if cfg.artifact:
+            from ..serve import artifact as artifact_mod
+
+            t0 = time.perf_counter()
+            files = [spill_mod.SpillFile(p) for p in shard_paths]
+            try:
+                u8_all = np.concatenate(
+                    [f.section("vocab").reshape(-1, width_g)
+                     for f in files])
+                word_lens_all = np.concatenate(
+                    [f.section("word_lens") for f in files])
+                df_all = np.concatenate([f.section("df") for f in files])
+                post_all = np.concatenate(
+                    [f.section("postings") for f in files])
+                tf_all = np.concatenate([f.section("tf") for f in files])
+            finally:
+                for f in files:
+                    f.close()
+            src_off = np.zeros(df_all.shape[0] + 1, dtype=np.int64)
+            np.cumsum(df_all, out=src_off[1:])
+            lex = np.argsort(ooc.as_terms(u8_all, width_g), kind="stable")
+            idx, post_off = ooc.gather_pairs(lex, src_off)
+            rows_lex = u8_all[lex]
+            lens_lex = word_lens_all[lex].astype(np.int64)
+            term_blob = rows_lex[
+                np.arange(width_g)[None, :] < lens_lex[:, None]]
+            term_offsets = np.zeros(lens_lex.shape[0] + 1, dtype=np.int64)
+            np.cumsum(lens_lex, out=term_offsets[1:])
+            df_lex = df_all[lex]
+            # df_order[emit position] = lex index; emit order is letter
+            # asc, then df desc with ties word asc within the letter —
+            # letter blocks are contiguous in both orders
+            df_order = np.zeros(lens_lex.shape[0], dtype=np.int64)
+            firsts = rows_lex[:, 0] if lens_lex.shape[0] else \
+                np.zeros(0, dtype=np.uint8)
+            for letter in range(ooc.ALPHABET_SIZE):
+                b0 = int(np.searchsorted(firsts, ord("a") + letter))
+                b1 = int(np.searchsorted(firsts, ord("a") + letter + 1))
+                if b1 > b0:
+                    df_order[b0:b1] = b0 + ooc.emit_order(df_lex[b0:b1])
+            run_files = [spill_mod.SpillFile(p) for p in run_paths]
+            try:
+                doc_lens = ooc.doc_lengths(run_files, max_doc_id)
+            finally:
+                for f in run_files:
+                    f.close()
+            art_bytes = artifact_mod.pack(
+                artifact_mod.artifact_path(out_dir),
+                term_blob=term_blob, term_offsets=term_offsets,
+                df=df_lex, post_offsets=post_off,
+                postings=post_all[idx], df_order=df_order,
+                max_doc_id=int(max_doc_id), width=width_g,
+                tf=tf_all[idx], doc_lens=doc_lens)
+            t1 = time.perf_counter()
+            if trace is not None:
+                trace.span("artifact_pack", t0, t1)
+            timer.count("artifact_bytes", int(art_bytes))
+            timer.count("artifact_build_ms", round((t1 - t0) * 1e3, 3))
+
+        spill_mod.remove_dir(sdir)
+        return {
+            "mstats": {
+                "documents": sum(run["docs"] for run in good_runs),
+                "tokens": sum(run["raw_tokens"] for run in good_runs),
+                "unique_terms": sum(shard_vocab),
+                "unique_pairs": sum(shard_pairs),
+                "lines_written": sum(shard_vocab),
+                "merge_ms": merge_wall_ms,
+            },
+            "emit_ms": emit_ms,
+            "emit_bytes": emit_bytes,
+            "audit_s": audit_s,
+            "build_shards": scheduler.term_shard_balance(shard_pairs),
+            "runs_merged": len(good_runs),
+            "runs_quarantined": quarantined,
+            "bytes_spilled": sum(run["bytes"] for run in all_runs),
+        }
 
     # -- TPU backend ---------------------------------------------------
 

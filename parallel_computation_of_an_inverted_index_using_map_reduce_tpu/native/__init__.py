@@ -265,6 +265,22 @@ def load():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.mri_hidx_runpack_info.restype = ctypes.c_int32
+        lib.mri_hidx_runpack_info.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_hidx_runpack.restype = ctypes.c_int32
+        lib.mri_hidx_runpack.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.mri_hidxm_audit.restype = ctypes.c_int32
         lib.mri_hidxm_audit.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
@@ -738,6 +754,72 @@ class HostIndexStream:
             ctypes.byref(raw))
         return {"vocab": int(vocab.value), "pairs": int(pairs.value),
                 "raw_tokens": int(raw.value)}
+
+    def runpack(self, shards: int) -> dict:
+        """Flatten + export this worker's scan state as term-hash-sharded
+        run arrays (the out-of-core spill tier's unit of work).
+
+        Terms come back in (shard asc, lex asc) order with NUL-padded
+        fixed-width rows; each term's postings run is doc-ascending with
+        a parallel tf column; ``shard_term_off`` / ``shard_pair_off``
+        (``shards + 1`` entries each) delimit every shard's slice.  The
+        ``doc_ids`` / ``doc_tokens`` columns carry per-document cleaned
+        token counts (doc-id ascending) for the artifact's doc-length
+        table.  After this call the handle is spent — close it and feed
+        a fresh stream.
+        """
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        vocab = ctypes.c_int32(0)
+        width = ctypes.c_int32(0)
+        pairs = ctypes.c_int64(0)
+        ndocs = ctypes.c_int64(0)
+        max_doc = ctypes.c_int64(0)
+        raw = ctypes.c_int64(0)
+        rc = self._lib.mri_hidx_runpack_info(
+            self._handle, ctypes.byref(vocab), ctypes.byref(width),
+            ctypes.byref(pairs), ctypes.byref(ndocs), ctypes.byref(max_doc),
+            ctypes.byref(raw))
+        if rc != 0:
+            raise MemoryError("native host index runpack allocation failure")
+        v, w = int(vocab.value), max(int(width.value), 1)
+        p, d = int(pairs.value), int(ndocs.value)
+        vocab_packed = np.zeros((max(v, 1), w), dtype=np.uint8)
+        word_lens = np.zeros(max(v, 1), dtype=np.int32)
+        df = np.zeros(max(v, 1), dtype=np.int64)
+        offsets = np.zeros(v + 1, dtype=np.int64)
+        postings = np.zeros(max(p, 1), dtype=np.int32)
+        tf = np.zeros(max(p, 1), dtype=np.int32)
+        shard_term_off = np.zeros(shards + 1, dtype=np.int64)
+        shard_pair_off = np.zeros(shards + 1, dtype=np.int64)
+        doc_ids = np.zeros(max(d, 1), dtype=np.int32)
+        doc_tokens = np.zeros(max(d, 1), dtype=np.int64)
+        rc = self._lib.mri_hidx_runpack(
+            self._handle, ctypes.c_int32(shards),
+            vocab_packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            word_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            df.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            postings.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            tf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            shard_term_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            shard_pair_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            doc_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            doc_tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise MemoryError("native host index runpack allocation failure")
+        return {
+            "vocab": v, "width": w, "pairs": p,
+            "max_doc_id": int(max_doc.value),
+            "raw_tokens": int(raw.value),
+            "vocab_packed": vocab_packed[:v],
+            "word_lens": word_lens[:v], "df": df[:v],
+            "offsets": offsets,
+            "postings": postings[:p], "tf": tf[:p],
+            "shard_term_off": shard_term_off,
+            "shard_pair_off": shard_pair_off,
+            "doc_ids": doc_ids[:d], "doc_tokens": doc_tokens[:d],
+        }
 
     def close(self):
         if self._handle:

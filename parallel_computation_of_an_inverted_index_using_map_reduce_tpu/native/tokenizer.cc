@@ -2111,6 +2111,110 @@ int32_t mri_hidx_partial(void* handle, int64_t* scan_ns_out,
   return -2;
 }
 
+// ---------------------------------------------------------------------------
+// Out-of-core spill support (build/spill.py): flatten one worker's scan
+// state and export it as flat run arrays partitioned by term-hash shard
+// — terms in (shard asc, lex asc) order, each term's postings run doc-
+// ascending with its tf column, plus the document-scale token counts
+// the v2 artifact's doc-length column needs.  The shard of a term is
+// HashWord(word) % shards — the same canonical zero-padded hash the
+// in-memory vocabulary join uses, so every worker agrees on a term's
+// shard without coordination.  The Python side writes the arrays to a
+// checksummed run file and replaces the handle with a fresh one; the
+// per-shard streaming merge later restores the exact in-memory merge
+// semantics (disjoint doc sets, ascending runs) from disk.
+// ---------------------------------------------------------------------------
+
+int32_t mri_hidx_runpack_info(void* handle, int32_t* vocab_out,
+                              int32_t* width_out, int64_t* pairs_out,
+                              int64_t* ndocs_out, int64_t* max_doc_id_out,
+                              int64_t* raw_tokens_out) try {
+  HostStreamState& h = *static_cast<HostStreamState*>(handle);
+  PartialFlatten(h);
+  StreamState& st = h.st;
+  const int32_t vocab = st.next_id;
+  int32_t width = 1;
+  for (int32_t g = 0; g < vocab; ++g)
+    width = std::max(width, static_cast<int32_t>(st.word_lens[g]));
+  if (vocab_out) *vocab_out = vocab;
+  if (width_out) *width_out = width;
+  if (pairs_out) *pairs_out = h.local_off[std::max(vocab, 1)];
+  if (ndocs_out) *ndocs_out = static_cast<int64_t>(h.doc_tokens.size());
+  if (max_doc_id_out) *max_doc_id_out = h.max_doc_id;
+  if (raw_tokens_out) *raw_tokens_out = st.raw_tokens;
+  return 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
+// Caller sizes every buffer from mri_hidx_runpack_info and zero-fills
+// vocab_packed (rows stay NUL-padded past each word's length).
+// offsets_out has vocab+1 entries (global cumulative, so shard s's
+// pairs live at [shard_pair_off[s], shard_pair_off[s+1])); the shard
+// offset arrays have shards+1 entries.
+int32_t mri_hidx_runpack(void* handle, int32_t shards, uint8_t* vocab_packed,
+                         int32_t* word_lens_out, int64_t* df_out,
+                         int64_t* offsets_out, int32_t* postings_out,
+                         int32_t* tf_out, int64_t* shard_term_off,
+                         int64_t* shard_pair_off, int32_t* doc_ids_out,
+                         int64_t* doc_tokens_out) try {
+  if (shards < 1) return -1;
+  HostStreamState& h = *static_cast<HostStreamState*>(handle);
+  PartialFlatten(h);
+  StreamState& st = h.st;
+  const int32_t vocab = st.next_id;
+  int32_t width = 1;
+  for (int32_t g = 0; g < vocab; ++g)
+    width = std::max(width, static_cast<int32_t>(st.word_lens[g]));
+  const uint8_t* base = st.arena.data();
+  // (shard asc, lex asc) term order: one stable counting partition over
+  // the radix lex order, so each shard's slice stays lex-sorted.
+  std::vector<int32_t> lex = LexOrderRadix(st, vocab);
+  std::vector<uint32_t> shard_of(std::max(vocab, 1));
+  std::vector<int64_t> count(static_cast<size_t>(shards) + 1, 0);
+  for (int32_t g = 0; g < vocab; ++g) {
+    shard_of[g] = static_cast<uint32_t>(
+        HashWord(base + st.word_offsets[g], st.word_lens[g]) %
+        static_cast<uint64_t>(shards));
+    ++count[shard_of[g] + 1];
+  }
+  for (int32_t s = 0; s < shards; ++s) count[s + 1] += count[s];
+  std::vector<int64_t> cur(count.begin(), count.end() - 1);
+  std::vector<int32_t> order(std::max(vocab, 1));
+  for (int32_t r = 0; r < vocab; ++r) {
+    const int32_t g = lex[r];
+    order[cur[shard_of[g]]++] = g;
+  }
+  for (int32_t s = 0; s <= shards; ++s) shard_term_off[s] = count[s];
+  offsets_out[0] = 0;
+  for (int32_t r = 0; r < vocab; ++r) {
+    const int32_t g = order[r];
+    const int64_t lo = h.local_off[g], hi = h.local_off[g + 1];
+    std::memcpy(vocab_packed + static_cast<int64_t>(r) * width,
+                base + st.word_offsets[g], st.word_lens[g]);
+    word_lens_out[r] = static_cast<int32_t>(st.word_lens[g]);
+    df_out[r] = hi - lo;
+    std::copy(h.local_flat.begin() + lo, h.local_flat.begin() + hi,
+              postings_out + offsets_out[r]);
+    std::copy(h.local_flat_tf.begin() + lo, h.local_flat_tf.begin() + hi,
+              tf_out + offsets_out[r]);
+    offsets_out[r + 1] = offsets_out[r] + (hi - lo);
+  }
+  for (int32_t s = 0; s <= shards; ++s)
+    shard_pair_off[s] = offsets_out[shard_term_off[s]];
+  // Document section, doc-id ascending for determinism (the steal
+  // queue can hand windows to this worker in any order).
+  std::vector<std::pair<int32_t, int64_t>> docs(h.doc_tokens);
+  std::sort(docs.begin(), docs.end());
+  for (size_t d = 0; d < docs.size(); ++d) {
+    doc_ids_out[d] = docs[d].first;
+    doc_tokens_out[d] = docs[d].second;
+  }
+  return 0;
+} catch (const std::bad_alloc&) {
+  return -2;
+}
+
 struct HostMergeState {
   std::vector<HostStreamState*> parts;  // non-owning: caller keeps alive
   StreamState merged;                   // global vocab when K > 1

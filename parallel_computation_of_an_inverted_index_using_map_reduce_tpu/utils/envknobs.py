@@ -167,6 +167,16 @@ declare("MRI_TPU_CKPT_LINK_MBPS", float, 8.0,
 declare("MRI_TPU_CKPT_STRETCH", int, 4,
         "Max consecutive over-budget checkpoint skips before one save "
         "is forced.")
+declare("MRI_BUILD_SHARDS", int, 8,
+        "Term-hash shard count for the out-of-core build (spill runs "
+        "and the streaming merge partition by term hash, not letter).",
+        minimum=1)
+declare("MRI_BUILD_SPILL_BYTES", int, None,
+        "Per-worker postings memory budget; when set, scan workers "
+        "spill term-hash-sharded sorted runs to disk at this estimated "
+        "footprint and reducers k-way-merge the runs (unset: the "
+        "all-in-memory merge).",
+        minimum=1)
 declare("MRI_NATIVE_SANITIZE", str, "",
         "Native tokenizer build variant: \"\" (production), asan, or "
         "ubsan — sanitized builds get suffix-tagged .so names.",
@@ -424,6 +434,9 @@ declare("MRI_DAEMON_OPEN_WINDOW", int, 2400,
 declare("MRI_EMIT_KILL_AFTER_LETTERS", int, None,
         "Crash hook: SIGKILL the process after N complete letter "
         "files (kill-mid-emit durability test).", scope="test")
+declare("MRI_SPILL_KILL_AFTER", int, None,
+        "Crash hook: SIGKILL the process after N complete spill run "
+        "files (kill-at-spill-boundary resume test).", scope="test")
 declare("MRI_TPU_STREAM_CRASH_AFTER_WINDOWS", int, 0,
         "Crash hook: die at a deterministic device-stream position "
         "(0: disabled).", scope="test")

@@ -1,0 +1,452 @@
+"""Out-of-core build: spill containers, term-hash shard merge, and the
+byte-identity of the disk tier against the in-memory path.
+
+The contract under test (README "Out-of-core build"): arming
+``MRI_BUILD_SPILL_BYTES`` may change WHERE the postings live while the
+build runs — never a byte of what it emits.  Letter files and the
+``index.mri`` artifact must be identical to the in-memory path at every
+(mappers, reducers, shards, budget) point; a torn run file degrades to
+quarantine + reported skips (exit-3 semantics, not corruption); a dead
+shard merger degrades to main-thread takeover; a SIGKILLed spill build
+leaves only a stale scratch dir the next run sweeps.
+"""
+
+import logging
+import os
+import signal
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT, read_letter_files, run_child
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    IndexConfig,
+    build_index,
+    faults,
+    native,
+    oracle_index,
+    read_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.build import (
+    ooc,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.build import (
+    spill as spill_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+    write_manifest,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.scheduler import (
+    term_shard_balance,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    write_corpus,
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.text.tokenizer import (
+    clean_token,
+)
+
+pytestmark = pytest.mark.spill
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+_TINY_BUDGET = 4096          # forces several run flushes on the corpus
+_HUGE_BUDGET = 1 << 30       # never trips: the zero-spill fast path
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.install(None)
+    faults.begin_run()
+    yield
+    faults.install(None)
+    faults.begin_run()
+
+
+@pytest.fixture(autouse=True)
+def _small_windows(monkeypatch):
+    """Many windows per worker so tiny budgets actually flush runs."""
+    monkeypatch.setenv("MRI_CPU_WINDOW_BYTES", "512")
+
+
+def _manifest(tmp_path, num_docs=29, seed=13, vocab=500, tokens=60):
+    docs = zipf_corpus(num_docs=num_docs, vocab_size=vocab,
+                       tokens_per_doc=tokens, seed=seed)
+    paths = write_corpus(tmp_path / "docs", docs)
+    write_manifest(tmp_path / "list.txt", paths)
+    return read_manifest(tmp_path / "list.txt"), docs
+
+
+def _build(manifest, out, *, mappers=3, reducers=4, budget=None,
+           shards=None, monkeypatch=None, **cfg_kw):
+    if budget is not None:
+        monkeypatch.setenv("MRI_BUILD_SPILL_BYTES", str(budget))
+    else:
+        monkeypatch.delenv("MRI_BUILD_SPILL_BYTES", raising=False)
+    if shards is not None:
+        monkeypatch.setenv("MRI_BUILD_SHARDS", str(shards))
+    return build_index(
+        manifest,
+        IndexConfig(backend="cpu", num_mappers=mappers,
+                    num_reducers=reducers, io_prefetch=2, **cfg_kw),
+        output_dir=out)
+
+
+def _no_spill_dirs(out):
+    return sorted(p.name for p in out.glob(".spill-*")) == []
+
+
+# -- spill container --------------------------------------------------
+
+
+def _sections():
+    return {
+        "vocab": np.arange(12, dtype=np.uint8).reshape(3, 4),
+        "df": np.array([2, 1, 3], dtype=np.int64),
+        "postings": np.array([1, 4, 2, 1, 3, 9], dtype=np.int32),
+    }
+
+
+def test_spill_container_roundtrip(tmp_path):
+    path = tmp_path / "t.bin"
+    sections = _sections()
+    nbytes = spill_mod.write_file(path, {"kind": "test", "n": 3}, sections)
+    assert path.stat().st_size == nbytes
+    with spill_mod.SpillFile(path) as sf:
+        assert sf.meta == {"kind": "test", "n": 3}
+        for name, arr in sections.items():
+            np.testing.assert_array_equal(sf.section(name), arr)
+        # row-sliced reads see the same bytes without loading the rest
+        np.testing.assert_array_equal(
+            sf.read_rows("vocab", 1, 3), sections["vocab"][1:3])
+        np.testing.assert_array_equal(
+            sf.read_rows("postings", 2, 5), sections["postings"][2:5])
+    spill_mod.verify_file(path)  # pristine file passes the checksum walk
+
+
+def test_spill_verify_catches_single_bit_flip(tmp_path):
+    path = tmp_path / "t.bin"
+    spill_mod.write_file(path, {"kind": "test"}, _sections())
+    with spill_mod.SpillFile(path) as sf:
+        at = sf.sections["postings"]["offset"]
+    data = bytearray(path.read_bytes())
+    data[at] ^= 0x40
+    path.write_bytes(data)
+    with pytest.raises(spill_mod.SpillError, match="postings"):
+        spill_mod.verify_file(path)
+    moved = spill_mod.quarantine(path)
+    assert moved.name == "t.bin.corrupt" and moved.exists()
+    assert not path.exists()
+
+
+def test_spill_rejects_bad_magic_and_version(tmp_path):
+    path = tmp_path / "bad.bin"
+    path.write_bytes(b"NOTSPILL" + b"\0" * 8)
+    with pytest.raises(spill_mod.SpillError, match="magic"):
+        spill_mod.SpillFile(path)
+    spill_mod.write_file(path, {"kind": "test"}, _sections())
+    data = bytearray(path.read_bytes())
+    data[8] = 99  # version field
+    path.write_bytes(data)
+    with pytest.raises(spill_mod.SpillError, match="version"):
+        spill_mod.SpillFile(path)
+
+
+def test_spill_header_checksums_are_adler32(tmp_path):
+    path = tmp_path / "t.bin"
+    sections = _sections()
+    spill_mod.write_file(path, {"kind": "test"}, sections)
+    with spill_mod.SpillFile(path) as sf:
+        for name, arr in sections.items():
+            want = f"{zlib.adler32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF:08x}"
+            assert sf.sections[name]["adler32"] == want
+
+
+def test_clean_stale_dirs_sweeps_only_foreign_pids(tmp_path):
+    stale = tmp_path / ".spill-424242"
+    stale.mkdir()
+    (stale / "run-w000-0000.bin").write_bytes(b"torn")
+    own = spill_mod.spill_dir(tmp_path)
+    own.mkdir()
+    (own / "live.bin").write_bytes(b"live")
+    assert spill_mod.clean_stale_dirs(tmp_path) == 1
+    assert not stale.exists()
+    assert own.exists() and (own / "live.bin").exists()
+    spill_mod.remove_dir(own)
+    assert not own.exists()
+
+
+# -- ooc merge algebra ------------------------------------------------
+
+
+def test_gather_pairs_permutes_offsets_and_indices():
+    src_off = np.array([0, 2, 3, 6], dtype=np.int64)
+    order = np.array([2, 0, 1])
+    idx, new_off = ooc.gather_pairs(order, src_off)
+    assert new_off.tolist() == [0, 3, 5, 6]
+    assert idx.tolist() == [3, 4, 5, 0, 1, 2]
+    pairs = np.array([10, 11, 20, 30, 31, 32])
+    assert pairs[idx].tolist() == [30, 31, 32, 10, 11, 20]
+
+
+def test_letter_offsets_bounds_each_first_letter():
+    terms = np.array([b"ab", b"ax", b"bz", b"da"], dtype="S2")
+    off = ooc.letter_offsets(ooc.terms_to_u8(terms))
+    assert off.shape == (27,)
+    assert off[0] == 0 and off[1] == 2       # 'a' terms in [0, 2)
+    assert off[2] == 3                        # 'b' terms in [2, 3)
+    assert off[3] == 3 and off[4] == 4        # 'c' empty, 'd' in [3, 4)
+    assert off[26] == 4
+
+
+def test_emit_order_df_desc_word_asc():
+    # lex-sorted input, df [3, 1, 3]: ties break word-ascending
+    assert ooc.emit_order(np.array([3, 1, 3])).tolist() == [0, 2, 1]
+
+
+def _write_run(path, terms, df, postings, tf):
+    """Minimal single-shard run container for merge_shard tests."""
+    u8 = ooc.terms_to_u8(np.array(terms, dtype="S2"))
+    spill_mod.write_file(path, {
+        "kind": "run",
+        "shard_term_off": [0, len(terms)],
+        "shard_pair_off": [0, len(postings)],
+    }, {
+        "vocab": u8,
+        "df": np.array(df, dtype=np.int64),
+        "postings": np.array(postings, dtype=np.int32),
+        "tf": np.array(tf, dtype=np.int32),
+    })
+    return spill_mod.SpillFile(path)
+
+
+def test_merge_shard_kway_disjoint_runs(tmp_path):
+    r1 = _write_run(tmp_path / "r1.bin", [b"ab", b"cd"],
+                    [2, 1], [1, 3, 2], [1, 1, 4])
+    r2 = _write_run(tmp_path / "r2.bin", [b"ab", b"bb"],
+                    [1, 1], [2, 5], [7, 1])
+    try:
+        merged = ooc.merge_shard([r1, r2], 0, 2)
+    finally:
+        r1.close()
+        r2.close()
+    assert ooc.as_terms(merged["vocab"], 2).tolist() == [b"ab", b"bb", b"cd"]
+    assert merged["df"].tolist() == [3, 1, 1]
+    # per-term postings doc-ascending across runs, tf riding along
+    assert merged["postings"].tolist() == [1, 2, 3, 5, 2]
+    assert merged["tf"].tolist() == [1, 7, 1, 1, 4]
+    assert merged["offsets"].tolist() == [0, 3, 4, 5]
+
+
+def test_merge_shard_duplicate_pair_raises(tmp_path):
+    # runs cover disjoint documents by construction; a (term, doc)
+    # collision means double-counted windows and must be fatal
+    r1 = _write_run(tmp_path / "r1.bin", [b"ab"], [1], [7], [1])
+    r2 = _write_run(tmp_path / "r2.bin", [b"ab"], [1], [7], [2])
+    try:
+        with pytest.raises(ValueError, match="duplicate"):
+            ooc.merge_shard([r1, r2], 0, 2)
+    finally:
+        r1.close()
+        r2.close()
+
+
+# -- byte-identity matrix ---------------------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("shards", [1, 8, 64])
+@pytest.mark.parametrize("budget", [_TINY_BUDGET, _HUGE_BUDGET])
+def test_spill_matrix_byte_identical(tmp_path, monkeypatch, shards,
+                                     budget):
+    manifest, _ = _manifest(tmp_path)
+    oracle_index(manifest, tmp_path / "clean")
+    out = tmp_path / f"out-{shards}-{budget}"
+    report = _build(manifest, out, budget=budget, shards=shards,
+                    monkeypatch=monkeypatch, audit=True)
+    assert read_letter_files(out) == read_letter_files(tmp_path / "clean")
+    sp = report["spill"]
+    if budget == _TINY_BUDGET:
+        assert sp["runs"] > 0 and sp["flushes"] >= sp["runs"] > 0
+        assert report["build_shards"]["shards"] == shards
+        assert sum(report["build_shards"]["postings_per_shard"]) \
+            == report["unique_pairs"]
+    else:
+        assert sp["runs"] == 0  # zero-spill fast path
+    assert _no_spill_dirs(out)
+
+
+@needs_native
+@pytest.mark.parametrize("mappers,reducers", [(1, 1), (2, 5), (4, 3)])
+def test_spill_km_grid_byte_identical(tmp_path, monkeypatch, mappers,
+                                      reducers):
+    manifest, _ = _manifest(tmp_path)
+    oracle_index(manifest, tmp_path / "clean")
+    out = tmp_path / "out"
+    _build(manifest, out, mappers=mappers, reducers=reducers,
+           budget=_TINY_BUDGET, shards=8, monkeypatch=monkeypatch)
+    assert read_letter_files(out) == read_letter_files(tmp_path / "clean")
+    assert _no_spill_dirs(out)
+
+
+@needs_native
+def test_spill_artifact_byte_identical(tmp_path, monkeypatch):
+    manifest, _ = _manifest(tmp_path)
+    mem = tmp_path / "mem"
+    _build(manifest, mem, monkeypatch=monkeypatch, artifact=True,
+           audit=True)
+    disk = tmp_path / "disk"
+    _build(manifest, disk, budget=_TINY_BUDGET, shards=8,
+           monkeypatch=monkeypatch, artifact=True, audit=True)
+    assert read_letter_files(disk) == read_letter_files(mem)
+    assert (disk / "index.mri").read_bytes() \
+        == (mem / "index.mri").read_bytes()
+
+
+@needs_native
+def test_reducers_over_26_all_do_real_work(tmp_path, monkeypatch):
+    """Regression for the silent M > 26 clamp: the term-hash reduce has
+    no 26-partition cap, so M = 64 must field 64 reduce workers and
+    still write oracle bytes."""
+    manifest, _ = _manifest(tmp_path)
+    oracle_index(manifest, tmp_path / "clean")
+    out = tmp_path / "out"
+    report = _build(manifest, out, mappers=2, reducers=64,
+                    budget=_TINY_BUDGET, shards=64,
+                    monkeypatch=monkeypatch)
+    assert report["reduce_workers"] == 64
+    assert read_letter_files(out) == read_letter_files(tmp_path / "clean")
+
+
+@needs_native
+def test_reducers_over_26_in_memory_path_warns(tmp_path, monkeypatch,
+                                               caplog):
+    """The in-memory letter reduce keeps the reference's degenerate
+    R > 26 contract (empty ranges past the alphabet) but must now SAY
+    so instead of silently wasting the extra reducers."""
+    manifest, _ = _manifest(tmp_path)
+    out = tmp_path / "out"
+    with caplog.at_level(logging.WARNING):
+        report = _build(manifest, out, mappers=2, reducers=30,
+                        monkeypatch=monkeypatch)
+    assert report["reduce_workers"] == 30
+    assert any("exceeds the 26 letter partitions" in r.message
+               for r in caplog.records)
+
+
+@needs_native
+def test_spill_budget_bounds_worker_memory(tmp_path, monkeypatch):
+    """The point of the tier: peak estimated postings footprint per
+    worker stays under the budget on a corpus many times its size."""
+    budget = 16 << 10
+    manifest, docs = _manifest(tmp_path, num_docs=200, seed=3)
+    assert sum(len(d) for d in docs) >= 4 * budget
+    report = _build(manifest, tmp_path / "out", budget=budget, shards=8,
+                    monkeypatch=monkeypatch)
+    sp = report["spill"]
+    assert sp["runs"] > 0
+    assert 0 < sp["peak_worker_est_bytes"] <= budget
+    assert sp["bytes_spilled"] > budget  # really went through disk
+
+
+# -- shard balance (satellite: hash shards vs the 26-letter split) ----
+
+
+@needs_native
+def test_hash_shards_beat_letter_split_on_zipf(tmp_path, monkeypatch):
+    """On a Zipf corpus the reference's 26-letter partition concentrates
+    postings mass on hot first letters; the term-hash shards must come
+    out measurably flatter (lower max/mean), even with fewer bins."""
+    manifest, docs = _manifest(tmp_path, num_docs=64, vocab=800,
+                               tokens=80, seed=7)
+    report = _build(manifest, tmp_path / "out", budget=_TINY_BUDGET,
+                    shards=8, monkeypatch=monkeypatch)
+    balance = report["build_shards"]
+    letter_pairs = [0] * 26
+    for blob in docs:
+        for word in {clean_token(r) for r in blob.split()} - {""}:
+            letter_pairs[ord(word[0]) - ord("a")] += 1
+    letter_balance = term_shard_balance(letter_pairs)
+    assert sum(letter_pairs) == sum(balance["postings_per_shard"])
+    assert balance["max_over_mean"] < letter_balance["max_over_mean"]
+
+
+# -- degradation: quarantine + takeover -------------------------------
+
+
+@needs_native
+def test_spill_corrupt_quarantines_and_reports(tmp_path, monkeypatch):
+    manifest, _ = _manifest(tmp_path)
+    faults.install("spill-corrupt:spill=1")
+    faults.begin_run()
+    out = tmp_path / "out"
+    report = _build(manifest, out, mappers=2, reducers=3,
+                    budget=_TINY_BUDGET, shards=8, monkeypatch=monkeypatch)
+    d = report["degradation"]
+    assert report["spill"]["runs_quarantined"] == 1
+    assert d["skipped_docs"]  # the loss is REPORTED, never silent
+    # degraded, not dead: the full letter set is still on disk
+    assert all((out / f"{chr(ord('a') + i)}.txt").exists()
+               for i in range(26))
+    assert _no_spill_dirs(out)
+
+
+@needs_native
+def test_merge_crash_takeover_byte_identical(tmp_path, monkeypatch):
+    manifest, _ = _manifest(tmp_path)
+    oracle_index(manifest, tmp_path / "clean")
+    faults.install("merge-crash")
+    faults.begin_run()
+    out = tmp_path / "out"
+    report = _build(manifest, out, mappers=2, reducers=3,
+                    budget=_TINY_BUDGET, shards=8, monkeypatch=monkeypatch)
+    d = report["degradation"]
+    assert d["reducer_takeovers"] >= 1
+    assert not d["skipped_docs"]
+    assert read_letter_files(out) == read_letter_files(tmp_path / "clean")
+    assert _no_spill_dirs(out)
+
+
+# -- SIGKILL at a spill boundary + --resume=auto ----------------------
+
+
+@needs_native
+def test_sigkill_after_spill_write_rerun_byte_identical(tmp_path,
+                                                        monkeypatch):
+    """A REAL kill right after the 2nd run file lands: the child leaves
+    only a stale .spill-<pid> dir; the rerun sweeps it and emits oracle
+    bytes."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+        main,
+    )
+
+    manifest, _ = _manifest(tmp_path)
+    oracle_index(manifest, tmp_path / "clean")
+    out = tmp_path / "out"
+    argv = ["2", "2", str(tmp_path / "list.txt"),
+            "--output-dir", str(out),
+            "--backend", "cpu", "--io-prefetch", "2", "--resume", "auto"]
+    proc = run_child(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"]
+        + argv,
+        cwd=str(REPO_ROOT),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "MRI_CPU_WINDOW_BYTES": "512",
+             "MRI_BUILD_SPILL_BYTES": str(_TINY_BUDGET),
+             "MRI_SPILL_KILL_AFTER": "2"},
+        timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+    stale = sorted(p.name for p in out.glob(".spill-*"))
+    assert stale  # the crash left its scratch dir behind
+    assert not (out / "a.txt").exists()  # died before any emit
+    monkeypatch.setenv("MRI_BUILD_SPILL_BYTES", str(_TINY_BUDGET))
+    monkeypatch.delenv("MRI_SPILL_KILL_AFTER", raising=False)
+    assert main(argv) == 0
+    assert read_letter_files(out) == read_letter_files(tmp_path / "clean")
+    assert _no_spill_dirs(out)

@@ -56,6 +56,11 @@ _BASIS = {
     "BENCH_SEGMENTS_r12.json": lambda d, ln: (
         "value IS the ratio: 16-segment AND qps vs the same run's "
         "single-artifact engine"),
+    "BENCH_BUILD_OOC_r15.json": lambda d, ln: (
+        "value IS the ratio: spill-tier wall vs the same run's "
+        "in-memory build on a {}x-budget corpus (zero-spill {}x)"
+        .format(d["gates"]["corpus_over_budget"],
+                d["gates"]["zero_spill_overhead_x"])),
 }
 
 _JSON_LINE_RE = re.compile(r"^\{.*\}$", re.M)

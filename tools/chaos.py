@@ -22,6 +22,18 @@ The (1, 1) cell routes down the single-worker pipelined path, which has
 no worker/reducer recovery layer by design (nothing to take over for) —
 its trials sample only the read-level kinds the retry policy handles.
 
+``--spill`` arms the out-of-core tier for every build trial: a tiny
+``MRI_BUILD_SPILL_BYTES`` budget forces each worker through run-file
+spills and the reduce through the k-way shard merge, and the seeded
+schedule may additionally sample ``spill-corrupt`` (torn run file —
+must be quarantined with the loss reported, degraded arm) and
+``merge-crash`` (dead shard merger — main thread takes over, clean
+arm stays byte-identical).  A finished trial must also have swept its
+own ``.spill-<pid>`` scratch directory:
+
+    python tools/chaos.py --spill --trials 36 --seed-base 5000
+    python tools/chaos.py --spill --repro 5011
+
 ``--daemon`` switches to the serve-side soak: seeded trials thrown at a
 REAL ``mri serve`` subprocess, cycled over five scenarios (overload
 burst, SIGTERM mid-request, corrupt hot reload, abrupt client
@@ -106,6 +118,14 @@ _WINDOW_BYTES = 512
 #: Read-level kinds only: safe on the single-worker pipelined path.
 _PIPELINED_KINDS = "read-error,slow-read"
 
+#: ``--spill`` soak: a budget this small forces every worker through
+#: dozens of run-file flushes on the soak corpus, so the out-of-core
+#: tier (spill write, checksum walk, k-way shard merge, letter emit)
+#: is on the hot path of every trial — and the sampler may draw the
+#: spill fault kinds on top of the default build kinds.
+_SPILL_BUDGET_BYTES = 4096
+_SPILL_KINDS = ",".join(faults.CHAOS_KINDS + faults.SPILL_CHAOS_KINDS)
+
 
 def make_corpus(root: Path, num_docs: int = 29, seed: int = 13):
     docs = zipf_corpus(num_docs=num_docs, vocab_size=500,
@@ -116,24 +136,34 @@ def make_corpus(root: Path, num_docs: int = 29, seed: int = 13):
 
 
 def trial_spec(seed: int, mappers: int, reducers: int,
-               num_windows: int, num_docs: int, n_faults: int = 3) -> str:
+               num_windows: int, num_docs: int, n_faults: int = 3,
+               spill: bool = False) -> str:
     spec = (f"chaos:seed={seed}:n={n_faults}:windows={num_windows}"
             f":workers={mappers}:reducers={reducers}:docs={num_docs}")
-    if mappers == 1 and reducers == 1:
+    if spill:
+        # an armed spill budget routes even the (1, 1) cell down the
+        # parallel recovery path, so the full build draw is safe there
+        spec += f":kinds={_SPILL_KINDS}"
+    elif mappers == 1 and reducers == 1:
         spec += f":kinds={_PIPELINED_KINDS}"
     return spec
 
 
 def run_trial(manifest, golden_md5: str, out_dir: Path, seed: int,
               mappers: int, reducers: int,
-              deadline_s: float = 120.0) -> dict:
+              deadline_s: float = 120.0, spill: bool = False) -> dict:
     """One seeded trial.  Returns a verdict dict; ``ok`` is False only
     on a contract violation (hang, wrong clean bytes, unreported loss,
     unexpected error)."""
     # the spec's window bounds and the run's actual plan must agree
     os.environ["MRI_CPU_WINDOW_BYTES"] = str(_WINDOW_BYTES)
+    if spill:
+        os.environ["MRI_BUILD_SPILL_BYTES"] = str(_SPILL_BUDGET_BYTES)
+    else:
+        os.environ.pop("MRI_BUILD_SPILL_BYTES", None)
     num_windows = len(list(plan_byte_windows(manifest, _WINDOW_BYTES)))
-    spec = trial_spec(seed, mappers, reducers, num_windows, len(manifest))
+    spec = trial_spec(seed, mappers, reducers, num_windows, len(manifest),
+                      spill=spill)
     verdict = {"seed": seed, "mappers": mappers, "reducers": reducers,
                "spec": spec, "ok": False, "outcome": "?"}
     box: dict = {}
@@ -176,6 +206,17 @@ def run_trial(manifest, golden_md5: str, out_dir: Path, seed: int,
     verdict["recoveries"] = d.get("worker_recoveries", 0)
     verdict["takeovers"] = d.get("reducer_takeovers", 0)
     verdict["skipped"] = len(d.get("skipped_docs", []))
+    if spill:
+        sp = stats.get("spill") or {}
+        verdict["spill_runs"] = sp.get("runs", 0)
+        verdict["quarantined"] = sp.get("runs_quarantined", 0)
+        # clean or degraded, a finished build must have swept its own
+        # per-pid spill directory
+        leftover = sorted(p.name for p in out_dir.glob(".spill-*"))
+        if leftover:
+            verdict["outcome"] = "SPILL-DIR-LEAK"
+            verdict["leftover"] = leftover
+            return verdict
     if verdict["skipped"]:
         # degraded arm: loss is reported; the letter set must still be
         # complete on disk (exit-3 semantics, not a crash)
@@ -200,12 +241,15 @@ def run_trial(manifest, golden_md5: str, out_dir: Path, seed: int,
 
 
 def run_soak(work_dir: Path, trials: int, seed_base: int,
-             deadline_s: float = 120.0, verbose: bool = True) -> dict:
+             deadline_s: float = 120.0, verbose: bool = True,
+             spill: bool = False) -> dict:
     """The full soak: ``trials`` seeded trials cycled over PLAN_MATRIX.
     Returns a summary dict; ``summary["failures"]`` is empty iff every
     trial honored the fault-tolerance contract."""
     # mrilint: allow(env-knobs) raw save/restore of the child-process env
     saved = os.environ.get("MRI_CPU_WINDOW_BYTES")
+    # mrilint: allow(env-knobs) same raw save/restore for the spill budget
+    saved_spill = os.environ.get("MRI_BUILD_SPILL_BYTES")
     os.environ["MRI_CPU_WINDOW_BYTES"] = str(_WINDOW_BYTES)
     try:
         work_dir.mkdir(parents=True, exist_ok=True)
@@ -218,7 +262,7 @@ def run_soak(work_dir: Path, trials: int, seed_base: int,
             seed = seed_base + t
             out = work_dir / f"trial-{seed}"
             v = run_trial(manifest, golden_md5, out, seed, mappers,
-                          reducers, deadline_s=deadline_s)
+                          reducers, deadline_s=deadline_s, spill=spill)
             results.append(v)
             if verbose:
                 print(json.dumps(v, sort_keys=True), flush=True)
@@ -229,6 +273,10 @@ def run_soak(work_dir: Path, trials: int, seed_base: int,
             os.environ.pop("MRI_CPU_WINDOW_BYTES", None)
         else:
             os.environ["MRI_CPU_WINDOW_BYTES"] = saved
+        if saved_spill is None:
+            os.environ.pop("MRI_BUILD_SPILL_BYTES", None)
+        else:
+            os.environ["MRI_BUILD_SPILL_BYTES"] = saved_spill
     failures = [v for v in results if not v["ok"]]
     summary = {
         "trials": len(results),
@@ -238,6 +286,11 @@ def run_soak(work_dir: Path, trials: int, seed_base: int,
         "takeovers": sum(v.get("takeovers", 0) for v in results),
         "failures": failures,
     }
+    if spill:
+        summary["spill_runs"] = sum(v.get("spill_runs", 0)
+                                    for v in results)
+        summary["quarantined"] = sum(v.get("quarantined", 0)
+                                     for v in results)
     return summary
 
 
@@ -1002,6 +1055,13 @@ def main(argv=None) -> int:
                     help="soak the resident serve daemon instead of the "
                          "build pipeline (scenarios: "
                          + ", ".join(DAEMON_SCENARIOS) + ")")
+    ap.add_argument("--spill", action="store_true",
+                    help="arm the out-of-core tier for every build "
+                         "trial: a tiny MRI_BUILD_SPILL_BYTES budget "
+                         "forces run-file spills, and the schedule may "
+                         "additionally sample spill-corrupt (torn run "
+                         "-> quarantine + reported skips) and "
+                         "merge-crash (dead shard merger -> takeover)")
     ap.add_argument("--segments", action="store_true",
                     help="soak the incremental-indexing subsystem: "
                          "concurrent append/delete/compact/query "
@@ -1049,11 +1109,12 @@ def main(argv=None) -> int:
         oracle_index(manifest, work / "golden")
         v = run_trial(manifest, letters_md5(work / "golden"),
                       work / f"repro-{args.repro}", args.repro,
-                      mappers, reducers, deadline_s=args.deadline)
+                      mappers, reducers, deadline_s=args.deadline,
+                      spill=args.spill)
         print(json.dumps(v, sort_keys=True))
         return 0 if v["ok"] else 1
     summary = run_soak(work, args.trials, args.seed_base,
-                       deadline_s=args.deadline)
+                       deadline_s=args.deadline, spill=args.spill)
     print(json.dumps(summary, sort_keys=True))
     return 0 if not summary["failures"] else 1
 
