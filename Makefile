@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-daemon bench-scrape bench-segments bench-slo bench-history capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-slo bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -74,7 +74,8 @@ test-serve-device:
 # interceptor aborts inside jaxlib's bundled MLIR bindings (a toolchain
 # clash, not a bug in this code) — and kept out of the ubsan run too so
 # both targets certify the same selection.
-NATIVE_SAN_TESTS = tests/test_native.py tests/test_tokenizer.py \
+NATIVE_SAN_TESTS = tests/test_native.py tests/test_native_serve.py \
+  tests/test_tokenizer.py \
   tests/test_emit_backend.py tests/test_conformance.py
 NATIVE_SAN_K = not tpu and not single_chip and not numpy_tokenizer \
   and not backends_agree and not degenerate_configs
@@ -164,6 +165,13 @@ bench-serve-v2:
 bench-serve-ranked:
 	$(PY) tools/bench_serve.py --ranked-ab
 
+# native serve-kernel A/B: numpy host engine vs the C++ mri_serve_*
+# kernels on the same v2.1 artifact (bm25 top-10 QPS at batch
+# 1/8/32/1024 + AND QPS, byte-parity gated against the numpy oracle,
+# >= 3x the r11 ranked gate) -> BENCH_NATIVE_r16.json
+bench-serve-native:
+	$(PY) tools/bench_serve.py --native-ab
+
 # resident-daemon bench: coalesced pipelined capacity vs the batch-1
 # closed-loop baseline, plus an open-loop (Poisson) sweep reporting
 # p50/p99 from scheduled arrival, shed rate, and deadline-miss rate at
@@ -206,7 +214,9 @@ rehearse:
 
 # drop every hashed native build artifact — production AND sanitizer
 # variants, in both the in-tree dir and the /tmp fallback (stale .so
-# files of the same variant are also auto-pruned on every rebuild)
+# files of the same variant are also auto-pruned on every rebuild).
+# The serve kernels (mri_serve_*) live in the same tagged .so as the
+# build-path symbols, so one sweep covers both API families.
 clean-native:
 	rm -rf parallel_computation_of_an_inverted_index_using_map_reduce_tpu/native/_build
 	rm -rf /tmp/mri_tpu_native_$$(id -u)

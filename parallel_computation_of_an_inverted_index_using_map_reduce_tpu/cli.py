@@ -360,7 +360,10 @@ def _query_main(argv: list[str]) -> int:
         return 2
     try:
         engine = create_engine(args.index_dir, args.engine)
-    except ArtifactError as e:
+    except (ArtifactError, ValueError) as e:
+        # ValueError covers construction-time knob reads (KnobError,
+        # e.g. a bad $MRI_SERVE_NATIVE) — same one-line exit-2
+        # contract the lazily-read knobs get from the query guard
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.explain:

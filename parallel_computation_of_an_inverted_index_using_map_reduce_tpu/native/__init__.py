@@ -350,6 +350,83 @@ def load():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
             ctypes.c_char_p,
         ]
+        lib.mri_serve_new.restype = ctypes.c_void_p
+        lib.mri_serve_new.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),   # blk_max
+            ctypes.POINTER(ctypes.c_int32),   # blk_first
+            ctypes.POINTER(ctypes.c_uint8),   # blk_width
+            ctypes.POINTER(ctypes.c_uint8),   # blk_tf_width
+            ctypes.POINTER(ctypes.c_uint8),   # blk_max_tf (raw bytes|NULL)
+            ctypes.POINTER(ctypes.c_uint8),   # blk_min_dl (raw bytes|NULL)
+            ctypes.POINTER(ctypes.c_uint32),  # post_words
+            ctypes.POINTER(ctypes.c_uint32),  # tf_words
+            ctypes.POINTER(ctypes.c_double),  # doc_lens
+            ctypes.POINTER(ctypes.c_int64),   # term_block_off
+            ctypes.POINTER(ctypes.c_int32),   # blk_cnt
+            ctypes.POINTER(ctypes.c_int64),   # blk_woff
+            ctypes.POINTER(ctypes.c_int64),   # blk_tf_woff
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32,
+        ]
+        lib.mri_serve_free.restype = None
+        lib.mri_serve_free.argtypes = [ctypes.c_void_p]
+        lib.mri_serve_decode_blocks.restype = ctypes.c_int32
+        lib.mri_serve_decode_blocks.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.mri_serve_decode_postings.restype = ctypes.c_int64
+        lib.mri_serve_decode_postings.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.mri_serve_and.restype = ctypes.c_int64
+        lib.mri_serve_and.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_serve_topk_bm25.restype = ctypes.c_int64
+        lib.mri_serve_topk_bm25.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_serve_set_topk_out.restype = ctypes.c_int64
+        lib.mri_serve_set_topk_out.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mri_serve_topk_prep.restype = ctypes.c_int64
+        lib.mri_serve_topk_prep.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.mri_serve_topk_prep_clear.restype = ctypes.c_int64
+        lib.mri_serve_topk_prep_clear.argtypes = [ctypes.c_void_p]
+        lib.mri_serve_topk_prep_free.restype = ctypes.c_int64
+        lib.mri_serve_topk_prep_free.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.mri_serve_topk_run.restype = ctypes.c_int64
+        lib.mri_serve_topk_run.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        # raw-address argtypes: the coalesced hot path passes
+        # array.array/ndarray buffer addresses as plain ints, skipping
+        # per-call ctypes pointer casts
+        lib.mri_serve_topk_batch.restype = ctypes.c_int64
+        lib.mri_serve_topk_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _lib = lib
     except (OSError, RuntimeError) as e:
         _lib_error = str(e)
@@ -1141,3 +1218,256 @@ def emit_native(out_dir, vocab: np.ndarray, order, df, offsets, postings,
     if rc < 0:
         raise OSError(f"native emit failed writing to {out_dir!r}")
     return int(rc)
+
+
+# -- serve-path kernels (mri_serve_*) ----------------------------------
+
+#: planner mode -> mri_serve_topk_bm25 mode argument
+_SERVE_MODES = {"exhaustive": 0, "bmw": 1, "maxscore": 2}
+_SERVE_MODE_NAMES = ("exhaustive", "bmw", "maxscore")
+
+
+def _serve_ptr(arr, ctype):
+    if arr is None:
+        return ctypes.cast(ctypes.c_void_p(), ctypes.POINTER(ctype))
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeServe:
+    """One ``mri_serve_*`` handle over a v2/v2.1 artifact's columns.
+
+    The handle borrows every pointer it is given, so this wrapper pins
+    the backing buffers (the artifact's mmap views plus the engine's
+    float64 doc-length column) for its lifetime — close the wrapper
+    before closing the artifact.  Calls are NOT thread-safe; the engine
+    serializes them (CPython GIL, daemon reload lock), the same
+    contract as the ``mri_hidx_*`` build streams.
+    """
+
+    # planner-mode → C mode code (and the inverse), exposed so the
+    # engine can memoize the translated code next to the prep id and
+    # account coalesced batches without re-deriving mode strings
+    MODES = _SERVE_MODES
+    MODE_NAMES = _SERVE_MODE_NAMES
+
+    def __init__(self, cols: dict, doc_lens: np.ndarray, avgdl: float,
+                 k1: float, b: float, cache_cap: int = 4096):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native serve unavailable: {_lib_error}")
+        self._lib = lib
+        self._cols = cols  # keeps the mmap views alive
+        self._doc_lens = np.ascontiguousarray(doc_lens, dtype=np.float64)
+        self.block_size = int(cols["block_size"])
+        self.score_bits = int(cols["score_bits"])
+        self._h = lib.mri_serve_new(
+            _serve_ptr(cols["blk_max"], ctypes.c_int32),
+            _serve_ptr(cols["blk_first"], ctypes.c_int32),
+            _serve_ptr(cols["blk_width"], ctypes.c_uint8),
+            _serve_ptr(cols["blk_tf_width"], ctypes.c_uint8),
+            _serve_ptr(cols["blk_max_tf"], ctypes.c_uint8),
+            _serve_ptr(cols["blk_min_dl"], ctypes.c_uint8),
+            _serve_ptr(cols["post_words"], ctypes.c_uint32),
+            _serve_ptr(cols["tf_words"], ctypes.c_uint32),
+            _serve_ptr(self._doc_lens, ctypes.c_double),
+            _serve_ptr(cols["term_block_off"], ctypes.c_int64),
+            _serve_ptr(cols["blk_cnt"], ctypes.c_int32),
+            _serve_ptr(cols["blk_woff"], ctypes.c_int64),
+            _serve_ptr(cols["blk_tf_woff"], ctypes.c_int64),
+            ctypes.c_int32(int(cols["vocab"])),
+            ctypes.c_int64(int(cols["num_blocks"])),
+            ctypes.c_int32(self.block_size),
+            ctypes.c_int32(self.score_bits),
+            ctypes.c_int64(len(self._doc_lens)),
+            ctypes.c_double(float(avgdl)), ctypes.c_double(float(k1)),
+            ctypes.c_double(float(b)), ctypes.c_int32(int(cache_cap)),
+        )
+        if not self._h:
+            raise RuntimeError(
+                "mri_serve_new rejected the artifact columns")
+        # reusable ranked-path output buffers (grown on demand),
+        # registered on the handle once: the per-query fast call then
+        # marshals 4 scalars instead of 9 mixed pointers
+        self._f_run = lib.mri_serve_topk_run
+        self._f_batch = lib.mri_serve_topk_batch
+        self._stats = np.zeros(3, dtype=np.int64)
+        self._p_stats = _serve_ptr(self._stats, ctypes.c_int64)
+        self._batch_bufs = None
+        self._grow_topk(256)
+
+    def _grow_topk(self, cap: int) -> None:
+        self._topk_cap = cap
+        self._out_d = np.empty(cap, dtype=np.int32)
+        self._out_s = np.empty(cap, dtype=np.float64)
+        self._p_out_d = _serve_ptr(self._out_d, ctypes.c_int32)
+        self._p_out_s = _serve_ptr(self._out_s, ctypes.c_double)
+        self._lib.mri_serve_set_topk_out(
+            self._h, self._p_out_d, self._p_out_s, self._p_stats)
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.mri_serve_free(h)
+        self._cols = None
+        self._doc_lens = None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.mri_serve_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- ops ------------------------------------------------------------
+
+    def decode_blocks(self, sel, want_tf: bool = True):
+        """``(ids, tf|None, cnt)`` for the selected global blocks —
+        the exact matrices (padding included) of the numpy
+        ``Artifact.decode_blocks`` / ``decode_tf_blocks`` pair.
+        ``None`` on a rejected call (caller falls back to numpy)."""
+        sel = np.ascontiguousarray(sel, dtype=np.int64)
+        n = len(sel)
+        B = self.block_size
+        ids = np.empty((max(n, 1), B), dtype=np.int32)
+        tfm = np.empty((max(n, 1), B), dtype=np.int32) if want_tf \
+            else None
+        cnt = np.empty(max(n, 1), dtype=np.int32)
+        rc = self._lib.mri_serve_decode_blocks(
+            self._h, _serve_ptr(sel, ctypes.c_int64), ctypes.c_int64(n),
+            _serve_ptr(ids, ctypes.c_int32),
+            _serve_ptr(tfm, ctypes.c_int32),
+            _serve_ptr(cnt, ctypes.c_int32))
+        if rc != 0:
+            return None
+        return ids[:n], (tfm[:n] if want_tf else None), cnt[:n]
+
+    def decode_postings(self, idx: int, df: int, want_tf: bool = True):
+        """``(docs, tf|None)`` of one term, or ``None`` on error."""
+        docs = np.empty(max(df, 1), dtype=np.int32)
+        tf = np.empty(max(df, 1), dtype=np.int32) if want_tf else None
+        got = self._lib.mri_serve_decode_postings(
+            self._h, ctypes.c_int32(int(idx)),
+            _serve_ptr(docs, ctypes.c_int32),
+            _serve_ptr(tf, ctypes.c_int32))
+        if got != df:
+            return None
+        return docs[:df], (tf[:df] if want_tf else None)
+
+    def query_and(self, acc, idx: int):
+        """``(survivors, blocks_decoded, blocks_skipped)`` of the
+        ascending candidate list intersected against term ``idx``, or
+        ``None`` on error."""
+        acc = np.ascontiguousarray(acc, dtype=np.int32)
+        out = np.empty(max(len(acc), 1), dtype=np.int32)
+        stats = np.zeros(2, dtype=np.int64)
+        m = self._lib.mri_serve_and(
+            self._h, _serve_ptr(acc, ctypes.c_int32),
+            ctypes.c_int64(len(acc)), ctypes.c_int32(int(idx)),
+            _serve_ptr(out, ctypes.c_int32),
+            _serve_ptr(stats, ctypes.c_int64))
+        if m < 0:
+            return None
+        return out[:m], int(stats[0]), int(stats[1])
+
+    def top_k_bm25(self, occ, idfs, k: int, mode: str):
+        """``(docs, scores, blocks_scored, blocks_skipped, candidates)``
+        for the occurrence list, byte-identical to the numpy oracle's
+        ``top_k_scored``; ``None`` on error (caller falls back)."""
+        occ_a = np.ascontiguousarray(occ, dtype=np.int32)
+        idf_a = np.ascontiguousarray(idfs, dtype=np.float64)
+        kk = max(int(k), 0)
+        out_d = np.empty(max(kk, 1), dtype=np.int32)
+        out_s = np.empty(max(kk, 1), dtype=np.float64)
+        stats = np.zeros(3, dtype=np.int64)
+        n = self._lib.mri_serve_topk_bm25(
+            self._h, _serve_ptr(occ_a, ctypes.c_int32),
+            ctypes.c_int32(len(occ_a)),
+            _serve_ptr(idf_a, ctypes.c_double),
+            ctypes.c_int32(kk), ctypes.c_int32(_SERVE_MODES[mode]),
+            _serve_ptr(out_d, ctypes.c_int32),
+            _serve_ptr(out_s, ctypes.c_double),
+            _serve_ptr(stats, ctypes.c_int64))
+        if n < 0:
+            return None
+        return (out_d[:n], out_s[:n], int(stats[0]), int(stats[1]),
+                int(stats[2]))
+
+    def prep_query(self, occ, idfs):
+        """Freeze one query's (occ, idf) argument arrays into the
+        handle, returning the prep id :meth:`top_k_bm25_fast` executes
+        (``None`` on rejection) — argument marshalling dominates a warm
+        ranked query, so the engine memoizes this per query key."""
+        occ_a = np.ascontiguousarray(occ, dtype=np.int32)
+        idf_a = np.ascontiguousarray(idfs, dtype=np.float64)
+        pid = self._lib.mri_serve_topk_prep(
+            self._h, _serve_ptr(occ_a, ctypes.c_int32), len(occ_a),
+            _serve_ptr(idf_a, ctypes.c_double))
+        return int(pid) if pid > 0 else None
+
+    def clear_preps(self) -> None:
+        """Drop every prepared query (engine prep-memo sweep)."""
+        if self._h:
+            self._lib.mri_serve_topk_prep_clear(self._h)
+
+    def free_prep(self, pid: int) -> None:
+        """Drop one prepared query (un-memoizable one-shot query)."""
+        if self._h:
+            self._lib.mri_serve_topk_prep_free(self._h, pid)
+
+    def top_k_bm25_fast(self, pid: int, k: int, mode: str):
+        """Ranked query over a :meth:`prep_query` id reusing the
+        handle's registered output buffers: ``(pairs, scored, skipped,
+        candidates)`` with ``pairs`` the engine's final
+        ``[(doc, score), ...]``; ``None`` on error."""
+        if k > self._topk_cap:
+            self._grow_topk(max(k, 2 * self._topk_cap))
+        n = self._f_run(self._h, pid, k, _SERVE_MODES[mode])
+        if n < 0:
+            return None
+        stats = self._stats
+        return (list(zip(self._out_d[:n].tolist(),
+                         self._out_s[:n].tolist())),
+                int(stats[0]), int(stats[1]), int(stats[2]))
+
+    def top_k_bm25_batch(self, pids, modes, nq: int, k: int):
+        """Coalesced ranked batch — ``nq`` prepared queries in ONE
+        library crossing (the router/daemon micro-batch regime, where
+        per-call dispatch would otherwise dominate the kernels).
+        ``pids`` is an ``array.array('q')`` of prep ids and ``modes``
+        an ``array.array('i')`` of ``MODES`` codes — the engine builds
+        them append-by-append, and their buffer addresses go straight
+        into the call.  Returns ``(pairs_list, scored, skipped,
+        candidates)`` with ``pairs_list[i]`` the i-th query's
+        ``[(doc, score), ...]`` and the stats summed across the batch;
+        ``None`` on any error (the caller re-runs per query)."""
+        need = nq * k
+        bb = self._batch_bufs
+        if bb is None or bb[8] < need or bb[9] < nq:
+            docs = np.empty(max(need, 256), dtype=np.int32)
+            scores = np.empty(max(need, 256), dtype=np.float64)
+            nhits = np.empty(max(nq, 64), dtype=np.int32)
+            stats = np.zeros(3, dtype=np.int64)
+            bb = (docs, scores, nhits, stats,
+                  docs.ctypes.data, scores.ctypes.data,
+                  nhits.ctypes.data, stats.ctypes.data,
+                  len(docs), len(nhits))
+            self._batch_bufs = bb
+        rc = self._f_batch(
+            self._h, pids.buffer_info()[0], modes.buffer_info()[0],
+            nq, k, bb[4], bb[5], bb[6], bb[7])
+        if rc < 0:
+            return None
+        dl = bb[0][:need].tolist()
+        sl = bb[1][:need].tolist()
+        nl = bb[2][:nq].tolist()
+        pairs_list = [list(zip(dl[lo:lo + n], sl[lo:lo + n]))
+                      for lo, n in zip(range(0, need, k), nl)]
+        s0, s1, s2 = bb[3].tolist()
+        return (pairs_list, s0, s1, s2)

@@ -900,6 +900,48 @@ def device_columns(art: Artifact) -> dict:
     return cols
 
 
+def serve_columns(art: Artifact) -> dict:
+    """Zero-copy column views handed to the native serve kernels.
+
+    Unlike ``device_columns`` this makes NO padded copies: every entry
+    is a view straight into the artifact mmap (or a derived geometry
+    array the loader already materialized), so the dict is valid only
+    while the artifact stays open.  The native unpack kernel reads one
+    u32 word past each block payload unconditionally; that over-read is
+    always in-file because the v2 layout places ``tf_data`` /
+    ``doc_lens`` / ``df_order`` after ``post_data`` and ``doc_lens`` /
+    ``df_order`` after ``tf_data`` (both 16-byte aligned), so no pad
+    word is appended here.  ``blk_max_tf`` / ``blk_min_dl`` are exposed
+    as raw bytes (``None`` on plain v2): C picks u8 vs u16-LE off
+    ``score_bits`` itself.
+    """
+    if art.version < VERSION_V2:
+        raise ArtifactError(
+            f"{art.path}: native serve kernels need a v2+ artifact "
+            f"(got version {art.version})")
+    has_scores = art.score_bits != 0
+    return {
+        "blk_max": art.blk_max,
+        "blk_first": art.blk_first,
+        "blk_width": art.blk_width,
+        "blk_tf_width": art.blk_tf_width,
+        "blk_max_tf": art.blk_max_tf.view(np.uint8) if has_scores
+        else None,
+        "blk_min_dl": art.blk_min_dl.view(np.uint8) if has_scores
+        else None,
+        "post_words": art.post_words,
+        "tf_words": art.tf_words,
+        "term_block_off": art.term_block_off,
+        "blk_cnt": art.blk_cnt,
+        "blk_woff": art.blk_woff,
+        "blk_tf_woff": art.blk_tf_woff,
+        "vocab": art.vocab,
+        "num_blocks": art.num_blocks,
+        "block_size": art.block_size,
+        "score_bits": art.score_bits,
+    }
+
+
 def bm25_corpus(art: Artifact) -> tuple[np.ndarray, int, float]:
     """``(doc_lens float64, ndocs, avgdl)`` for BM25 scoring.
 

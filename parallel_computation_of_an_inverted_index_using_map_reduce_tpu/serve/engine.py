@@ -13,6 +13,7 @@ is an O(k) slice of the artifact's ``df_order`` permutation.
 
 from __future__ import annotations
 
+import array
 import time
 
 import numpy as np
@@ -150,6 +151,84 @@ class Engine:
         # form costs a couple of microseconds per call — real money at
         # the QPS the lean small-query path runs at)
         self._h_topk = self._ops.histogram("top_k_scored")
+        # native (C++) serve kernels.  The knob is resolved ONCE per
+        # engine: a daemon SIGHUP reload swaps the engine, which is the
+        # re-resolution point for this and every other serve knob.  The
+        # handle itself builds lazily on the first eligible op (the
+        # first load compiles the extension); answers are byte-
+        # identical either way, so a mid-stream fallback is invisible.
+        self._native_mode = resolve_native()
+        self._native = None
+        self._native_err: str | None = None
+        self._idf_memo: dict[int, float] = {}
+        #: query key -> (prep id, dfs): the frozen C-side arguments a
+        #: warm native ranked query is re-issued with, plus the ranked
+        #: plan memo keyed (query key, k) against the raw planner token
+        self._nat_prep: dict[bytes, tuple] = {}
+        self._plan_memo: dict[tuple, tuple] = {}
+        # per-k {query key -> (prep id, mode, mode code, env token)}
+        # plus reusable marshalling arrays for the coalesced path
+        self._batch_memo: dict[int, dict] = {}
+        self._ba_pids = array.array("q")
+        self._ba_modes = array.array("i")
+        self._c_native_ops = self.metrics.counter(
+            "mri_native_ops_total")
+        self._c_native_fallback = self.metrics.counter(
+            "mri_native_fallback_total")
+        if self._native_mode == "1":
+            self._native_handle()  # required -> fail loudly up front
+
+    # -- native serve kernels -------------------------------------------
+
+    def _native_handle(self):
+        """The lazily-built ``NativeServe`` handle, or None when native
+        is off, unsupported (v1 artifact) or unavailable (no compiled
+        extension).  Under ``MRI_SERVE_NATIVE=1`` unavailability raises
+        instead of silently serving numpy."""
+        if self._native is not None:
+            return self._native
+        if self._native_mode != "0" and self._native_err is None:
+            art = self.artifact
+            if art.version < artifact_mod.VERSION_V2:
+                self._native_err = "v1 artifact (native needs v2+)"
+            else:
+                try:
+                    from .. import native as native_mod
+                    doc_lens, _, avgdl = self._bm25_corpus()
+                    self._native = native_mod.NativeServe(
+                        artifact_mod.serve_columns(art), doc_lens,
+                        avgdl, BM25_K1, BM25_B,
+                        cache_cap=self._memo_cap)
+                except Exception as e:
+                    self._native_err = f"{type(e).__name__}: {e}"
+        if self._native is None and self._native_mode == "1":
+            raise RuntimeError(
+                "MRI_SERVE_NATIVE=1 but the native serve kernels are "
+                f"unavailable: {self._native_err}")
+        return self._native
+
+    def _close_native(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        self._native_err = None
+        self._nat_prep.clear()
+        self._plan_memo.clear()
+        self._batch_memo.clear()
+
+    def _term_idf(self, i: int) -> float:
+        """The scalar idf the native scorer receives for lex term
+        ``i`` — the exact double :meth:`_term_scores` computes, so both
+        backends multiply by bit-equal factors (memoized)."""
+        hit = self._idf_memo.get(i)
+        if hit is None:
+            _, ndocs, _ = self._bm25_corpus()
+            dfi = self._scoring_df(i, int(self._df[i]))
+            hit = float(np.log(1.0 + (ndocs - dfi + 0.5) / (dfi + 0.5)))
+            if len(self._idf_memo) >= self._memo_cap:
+                self._idf_memo.clear()
+            self._idf_memo[i] = hit
+        return hit
 
     # -- term resolution ------------------------------------------------
 
@@ -229,7 +308,24 @@ class Engine:
         if hit is not None:
             return hit
         art = self.artifact
-        decoded = art.decode_postings(idx)
+        decoded = None
+        if self._native_mode != "0" \
+                and art.version >= artifact_mod.VERSION_V2:
+            nat = self._native_handle()
+            if nat is not None:
+                res = nat.decode_postings(idx, int(self._df[idx]))
+                if res is not None:
+                    decoded, tf = res
+                    self._c_native_ops.inc()
+                    # the tf column came out of the same block walk —
+                    # warm its cache so _term_scores never re-decodes
+                    if self._tf_cache.peek(idx) is None:
+                        tf.setflags(write=False)
+                        self._tf_cache.put(idx, tf)
+                else:
+                    self._c_native_fallback.inc()
+        if decoded is None:
+            decoded = art.decode_postings(idx)
         coll = obs_attrib.active()
         if art.version >= artifact_mod.VERSION_V2:
             b0 = int(art.term_block_off[idx])
@@ -337,11 +433,20 @@ class Engine:
             acc = self.postings_by_index(uniq[0])
             v2 = self.artifact.version >= artifact_mod.VERSION_V2
             B = self.artifact.block_size
+            nat = self._native_handle() \
+                if self._native_mode != "0" and v2 else None
+            coll = obs_attrib.active()
             for i in uniq[1:]:
                 if len(acc) == 0:
                     break
-                arm = self.planner.plan_and(len(acc), int(self._df[i]))
                 cached = self._cache.peek(i)
+                # native takes the gallop arm only when the run is NOT
+                # already decoded in cache: probing a cached array is a
+                # single numpy searchsorted, cheaper than re-walking
+                # blocks in C
+                arm = self.planner.plan_and(
+                    len(acc), int(self._df[i]),
+                    native=nat is not None and cached is None)
                 if arm == "merge":
                     # merge only fires when the partner run is at most
                     # ~2x the accumulator, so decoding it whole is
@@ -349,7 +454,21 @@ class Engine:
                     run = cached if cached is not None \
                         else self.postings_by_index(i)
                     acc = np.intersect1d(acc, run, assume_unique=True)
-                elif cached is not None:
+                    continue
+                if arm == "native":
+                    res = nat.query_and(
+                        np.ascontiguousarray(acc, dtype=np.int32), i)
+                    if res is not None:
+                        acc, dec, skp = res
+                        self._c_native_ops.inc()
+                        self._c_blocks_decoded.inc(dec)
+                        self._c_blocks_skipped.inc(skp)
+                        if coll is not None:
+                            coll.decoded(dec, 0)
+                            coll.skipped(skp)
+                        continue
+                    self._c_native_fallback.inc()
+                if cached is not None:
                     acc = self._and_probe(acc, cached)
                 elif v2 and len(acc) * B < int(self._df[i]):
                     acc = self._and_skip(acc, i)
@@ -403,6 +522,10 @@ class Engine:
         self._score_memo.clear()
         self._bound_memo.clear()
         self._occ_memo.clear()
+        self._idf_memo.clear()
+        # the native handle bakes avgdl in at construction — rebuild it
+        # lazily against the overridden stats
+        self._close_native()
 
     def _scoring_df(self, i: int, dfi: int) -> int:
         """The df that enters the idf term for lex index ``i``: the
@@ -444,6 +567,49 @@ class Engine:
                 for i in occ:
                     coll.term(art.term(i), i, True,
                               int(self._df[i]), "cache")
+            if occ and k > 0 and self._native_mode != "0":
+                nat = self._native_handle()
+                if nat is not None:
+                    res = None
+                    prep = self._nat_prep.get(key) \
+                        if key is not None else None
+                    if prep is None:
+                        pid = nat.prep_query(
+                            occ, [self._term_idf(i) for i in occ])
+                        if pid is not None:
+                            prep = (pid,
+                                    [int(self._df[i]) for i in occ])
+                            if key is not None:
+                                if len(self._nat_prep) > (1 << 16):
+                                    self._nat_prep.clear()
+                                    self._plan_memo.clear()
+                                    self._batch_memo.clear()
+                                    nat.clear_preps()
+                                self._nat_prep[key] = prep
+                    if prep is not None:
+                        raw = _planner_raw_token()
+                        pk = (key, k)
+                        pm = self._plan_memo.get(pk)
+                        if pm is not None and pm[1] == raw:
+                            mode = pm[0]
+                        else:
+                            mode = self.planner.plan_ranked(
+                                self.artifact, prep[1], k)
+                            if key is not None:
+                                if len(self._plan_memo) > (1 << 16):
+                                    self._plan_memo.clear()
+                                self._plan_memo[pk] = (mode, raw)
+                        res = nat.top_k_bm25_fast(prep[0], k, mode)
+                        if key is None:
+                            nat.free_prep(prep[0])
+                    if res is not None:
+                        pairs, scored, skipped, ncand = res
+                        self._c_native_ops.inc()
+                        self.planner.note_ranked(
+                            mode, scored, skipped, ncand,
+                            backend="native")
+                        return pairs
+                    self._c_native_fallback.inc()
             if occ and k > 0 and len(occ) <= 2:
                 out = self._top_k_small(occ, k, coll)
                 if out is not None:
@@ -457,6 +623,94 @@ class Engine:
             return out
         finally:
             self._h_topk.observe(time.perf_counter() - t0)
+
+    def top_k_scored_batch(self, batches, k: int):
+        """Answer a coalesced group of ranked queries — the daemon /
+        scale-out-router micro-batch regime — returning one
+        ``top_k_scored`` result list per encoded batch, byte-identical
+        to issuing them serially.
+
+        With the native backend every warm query in the group resolves
+        to a prepared id and the whole group crosses into C ONCE
+        (``mri_serve_topk_batch``), amortizing the per-call dispatch
+        (ctypes marshalling, latency observation, planner accounting)
+        that dominates single-query serving on small corpora.  Cold
+        queries, attribution-collected requests, and the numpy backend
+        all take the per-query path, so semantics (memo fills, EXPLAIN
+        spans, counters) are unchanged."""
+        if k <= 0 or self._native_mode == "0" \
+                or obs_attrib.active() is not None:
+            return [self.top_k_scored(b, k) for b in batches]
+        nat = self._native_handle()
+        if nat is None:
+            return [self.top_k_scored(b, k) for b in batches]
+        t0 = time.perf_counter()
+        out: list = [None] * len(batches)
+        pids = self._ba_pids
+        modes_i = self._ba_modes
+        del pids[:]
+        del modes_i[:]
+        ncold = 0
+        raw = _planner_raw_token()
+        bmk = self._batch_memo.get(k)
+        if bmk is None:
+            bmk = self._batch_memo[k] = {}
+        bm_get = bmk.get
+        app_p = pids.append
+        app_m = modes_i.append
+        for qi, batch in enumerate(batches):
+            key = batch.tobytes() if isinstance(batch, np.ndarray) \
+                else None
+            ent = bm_get(key) if key is not None else None
+            if ent is None or ent[3] != raw:
+                prep = self._nat_prep.get(key) if key is not None \
+                    else None
+                occ = self._occ_memo.get(key) if key is not None \
+                    else None
+                if prep is None or occ is None:
+                    # cold query: the single path fills every memo
+                    # (occ, prep, plan) so the next group runs warm
+                    out[qi] = self.top_k_scored(batch, k)
+                    ncold += 1
+                    continue
+                mode = self.planner.plan_ranked(
+                    self.artifact, prep[1], k)
+                ent = (prep[0], mode, nat.MODES[mode], raw)
+                if len(bmk) > (1 << 16):
+                    bmk.clear()
+                bmk[key] = ent
+            app_p(ent[0])
+            app_m(ent[2])
+        if pids:
+            nq = len(pids)
+            res = nat.top_k_bm25_batch(pids, modes_i, nq, k)
+            if res is None:
+                self._c_native_fallback.inc()
+                for qi in range(len(batches)):
+                    if out[qi] is None:
+                        out[qi] = self.top_k_scored(batches[qi], k)
+            else:
+                pairs_list, scored, skipped, ncand = res
+                self._c_native_ops.inc(nq)
+                counts = {}
+                for ci, nm in enumerate(nat.MODE_NAMES):
+                    c = modes_i.count(ci)
+                    if c:
+                        counts[nm] = c
+                self.planner.note_ranked_batch(
+                    counts, nat.MODE_NAMES[modes_i[-1]],
+                    scored, skipped, ncand, backend="native")
+                if ncold == 0:
+                    out = pairs_list
+                else:
+                    it = iter(pairs_list)
+                    for qi in range(len(batches)):
+                        if out[qi] is None:
+                            out[qi] = next(it)
+            # one ranked-op latency observation for the fused group
+            # (cold queries above observed their own)
+            self._h_topk.observe(time.perf_counter() - t0)
+        return out
 
     def _top_k_small(self, occ: list[int], k: int, coll=None):
         """Lean 1-2 occurrence ranked path over memoized contributions.
@@ -840,15 +1094,24 @@ class Engine:
             "ops": self.op_stats(),
             "decode": self.decode_stats(),
             "planner": self.planner.describe(),
+            "native": {
+                "mode": self._native_mode,
+                "active": self._native is not None,
+                "error": self._native_err,
+                "ops": self._c_native_ops.value,
+                "fallbacks": self._c_native_fallback.value,
+            },
         }
 
     def close(self) -> None:
+        self._close_native()
         self._cache.clear()
         self._tf_cache.clear()
         self._memo.clear()
         self._score_memo.clear()
         self._bound_memo.clear()
         self._occ_memo.clear()
+        self._idf_memo.clear()
         self._bm25_cols = None
         self._df = self._keys = self._terms = self._rows = None
         self.artifact.close()
@@ -879,6 +1142,44 @@ BM25_B = 0.75
 
 SCORE_CHOICES = ("df", "bm25")
 SCORE_ENV = "MRI_SERVE_SCORE"
+
+NATIVE_ENV = "MRI_SERVE_NATIVE"
+NATIVE_CHOICES = ("auto", "0", "1")
+
+# Fast raw-token probe for the native ranked-plan memo: the planner's
+# resolve_cached() re-reads $MRI_SERVE_PLANNER every call so mid-session
+# flips take effect immediately, and the memo below must invalidate on
+# the same signal.  CPython's os.environ backing dict returns the raw
+# token without the Environ wrapper's decode layer (~4x cheaper on the
+# warm path); fall back to the portable getter when unavailable.
+# mrilint: allow(env-knobs) raw-string cache token only; the parse
+# still goes through the declared knob via planner.resolve_cached
+import os as _os  # noqa: E402
+
+try:
+    _PLAN_ENV_DB = _os.environ._data
+    _PLAN_ENV_KEY = _os.environ.encodekey(planner_mod.PLANNER_ENV)
+    _PLAN_ENV_DB.get(_PLAN_ENV_KEY)
+except Exception:  # pragma: no cover - non-CPython environ layout
+    _PLAN_ENV_DB, _PLAN_ENV_KEY = None, None
+
+
+def _planner_raw_token():
+    """The raw (undecoded) $MRI_SERVE_PLANNER value, or ``None``."""
+    if _PLAN_ENV_DB is not None:
+        return _PLAN_ENV_DB.get(_PLAN_ENV_KEY)
+    return _os.environ.get(planner_mod.PLANNER_ENV)
+
+
+def resolve_native(mode: str | None = None) -> str:
+    """``auto``/``0``/``1`` (+ $MRI_SERVE_NATIVE default), validated.
+    Resolved once per engine; a daemon reload swaps the engine and so
+    re-resolves it."""
+    mode = mode or envknobs.get(NATIVE_ENV)
+    if mode not in NATIVE_CHOICES:
+        raise ValueError(
+            f"unknown native mode {mode!r} (choices: {NATIVE_CHOICES})")
+    return mode
 
 
 def resolve_score(score: str | None = None) -> str:
@@ -1050,6 +1351,10 @@ class AutoEngine:
     def top_k_scored(self, batch, k):
         # mrilint: allow(trace) delegation; host engine attributes
         return self._host.top_k_scored(batch, k)
+
+    def top_k_scored_batch(self, batches, k):
+        # mrilint: allow(trace) delegation; host engine attributes
+        return self._host.top_k_scored_batch(batches, k)
 
     # -- bookkeeping ----------------------------------------------------
 

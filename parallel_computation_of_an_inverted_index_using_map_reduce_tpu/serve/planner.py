@@ -81,7 +81,7 @@ class Planner:
             for m in ("exhaustive", "bmw", "maxscore")}
         self._c_and = {
             m: registry.counter(f"mri_planner_and_{m}_total")
-            for m in ("gallop", "merge")}
+            for m in ("gallop", "merge", "native")}
         self._c_scored = registry.counter(
             "mri_planner_blocks_scored_total")
         self._c_skipped = registry.counter(
@@ -118,13 +118,19 @@ class Planner:
             mode = "bmw" if max(dfs) > 4 * art.block_size else "maxscore"
         return mode
 
-    def plan_and(self, n_acc: int, df: int) -> str:
+    def plan_and(self, n_acc: int, df: int, native: bool = False) -> str:
         """Gallop (probe the partner run at surviving candidates only)
         vs merge (linear sorted-set intersection) for one AND step.
         Galloping wins when the partner dwarfs the accumulator; a
         linear merge is cache-friendlier when the runs are comparable.
+        With ``native`` the C kernel (which fuses blk_max skip routing
+        with in-block galloping) takes the gallop arm's territory; the
+        comparable-runs merge stays numpy, where a linear pass over an
+        already-decoded cached array beats re-decoding blocks.
         """
         mode = "merge" if df <= 2 * n_acc else "gallop"
+        if native and mode == "gallop":
+            mode = "native"
         self._c_and[mode].inc()
         coll = obs_attrib.active()
         if coll is not None:
@@ -132,8 +138,10 @@ class Planner:
         return mode
 
     def note_ranked(self, mode: str, scored: int, skipped: int,
-                    candidates: int) -> None:
-        """Record one ranked query's decision + block economy."""
+                    candidates: int, backend: str = "numpy") -> None:
+        """Record one ranked query's decision + block economy.
+        ``backend`` labels who executed the chosen plan (numpy or
+        native) so the trace span and ``--stats`` stay auditable."""
         self._c_ranked[mode].inc()
         if scored:
             self._c_scored.inc(scored)
@@ -141,9 +149,35 @@ class Planner:
             self._c_skipped.inc(skipped)
         coll = obs_attrib.active()
         if coll is not None:
-            coll.ranked(mode, scored, skipped, candidates)
+            coll.ranked(f"{mode}/native" if backend == "native"
+                        else mode, scored, skipped, candidates)
         self.last_ranked = {
             "mode": mode,
+            "backend": backend,
+            "blocks_scored": scored,
+            "blocks_skipped": skipped,
+            "candidates": candidates,
+        }
+
+    def note_ranked_batch(self, counts: dict, last_mode: str,
+                          scored: int, skipped: int, candidates: int,
+                          backend: str = "native") -> None:
+        """Accounting for one coalesced ranked batch: per-mode ranked
+        counters advance by each query (``counts`` maps mode → how
+        many of the batch ran it, so ``describe()`` totals match the
+        per-query path exactly) while the block-economy totals —
+        already summed across the batch by the native kernel — land in
+        one locked increment each.  ``last_ranked`` records the final
+        query's mode with the batch's summed block economy."""
+        for m, c in counts.items():
+            self._c_ranked[m].inc(c)
+        if scored:
+            self._c_scored.inc(scored)
+        if skipped:
+            self._c_skipped.inc(skipped)
+        self.last_ranked = {
+            "mode": last_mode,
+            "backend": backend,
             "blocks_scored": scored,
             "blocks_skipped": skipped,
             "candidates": candidates,

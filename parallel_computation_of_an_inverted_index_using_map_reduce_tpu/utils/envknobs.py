@@ -243,6 +243,12 @@ declare("MRI_SERVE_PLANNER", str, "auto",
         "Ranked-query planner: auto (df/k heuristic), exhaustive "
         "(score every posting), bmw (Block-Max WAND) or maxscore.",
         scope="serve", choices=("auto", "exhaustive", "bmw", "maxscore"))
+declare("MRI_SERVE_NATIVE", str, "auto",
+        "Native (C++) serve kernels for v2 decode/AND/BM25: auto "
+        "(on when the compiled library loads), 1 (require native — "
+        "engine creation fails loudly if the .so is unavailable) or "
+        "0 (numpy only).  Answers are byte-identical either way.",
+        scope="serve", choices=("auto", "0", "1"))
 declare("MRI_SERVE_CROSSOVER", int, None,
         "--engine auto host->device batch-size crossover: unset probes "
         "it by measurement, 0 pins host, N>0 routes batches >= N to "

@@ -1,0 +1,434 @@
+"""Native serve kernels (``mri_serve_*``): byte-identity with the
+numpy oracle engine.
+
+The conformance contract is absolute: for every (query, k, planner
+mode) the native backend must return the EXACT list — same doc ids,
+same float64 score bits, same tie order — that the numpy engine
+returns, and the decode/AND kernels must reproduce the artifact
+decoders' matrices including their padding semantics.  The fuzz corpus
+pins term dfs at the block-size boundaries (1/127/128/129/256/300 with
+the default 128-doc blocks) and spreads doc ids so the packed delta
+widths run from 0 (consecutive ids) up to the corpus maximum.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from test_serve import build_corpus
+from test_daemon import Client, serving
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    native,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    engine as engine_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+    planner as planner_mod,
+)
+
+pytestmark = [
+    pytest.mark.serve,
+    pytest.mark.skipif(not native.available(),
+                       reason="no C++ toolchain"),
+]
+
+NDOCS = 1200
+#: dfs straddling the default 128-doc block boundary
+TARGET_DFS = (1, 127, 128, 129, 256, 300)
+KS = (1, 10, 128)
+MODES = ("auto", "exhaustive", "bmw", "maxscore")
+
+
+def _corpus():
+    """Deterministic member lists per term + the doc blobs."""
+    import random
+    rng = random.Random(41)
+    members = {}
+    for df in TARGET_DFS:
+        if df == 1:
+            ids = [NDOCS // 2]
+        else:
+            step = max(1, (NDOCS - 2) // df)
+            ids = list(range(1, 1 + step * df, step))[:df]
+        # tokenizer keeps alphabetic terms only: spell the df in
+        # letters (1 -> "b", 127 -> "bch", ...)
+        name = "df" + "".join("abcdefghij"[int(c)] for c in str(df))
+        members[name] = ids
+    # consecutive ids: delta-1 everywhere packs the block at width 0
+    members["runzero"] = list(range(5, 5 + 300))
+    # geometric gaps: deltas up to ~NDOCS push the width to the max
+    g, ids = 1, []
+    while g <= NDOCS:
+        ids.append(g)
+        g = max(g + 1, int(g * 1.9))
+    members["wide"] = ids
+    members["spread"] = sorted(rng.sample(range(1, NDOCS + 1), 300))
+    for t in range(40):
+        df = rng.randint(2, 200)
+        members["noise" + "abcdefghij"[t // 10] + "abcdefghij"[t % 10]] \
+            = sorted(rng.sample(range(1, NDOCS + 1), df))
+    per_doc = [[] for _ in range(NDOCS + 1)]
+    for name, docs in members.items():
+        for d in docs:
+            tf = 1 + ((d * (len(name) + 3)) % 9)
+            per_doc[d].extend([name] * tf)
+    blobs = []
+    for d in range(1, NDOCS + 1):
+        toks = per_doc[d] or ["filler"]
+        rng.shuffle(toks)
+        blobs.append(" ".join(toks).encode())
+    return blobs, members
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    blobs, members = _corpus()
+    out = build_corpus(tmp_path_factory.mktemp("native_serve"), blobs)
+    return out, members
+
+
+@pytest.fixture(scope="module")
+def engines(built):
+    """(numpy oracle, native-required) engine pair over one artifact.
+
+    The backend knob is resolved at construction, so pinning the env
+    around each constructor gives two engines with opposite backends
+    that can then run side by side."""
+    out, _ = built
+    old = os.environ.get(engine_mod.NATIVE_ENV)
+    try:
+        os.environ[engine_mod.NATIVE_ENV] = "0"
+        ref = engine_mod.Engine(out)
+        os.environ[engine_mod.NATIVE_ENV] = "1"
+        nat = engine_mod.Engine(out)
+    finally:
+        if old is None:
+            os.environ.pop(engine_mod.NATIVE_ENV, None)
+        else:
+            os.environ[engine_mod.NATIVE_ENV] = old
+    yield ref, nat
+    nat.close()
+    ref.close()
+
+
+def _lex(engine, word: str) -> int:
+    idx, found = engine.lookup(engine.encode_batch([word]))
+    assert found[0], word
+    return int(idx[0])
+
+
+# -- decode kernels -------------------------------------------------------
+
+
+def _assert_blocks_equal(art, h, sel):
+    """ids match the oracle bit-for-bit INCLUDING its padding (rows
+    past a block's count repeat the last real doc id); tf matches
+    under the count mask — the only region either decoder defines."""
+    want_ids, want_cnt = art.decode_blocks(sel)
+    want_tf, _ = art.decode_tf_blocks(sel)
+    got = h.decode_blocks(sel)
+    assert got is not None
+    ids, tfm, cnt = got
+    np.testing.assert_array_equal(cnt, want_cnt)
+    np.testing.assert_array_equal(ids, want_ids)
+    mask = np.arange(art.block_size)[None, :] < want_cnt[:, None]
+    np.testing.assert_array_equal(tfm[mask],
+                                  want_tf[:, :art.block_size][mask])
+    # native's own padding contract: tf entries past cnt are 1
+    assert (tfm[~mask] == 1).all()
+
+
+def test_decode_blocks_identity_all_terms(engines):
+    """Every block of every term against the numpy decoders."""
+    ref, nat = engines
+    art = ref.artifact
+    h = nat._native_handle()
+    assert h is not None
+    widths_seen = set()
+    for i in range(art.vocab):
+        b0, b1 = int(art.term_block_off[i]), int(art.term_block_off[i + 1])
+        if b0 == b1:
+            continue
+        sel = np.arange(b0, b1, dtype=np.int64)
+        widths_seen.update(art.blk_width[sel].tolist())
+        _assert_blocks_equal(art, h, sel)
+    assert 0 in widths_seen and max(widths_seen) >= 8, widths_seen
+
+
+def test_decode_blocks_mixed_selection(engines):
+    """One call over blocks of MANY terms at once (mixed widths and
+    counts in a single selection vector, out of order)."""
+    ref, nat = engines
+    art = ref.artifact
+    h = nat._native_handle()
+    rng = np.random.default_rng(7)
+    sel = rng.permutation(art.num_blocks)[:200].astype(np.int64)
+    _assert_blocks_equal(art, h, sel)
+
+
+def test_decode_postings_identity(engines, built):
+    _, members = built
+    ref, nat = engines
+    art = ref.artifact
+    h = nat._native_handle()
+    for word, docs in members.items():
+        i = _lex(ref, word)
+        got = h.decode_postings(i, int(ref._df[i]))
+        assert got is not None
+        np.testing.assert_array_equal(got[0], art.decode_postings(i))
+        np.testing.assert_array_equal(got[1], art.decode_tf(i))
+        assert got[0].tolist() == docs
+
+
+# -- AND kernel -----------------------------------------------------------
+
+
+def test_and_kernel_against_set_oracle(engines, built):
+    """Raw kernel vs set intersection, with candidates that miss every
+    block, sit between members, or exceed the final blk_max."""
+    _, members = built
+    ref, nat = engines
+    art = ref.artifact
+    h = nat._native_handle()
+    rng = np.random.default_rng(11)
+    names = sorted(members)
+    for trial in range(60):
+        word = names[int(rng.integers(len(names)))]
+        i = _lex(ref, word)
+        run = art.decode_postings(i)
+        n = int(rng.integers(1, 400))
+        cand = np.unique(rng.integers(0, NDOCS + 40, size=n)
+                         .astype(np.int32))
+        res = h.query_and(cand, i)
+        assert res is not None
+        got, dec, skp = res
+        want = np.intersect1d(cand, run)
+        np.testing.assert_array_equal(got, want)
+        b0, b1 = int(art.term_block_off[i]), int(art.term_block_off[i + 1])
+        assert dec + skp == b1 - b0 and dec >= 0 and skp >= 0
+
+
+def test_query_and_engine_parity(engines, built):
+    _, members = built
+    ref, nat = engines
+    names = sorted(members)
+    import random
+    rng = random.Random(13)
+    queries = [[n] for n in names[:6]]
+    for _ in range(60):
+        queries.append(rng.sample(names, rng.randint(2, 4)))
+    queries.append(["dfb", "runzero", "wide"])
+    queries.append(["dfb", "absentword"])
+    for q in queries:
+        a0 = ref.query_and(ref.encode_batch(q))
+        a1 = nat.query_and(nat.encode_batch(q))
+        np.testing.assert_array_equal(a0, a1)
+
+
+# -- ranked kernel: the byte-identity fuzz matrix -------------------------
+
+
+def _ranked_queries(members):
+    import random
+    rng = random.Random(17)
+    names = sorted(members)
+    qs = [[n] for n in names[:8]]          # singles, all boundary dfs
+    qs += [[n, n] for n in names[:4]]      # duplicated occurrences
+    for _ in range(40):
+        qs.append(rng.sample(names, rng.randint(2, 5)))
+    qs.append(names[:3] + ["absentword"])  # absent terms drop out
+    qs.append(["absentword"])
+    return qs
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_topk_bm25_byte_identity_matrix(engines, built, monkeypatch,
+                                        mode):
+    """The fuzz matrix: planner mode x k in {1,10,128} x boundary-df
+    query mix.  Exact ``==`` on the (doc, score) lists — float bits
+    included."""
+    _, members = built
+    ref, nat = engines
+    monkeypatch.setenv(planner_mod.PLANNER_ENV, mode)
+    for q in _ranked_queries(members):
+        for k in KS:
+            b = ref.encode_batch(q)
+            r0 = ref.top_k_scored(b, k)
+            r1 = nat.top_k_scored(nat.encode_batch(q), k)
+            assert r0 == r1, (mode, q, k)
+    d = nat.describe()["native"]
+    assert d["ops"] > 0 and d["fallbacks"] == 0
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_topk_batch_parity(engines, built, monkeypatch, mode):
+    """``top_k_scored_batch`` (the coalesced one-crossing path) must be
+    byte-identical to issuing the group serially — cold first pass,
+    warm second pass, and ragged group sizes included."""
+    _, members = built
+    ref, nat = engines
+    monkeypatch.setenv(planner_mod.PLANNER_ENV, mode)
+    qs = _ranked_queries(members)
+    for k in KS:
+        want = [ref.top_k_scored(ref.encode_batch(q), k) for q in qs]
+        encs = [nat.encode_batch(q) for q in qs]
+        for size in (1, 3, 8, len(qs)):
+            got = []
+            for i in range(0, len(encs), size):
+                got.extend(nat.top_k_scored_batch(encs[i:i + size], k))
+            assert got == want, (mode, k, size)
+
+
+def test_topk_batch_accounting(engines, built, monkeypatch):
+    """A coalesced group advances the planner's ranked counters by one
+    per query (identical totals to the serial path) and lands its ops
+    on the native counter."""
+    _, members = built
+    ref, nat = engines
+    monkeypatch.setenv(planner_mod.PLANNER_ENV, "auto")
+    names = sorted(members)
+    qs = [[n, names[0]] for n in names[:6]]
+    encs = [nat.encode_batch(q) for q in qs]
+    for b in encs:  # warm every memo so the group fuses
+        nat.top_k_scored(b, 5)
+    before = nat.planner.describe()
+    ops0 = nat.describe()["native"]["ops"]
+    nat.top_k_scored_batch(encs, 5)
+    after = nat.planner.describe()
+    assert sum(after["ranked"].values()) \
+        == sum(before["ranked"].values()) + len(qs)
+    assert nat.describe()["native"]["ops"] >= ops0 + len(qs)
+    assert after["last_ranked"]["backend"] == "native"
+    # the numpy backend serves the same API through the serial path
+    want = [ref.top_k_scored(ref.encode_batch(q), 5) for q in qs]
+    assert ref.top_k_scored_batch(
+        [ref.encode_batch(q) for q in qs], 5) == want
+    assert ref.planner.describe()["last_ranked"]["backend"] == "numpy"
+
+
+def test_topk_reports_native_backend(engines, built, monkeypatch):
+    _, members = built
+    ref, nat = engines
+    monkeypatch.setenv(planner_mod.PLANNER_ENV, "auto")
+    name = sorted(members)[0]
+    nat.top_k_scored(nat.encode_batch([name, "spread"]), 5)
+    last = nat.planner.describe()["last_ranked"]
+    assert last["backend"] == "native"
+    ref.top_k_scored(ref.encode_batch([name, "spread"]), 5)
+    assert ref.planner.describe()["last_ranked"]["backend"] == "numpy"
+
+
+def test_native_modes_zero_and_required(built, monkeypatch):
+    """``0`` never builds a handle; ``1`` fails loudly when it can't."""
+    out, members = built
+    monkeypatch.setenv(engine_mod.NATIVE_ENV, "0")
+    with engine_mod.Engine(out) as eng:
+        eng.top_k_scored(eng.encode_batch([sorted(members)[0]]), 3)
+        d = eng.describe()["native"]
+        assert d == {"mode": "0", "active": False, "error": None,
+                     "ops": 0, "fallbacks": 0}
+    monkeypatch.setenv(engine_mod.NATIVE_ENV, "1")
+    monkeypatch.setattr(native, "load", lambda *a, **kw: None)
+    with pytest.raises(RuntimeError, match="MRI_SERVE_NATIVE=1"):
+        engine_mod.Engine(out)
+
+
+# -- daemon: wire parity + knob re-resolution on reload -------------------
+
+
+def test_daemon_wire_parity_native_flipped(built, monkeypatch):
+    """The daemon's ranked/AND answers are byte-identical with
+    ``MRI_SERVE_NATIVE`` flipped both ways."""
+    out, members = built
+    names = sorted(members)
+    got = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv(engine_mod.NATIVE_ENV, flag)
+        with serving(out) as d, Client(d) as c:
+            r = c.rpc(id=1, op="top_k", score="bm25", k=10,
+                      terms=names[:3])
+            assert r["ok"]
+            a = c.rpc(id=2, op="and", terms=["runzero", "spread"])
+            assert a["ok"]
+            s = c.rpc(id=3, op="stats")["stats"]
+            assert s["engine"]["native"]["mode"] == flag
+            if flag == "1":
+                assert s["engine"]["native"]["ops"] > 0
+            # ranked "docs" carries [doc, score] pairs: float64 bits
+            # round-trip exactly through the JSON wire
+            got[flag] = (r["docs"], a["docs"])
+    assert got["1"] == got["0"]
+
+
+def test_daemon_reload_reresolves_backend_knobs(built, monkeypatch):
+    """Satellite regression: knobs resolved at engine construction
+    (``MRI_SERVE_NATIVE``) are NOT live-read — they must re-resolve
+    when a SIGHUP reload swaps the engine, not before."""
+    out, members = built
+    name = sorted(members)[0]
+    monkeypatch.setenv(engine_mod.NATIVE_ENV, "0")
+    monkeypatch.setenv(planner_mod.PLANNER_ENV, "maxscore")
+    with serving(out) as d, Client(d) as c:
+        r = c.rpc(id=1, op="top_k", score="bm25", k=3,
+                  terms=[name, "spread"])
+        assert r["ok"]
+        s = c.rpc(id=2, op="stats")["stats"]["engine"]
+        assert s["native"]["mode"] == "0"
+        assert s["planner"]["last_ranked"]["backend"] == "numpy"
+        assert s["planner"]["last_ranked"]["mode"] == "maxscore"
+        # flip the env: the serving engine must keep its memoized
+        # resolution until the reload swap installs a fresh engine
+        monkeypatch.setenv(engine_mod.NATIVE_ENV, "1")
+        s = c.rpc(id=3, op="stats")["stats"]["engine"]
+        assert s["native"]["mode"] == "0"
+        ok, err = d.reload()
+        assert ok, err
+        r = c.rpc(id=4, op="top_k", score="bm25", k=3,
+                  terms=[name, "spread"])
+        assert r["ok"]
+        s = c.rpc(id=5, op="stats")["stats"]["engine"]
+        assert s["native"]["mode"] == "1"
+        assert s["planner"]["last_ranked"]["backend"] == "native"
+
+
+# -- CLI: --stats audits the answering backend ----------------------------
+
+
+def test_cli_query_stats_reports_backend(built, monkeypatch, capsys):
+    out, members = built
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (  # noqa: E501
+        main as cli_main,
+    )
+    name = sorted(members)[0]
+    monkeypatch.setenv(engine_mod.NATIVE_ENV, "1")
+    assert cli_main(["query", str(out), name, "spread", "--score",
+                     "bm25", "--top-k", "3", "--stats"]) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["native"]["mode"] == "1" and stats["native"]["ops"] > 0
+    assert stats["planner"]["last_ranked"]["backend"] == "native"
+    monkeypatch.setenv(engine_mod.NATIVE_ENV, "0")
+    assert cli_main(["query", str(out), name, "spread", "--score",
+                     "bm25", "--top-k", "3", "--stats"]) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["native"]["mode"] == "0" and stats["native"]["ops"] == 0
+    assert stats["planner"]["last_ranked"]["backend"] == "numpy"
+
+
+def test_cli_query_bad_native_knob_exits_2(built, monkeypatch, capsys):
+    """A bad ``$MRI_SERVE_NATIVE`` hits the CLI's one-line exit-2
+    contract even though the knob is read at engine construction,
+    not lazily at query time like the planner knob."""
+    out, members = built
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (  # noqa: E501
+        main as cli_main,
+    )
+    monkeypatch.setenv(engine_mod.NATIVE_ENV, "2")
+    assert cli_main(["query", str(out), sorted(members)[0], "--score",
+                     "bm25", "--top-k", "3"]) == 2
+    err = capsys.readouterr().err.strip()
+    assert err.startswith("error:") and "MRI_SERVE_NATIVE" in err \
+        and "\n" not in err

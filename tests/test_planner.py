@@ -276,8 +276,11 @@ def test_plan_and_threshold():
     p = Planner(Registry())
     assert p.plan_and(100, 200) == "merge"    # df <= 2 * n_acc
     assert p.plan_and(100, 201) == "gallop"
+    # native takes the gallop arm's territory, never the merge arm's
+    assert p.plan_and(100, 200, native=True) == "merge"
+    assert p.plan_and(100, 201, native=True) == "native"
     d = p.describe()
-    assert d["and"] == {"merge": 1, "gallop": 1}
+    assert d["and"] == {"merge": 2, "gallop": 1, "native": 1}
 
 
 def test_note_ranked_counters_and_last(monkeypatch):
@@ -290,8 +293,9 @@ def test_note_ranked_counters_and_last(monkeypatch):
     d = p.describe()
     assert d["ranked"]["bmw"] == 1 and d["ranked"]["exhaustive"] == 1
     assert d["blocks_scored"] == 7 and d["blocks_skipped"] == 3
-    assert d["last_ranked"] == {"mode": "exhaustive", "blocks_scored": 0,
-                                "blocks_skipped": 0, "candidates": 40}
+    assert d["last_ranked"] == {"mode": "exhaustive", "backend": "numpy",
+                                "blocks_scored": 0, "blocks_skipped": 0,
+                                "candidates": 40}
 
 
 def test_bm25_corpus_memoized_per_engine(tmp_path, monkeypatch):

@@ -56,6 +56,10 @@ _BASIS = {
     "BENCH_SEGMENTS_r12.json": lambda d, ln: (
         "value IS the ratio: 16-segment AND qps vs the same run's "
         "single-artifact engine"),
+    "BENCH_NATIVE_r16.json": lambda d, ln: (
+        "{}x r11 ranked qps at submission group 32; {}x the same-run "
+        "host engine at that group".format(
+            d["speedup_vs_r11"], d["batches"]["32"]["speedup"])),
     "BENCH_BUILD_OOC_r15.json": lambda d, ln: (
         "value IS the ratio: spill-tier wall vs the same run's "
         "in-memory build on a {}x-budget corpus (zero-spill {}x)"
