@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-slo bench-history capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-wal test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -52,9 +52,17 @@ test-spill:
 	$(PY) -m pytest tests/ -q -m spill
 
 # chaos suite: the fast matrix cycle runs in tier-1 (`chaos and not
-# slow`); this target adds the full 50+-trial seeded soak
+# slow`); this target adds the full 50+-trial seeded soaks (build
+# matrix, daemon scenarios, segments schedules, and the --wal
+# durability/replication sweep)
 test-chaos:
 	$(PY) -m pytest tests/ -q -m chaos
+
+# durability suite: WAL container integrity, torn-tail quarantine,
+# crash replay (incl. SIGKILL during a buffered tombstone batch),
+# lease semantics, segment-shipping replica catch-up + rollback refusal
+test-wal:
+	$(PY) -m pytest tests/ -q -m wal
 
 # query-serving suite: index.mri format + Engine parity vs a naive text
 # scan, artifact corruption rejection, LRU cache semantics
@@ -190,6 +198,12 @@ bench-scrape:
 # gated), and compaction cost -> BENCH_SEGMENTS_r12.json
 bench-segments:
 	$(PY) tools/bench_serve.py --segments-ab
+
+# durability A/B: the same live-daemon mutation schedule with the WAL
+# off vs on (ack p99 gated at 2x, byte-parity between the legs), plus
+# cold replica catch-up rate by segment shipping -> BENCH_WAL_r17.json
+bench-wal:
+	$(PY) tools/bench_serve.py --wal-ab
 
 # operational-health overhead gate: rolling-windows sampler tick + a
 # 1 Hz `slo` poll priced in-run (<1% of a serving second), with `mri

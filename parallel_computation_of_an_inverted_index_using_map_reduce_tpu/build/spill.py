@@ -29,13 +29,13 @@ import json
 import logging
 import os
 import signal
-import zlib
 from pathlib import Path
 
 import numpy as np
 
 from .. import faults
 from ..utils import envknobs
+from ..utils.checksum import adler32_hex
 
 log = logging.getLogger("mri.build.spill")
 
@@ -113,7 +113,7 @@ def write_file(path, meta: dict, sections: dict[str, np.ndarray]) -> int:
             "nbytes": len(raw),
             "dtype": arr.dtype.str,
             "shape": list(arr.shape),
-            "adler32": f"{zlib.adler32(raw) & 0xFFFFFFFF:08x}",
+            "adler32": adler32_hex(raw),
         }
         payloads.append(raw)
     # section offsets depend on the header's own encoded length, which
@@ -241,7 +241,7 @@ def verify_file(path) -> None:
             raw = sf._fh.read(info["nbytes"])
             if len(raw) != info["nbytes"]:
                 raise SpillError(f"truncated section {name!r} in {path}")
-            got = f"{zlib.adler32(raw) & 0xFFFFFFFF:08x}"
+            got = adler32_hex(raw)
             if got != info["adler32"]:
                 raise SpillError(
                     f"checksum mismatch in section {name!r} of {path}: "

@@ -67,6 +67,17 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
                                  segment is built, before the swap
   tombstone-corrupt              segments: staged tombstone bitmap
                                  corrupted; the write is rejected
+  wal-torn-record                segments: the WAL append tears before
+                                 its fsync — the mutation is rejected
+                                 un-acked; recover quarantines the
+                                 torn tail bytes
+  fetch-partial                  replication: one shipped segment file
+                                 is truncated in flight — the replica's
+                                 adler32 check rejects it and refetches
+  lease-steal                    replication: a foreign owner grabs the
+                                 mutation lease — the next mutation is
+                                 rejected 'lease_lost' until the TTL
+                                 expires
   chaos:seed=5:n=3               sample 3 faults deterministically
                                  (bounds: windows= workers= reducers=
                                  docs= reqs= kinds=a,b,c)
@@ -96,6 +107,26 @@ incremental indexing (live index; see README "Incremental indexing"):
                                  longer referenced by the manifest
                                  (only safe with no live readers on
                                  older generations)
+
+durability & replication (see README "Durability & replication"):
+  mri-tpu recover DIR            replay the mutation WAL after a crash:
+                                 acknowledged-but-unpublished records
+                                 are applied, torn tail records land in
+                                 segments.wal.corrupt, mutation scratch
+                                 is swept; idempotent (a primary daemon
+                                 runs this on every start)
+  mri-tpu replicate DIR --from HOST:PORT
+                                 one catch-up round against a primary
+                                 daemon: snapshot diff, adler32-verified
+                                 segment fetches, WAL tail adoption —
+                                 never re-indexes
+  mri-tpu serve DIR --replica-of HOST:PORT
+                                 run a read-only replica: catches up
+                                 every MRI_REPLICA_POLL_MS ms, rejects
+                                 mutations, healthz says
+                                 'replica_lagging' until the first
+                                 round lands; promote by stopping it
+                                 and running 'mri-tpu recover DIR'
 
 query mode (the serving read path; needs an --artifact build):
   mri-tpu query DIR word...          df + postings per word (JSON lines)
@@ -457,6 +488,12 @@ def _serve_main(argv: list[str]) -> int:
                    help="also serve Prometheus text metrics over plain "
                         "HTTP on 127.0.0.1:PORT (0 = ephemeral; the "
                         "chosen port is printed in the 'listening' line)")
+    p.add_argument("--replica-of", default=None, metavar="HOST:PORT",
+                   help="run as a read-only replica of the primary "
+                        "daemon at HOST:PORT: catch up by segment "
+                        "shipping every MRI_REPLICA_POLL_MS, reject "
+                        "mutations, report replica_lagging in healthz "
+                        "until the first round succeeds")
     args = p.parse_args(argv)
 
     # the daemon is the one long-lived process: route every mri_tpu.*
@@ -491,13 +528,25 @@ def _serve_main(argv: list[str]) -> int:
               f"{args.listen_metrics}", file=sys.stderr)
         return 2
 
+    from . import segments
+    if args.replica_of is not None:
+        try:
+            segments.replica.parse_addr(args.replica_of)
+        except segments.SegmentError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
     try:
+        # construction runs startup WAL recovery (primaries) before the
+        # first engine open — a torn directory rejects here, exit 2
         daemon = ServeDaemon(args.index_dir, host, port,
                              engine=args.engine,
                              cache_terms=args.cache_terms,
                              shards=args.shards,
-                             metrics_port=args.listen_metrics)
-    except (ArtifactError, ValueError, OSError) as e:
+                             metrics_port=args.listen_metrics,
+                             replica_of=args.replica_of)
+    except (ArtifactError, segments.SegmentError, ValueError,
+            OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     try:
@@ -921,6 +970,78 @@ def _segments_main(cmd: str, argv: list[str]) -> int:
     return 0
 
 
+def _recover_main(argv: list[str]) -> int:
+    """``mri-tpu recover DIR`` — roll a live index directory forward to
+    the last acknowledged mutation (segments/wal.py): replay WAL
+    records above the manifest's generation, quarantine torn tail
+    records, sweep mutation scratch.  Idempotent; also runs implicitly
+    when a primary daemon starts."""
+    p = argparse.ArgumentParser(
+        prog="mri-tpu recover",
+        description="replay the mutation WAL after a crash: apply "
+                    "acknowledged-but-unpublished records, quarantine "
+                    "torn tail records, remove mutation scratch")
+    p.add_argument("index_dir", help="a live (segment-managed) index "
+                                     "directory")
+    p.add_argument("--fault-spec", default=None,
+                   help="inject faults (see mri-tpu --help for grammar)")
+    args = p.parse_args(argv)
+    if args.fault_spec is not None:
+        try:
+            faults.install(args.fault_spec)
+        except faults.FaultSpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    from . import segments
+    try:
+        report = segments.recover(args.index_dir)
+    except segments.SegmentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+def _replicate_main(argv: list[str]) -> int:
+    """``mri-tpu replicate DIR --from HOST:PORT`` — one catch-up round
+    against a primary daemon (segments/replica.py): fetch the segment
+    files this directory is missing (adler32-verified, staged, then
+    atomically adopted) plus the primary's WAL tail.  Never re-indexes.
+    Run it in a loop — or use ``mri-tpu serve --replica-of`` — for a
+    live replica."""
+    p = argparse.ArgumentParser(
+        prog="mri-tpu replicate",
+        description="catch a local index directory up to a primary "
+                    "daemon by segment shipping (snapshot diff + "
+                    "verified fetch + WAL tail adoption)")
+    p.add_argument("index_dir", help="the replica's index directory "
+                                     "(created if empty)")
+    p.add_argument("--from", dest="source", required=True,
+                   metavar="HOST:PORT",
+                   help="the primary daemon's --listen address")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="per-RPC socket timeout in seconds")
+    p.add_argument("--fault-spec", default=None,
+                   help="inject faults (see mri-tpu --help for grammar)")
+    args = p.parse_args(argv)
+    if args.fault_spec is not None:
+        try:
+            faults.install(args.fault_spec)
+        except faults.FaultSpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    from . import segments
+    try:
+        addr = segments.replica.parse_addr(args.source)
+        res = segments.replicate(args.index_dir, addr,
+                                 timeout=args.timeout)
+    except (segments.SegmentError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(res, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     # --verify DIR / query DIR / serve DIR / metrics TARGET are
     # standalone modes (no reference positionals): pre-parse them so
@@ -939,6 +1060,10 @@ def main(argv: list[str] | None = None) -> int:
         return _top_main(argv[1:])
     if argv and argv[0] in ("append", "delete", "compact"):
         return _segments_main(argv[0], argv[1:])
+    if argv and argv[0] == "recover":
+        return _recover_main(argv[1:])
+    if argv and argv[0] == "replicate":
+        return _replicate_main(argv[1:])
     if "--verify" in argv:
         i = argv.index("--verify")
         if i + 1 >= len(argv):

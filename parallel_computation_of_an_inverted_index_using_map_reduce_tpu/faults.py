@@ -45,6 +45,15 @@ Three layers live here:
                                      segment is built, before publish
         tombstone-corrupt            segments: staged tombstone bitmap
                                      corrupted (write rejected)
+        wal-torn-record              segments: WAL record torn before
+                                     its fsync (mutation fails un-acked;
+                                     recovery quarantines the tail)
+        fetch-partial                replica: one fetch_segment payload
+                                     truncated on the primary (the
+                                     replica's checksum rejects + retries)
+        lease-steal                  replica: the primary's lease is
+                                     rewritten to a foreign owner once
+                                     (next mutation rejects lease_lost)
         chaos:seed=5:n=3             sample 3 faults from a seeded RNG
         seed=7                       RNG seed for ``p=`` rules
 
@@ -166,6 +175,16 @@ class InjectedCompactCrash(RuntimeError):
     directory no manifest references — what a real crash leaves."""
 
 
+class InjectedWalTorn(RuntimeError):
+    """Injected WAL append tear (``wal-torn-record`` rule): the record
+    bytes were truncated mid-payload and the fsync never ran, so the
+    mutation fails *un-acked* — exactly the torn tail
+    ``segments.wal.read_records`` must quarantine on the next read.
+    ``segments.wal`` maps it to a ``WalError`` so callers see the
+    usual SegmentError surface.  (Plain RuntimeError — faults.py sits
+    below segments/ in the import graph.)"""
+
+
 # -- injector ---------------------------------------------------------
 
 _READ_KINDS = ("read-error", "slow-read", "truncate")
@@ -176,6 +195,7 @@ _SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
                 "handler-crash", "dispatcher-hang")
 _SEGMENT_KINDS = ("append-torn-manifest", "compact-crash",
                   "tombstone-corrupt")
+_WAL_KINDS = ("wal-torn-record", "fetch-partial", "lease-steal")
 
 #: What ``chaos:`` may sample by default — every kind the parallel host
 #: path recovers from in-run (sigkill is excluded: its story is the
@@ -202,6 +222,11 @@ SEGMENT_CHAOS_KINDS = _SEGMENT_KINDS
 #: merger).  Named-only: they can only fire when
 #: ``MRI_BUILD_SPILL_BYTES`` routes the build through the spill tier.
 SPILL_CHAOS_KINDS = ("spill-corrupt", "merge-crash")
+
+#: What ``chaos:kinds=...`` may name for durability/replication soaks
+#: — the WAL tear, the partial segment ship, and the lease steal.
+#: Named-only like the other serve-side families.
+WAL_CHAOS_KINDS = _WAL_KINDS
 
 
 @dataclasses.dataclass
@@ -257,7 +282,7 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         return None
     rule = _Rule(kind=head)
     if head not in (_READ_KINDS + _DEATH_KINDS + _SERVE_KINDS
-                    + _SEGMENT_KINDS):
+                    + _SEGMENT_KINDS + _WAL_KINDS):
         raise FaultSpecError(f"unknown fault kind {head!r}")
     for field in parts[1:]:
         if field == "all":
@@ -317,12 +342,13 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             kinds = tuple(s for s in v.split(",") if s)
             bad = [s for s in kinds
                    if s not in (CHAOS_KINDS + SERVE_CHAOS_KINDS
-                                + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS)]
+                                + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS
+                                + WAL_CHAOS_KINDS)]
             if bad:
                 raise FaultSpecError(
                     f"chaos: kinds not samplable: {bad} "
                     f"(choose from "
-                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS)})")
+                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS + WAL_CHAOS_KINDS)})")
             rule.kinds = kinds
         else:
             raise FaultSpecError(f"{head}: unknown key {k!r}")
@@ -400,9 +426,9 @@ def _sample_chaos(rule: _Rule) -> list[_Rule]:
             # any-shard: fires on whichever merger reaches it first,
             # so the takeover is guaranteed to be exercised
             out.append(_Rule(kind=kind))
-        elif kind in _SEGMENT_KINDS:
+        elif kind in _SEGMENT_KINDS + _WAL_KINDS:
             # no ordinal to pick: each fires once, on the next matching
-            # segment mutation (times=1 default)
+            # segment mutation / fetch / lease check (times=1 default)
             out.append(_Rule(kind=kind))
         else:  # reload-corrupt
             out.append(_Rule(kind="reload-corrupt"))
@@ -744,6 +770,60 @@ class FaultInjector:
                     raise InjectedCompactCrash(
                         "injected compaction crash before publish "
                         "(fault spec)")
+
+    def on_wal_append(self, path: str) -> None:
+        """Fires in ``segments.wal.log_mutation`` after the record
+        bytes are written, BEFORE the fsync.  The ``wal-torn-record``
+        rule truncates the just-appended record mid-payload and raises
+        :class:`InjectedWalTorn`: the mutation fails un-acked, and the
+        next WAL read quarantines the torn tail — proving "acked means
+        durable" covers the append syscall window itself."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "wal-torn-record":
+                    continue
+                if self._fire_once(ri, rule):
+                    size = os.path.getsize(path)
+                    with open(path, "r+b") as f:
+                        f.truncate(max(size - 7, 1))
+                    log.warning("fault injection: tore wal record in "
+                                "%s before fsync", path)
+                    raise InjectedWalTorn(
+                        "injected wal record tear before fsync "
+                        "(fault spec)")
+
+    def on_fetch_payload(self, name: str, data: bytes) -> bytes:
+        """Fires on the PRIMARY as a ``fetch_segment`` admin payload is
+        about to ship; the ``fetch-partial`` rule truncates it to half.
+        The replica's per-file adler32 verification must reject the
+        short payload and retry — a partial ship may never be swapped
+        into a manifest."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "fetch-partial":
+                    continue
+                if self._fire_once(ri, rule):
+                    log.warning("fault injection: truncating fetch "
+                                "payload for %s (%d -> %d bytes)",
+                                name, len(data), max(len(data) // 2, 1))
+                    return data[:max(len(data) // 2, 1)]
+        return data
+
+    def on_lease_check(self) -> bool:
+        """Fires as a lease holder validates/renews its lease before a
+        mutation.  The ``lease-steal`` rule returns True ONCE: the
+        caller rewrites the lease to a foreign owner before its normal
+        check runs, which must then reject the mutation with
+        ``lease_lost`` while the old generation keeps serving reads."""
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "lease-steal":
+                    continue
+                if self._fire_once(ri, rule):
+                    log.warning("fault injection: stealing the "
+                                "mutation lease")
+                    return True
+        return False
 
     def on_reload(self) -> None:
         """Fires in the serve daemon's hot-reload path after the

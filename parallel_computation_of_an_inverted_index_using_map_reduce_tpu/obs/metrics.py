@@ -315,6 +315,19 @@ KNOWN_METRICS = (
      "Live mutations (append/delete/compact) applied by the daemon."),
     ("mri_serve_mutation_rejected_total", "counter",
      "Live mutations rejected; the old generation kept serving."),
+    # durability & replication (WAL + segment shipping; daemon registry)
+    ("mri_wal_records_total", "counter",
+     "Mutation WAL records fsync'd (the durability point every "
+     "acknowledgement waits on)."),
+    ("mri_wal_replayed_total", "counter",
+     "WAL records applied by crash recovery (acknowledged mutations "
+     "rolled forward after a crash)."),
+    ("mri_replica_lag_generations", "gauge",
+     "Manifest generations a replica was behind its primary at the "
+     "last successful catch-up round (0 = caught up)."),
+    ("mri_serve_stale_generation_total", "counter",
+     "Requests refused because the client's min_generation token is "
+     "ahead of the serving generation (read-your-writes fence)."),
     # operational health (rolling SLIs, SLOs, watchdog; daemon registry)
     ("mri_slo_<slo>_ratio_<window>", "gauge",
      "Rolling good-event ratio of one SLO (availability, latency) "

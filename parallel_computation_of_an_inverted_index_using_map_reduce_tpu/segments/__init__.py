@@ -16,14 +16,20 @@ from .manifest import (LOCK_NAME, MANIFEST_NAME, SEGMENTS_DIR,
                        is_segmented, load_manifest, manifest_path,
                        mutation_lock, save_manifest, segment_dir,
                        segments_root)
+from .replica import (LeaseError, ReplicaError, read_lease,
+                      release_lease, renew_lease, replicate)
 from .tombstones import empty_bitmap, tombstone_name
+from .wal import WAL_NAME, WalError, recover, replay, wal_path
 from .writer import append_files, delete_docs
 
 __all__ = [
-    "LOCK_NAME", "MANIFEST_NAME", "SEGMENTS_DIR",
-    "SegmentEntry", "SegmentError", "SegmentManifest",
+    "LOCK_NAME", "MANIFEST_NAME", "SEGMENTS_DIR", "WAL_NAME",
+    "LeaseError", "ReplicaError", "SegmentEntry", "SegmentError",
+    "SegmentManifest", "WalError",
     "append_files", "compact", "compact_to_limit", "delete_docs",
     "empty_bitmap", "is_segmented", "load_manifest", "manifest_path",
-    "mutation_lock", "prune_retired", "save_manifest", "segment_dir",
-    "segments_root", "should_compact", "tombstone_name",
+    "mutation_lock", "prune_retired", "read_lease", "recover",
+    "release_lease", "renew_lease", "replay", "replicate",
+    "save_manifest", "segment_dir", "segments_root", "should_compact",
+    "tombstone_name", "wal_path",
 ]

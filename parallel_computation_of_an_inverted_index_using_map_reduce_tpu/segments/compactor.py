@@ -37,9 +37,10 @@ import time
 import numpy as np
 
 from . import tombstones as tomb_mod
-from .manifest import (SegmentEntry, SegmentManifest, load_manifest,
-                       mutation_lock, save_manifest, segment_dir,
-                       segments_root)
+from . import wal as wal_mod
+from .manifest import (SegmentEntry, SegmentError, SegmentManifest,
+                       load_manifest, mutation_lock, save_manifest,
+                       segment_dir, segments_root)
 from .. import faults
 from ..obs import metrics as obs_metrics
 from ..serve import artifact as artifact_mod
@@ -146,12 +147,14 @@ def _merge_segments(root, picked: list[SegmentEntry], *, name: str
     return crc, size, span, dropped
 
 
-def compact(root, *, force: bool = False, registry=None) -> dict:
+def compact(root, *, force: bool = False, registry=None,
+            wal_seq=None) -> dict:
     """One compaction round; publishes the next generation.
 
     Below the ``MRI_SEGMENT_COMPACT_TRIGGER`` segment count this is a
     counted no-op unless ``force`` — background callers can invoke it
-    unconditionally and let the trigger decide.
+    unconditionally and let the trigger decide.  ``wal_seq`` marks the
+    recovery re-application of an already logged record.
     """
     t0 = time.perf_counter()
     with mutation_lock(root):
@@ -167,25 +170,43 @@ def compact(root, *, force: bool = False, registry=None) -> dict:
                               f"({envknobs.get(TRIGGER_ENV)} segments)",
                     "generation": man.generation,
                     "segments": len(man.entries)}
+        seq = wal_seq
+        if seq is None and wal_mod.wal_enabled():
+            # logged before the merge: a SIGKILL anywhere inside the
+            # merge window replays the whole round on recovery
+            seq = wal_mod.log_mutation(root, "compact",
+                                       {"force": bool(force)},
+                                       base_seq=man.wal_seq,
+                                       registry=registry)
         start, stop = _pick_run(man.entries)
         picked = list(man.entries[start:stop])
         gen = man.generation + 1
         name = f"seg_{gen}_{man.next_seg}"
-        crc, size, span, dropped = _merge_segments(
-            root, picked, name=name)
-        inj = faults.active()
-        if inj is not None:
-            # the injected mid-compaction crash: replacement built but
-            # never published — old generation keeps serving, the
-            # orphan directory is exactly what a real crash leaves
-            inj.on_compact()
-        merged = SegmentEntry(name=name, doc_base=picked[0].doc_base,
-                              docs=span, adler32=crc, bytes=size)
-        new = SegmentManifest(
-            generation=gen, next_seg=man.next_seg + 1,
-            entries=man.entries[:start] + (merged,)
-            + man.entries[stop:])
-        save_manifest(root, new, op="compact")
+        try:
+            crc, size, span, dropped = _merge_segments(
+                root, picked, name=name)
+            inj = faults.active()
+            if inj is not None:
+                # the injected mid-compaction crash: replacement built
+                # but never published — old generation keeps serving,
+                # the orphan directory is exactly what a real crash
+                # leaves
+                inj.on_compact()
+            merged = SegmentEntry(name=name, doc_base=picked[0].doc_base,
+                                  docs=span, adler32=crc, bytes=size)
+            new = SegmentManifest(
+                generation=gen, next_seg=man.next_seg + 1,
+                entries=man.entries[:start] + (merged,)
+                + man.entries[stop:],
+                wal_seq=man.wal_seq if seq is None else seq)
+            save_manifest(root, new, op="compact")
+        except (SegmentError, faults.InjectedCompactCrash):
+            # rejected to the caller: replay must not redo this round
+            if seq is not None and wal_seq is None:
+                wal_mod.discard(root, seq)
+            raise
+        if seq is not None:
+            wal_mod.truncate_published(root)
     dt = time.perf_counter() - t0
     reg = registry if registry is not None \
         else obs_metrics.default_registry()

@@ -40,10 +40,10 @@ import contextlib
 import dataclasses
 import json
 import os
-import zlib
 from pathlib import Path
 
 from .. import faults
+from ..utils.checksum import adler32_hex
 
 MANIFEST_NAME = "segments.manifest.json"
 SEGMENTS_DIR = "segments"
@@ -103,6 +103,7 @@ class SegmentManifest:
     generation: int
     next_seg: int             # monotonic segment ordinal allocator
     entries: tuple[SegmentEntry, ...]
+    wal_seq: int = 0          # highest WAL record seq this set covers
 
     @property
     def doc_span(self) -> int:
@@ -117,14 +118,14 @@ class SegmentManifest:
 
     def to_json(self) -> dict:
         return {"magic": MAGIC, "generation": self.generation,
-                "next_seg": self.next_seg,
+                "next_seg": self.next_seg, "wal_seq": self.wal_seq,
                 "entries": [e.to_json() for e in self.entries]}
 
 
 def _body_checksum(body: dict) -> str:
     blob = json.dumps(body, sort_keys=True,
                       separators=(",", ":")).encode("utf-8")
-    return f"{zlib.adler32(blob):08x}"
+    return adler32_hex(blob)
 
 
 def manifest_path(root) -> Path:
@@ -189,7 +190,9 @@ def load_manifest(root) -> SegmentManifest | None:
             generation=int(doc["generation"]),
             next_seg=int(doc["next_seg"]),
             entries=tuple(SegmentEntry.from_json(e)
-                          for e in doc["entries"]))
+                          for e in doc["entries"]),
+            # pre-WAL manifests carry no wal_seq: they cover seq 0
+            wal_seq=int(doc.get("wal_seq", 0)))
     except (KeyError, TypeError, ValueError) as e:
         raise SegmentError(f"{path}: malformed manifest: {e}") from e
     bases = [(e.doc_base, e.doc_base + e.docs) for e in man.entries]

@@ -31,6 +31,7 @@ import numpy as np
 
 from .manifest import SegmentError
 from .. import faults
+from ..utils.checksum import adler32_hex
 
 TOMB_MAGIC = b"MRITOMB1"
 
@@ -113,4 +114,4 @@ def save(path, bits: np.ndarray) -> tuple[str, int]:
         os.unlink(tmp)
         raise
     os.replace(tmp, path)
-    return f"{zlib.adler32(staged):08x}", len(staged)
+    return adler32_hex(staged), len(staged)

@@ -21,6 +21,7 @@ import shutil
 import numpy as np
 
 from . import tombstones as tomb_mod
+from . import wal as wal_mod
 from .manifest import (SegmentEntry, SegmentError, SegmentManifest,
                        load_manifest, manifest_path, mutation_lock,
                        save_manifest, segment_dir, segments_root)
@@ -93,10 +94,15 @@ def _build_segment_artifact(root, files: list[str], *, name: str) -> tuple:
     return crc, size, len(paths)
 
 
-def append_files(root, files, *, registry=None) -> dict:
+def append_files(root, files, *, registry=None, wal_seq=None) -> dict:
     """Append a batch of corpus files as one new immutable segment and
     publish the next manifest generation.  Global doc ids continue
-    densely from the current span; returns the assignment."""
+    densely from the current span; returns the assignment.
+
+    ``wal_seq`` is the recovery path only: replay re-runs an already
+    logged record, so no new record is written and the manifest is
+    stamped with the replayed seq.
+    """
     files = [str(f) for f in files]
     if not files:
         raise SegmentError("append needs at least one file")
@@ -105,6 +111,14 @@ def append_files(root, files, *, registry=None) -> dict:
         raise SegmentError(f"append: no such file(s): {missing}")
     with mutation_lock(root):
         man = _load_or_seed(root)
+        seq = wal_seq
+        if seq is None and wal_mod.wal_enabled():
+            # the durability point: the record is fsync'd before any
+            # segment bytes exist, so a crash anywhere past here
+            # replays instead of losing the acked mutation
+            seq = wal_mod.log_mutation(root, "append", {"files": files},
+                                       base_seq=man.wal_seq,
+                                       registry=registry)
         gen = man.generation + 1
         name = f"seg_{gen}_{man.next_seg}"
         doc_base = man.doc_span
@@ -112,14 +126,21 @@ def append_files(root, files, *, registry=None) -> dict:
         entry = SegmentEntry(name=name, doc_base=doc_base, docs=docs,
                              adler32=crc, bytes=size)
         new = SegmentManifest(generation=gen, next_seg=man.next_seg + 1,
-                              entries=man.entries + (entry,))
+                              entries=man.entries + (entry,),
+                              wal_seq=man.wal_seq if seq is None else seq)
         try:
             save_manifest(root, new, op="append")
         except SegmentError:
             # injected/real publish failure: retire the orphan segment
-            # so --verify of the surviving generation stays clean
+            # so --verify of the surviving generation stays clean, and
+            # drop the WAL record — this mutation is REJECTED to the
+            # caller, so replay must never resurrect it
             shutil.rmtree(segment_dir(root, name), ignore_errors=True)
+            if seq is not None and wal_seq is None:
+                wal_mod.discard(root, seq)
             raise
+        if seq is not None:
+            wal_mod.truncate_published(root)
     reg = registry if registry is not None \
         else obs_metrics.default_registry()
     reg.gauge("mri_generation").set(new.generation)
@@ -139,12 +160,14 @@ def _entry_for(man: SegmentManifest, gid: int) -> SegmentEntry:
         f"(live span is 1..{man.doc_span})")
 
 
-def delete_docs(root, doc_ids, *, registry=None) -> dict:
+def delete_docs(root, doc_ids, *, registry=None, wal_seq=None) -> dict:
     """Tombstone global doc ids and publish the next generation.
 
     Idempotent per id (re-deleting is a no-op bit set); an id outside
     every segment's range is an error.  The artifact files are never
     touched — only new generation-tagged bitmap sidecars appear.
+    ``wal_seq`` marks the recovery re-application of an already logged
+    record (no new record, manifest stamped with the replayed seq).
     """
     ids = sorted({int(d) for d in doc_ids})
     if not ids:
@@ -154,37 +177,53 @@ def delete_docs(root, doc_ids, *, registry=None) -> dict:
         if not man.entries:
             raise SegmentError(
                 f"{manifest_path(root)}: nothing indexed yet")
+        seq = wal_seq
+        if seq is None and wal_mod.wal_enabled():
+            seq = wal_mod.log_mutation(root, "delete", {"docs": ids},
+                                       base_seq=man.wal_seq,
+                                       registry=registry)
         gen = man.generation + 1
-        per: dict[str, list[int]] = {}
-        by_name = {e.name: e for e in man.entries}
-        for gid in ids:
-            e = _entry_for(man, gid)
-            per.setdefault(e.name, []).append(gid - e.doc_base)
-        entries = []
-        newly = 0
-        for e in man.entries:
-            locals_ = per.get(e.name)
-            if not locals_:
-                entries.append(e)
-                continue
-            seg = segment_dir(root, e.name)
-            if e.tombstones is not None:
-                bits = tomb_mod.load(seg / e.tombstones, ndocs=e.docs)
-            else:
-                bits = tomb_mod.empty_bitmap(e.docs)
-            before = int(bits.sum())
-            bits[np.asarray(locals_, dtype=np.int64) - 1] = True
-            count = int(bits.sum())
-            newly += count - before
-            tname = tomb_mod.tombstone_name(gen)
-            crc, size = tomb_mod.save(seg / tname, bits)
-            entries.append(SegmentEntry(
-                name=e.name, doc_base=e.doc_base, docs=e.docs,
-                adler32=e.adler32, bytes=e.bytes, tombstones=tname,
-                tomb_adler32=crc, tomb_bytes=size, tomb_count=count))
-        new = SegmentManifest(generation=gen, next_seg=man.next_seg,
-                              entries=tuple(entries))
-        save_manifest(root, new, op="delete")
+        try:
+            per: dict[str, list[int]] = {}
+            by_name = {e.name: e for e in man.entries}
+            for gid in ids:
+                e = _entry_for(man, gid)
+                per.setdefault(e.name, []).append(gid - e.doc_base)
+            entries = []
+            newly = 0
+            for e in man.entries:
+                locals_ = per.get(e.name)
+                if not locals_:
+                    entries.append(e)
+                    continue
+                seg = segment_dir(root, e.name)
+                if e.tombstones is not None:
+                    bits = tomb_mod.load(seg / e.tombstones, ndocs=e.docs)
+                else:
+                    bits = tomb_mod.empty_bitmap(e.docs)
+                before = int(bits.sum())
+                bits[np.asarray(locals_, dtype=np.int64) - 1] = True
+                count = int(bits.sum())
+                newly += count - before
+                tname = tomb_mod.tombstone_name(gen)
+                crc, size = tomb_mod.save(seg / tname, bits)
+                entries.append(SegmentEntry(
+                    name=e.name, doc_base=e.doc_base, docs=e.docs,
+                    adler32=e.adler32, bytes=e.bytes, tombstones=tname,
+                    tomb_adler32=crc, tomb_bytes=size, tomb_count=count))
+            new = SegmentManifest(generation=gen, next_seg=man.next_seg,
+                                  entries=tuple(entries),
+                                  wal_seq=man.wal_seq if seq is None
+                                  else seq)
+            save_manifest(root, new, op="delete")
+        except SegmentError:
+            # rejected to the caller (bad id, torn bitmap, torn
+            # publish): replay must never resurrect this record
+            if seq is not None and wal_seq is None:
+                wal_mod.discard(root, seq)
+            raise
+        if seq is not None:
+            wal_mod.truncate_published(root)
     total = sum(e.tomb_count for e in new.entries)
     reg = registry if registry is not None \
         else obs_metrics.default_registry()

@@ -106,6 +106,7 @@ from pathlib import Path
 import numpy as np
 
 from ..utils import envknobs
+from ..utils.checksum import file_checksum
 
 #: Written next to a.txt..z.txt by ``--artifact`` runs.
 ARTIFACT_NAME = "index.mri"
@@ -972,9 +973,9 @@ def bm25_corpus(art: Artifact) -> tuple[np.ndarray, int, float]:
 
 def checksum(path: str | Path) -> tuple[str, int]:
     """``(adler32_hex, size)`` of the artifact file — the audit
-    manifest's fingerprint, same scheme as the letter files."""
-    data = Path(path).read_bytes()
-    return f"{zlib.adler32(data):08x}", len(data)
+    manifest's fingerprint, same scheme as the letter files.  Shim
+    over :func:`..utils.checksum.file_checksum`."""
+    return file_checksum(path)
 
 
 # -- builders: lex arrays from each engine family's native shapes --------

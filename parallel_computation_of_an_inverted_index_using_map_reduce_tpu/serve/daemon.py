@@ -61,6 +61,12 @@ Protocol — one JSON object per line, one response line per request::
     {"id": 14, "op": "flightdump"}  # admin: flight-recorder contents
     {"id": 15, "op": "top_k", "score": "bm25", "k": 3,
                "terms": ["big", "cat"], "explain": true}  # cost report
+    {"id": 16, "op": "snapshot"}    # admin: manifest for replication
+    {"id": 17, "op": "fetch_segment", "segment": "seg_2_1",
+               "file": "index.mri"}  # admin: ship one segment file
+    {"id": 18, "op": "wal_tail", "after_seq": 12}  # admin: WAL tail
+    {"id": 19, "op": "df", "terms": ["cat"],
+               "min_generation": 7}  # read-your-writes fence
 
 Live mutations (the ``append``/``delete``/``compact`` ops) run on the
 reader thread under the reload lock — never the dispatcher — publish a
@@ -70,6 +76,23 @@ failure keeps the OLD generation serving and counts
 ``mutation_rejected``.  Deletes batch per
 ``MRI_SEGMENT_TOMBSTONE_FLUSH`` (a generation is published every N
 delete ops; a ``compact`` or drain flushes the remainder).
+
+Durability: with ``MRI_SEGMENT_WAL`` on (default), every mutation's
+checksummed WAL record is fsync'd BEFORE its manifest swap and before
+the ack leaves the wire — buffered delete ops included, so a SIGKILL
+between an acknowledged delete and its batched tombstone flush is
+replayed by the startup recovery (``segments.recover``) that runs
+before the first engine opens.  Replication: ``snapshot`` /
+``fetch_segment`` / ``wal_tail`` serve a replica's catch-up round
+(``--replica-of`` or ``mri replicate``); a replica is read-only,
+reports ``replica_lagging`` in healthz until a round succeeds, and
+adopts shipped generations with a quiet engine swap.  Read-your-writes
+across failover: mutation acks echo a ``generation`` token, and any
+request may carry ``min_generation`` — a node still behind that
+generation answers ``stale_generation`` instead of serving stale
+state.  With ``MRI_SEGMENT_LEASE_TTL_S`` > 0 mutations renew a TTL'd
+primary lease inside ``segments.lock`` first; a live foreign holder
+rejects the mutation with a ``lease_lost`` detail.
 
 Success: ``{"id":1,"ok":true,"df":[5241,3]}``.  Failure:
 ``{"id":2,"error":"<kind>","detail":"..."}`` with kind one of
@@ -138,7 +161,8 @@ OUTBOUND_DEPTH = 1024
 
 DATA_OPS = ("df", "postings", "and", "or", "top_k")
 ADMIN_OPS = ("stats", "healthz", "reload", "metrics", "trace",
-             "append", "delete", "compact", "flightdump", "slo")
+             "append", "delete", "compact", "flightdump", "slo",
+             "snapshot", "fetch_segment", "wal_tail")
 
 OVERLOAD_ENV = "MRI_OBS_OVERLOAD_SHED_RATE"
 
@@ -163,6 +187,7 @@ _COUNTER_NAMES = (
     ("connections", "mri_serve_connections_total"),
     ("mutations", "mri_serve_mutations_total"),
     ("mutation_rejected", "mri_serve_mutation_rejected_total"),
+    ("stale_generation", "mri_serve_stale_generation_total"),
 )
 
 
@@ -276,8 +301,35 @@ class ServeDaemon:
                  queue_depth: int | None = None,
                  max_batch: int | None = None,
                  drain_s: float | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 replica_of: str | None = None):
         self._path = path
+        self._replica_of = replica_of
+        if replica_of is None:
+            # startup recovery BEFORE the first engine opens: WAL
+            # records acknowledged by a crashed predecessor are part of
+            # the index, not debris — roll the directory forward to the
+            # exact last-acked generation.  Replicas skip this: their
+            # adopted tail may reference source files that only exist
+            # on the primary, so they converge by segment shipping.
+            from .. import segments
+            rep = segments.recover(path)
+            if rep.get("replayed"):
+                log.info("startup recovery: %s", json.dumps(rep))
+        else:
+            # bootstrap catch-up so a replica born on an empty dir has
+            # a generation to open; an unreachable primary only warns —
+            # an existing local generation serves stale while the poll
+            # loop heals (a dir with nothing to serve still fails the
+            # engine open below)
+            from .. import segments
+            from ..segments import replica as segrep
+            addr = segrep.parse_addr(replica_of)
+            try:
+                segrep.replicate(path, addr)
+            except (segments.SegmentError, OSError) as e:
+                log.warning("initial replica catch-up from %s failed: "
+                            "%s", replica_of, e)
         self._engine_choice = engine
         self._cache_terms = cache_terms
         self._shards = shards
@@ -349,6 +401,26 @@ class ServeDaemon:
         self._pending_deletes: list[int] = []
         self._delete_ops = 0
         self._tomb_flush = envknobs.get("MRI_SEGMENT_TOMBSTONE_FLUSH")
+        # a failed delete flush leaves acked WAL records above the
+        # manifest's wal_seq; the next mutation replays them first so
+        # truncation can never pass an unapplied acked record
+        self._stale_wal = False  # guarded by: self._reload_lock
+        self._lease_owner = f"pid{os.getpid()}"  # rebound on start()
+        # last published generation — the read-your-writes token echoed
+        # on mutation acks and checked against ``min_generation``
+        from .. import segments
+        try:
+            man = segments.load_manifest(path)
+        except segments.SegmentError:
+            man = None
+        self._generation = 0 if man is None else man.generation
+        self._replica_stop = threading.Event()
+        self._replica_thread: threading.Thread | None = None
+        # a replica is born lagging: not ready until one catch-up
+        # round against the primary has succeeded
+        self._replica_lagging = replica_of is not None
+        self._g_replica_lag = \
+            self.registry.gauge("mri_replica_lag_generations")
         self._host = host
         self._port = port
         self.final_stats: dict | None = None
@@ -364,6 +436,7 @@ class ServeDaemon:
         ls.settimeout(0.2)
         self._listener = ls
         self._host, self._port = ls.getsockname()[:2]
+        self._lease_owner = f"{self._host}:{self._port}#{os.getpid()}"
         self._watchdog.register("dispatcher")
         self._watchdog.register("accept")
         self._rolling.start()
@@ -387,6 +460,11 @@ class ServeDaemon:
                 target=self._metrics_loop, name="mri-serve-metrics",
                 daemon=True)
             self._metrics_thread.start()
+        if self._replica_of is not None:
+            self._replica_thread = threading.Thread(
+                target=self._replica_loop, name="mri-serve-replica",
+                daemon=True)
+            self._replica_thread.start()
         # mrilint: allow(guarded-by) no reload can race start()
         log.info("serving %s on %s:%d (engine=%s coalesce_us=%d "
                  "queue_depth=%d max_batch=%d)", self._path, self._host,
@@ -423,6 +501,8 @@ class ServeDaemon:
             reasons.append("reloading")
         if self._watchdog.stalled():
             reasons.append("stalled")
+        if self._replica_of is not None and self._replica_lagging:
+            reasons.append("replica_lagging")
         limit = self._overload_shed_rate
         if limit > 0:
             counts = self._rolling.counts(10.0)
@@ -557,6 +637,23 @@ class ServeDaemon:
                 payload["trace_id"] = tid
             conn.enqueue(0, payload)
             return
+        mg = req.get("min_generation")
+        if mg is not None and self._generation < mg:
+            # read-your-writes: the client holds a generation token from
+            # a mutation ack this node (a lagging replica) has not yet
+            # caught up to — refusing is correct, serving stale is not
+            self._count("stale_generation")
+            payload = {"error": "stale_generation",
+                       "detail": f"serving generation "
+                                 f"{self._generation}, client requires "
+                                 f">= {mg}",
+                       "generation": self._generation}
+            if rid is not None:
+                payload["id"] = rid
+            if tid is not None:
+                payload["trace_id"] = tid
+            conn.enqueue(0, payload)
+            return
         if tid is None and self._obs_enabled:
             tid = obs_tracing.gen_trace_id()
         t_admit = time.monotonic()
@@ -598,6 +695,11 @@ class ServeDaemon:
         ex = req.get("explain")
         if ex is not None and not isinstance(ex, bool):
             return f"explain must be a boolean, got {ex!r}"
+        mg = req.get("min_generation")
+        if mg is not None and (not isinstance(mg, int)
+                               or isinstance(mg, bool) or mg < 0):
+            return (f"min_generation must be a non-negative integer, "
+                    f"got {mg!r}")
         if op == "top_k":
             score = req.get("score") or "df"
             if score not in ("df", "bm25"):
@@ -627,8 +729,9 @@ class ServeDaemon:
         """Admin ops answer inline from the reader thread — they must
         work while the dispatcher is wedged in a batch."""
         # mrilint: allow(trace) stats healthz slo metrics trace flightdump
-        # — read-only introspection ops: answered inline from state the
-        # trace ring already covers, no engine or generation change
+        # snapshot fetch_segment wal_tail — read-only introspection and
+        # replication-source ops: answered inline from published state,
+        # no engine or generation change
         if op == "healthz":
             # liveness vs readiness: ``ok`` stays unconditionally True
             # for old clients (the process answered — it is alive);
@@ -682,6 +785,39 @@ class ServeDaemon:
                     payload = {"ok": True, "result": out}
                 else:
                     payload = {"error": "mutation_rejected", "detail": out}
+        elif op in ("snapshot", "fetch_segment", "wal_tail"):
+            # mrilint: allow(trace) snapshot fetch_segment wal_tail — read-only
+            # replication source ops: read-only views over PUBLISHED
+            # state (manifest, immutable segment files, the WAL tail) —
+            # a replica's catch-up round is snapshot → fetch_segment per
+            # missing file → wal_tail
+            from .. import segments
+            from ..segments import replica as segrep
+            try:
+                if op == "snapshot":
+                    payload = {"ok": True,
+                               "snapshot":
+                                   segrep.snapshot_payload(self._path),
+                               "lease": segments.read_lease(self._path)}
+                elif op == "fetch_segment":
+                    payload = {"ok": True,
+                               **segrep.segment_file_payload(
+                                   self._path,
+                                   str(req.get("segment") or ""),
+                                   str(req.get("file") or ""))}
+                else:  # wal_tail
+                    after = req.get("after_seq", 0)
+                    if not isinstance(after, int) \
+                            or isinstance(after, bool) or after < 0:
+                        raise segments.ReplicaError(
+                            f"after_seq must be a non-negative "
+                            f"integer, got {after!r}")
+                    payload = {"ok": True,
+                               "records": segrep.wal_tail_payload(
+                                   self._path, after)}
+            except segments.SegmentError as e:
+                self._count("bad_request")
+                payload = {"error": "bad_request", "detail": str(e)}
         else:  # reload
             t0 = time.monotonic()
             ok, detail = self.reload()
@@ -973,7 +1109,7 @@ class ServeDaemon:
 
     # -- live mutations (segment-managed dirs) -------------------------
 
-    def _flush_deletes_locked(self):
+    def _flush_deletes_locked(self):  # mrilint: holds(self._reload_lock)
         """Publish every buffered delete op as ONE tombstone generation.
         Caller holds ``_reload_lock``.  Returns the mutation result, or
         None when the buffer was empty.  On failure the buffer is
@@ -982,11 +1118,19 @@ class ServeDaemon:
         if not self._pending_deletes:
             return None
         from .. import segments
+        from ..segments import wal as wal_mod
         ids = sorted(set(self._pending_deletes))
         self._pending_deletes = []
         self._delete_ops = 0
-        return segments.delete_docs(self._path, ids,
-                                    registry=self.registry)
+        try:
+            return segments.delete_docs(self._path, ids,
+                                        registry=self.registry)
+        except Exception:
+            # the buffer is gone but its acked per-op WAL records are
+            # not: the next mutation must replay them before logging
+            # anything newer, or truncation would pass them unapplied
+            self._stale_wal = wal_mod.wal_enabled()
+            raise
 
     def mutate(self, op: str, *, files=None, docs=None,
                force: bool = True) -> tuple[bool, dict | str]:
@@ -998,13 +1142,38 @@ class ServeDaemon:
         The mutation publishes its manifest generation atomically on
         disk first; only then is a fresh engine opened and swapped under
         the dispatch lock.  On ANY failure the old generation keeps
-        serving and the attempt is counted ``mutation_rejected``."""
+        serving and the attempt is counted ``mutation_rejected``.
+
+        Durability (acknowledgement) ordering: every mutation's WAL
+        record is fsync'd BEFORE its manifest swap — for buffered
+        deletes the record is fsync'd here, before the ack, even though
+        the tombstone generation publishes ops later.  With leasing
+        enabled (``MRI_SEGMENT_LEASE_TTL_S`` > 0) the lease is renewed
+        first; a live foreign holder rejects the mutation with
+        ``lease_lost`` while reads keep serving."""
         from .. import segments
+        from ..segments import wal as wal_mod
+        if self._replica_of is not None:
+            self._count("mutation_rejected")
+            return False, ("replica is read-only: mutations go to the "
+                           f"primary at {self._replica_of}")
         with self._reload_lock:
             t0 = time.monotonic()
             published = True
             try:
+                segments.renew_lease(self._path, self._lease_owner)
+                if self._stale_wal:
+                    # a failed delete flush left acked records above
+                    # the manifest's wal_seq — apply them before this
+                    # mutation logs (and later truncates past) a
+                    # higher seq
+                    segments.replay(self._path, registry=self.registry)
+                    self._stale_wal = False
                 if op == "append":
+                    # buffered deletes flush first: WAL seq order must
+                    # match apply order, and the append's published
+                    # wal_seq must never cover an unapplied delete
+                    self._flush_deletes_locked()
                     res = segments.append_files(self._path, files,
                                                 registry=self.registry)
                     auto = segments.compact_to_limit(
@@ -1026,6 +1195,19 @@ class ServeDaemon:
                         raise segments.SegmentError(
                             f"doc ids {bad} are outside every segment "
                             f"(live span is 1..{man.doc_span})")
+                    wal_seq = None
+                    if self._delete_ops + 1 < self._tomb_flush \
+                            and wal_mod.wal_enabled():
+                        # durability point for a buffered ack: the
+                        # tombstone generation publishes later, but
+                        # this fsync'd record survives a crash now
+                        # (replayed by recover; made idempotent by
+                        # bitmap-OR semantics)
+                        with segments.mutation_lock(self._path):
+                            wal_seq = wal_mod.log_mutation(
+                                self._path, "delete",
+                                {"docs": sorted(set(docs))},
+                                registry=self.registry)
                     self._pending_deletes.extend(docs)
                     self._delete_ops += 1
                     if self._delete_ops >= self._tomb_flush:
@@ -1035,7 +1217,9 @@ class ServeDaemon:
                         res = {"buffered": True,
                                "pending_docs":
                                    len(set(self._pending_deletes)),
-                               "pending_ops": self._delete_ops}
+                               "pending_ops": self._delete_ops,
+                               "wal_seq": wal_seq,
+                               "generation": self._generation}
                 else:  # compact (flushes buffered deletes first, so the
                     #    merge sees every tombstone it should drop)
                     self._flush_deletes_locked()
@@ -1057,12 +1241,18 @@ class ServeDaemon:
                 with self._engine_lock:
                     old, self._engine = self._engine, new_engine
                 old.close()
+                if isinstance(res, dict) \
+                        and res.get("generation") is not None:
+                    self._generation = int(res["generation"])
             self._count("mutations")
             dur_ms = round((time.monotonic() - t0) * 1e3, 3)
             # mrilint: allow(trace) append delete compact — every
             # mutation op lands here; the span carries the generation it
-            # produced (buffered deletes: no publish, no generation yet)
-            gen = res.get("generation") if isinstance(res, dict) else None
+            # produced.  A buffered delete publishes nothing — its ack
+            # echoes the CURRENT generation as a read-your-writes token,
+            # which must not masquerade as a produced one here.
+            gen = res.get("generation") \
+                if isinstance(res, dict) and published else None
             self._admin_trace(op, t0, generation=gen)
             log.info("%s: %s (%.1f ms)", op, json.dumps(res), dur_ms)
             return True, res
@@ -1104,6 +1294,52 @@ class ServeDaemon:
                 return True, ""
             finally:
                 self._reloading = False
+
+    # -- replica catch-up ----------------------------------------------
+
+    def _replica_loop(self) -> None:
+        """Poll the primary every ``MRI_REPLICA_POLL_MS``: one
+        :func:`~..segments.replica.replicate` round per tick, adopting
+        the shipped generation when it changed.  Failures mark the
+        replica lagging (healthz ``replica_lagging``) and keep
+        polling — a partition heals by itself."""
+        from .. import segments
+        from ..segments import replica as segrep
+        try:
+            addr = segrep.parse_addr(self._replica_of)
+        except segments.SegmentError as e:
+            log.error("replica mode dead on arrival: %s", e)
+            return
+        period = max(0.001, envknobs.get(segrep.POLL_ENV) / 1e3)
+        while True:
+            try:
+                res = segrep.replicate(self._path, addr,
+                                       registry=self.registry)
+                self._g_replica_lag.set(max(0, res["behind"]))
+                if res["changed"] or self._replica_lagging:
+                    self._adopt_generation(res["generation"])
+                self._replica_lagging = False
+                self._g_replica_lag.set(0)
+            except (segments.SegmentError, ArtifactError, ValueError,
+                    OSError) as e:
+                self._replica_lagging = True
+                log.warning("replica catch-up from %s failed: %s",
+                            self._replica_of, e)
+            if self._replica_stop.wait(period):
+                return
+
+    def _adopt_generation(self, generation: int) -> None:
+        """Swap in an engine over a freshly shipped generation.  Quiet
+        on purpose: adoption is not a reload — no ``reload_ok`` count,
+        no ``reloading`` readiness blip — readers never notice."""
+        with self._reload_lock:
+            new_engine = create_engine(
+                self._path, self._engine_choice,
+                cache_terms=self._cache_terms, shards=self._shards)
+            with self._engine_lock:
+                old, self._engine = self._engine, new_engine
+            old.close()
+            self._generation = generation
 
     # -- stats ---------------------------------------------------------
 
@@ -1250,6 +1486,9 @@ class ServeDaemon:
         # threads gone with the rest
         self._watchdog.stop()
         self._rolling.stop()
+        self._replica_stop.set()
+        if self._replica_thread is not None:
+            self._replica_thread.join(timeout=5.0)
         deadline = time.monotonic() + self.drain_s
         if self._listener is not None:
             try:
@@ -1318,6 +1557,11 @@ class ServeDaemon:
                 self._flush_deletes_locked()
             except Exception as e:
                 log.warning("drain: buffered delete flush failed: %s", e)
+        # a clean exit hands the lease to the successor immediately
+        # instead of making it wait out the TTL
+        with contextlib.suppress(Exception):
+            from .. import segments
+            segments.release_lease(self._path, self._lease_owner)
         self.final_stats = self.stats()
         with self._engine_lock:
             self._engine.close()

@@ -1,0 +1,33 @@
+"""Canonical adler32 helpers: one spelling for every container checksum.
+
+Three subsystems grew identical hand-rolled adler32 hex helpers — the
+``MRISPILL`` per-section checksums in ``build/spill.py``, the packed
+artifact's whole-file checksum in ``serve/artifact.py``, and the
+segment manifest's body checksum in ``segments/manifest.py`` (plus the
+staged-bytes rider in ``segments/tombstones.py``).  The WAL
+(``segments/wal.py``) would have been the fourth copy.  The canonical
+spelling lives here; the old call sites are thin shims over it.
+
+Deliberately stdlib-only and policy-free: hashing bytes for a checksum
+is not a fault-injection boundary (there is no retry decision to make
+here — callers own their own error handling), so this module carries a
+file-level allow-list entry in mrilint's ``fault-boundary`` check.
+"""
+
+from __future__ import annotations
+
+import zlib
+from pathlib import Path
+
+
+def adler32_hex(data: bytes) -> str:
+    """Adler-32 of ``data`` as 8 lowercase hex digits — the repo-wide
+    container checksum format (spill sections, segment manifests,
+    packed artifacts, tombstone stages, WAL records)."""
+    return f"{zlib.adler32(data) & 0xFFFFFFFF:08x}"
+
+
+def file_checksum(path) -> tuple[str, int]:
+    """``(adler32 hex, byte length)`` of a whole file's contents."""
+    data = Path(path).read_bytes()
+    return adler32_hex(data), len(data)

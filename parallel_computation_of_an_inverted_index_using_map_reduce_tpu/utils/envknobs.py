@@ -268,6 +268,23 @@ declare("MRI_SEGMENT_TOMBSTONE_FLUSH", int, 1,
         "a compact or drain flushes the remainder; CLI deletes always "
         "publish).",
         scope="serve", minimum=1)
+declare("MRI_SEGMENT_WAL", int, 1,
+        "Mutation write-ahead log: 1 fsyncs a checksummed WAL record "
+        "before every segment mutation publish (crash replay via 'mri "
+        "recover' / daemon start), 0 disables logging (replay of an "
+        "existing log still runs).",
+        scope="serve", choices=(0, 1))
+declare("MRI_SEGMENT_LEASE_TTL_S", float, 0.0,
+        "Primary-election lease TTL in seconds: mutations renew a "
+        "TTL'd lease inside segments.lock and are rejected with "
+        "'lease_lost' once another holder owns it; 0 disables "
+        "leasing (single-writer deployments).",
+        scope="serve", minimum=0)
+declare("MRI_REPLICA_POLL_MS", int, 500,
+        "Replica catch-up poll period in ms for 'mri serve "
+        "--replica-of' (each poll ships missing segments + WAL tail "
+        "from the primary).",
+        scope="serve", minimum=1)
 
 # -- observability ----------------------------------------------------
 declare("MRI_OBS_ENABLE", int, 1,

@@ -1,0 +1,467 @@
+"""Durable mutations: the checksummed WAL, crash replay, and
+segment-shipping replicas (segments/wal.py, segments/replica.py).
+
+The contract under test is ack-ordering durability: a mutation the
+client saw acknowledged survives ANY process death, because its WAL
+record was fsync'd before the ack.  The flagship here is the SIGKILL-
+during-tombstone-batch-flush test — buffered deletes that never
+published still replay to a state byte-equal (BM25 floats included)
+to a from-scratch build without them.
+
+The replica side pins segment shipping: catch-up fetches only missing
+content-hashed files (never re-indexes), verifies every byte against
+the manifest's adler32 before adoption, is idempotent when current,
+and refuses to roll a local manifest backwards.  Leases: a live
+foreign holder rejects mutations with ``lease_lost``; expiry and
+clean release both hand the lease over.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from conftest import REPO_ROOT
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+    segments,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.audit import (
+    verify_output_dir,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    main,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.segments import (
+    replica as replica_mod,
+    wal as wal_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+    create_engine,
+)
+
+pytestmark = pytest.mark.wal
+
+PKG = "parallel_computation_of_an_inverted_index_using_map_reduce_tpu"
+
+# pure-alphabetic vocabulary (the tokenizer strips digits)
+_WORDS = [f"{c}term{s}" for c in "bdfhkmqv" for s in "aeiou"]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.install(None)
+    faults.begin_run()
+    yield
+    faults.install(None)
+    faults.begin_run()
+
+
+def make_docs(tmp_path, specs, prefix="doc"):
+    ddir = tmp_path / f"{prefix}-docs"
+    ddir.mkdir(exist_ok=True)
+    paths = []
+    for i, words in enumerate(specs):
+        p = ddir / f"{prefix}{i:04d}.txt"
+        p.write_text(" ".join(words) + "\n", encoding="ascii")
+        paths.append(str(p))
+    return paths, list(specs)
+
+
+def doc_specs(rng, n, tokens=(10, 25)):
+    import random
+
+    assert isinstance(rng, random.Random)
+    return [[_WORDS[rng.randrange(len(_WORDS))]
+             for _ in range(rng.randrange(*tokens))] for _ in range(n)]
+
+
+def build_reference(tmp_path, token_lists, name="ref"):
+    """From-scratch single-artifact build of exactly these documents."""
+    paths, _ = make_docs(tmp_path, token_lists, prefix=name)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+    listfile = tmp_path / f"{name}-list.txt"
+    write_manifest(listfile, paths)
+    out = tmp_path / f"{name}-out"
+    assert main(["1", "1", str(listfile), "--backend", "cpu",
+                 "--output-dir", str(out), "--artifact"]) == 0
+    return out
+
+
+def assert_state_identical(idx_dir, truth: dict, tmp_path, tag=""):
+    """Multi-segment answers == from-scratch single-artifact answers
+    for the same live docs (ids remapped densely by rank), with BM25
+    floats compared exactly."""
+    live = sorted(truth)
+    remap = {gid: i + 1 for i, gid in enumerate(live)}
+    ref = build_reference(tmp_path, [truth[g] for g in live],
+                          name=f"ref{tag}{len(live)}")
+    vocab = sorted({w for words in truth.values() for w in words})
+    with create_engine(str(idx_dir), None) as em, \
+            create_engine(str(ref), None) as er:
+        bm, br = em.encode_batch(vocab), er.encode_batch(vocab)
+        assert em.df(bm).tolist() == er.df(br).tolist()
+        for t, pm, pr in zip(vocab, em.postings(bm), er.postings(br)):
+            got = [] if pm is None else [remap[g] for g in pm.tolist()]
+            want = [] if pr is None else pr.tolist()
+            assert got == want, t
+        for q in ([vocab[0]], vocab[:3], [vocab[-1]]):
+            got = [(remap[g], s) for g, s in
+                   em.top_k_scored(em.encode_batch(q), 10)]
+            assert got == er.top_k_scored(er.encode_batch(q), 10), q
+
+
+def seed_segmented(tmp_path, rng, n=4, prefix="seed"):
+    """A generation-1 segmented dir + its truth dict."""
+    paths, specs = make_docs(tmp_path, doc_specs(rng, n), prefix=prefix)
+    idx = tmp_path / f"{prefix}-idx"
+    segments.append_files(idx, paths)
+    return idx, {i + 1: w for i, w in enumerate(specs)}
+
+
+# -- WAL container ------------------------------------------------------
+
+
+def test_wal_container_round_trip(tmp_path):
+    s1 = wal_mod.log_mutation(tmp_path, "append", {"files": ["a.txt"]})
+    s2 = wal_mod.log_mutation(tmp_path, "delete", {"docs": [3, 7]})
+    s3 = wal_mod.log_mutation(tmp_path, "compact", {"force": True})
+    assert (s1, s2, s3) == (1, 2, 3)
+    records, info = wal_mod.read_records(tmp_path)
+    assert info == {}
+    assert [r["op"] for r in records] == ["append", "delete", "compact"]
+    assert records[1]["docs"] == [3, 7]
+    assert wal_mod.tail(tmp_path, 1) == records[1:]
+    assert wal_mod.tail(tmp_path, 3) == []
+    # discard drops exactly the rejected record
+    wal_mod.discard(tmp_path, s2)
+    assert [r["seq"] for r in wal_mod.read_records(tmp_path)[0]] == [1, 3]
+    # seq never reuses a discarded number
+    assert wal_mod.log_mutation(tmp_path, "append", {"files": []}) == 4
+
+
+def test_wal_torn_tail_quarantined(tmp_path):
+    wal_mod.log_mutation(tmp_path, "append", {"files": ["a.txt"]})
+    wal_mod.log_mutation(tmp_path, "delete", {"docs": [1]})
+    path = wal_mod.wal_path(tmp_path)
+    whole = path.read_bytes()
+    # tear mid-record: whole prefix survives, tail is quarantined
+    path.write_bytes(whole[:-7])
+    records, info = wal_mod.read_records(tmp_path)
+    assert [r["op"] for r in records] == ["append"]
+    assert info["quarantined_bytes"] > 0
+    assert wal_mod.corrupt_path(tmp_path).exists()
+    # the log was repaired in place: a second read is clean
+    assert wal_mod.read_records(tmp_path) == (records, {})
+    # garbage *between* records (flipped checksum) also quarantines
+    bad = bytearray(whole)
+    bad[-3] ^= 0xFF
+    path.write_bytes(bytes(bad))
+    records, info = wal_mod.read_records(tmp_path)
+    assert [r["op"] for r in records] == ["append"]
+    assert "checksum" in info["damage"]
+
+
+def test_wal_torn_record_fault_fails_unacked(tmp_path):
+    rng = __import__("random").Random(11)
+    idx, truth = seed_segmented(tmp_path, rng)
+    gen = segments.load_manifest(idx).generation
+    faults.install("wal-torn-record")
+    faults.begin_run()
+    try:
+        with pytest.raises(segments.SegmentError):
+            segments.delete_docs(idx, [1])
+    finally:
+        faults.install(None)
+        faults.begin_run()
+    # the mutation failed un-acked: nothing published, doc 1 still live
+    assert segments.load_manifest(idx).generation == gen
+    rep = segments.recover(idx)
+    assert rep["replayed"] == 0
+    assert wal_mod.corrupt_path(idx).exists()
+    assert_state_identical(idx, truth, tmp_path, tag="torn")
+
+
+def test_wal_replay_applies_unpublished_records(tmp_path):
+    """A record logged but never applied (crash between fsync and
+    publish) replays to the exact state the ack promised."""
+    rng = __import__("random").Random(23)
+    idx, truth = seed_segmented(tmp_path, rng, n=5)
+    with segments.mutation_lock(idx):
+        wal_mod.log_mutation(idx, "delete", {"docs": [2, 4]})
+    rep = segments.replay(idx)
+    assert rep["replayed"] == 1
+    truth.pop(2)
+    truth.pop(4)
+    assert_state_identical(idx, truth, tmp_path, tag="replay")
+    # replay is idempotent: the applied record was truncated
+    assert segments.replay(idx)["replayed"] == 0
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+
+
+def test_wal_disabled_by_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRI_SEGMENT_WAL", "0")
+    rng = __import__("random").Random(31)
+    idx, truth = seed_segmented(tmp_path, rng)
+    segments.delete_docs(idx, [1])
+    assert not wal_mod.wal_path(idx).exists()
+    truth.pop(1)
+    assert_state_identical(idx, truth, tmp_path, tag="off")
+
+
+def test_recover_cli_reports_json(tmp_path, capsys):
+    rng = __import__("random").Random(41)
+    idx, _ = seed_segmented(tmp_path, rng)
+    with segments.mutation_lock(idx):
+        wal_mod.log_mutation(idx, "delete", {"docs": [1]})
+    assert main(["recover", str(idx)]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["replayed"] == 1 and rep["segmented"]
+    # a dir with nothing to recover is a benign no-op, not an error
+    assert main(["recover", str(tmp_path / "nowhere")]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep == {"generation": 0, "replayed": 0, "segmented": False,
+                   "skipped": 0, "swept": [], "truncated": 0,
+                   "wal_seq": 0}
+
+
+# -- SIGKILL during tombstone batch flush (the flagship) ----------------
+
+
+@pytest.mark.daemon
+def test_sigkill_during_tombstone_batch_flush(tmp_path):
+    """MRI_SEGMENT_TOMBSTONE_FLUSH > 1: deletes are acked buffered,
+    each backed by its own fsync'd WAL record.  SIGKILL the daemon
+    before the batch publishes — recovery must replay every acked
+    delete, landing byte-equal to a build that never had those docs."""
+    import os
+    import random
+
+    rng = random.Random(53)
+    idx, truth = seed_segmented(tmp_path, rng, n=6)
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT),
+               JAX_PLATFORMS="cpu", MRI_SEGMENT_TOMBSTONE_FLUSH="4")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", PKG, "serve", str(idx),
+         "--listen", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=str(REPO_ROOT), text=True)
+    try:
+        ready = json.loads(proc.stdout.readline())
+        sock = socket.create_connection((ready["host"], ready["port"]),
+                                        timeout=30)
+        f = sock.makefile("rwb")
+
+        def rpc(**kw):
+            f.write((json.dumps(kw) + "\n").encode())
+            f.flush()
+            return json.loads(f.readline())
+
+        try:
+            # one published append, then three acked-buffered deletes
+            more, mspecs = make_docs(tmp_path, doc_specs(rng, 2),
+                                     prefix="live")
+            r = rpc(id=1, op="append", files=more)
+            assert r["ok"], r
+            for gid, words in zip(r["result"]["doc_ids"], mspecs):
+                truth[gid] = words
+            for i, victim in enumerate((1, 3, 7)):
+                r = rpc(id=10 + i, op="delete", docs=[victim])
+                assert r["ok"] and r["result"]["buffered"], r
+                assert r["result"]["wal_seq"] > 0
+                truth.pop(victim)
+        finally:
+            f.close()
+            sock.close()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        proc.stdout.close()
+        proc.stderr.close()
+    # nothing flushed: the manifest still counts zero tombstones
+    assert sum(e.tomb_count
+               for e in segments.load_manifest(idx).entries) == 0
+    rep = segments.recover(idx)
+    assert rep["replayed"] == 3, rep
+    ok, problems = verify_output_dir(idx)
+    assert ok, problems
+    assert_state_identical(idx, truth, tmp_path, tag="kill")
+
+
+# -- leases -------------------------------------------------------------
+
+
+def test_lease_renew_reject_expire_release(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRI_SEGMENT_LEASE_TTL_S", "30")
+    assert replica_mod.read_lease(tmp_path) is None
+    lease = segments.renew_lease(tmp_path, "alice")
+    assert lease["owner"] == "alice"
+    # the holder renews freely; a live foreign owner is rejected
+    segments.renew_lease(tmp_path, "alice")
+    with pytest.raises(segments.LeaseError, match="lease_lost"):
+        segments.renew_lease(tmp_path, "bob")
+    # expiry hands the lease over without a release
+    segments.renew_lease(tmp_path, "alice", ttl=0.05)
+    time.sleep(0.1)
+    assert segments.renew_lease(tmp_path, "bob")["owner"] == "bob"
+    # release is owner-gated
+    assert not segments.release_lease(tmp_path, "alice")
+    assert segments.release_lease(tmp_path, "bob")
+    assert replica_mod.read_lease(tmp_path) is None
+
+
+def test_lease_disabled_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("MRI_SEGMENT_LEASE_TTL_S", raising=False)
+    assert segments.renew_lease(tmp_path, "anyone") is None
+    assert not segments.release_lease(tmp_path, "anyone")
+
+
+# -- segment shipping ---------------------------------------------------
+
+
+def _daemon(idx, **kw):
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.daemon import (
+        ServeDaemon,
+    )
+    d = ServeDaemon(str(idx), port=0, **kw)
+    d.start()
+    return d
+
+
+def _tree_bytes(root: Path) -> dict:
+    """Replicated content: manifest + every segment file, by rel path."""
+    out = {"manifest": segments.manifest_path(root).read_bytes()}
+    for p in sorted(segments.segments_root(root).rglob("*")):
+        if p.is_file():
+            out[str(p.relative_to(root))] = p.read_bytes()
+    return out
+
+
+@pytest.mark.daemon
+def test_replicate_ships_segments_byte_equal(tmp_path):
+    import random
+
+    rng = random.Random(71)
+    idx, truth = seed_segmented(tmp_path, rng, n=5)
+    segments.delete_docs(idx, [2])
+    truth.pop(2)
+    d = _daemon(idx)
+    rep = tmp_path / "replica"
+    try:
+        res = segments.replicate(rep, d.address)
+        assert res["generation"] == 2 and res["fetched"]
+        # every shipped byte identical, and a current replica is a no-op
+        assert _tree_bytes(rep) == _tree_bytes(idx)
+        res2 = segments.replicate(rep, d.address)
+        assert not res2["changed"] and res2["fetched"] == []
+        # primary moves on; the next round ships only the delta
+        more, mspecs = make_docs(tmp_path, doc_specs(rng, 2), prefix="m")
+        r = segments.append_files(idx, more)
+        for gid, words in zip(r["doc_ids"], mspecs):
+            truth[gid] = words
+        res3 = segments.replicate(rep, d.address)
+        assert res3["behind"] >= 1 and res3["changed"]
+        assert _tree_bytes(rep) == _tree_bytes(idx)
+    finally:
+        d.drain()
+    assert_state_identical(rep, truth, tmp_path, tag="rep")
+    ok, problems = verify_output_dir(rep)
+    assert ok, problems
+
+
+@pytest.mark.daemon
+def test_replicate_rejects_torn_fetch_then_heals(tmp_path):
+    """A half-shipped file must never be adopted: the adler32 check
+    rejects it and the retry fetches the whole thing."""
+    import random
+
+    rng = random.Random(83)
+    idx, truth = seed_segmented(tmp_path, rng, n=4)
+    d = _daemon(idx)
+    rep = tmp_path / "replica"
+    try:
+        # the in-process daemon shares this injector: the tear fires
+        # inside segment_file_payload on the serving side
+        faults.install("fetch-partial")
+        faults.begin_run()
+        res = segments.replicate(rep, d.address)
+        assert res["generation"] == 1
+        assert _tree_bytes(rep) == _tree_bytes(idx)
+    finally:
+        d.drain()
+    assert_state_identical(rep, truth, tmp_path, tag="heal")
+
+
+@pytest.mark.daemon
+def test_replicate_refuses_manifest_rollback(tmp_path):
+    import random
+
+    rng = random.Random(89)
+    idx, _ = seed_segmented(tmp_path, rng, n=3)
+    rep_idx, _ = seed_segmented(tmp_path, rng, n=3, prefix="rep")
+    segments.delete_docs(rep_idx, [1])  # replica is at generation 2
+    d = _daemon(idx)
+    try:
+        with pytest.raises(segments.ReplicaError, match="ahead"):
+            segments.replicate(rep_idx, d.address)
+    finally:
+        d.drain()
+
+
+def test_replicate_cli_and_parse_addr(tmp_path):
+    assert replica_mod.parse_addr("host:99") == ("host", 99)
+    for bad in ("nohost", "h:0", "h:notaport", ":7"):
+        with pytest.raises(segments.ReplicaError):
+            replica_mod.parse_addr(bad)
+    # nothing listening: exit 2, not a traceback
+    assert main(["replicate", str(tmp_path / "r"),
+                 "--from", "127.0.0.1:1"]) == 2
+
+
+# -- read-your-writes fence ---------------------------------------------
+
+
+@pytest.mark.daemon
+def test_min_generation_fence(tmp_path):
+    import random
+
+    rng = random.Random(97)
+    idx, _ = seed_segmented(tmp_path, rng, n=3)
+    term = _WORDS[0]
+    d = _daemon(idx)
+    try:
+        sock = socket.create_connection(d.address)
+        f = sock.makefile("rwb")
+
+        def rpc(**kw):
+            f.write((json.dumps(kw) + "\n").encode())
+            f.flush()
+            return json.loads(f.readline())
+
+        try:
+            ok = rpc(id=1, op="df", terms=[term], min_generation=1)
+            assert "error" not in ok
+            stale = rpc(id=2, op="df", terms=[term], min_generation=99)
+            assert stale["error"] == "stale_generation"
+            assert stale["generation"] == 1
+            bad = rpc(id=3, op="df", terms=[term], min_generation=-1)
+            assert bad["error"] == "bad_request"
+        finally:
+            f.close()
+            sock.close()
+    finally:
+        d.drain()

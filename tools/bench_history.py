@@ -60,6 +60,10 @@ _BASIS = {
         "{}x r11 ranked qps at submission group 32; {}x the same-run "
         "host engine at that group".format(
             d["speedup_vs_r11"], d["batches"]["32"]["speedup"])),
+    "BENCH_WAL_r17.json": lambda d, ln: (
+        "value IS the ratio: WAL-on mutation ack p99 vs the same "
+        "run's WAL-off leg (budget {}x); replica catch-up {} MB/s"
+        .format(d["gate"], d["replication"]["mb_per_s"])),
     "BENCH_BUILD_OOC_r15.json": lambda d, ln: (
         "value IS the ratio: spill-tier wall vs the same run's "
         "in-memory build on a {}x-budget corpus (zero-spill {}x)"

@@ -50,6 +50,14 @@ Four modes, all printing ONE JSON line mirroring bench.py's shape:
                       answers must be identical), and the cost of
                       compacting the 16-segment run back to one —
                       written to --out-segments (BENCH_SEGMENTS_r12.json)
+  --wal-ab            durability A/B (make bench-wal): the same
+                      mutation schedule through a live daemon with
+                      MRI_SEGMENT_WAL off vs on — per-op ack p50/p99,
+                      gated at 2x the WAL-off p99 — byte-parity
+                      between the legs, and cold replica catch-up
+                      rate by segment shipping (s/GB + the idempotent
+                      no-op round); written to --out-wal
+                      (BENCH_WAL_r17.json)
   --daemon-bench      the resident-daemon sweep (make bench-daemon):
                       pipelined coalesced capacity + closed-loop rpc
                       floor vs the in-process batch-1 baseline, then an
@@ -1794,6 +1802,163 @@ def _segments_ab(out_path: str | None) -> dict:
     return line
 
 
+def _wal_mutation_leg(idx: str, paths: list[str], wal_on: bool) -> dict:
+    """One daemon run over a fixed mutation schedule; per-op ack
+    latency measured client-side (send -> response line)."""
+    import socket
+
+    proc, addr = _spawn_daemon(
+        idx, env_extra={"MRI_SEGMENT_WAL": "1" if wal_on else "0"})
+    append_ms, delete_ms = [], []
+    try:
+        sock = socket.create_connection(addr, timeout=60)
+        f = sock.makefile("rwb")
+        try:
+            def ack(**kw):
+                raw = (json.dumps(kw) + "\n").encode()
+                t0 = time.perf_counter()
+                f.write(raw)
+                f.flush()
+                r = json.loads(f.readline())
+                dt = (time.perf_counter() - t0) * 1e3
+                assert r.get("ok"), r
+                return r, dt
+
+            next_doc = None
+            for i, p in enumerate(paths):
+                r, dt = ack(id=i, op="append", files=[p])
+                append_ms.append(dt)
+                next_doc = r["result"]["doc_ids"][-1]
+                if i and i % 4 == 0:
+                    # delete the doc appended two rounds ago: every
+                    # leg kills the same ids, so the legs stay
+                    # byte-comparable
+                    _, ddt = ack(id=1000 + i, op="delete",
+                                 docs=[next_doc - 2])
+                    delete_ms.append(ddt)
+        finally:
+            f.close()
+            sock.close()
+        counters = _stop_daemon(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    def pct(xs):
+        return {"p50_ms": round(float(np.percentile(xs, 50)), 3),
+                "p99_ms": round(float(np.percentile(xs, 99)), 3),
+                "mean_ms": round(float(np.mean(xs)), 3),
+                "n": len(xs)}
+
+    return {"append": pct(append_ms), "delete": pct(delete_ms),
+            "all": pct(append_ms + delete_ms),
+            "mutations": counters.get("mutations", 0)}
+
+
+def _wal_ab(out_path: str | None) -> dict:
+    """`--wal-ab`: the durability tax and the replication rate.
+
+    The same mutation schedule (one-doc appends + interleaved deletes
+    through a live `mri serve` daemon) runs twice — MRI_SEGMENT_WAL=0
+    and =1 — and per-op acknowledgement latency is compared.  The WAL
+    leg pays a read-verify-append-fsync of the log inside every ack;
+    the gate is ack p99 <= 2x the WAL-off leg.  Both legs must land
+    byte-identical answers (BM25 floats included) before any number
+    counts.  Then a cold replica catches up from the WAL-on primary
+    by segment shipping (`segments.replicate`), timed and sized ->
+    catch-up seconds/GB, with the idempotent no-op round priced too."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+        segments,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+        create_engine,
+    )
+
+    manifest, corpus_metric = bench._manifest()
+    paths = list(manifest.paths)
+    rng = np.random.default_rng(SEED)
+    seed_n = max(4, len(paths) - 48)
+    mutation_srcs = paths[seed_n:]
+
+    legs = {}
+    dirs = {}
+    for name, wal_on in (("wal_off", False), ("wal_on", True)):
+        idx = os.path.join(bench._scratch_mkdtemp(f"bench_walab_{name}_"),
+                           "idx")
+        segments.append_files(idx, paths[:seed_n])
+        legs[name] = _wal_mutation_leg(idx, mutation_srcs, wal_on)
+        dirs[name] = idx
+        print(f"# {name}: {legs[name]['all']}", file=sys.stderr,
+              flush=True)
+
+    # term sampling needs a packed df table: the seed segment's own
+    # single artifact is exactly that (Zipf over the seed vocabulary)
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+    seed_art = os.path.join(dirs["wal_off"], "segments", "seg_1_0",
+                            "index.mri")
+    with Engine(seed_art) as seed_eng:
+        terms = _zipf_terms(seed_eng, LOOKUPS, rng)
+
+    # identical schedule -> identical answers, floats and all
+    with create_engine(dirs["wal_off"], None) as off_eng, \
+            create_engine(dirs["wal_on"], None) as on_eng:
+        parity_checked = _assert_segment_parity(off_eng, on_eng,
+                                                terms, rng)
+
+    ratio = round(legs["wal_on"]["all"]["p99_ms"]
+                  / legs["wal_off"]["all"]["p99_ms"], 4)
+    assert ratio <= 2.0, \
+        f"WAL ack p99 is {ratio}x the WAL-off leg (budget: 2x)"
+
+    # replication rate: cold catch-up from a live WAL-on primary
+    proc, addr = _spawn_daemon(dirs["wal_on"])
+    try:
+        rep_dir = os.path.join(
+            bench._scratch_mkdtemp("bench_walab_rep_"), "replica")
+        cold = segments.replicate(rep_dir, addr)
+        noop = segments.replicate(rep_dir, addr)
+        assert not noop["changed"], noop
+    finally:
+        _stop_daemon(proc)
+    gb = cold["bytes_fetched"] / 1e9
+    replication = {
+        "files": len(cold["fetched"]),
+        "bytes": cold["bytes_fetched"],
+        "cold_s": cold["seconds"],
+        "s_per_gb": round(cold["seconds"] / gb, 3) if gb else None,
+        "mb_per_s": round(cold["bytes_fetched"] / 1e6
+                          / cold["seconds"], 1) if cold["seconds"] else None,
+        "noop_round_s": noop["seconds"],
+        "generation": cold["generation"],
+    }
+    with create_engine(dirs["wal_on"], None) as on_eng, \
+            create_engine(rep_dir, None) as rep_eng:
+        parity_checked += _assert_segment_parity(on_eng, rep_eng,
+                                                 terms, rng)
+
+    line = {
+        "metric": "wal_ack_p99_ratio",
+        "value": ratio,
+        "unit": "x WAL-off mutation ack p99 (budget 2.0)",
+        "gate": 2.0,
+        "corpus_metric": corpus_metric,
+        "docs": len(paths),
+        "seed_docs": seed_n,
+        "mutations_per_leg": legs["wal_on"]["mutations"],
+        "parity_checked": parity_checked,
+        "legs": legs,
+        "replication": replication,
+        "host_cores": os.cpu_count(),
+        "scratch": bench._scratch_backing(),
+    }
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
 # -- default closed-loop host bench (the r05 shape, unchanged) ----------
 
 
@@ -1937,6 +2102,14 @@ def main(argv: list[str] | None = None) -> int:
                         "exemplars on, add the explain-latency and "
                         "attribution-overhead legs, gate against the "
                         "recorded r11 ranked QPS")
+    p.add_argument("--wal-ab", action="store_true",
+                   help="durability A/B: the same mutation schedule "
+                        "through a live daemon with MRI_SEGMENT_WAL "
+                        "off vs on (ack p99 gated at 2x), byte-parity "
+                        "between the legs, plus cold replica catch-up "
+                        "rate by segment shipping")
+    p.add_argument("--out-wal", default="BENCH_WAL_r17.json",
+                   help="where --wal-ab writes its JSON report")
     p.add_argument("--slo-check", action="store_true",
                    help="operational-health overhead gate: price the "
                         "rolling-windows sampler tick + a 1 Hz `slo` "
@@ -1947,7 +2120,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="where --slo-check writes its JSON report")
     args = p.parse_args(argv)
 
-    if args.segments_ab:
+    if args.wal_ab:
+        line = _wal_ab(args.out_wal)
+    elif args.segments_ab:
         line = _segments_ab(args.out_segments)
     elif args.slo_check:
         line = _slo_check(args.out_slo)

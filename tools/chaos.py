@@ -1036,6 +1036,342 @@ def run_segments_soak(work_dir: Path, trials: int, seed_base: int,
     }
 
 
+# -- wal / replication soak ---------------------------------------------
+#
+# The durability contract under real process death: a mutation the
+# client saw acknowledged is NEVER lost — a SIGKILL'd primary rolls
+# forward through `mri recover` (WAL replay), a replica converges by
+# segment shipping to byte-equal answers, and a stolen lease rejects
+# mutations without corrupting anything.  Truth tracking is exact:
+# every trial only mutates through acknowledged ops, so the final
+# state must match a from-scratch build of the truth dict bit-for-bit.
+
+WAL_SCENARIOS = ("kill-mid-compaction", "sigkill-tombstone-flush",
+                 "replica-partition", "lease-steal")
+
+#: lease-steal trials: short enough that one post-TTL retry fits the
+#: trial budget, long enough that the first retry deterministically
+#: loses to the thief
+_WAL_LEASE_TTL_S = 1.0
+
+
+def _wal_make_base(work: Path):
+    """Deterministic 8-doc artifact base every wal trial copies, built
+    from _seg_write_docs output so the truth dict is exact."""
+    rng = random.Random(0x5EED)
+    ids = list(range(1, 9))
+    paths, toks = _seg_write_docs(work / "base-docs", rng, ids)
+    write_manifest(work / "base-list.txt", paths)
+    out = work / "base-out"
+    build_index(read_manifest(work / "base-list.txt"),
+                IndexConfig(backend="cpu", num_mappers=1, num_reducers=1,
+                            artifact=True),
+                output_dir=out)
+    return out, dict(zip(ids, toks))
+
+
+def _wal_scratch_leak(idx: Path) -> list[str]:
+    """Staging debris a finished trial must not leave behind."""
+    leftovers = [p.name for p in idx.glob("*.tmp")]
+    segs = idx / "segments"
+    if segs.exists():
+        leftovers += [f"segments/{p.name}" for p in segs.iterdir()
+                      if p.name.startswith((".build_", ".fetch_"))]
+    return sorted(leftovers)
+
+
+def _wal_append(c: _ChaosClient, docs_dir: Path, truth: dict,
+                next_gid: int, rng: random.Random) -> int:
+    """One acknowledged append through the daemon; mutates truth."""
+    ids = list(range(next_gid, next_gid + rng.randrange(2, 4)))
+    paths, toks = _seg_write_docs(docs_dir, rng, ids)
+    r = c.rpc(id=f"a{next_gid}", op="append", files=paths)
+    if not r.get("ok"):
+        raise RuntimeError(f"append rejected: {r}")
+    for gid, words in zip(ids, toks):
+        truth[gid] = words
+    return ids[-1] + 1
+
+
+def _wal_delete(c: _ChaosClient, truth: dict, rng: random.Random,
+                *, expect_buffered: bool = False) -> None:
+    """One acknowledged delete through the daemon; mutates truth."""
+    victims = rng.sample(sorted(truth),
+                         min(rng.randrange(1, 3), len(truth)))
+    r = c.rpc(id=f"d{victims[0]}", op="delete", docs=victims)
+    if not r.get("ok"):
+        raise RuntimeError(f"delete rejected: {r}")
+    if expect_buffered and not r["result"].get("buffered"):
+        raise RuntimeError(f"expected a buffered ack, got {r}")
+    for gid in victims:
+        truth.pop(gid)
+
+
+def _wal_dirs_parity(a: Path, b: Path, truth: dict) -> str | None:
+    """Two live dirs must answer byte-identically (df, postings, BM25
+    floats included) — the primary-vs-replica oracle."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (  # noqa: E501
+        create_engine,
+    )
+
+    vocab = sorted({w for words in truth.values() for w in words})
+    rng = random.Random(0xBEEF)
+    eng_a = create_engine(str(a), None)
+    eng_b = create_engine(str(b), None)
+    try:
+        ba, bb = eng_a.encode_batch(vocab), eng_b.encode_batch(vocab)
+        if eng_a.df(ba).tolist() != eng_b.df(bb).tolist():
+            return "df divergence between primary and replica"
+        for t, pa, pb in zip(vocab, eng_a.postings(ba),
+                             eng_b.postings(bb)):
+            la = [] if pa is None else pa.tolist()
+            lb = [] if pb is None else pb.tolist()
+            if la != lb:
+                return f"postings divergence for {t!r}"
+        for _ in range(8):
+            q = rng.sample(vocab, min(rng.randrange(1, 4), len(vocab)))
+            got = eng_a.top_k_scored(eng_a.encode_batch(q), 5)
+            want = eng_b.top_k_scored(eng_b.encode_batch(q), 5)
+            if got != want:
+                return f"bm25 divergence for {q}: {got} != {want}"
+    finally:
+        eng_a.close()
+        eng_b.close()
+    return None
+
+
+def run_wal_trial(work_dir: Path, base: Path, base_truth: dict,
+                  seed: int, scenario: str,
+                  deadline_s: float = 120.0) -> dict:
+    """One seeded durability trial; ``ok`` False only on a contract
+    violation (a lost acknowledged mutation, divergent replica bytes,
+    failed byte-audit, leaked scratch, bad exit)."""
+    import shutil
+
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (  # noqa: E501
+        segments,
+    )
+
+    rng = random.Random(seed)
+    verdict = {"seed": seed, "scenario": scenario, "ok": False,
+               "outcome": "?"}
+    work = work_dir / f"wal-{seed}"
+    idx = work / "idx"
+    work.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(base, idx)
+    truth = {gid: list(words) for gid, words in base_truth.items()}
+    next_gid = max(truth) + 1
+    extra, env_extra = [], {}
+    if scenario == "sigkill-tombstone-flush":
+        env_extra["MRI_SEGMENT_TOMBSTONE_FLUSH"] = "4"
+    elif scenario == "lease-steal":
+        extra = ["--fault-spec", "lease-steal"]
+        env_extra["MRI_SEGMENT_LEASE_TTL_S"] = str(_WAL_LEASE_TTL_S)
+    elif scenario == "replica-partition":
+        extra = ["--fault-spec", "fetch-partial"]
+    t0 = time.monotonic()
+    try:
+        proc, addr = _spawn_daemon(idx, *extra, env_extra=env_extra)
+    except (RuntimeError, OSError, subprocess.TimeoutExpired) as e:
+        verdict["outcome"] = f"spawn-failed:{e}"
+        return verdict
+    killed = False
+    replica_dir = None
+    try:
+        c = _ChaosClient(addr, timeout=max(15.0, deadline_s / 2))
+        try:
+            err = None
+            if scenario == "kill-mid-compaction":
+                for _ in range(rng.randrange(2, 4)):
+                    next_gid = _wal_append(c, work / "docs", truth,
+                                           next_gid, rng)
+                _wal_delete(c, truth, rng)
+                # fire the compaction and SIGKILL the daemon inside the
+                # merge window; the WAL record was fsync'd before the
+                # merge started, so recovery replays the whole round.
+                # Compaction preserves ids, so truth is exact either way.
+                c.send(id="boom", op="compact", force=True)
+                time.sleep(rng.random() * 0.04)
+                proc.kill()
+                killed = True
+            elif scenario == "sigkill-tombstone-flush":
+                next_gid = _wal_append(c, work / "docs", truth,
+                                       next_gid, rng)
+                # 2-3 buffered deletes: acked + WAL-logged, but the
+                # MRI_SEGMENT_TOMBSTONE_FLUSH=4 threshold is never hit,
+                # so no tombstone generation publishes before the kill
+                for _ in range(rng.randrange(2, 4)):
+                    _wal_delete(c, truth, rng, expect_buffered=True)
+                proc.kill()
+                killed = True
+            elif scenario == "replica-partition":
+                next_gid = _wal_append(c, work / "docs", truth,
+                                       next_gid, rng)
+                _wal_delete(c, truth, rng)
+                replica_dir = work / "replica"
+                # first catch-up round eats the armed fetch-partial
+                # tear: the adler32 check must reject + refetch, never
+                # adopt a torn segment
+                segments.replicate(replica_dir, addr)
+                # the "partition": more acked mutations the replica
+                # does not see until its next round
+                next_gid = _wal_append(c, work / "docs", truth,
+                                       next_gid, rng)
+                res = segments.replicate(replica_dir, addr)
+                if res["behind"] <= 0:
+                    err = f"replica saw no lag to heal: {res}"
+                elif segments.replicate(replica_dir, addr)["changed"]:
+                    err = "third catch-up round was not a no-op"
+            else:  # lease-steal
+                ids = [next_gid]
+                paths, toks = _seg_write_docs(work / "docs", rng, ids)
+                r1 = c.rpc(id="steal", op="append", files=paths)
+                if r1.get("error") != "mutation_rejected" \
+                        or "lease_lost" not in r1.get("detail", ""):
+                    err = f"stolen lease did not reject: {r1}"
+                else:
+                    time.sleep(_WAL_LEASE_TTL_S + 0.3)
+                    r2 = c.rpc(id="retry", op="append", files=paths)
+                    if not r2.get("ok"):
+                        err = f"post-TTL retry rejected: {r2}"
+                    else:
+                        truth[ids[0]] = toks[0]
+                        next_gid = ids[0] + 1
+        except (OSError, RuntimeError, ValueError, KeyError) as e:
+            err = f"{type(e).__name__}: {e}"
+        finally:
+            c.close()
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        if killed:
+            proc.wait()
+            # roll the murdered primary forward; half the trials take
+            # the CLI path, half the library path — same code, both
+            # entrances proven
+            if rng.random() < 0.5:
+                cp = subprocess.run(
+                    [sys.executable, "-m",
+                     "parallel_computation_of_an_inverted_index_"
+                     "using_map_reduce_tpu", "recover", str(idx)],
+                    capture_output=True, text=True, timeout=60,
+                    cwd=str(REPO_ROOT),
+                    env=dict(os.environ, PYTHONPATH=str(REPO_ROOT),
+                             JAX_PLATFORMS="cpu"))
+                if cp.returncode != 0:
+                    verdict["outcome"] = f"recover-rc={cp.returncode}"
+                    verdict["error"] = cp.stderr[-2000:]
+                    return verdict
+                verdict["recover"] = json.loads(
+                    cp.stdout.strip().splitlines()[-1])
+            else:
+                verdict["recover"] = segments.recover(idx)
+        elif not _drain_to_zero(proc, verdict,
+                                timeout=max(10.0, deadline_s - (
+                                    time.monotonic() - t0))):
+            return verdict
+        leak = _wal_scratch_leak(idx)
+        if leak:
+            verdict["outcome"] = "SCRATCH-LEAK"
+            verdict["leftover"] = leak
+            return verdict
+        ok_verify, problems = verify_output_dir(idx)
+        if not ok_verify:
+            verdict["outcome"] = "BAD-AUDIT"
+            verdict["error"] = str(problems[:3])
+            return verdict
+        err = _seg_final_parity(idx, truth, work)
+        if err is None and replica_dir is not None:
+            err = _wal_dirs_parity(idx, replica_dir, truth)
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        verdict["generation"] = segments.load_manifest(idx).generation
+        verdict["live_docs"] = len(truth)
+        verdict["outcome"] = "clean"
+        verdict["ok"] = True
+        return verdict
+    finally:
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        proc.stdout.close()
+        proc.stderr.close()
+
+
+def run_wal_soak(work_dir: Path, trials: int, seed_base: int,
+                 deadline_s: float = 120.0, verbose: bool = True) -> dict:
+    """``trials`` seeded durability trials cycled over WAL_SCENARIOS.
+    Zero lost acknowledged mutations or the soak fails."""
+    work_dir.mkdir(parents=True, exist_ok=True)
+    base, base_truth = _wal_make_base(work_dir)
+    results = []
+    for t in range(trials):
+        scenario = WAL_SCENARIOS[t % len(WAL_SCENARIOS)]
+        v = run_wal_trial(work_dir, base, base_truth, seed_base + t,
+                          scenario, deadline_s=deadline_s)
+        results.append(v)
+        if verbose:
+            print(json.dumps(v, sort_keys=True), flush=True)
+        if v["outcome"] == "HANG":
+            break
+    failures = [v for v in results if not v["ok"]]
+    return {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "by_scenario": {s: sum(v["scenario"] == s and v["ok"]
+                               for v in results)
+                        for s in WAL_SCENARIOS},
+        "failures": failures,
+    }
+
+
+# -- scenario registry ---------------------------------------------------
+#
+# One queryable source of truth for what this harness can throw, so
+# `tools/chaos.py --list` answers "what do the soaks cover?" without
+# reading five docstrings.  Each entry: (mode, flag, description,
+# scenario/kind names).
+
+SCENARIO_REGISTRY = (
+    ("build", "(default)",
+     "seeded fault schedules vs the (K, M) plan matrix; byte-identity "
+     "or honestly-reported degradation",
+     faults.CHAOS_KINDS),
+    ("spill", "--spill",
+     "out-of-core tier armed on every build trial (tiny "
+     "MRI_BUILD_SPILL_BYTES budget) plus the spill fault kinds",
+     faults.SPILL_CHAOS_KINDS),
+    ("daemon", "--daemon",
+     "seeded scenarios vs a real `mri serve` subprocess; every request "
+     "answered exactly once, SIGTERM always drains to exit 0",
+     DAEMON_SCENARIOS),
+    ("segments", "--segments",
+     "concurrent append/delete/compact/query schedules with segment "
+     "fault kinds armed mid-trial; per-op --verify, final from-scratch "
+     "parity",
+     SEGMENT_FAULT_KINDS),
+    ("wal", "--wal",
+     "durability & replication: SIGKILL'd primaries recover every "
+     "acknowledged mutation via WAL replay, replicas converge to "
+     "byte-equal answers, stolen leases reject without corruption",
+     WAL_SCENARIOS),
+)
+
+
+def list_scenarios() -> str:
+    lines = []
+    for mode, flag, desc, names in SCENARIO_REGISTRY:
+        lines.append(f"{mode} {flag}")
+        lines.append(f"    {desc}")
+        for n in names:
+            lines.append(f"      - {n}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos soak: seeded fault schedules vs the (K, M) "
@@ -1068,13 +1404,40 @@ def main(argv=None) -> int:
                          "schedules with segment fault kinds armed "
                          "mid-trial, per-op --verify byte-audit, and a "
                          "final from-scratch parity check")
+    ap.add_argument("--wal", action="store_true",
+                    help="soak the durability & replication layer: "
+                         "SIGKILL'd primaries must recover every "
+                         "acknowledged mutation through WAL replay, "
+                         "replicas must converge to byte-equal answers "
+                         "(scenarios: " + ", ".join(WAL_SCENARIOS) + ")")
+    ap.add_argument("--list", action="store_true",
+                    help="print every soak mode and its scenario/fault-"
+                         "kind names, then exit")
     args = ap.parse_args(argv)
+    if args.list:
+        print(list_scenarios())
+        return 0
     if args.work_dir is None:
         import tempfile
 
         work = Path(tempfile.mkdtemp(prefix="mri-chaos-"))
     else:
         work = Path(args.work_dir)
+    work = work.resolve()
+    if args.wal:
+        if args.repro is not None:
+            t = args.repro - args.seed_base
+            scenario = WAL_SCENARIOS[t % len(WAL_SCENARIOS)]
+            work.mkdir(parents=True, exist_ok=True)
+            base, base_truth = _wal_make_base(work)
+            v = run_wal_trial(work, base, base_truth, args.repro,
+                              scenario, deadline_s=args.deadline)
+            print(json.dumps(v, sort_keys=True))
+            return 0 if v["ok"] else 1
+        summary = run_wal_soak(work, args.trials, args.seed_base,
+                               deadline_s=args.deadline)
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if not summary["failures"] else 1
     if args.segments:
         if args.repro is not None:
             work.mkdir(parents=True, exist_ok=True)

@@ -10,6 +10,8 @@ explicit ``# mrilint: allow(fault-boundary) reason``.
 
 Scope: package files only; ``faults.py`` itself is exempt (it IS the
 boundary), as are test hooks and the lint tooling outside the package.
+A small file allow-list covers modules that are *below* the boundary
+by contract — pure helpers with no retry decision to make.
 """
 from __future__ import annotations
 
@@ -21,6 +23,14 @@ RULE = "fault-boundary"
 
 _IO_TAILS = {"open", "socket", "create_connection", "makefile", "mmap"}
 _HOOK_MARKERS = ("faults", "policy", "retry")
+
+#: Modules exempt wholesale: policy-free leaf helpers whose callers own
+#: the fault boundary (checksum.py just hashes bytes — spill/manifest/
+#: artifact/WAL readers wrap it in their own verify-or-quarantine
+#: logic, which is where the hooks fire).
+_ALLOWED_FILES = frozenset({
+    PACKAGE + "/utils/checksum.py",
+})
 
 
 def _tail(fn: ast.AST) -> str | None:
@@ -35,6 +45,8 @@ def check(src: Source) -> list[Finding]:
     if not src.rel.startswith(PACKAGE + "/"):
         return []
     if src.rel.endswith("/faults.py"):
+        return []
+    if src.rel in _ALLOWED_FILES:
         return []
     findings: list[Finding] = []
     for node in ast.walk(src.tree):
