@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-wal test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-history capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-wal test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-cluster test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-cluster bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -125,6 +125,14 @@ test-obs:
 test-segments:
 	$(PY) -m pytest tests/ -q -m segments
 
+# scale-out serving suite (cluster/): corpus partitioner invariants,
+# D-way gather merge byte-identity (incl. the D in {1,2,4,8} fuzz vs a
+# monolithic build), router failover / hedging / deadline semantics
+# against live shard daemons; none are `slow`, so the default
+# `make test-fast` sweep runs them too
+test-cluster:
+	$(PY) -m pytest tests/ -q -m cluster
+
 # query-cost attribution suite: per-request EXPLAIN reports vs registry
 # counter parity (host/device/multi-segment), daemon explain + flight
 # recorder dumps, OpenMetrics exemplars, trace-coverage checker; none
@@ -210,6 +218,15 @@ bench-wal:
 # top --once --json` parity vs the raw ops -> BENCH_SLO_r14.json
 bench-slo:
 	$(PY) tools/bench_serve.py --slo-check
+
+# doc-sharded cluster A/B: monolithic engine vs D local shard daemons
+# behind the scatter-gather router at D=4/8 (pipelined + open-loop
+# Poisson ranked load, byte-parity gated vs the monolithic artifact,
+# hedged-vs-unhedged p99 under an injected slow shard)
+# -> BENCH_CLUSTER_r18.json; see tools/bench_serve.py for the
+# MRI_CLUSTER_BENCH_* knobs
+bench-cluster:
+	$(PY) tools/bench_serve.py --cluster-ab
 
 # print the cross-round BENCH_*.json trajectory table (ratios against
 # each round's own baseline); `--write` regenerates the README block

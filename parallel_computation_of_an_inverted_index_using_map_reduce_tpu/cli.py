@@ -78,6 +78,14 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
                                  mutation lease — the next mutation is
                                  rejected 'lease_lost' until the TTL
                                  expires
+  shard-dead:shard=0             router: the next send to shard 0
+                                 hits a reset connection (failover
+                                 retries another replica)
+  shard-slow:shard=1:ms=50       router: sends to shard 1 stall 50 ms
+                                 (the hedge path's test hook)
+  router-conn-reset:req=3        router: client connection 3 is reset
+                                 mid-stream (exactly-once: admitted
+                                 requests still answer or count)
   chaos:seed=5:n=3               sample 3 faults deterministically
                                  (bounds: windows= workers= reducers=
                                  docs= reqs= kinds=a,b,c)
@@ -167,6 +175,31 @@ serve mode (resident daemon; loads the artifact ONCE):
                                  = crash-safe hot reload of index.mri
                                  (a failed verification keeps the old
                                  artifact and counts reload_rejected)
+
+cluster mode (doc-sharded scale-out; see README "Cluster serving"):
+  mri-tpu shard LIST --shards 4 --out DIR [--mode size-balanced]
+                                 partition the corpus into 4 doc-
+                                 shards under DIR/shard-N, build each
+                                 with the unchanged --artifact path,
+                                 and stamp global BM25 stats into
+                                 per-shard sidecars; --verify byte-
+                                 checks every per-shard manifest
+  mri-tpu serve DIR/shard-N --listen ...   a shard daemon is a plain
+                                 serve daemon over the shard dir (the
+                                 sidecar makes it answer global ids)
+  mri-tpu router --shards h:1|h:2,h:3 --listen HOST:PORT
+                                 scatter-gather front-end: same JSON-
+                                 lines protocol, data ops fan out and
+                                 gather (D-way ranked merge); '|'
+                                 joins replicas of one shard — hedged
+                                 requests (MRI_CLUSTER_HEDGE_MS) and
+                                 failover ride per-replica health
+                                 probes (MRI_CLUSTER_HEALTH_MS);
+                                 answers are byte-identical to one
+                                 monolithic daemon over the same
+                                 corpus, BM25 floats included
+  mri-tpu top ROUTER:PORT        fleet view: the router's stats carry
+                                 per-shard replica health rows
 
 metrics mode (Prometheus text exposition; obs/ registry):
   mri-tpu metrics DIR            open DIR's artifact, print the engine
@@ -607,6 +640,161 @@ def _serve_main(argv: list[str]) -> int:
     return rc
 
 
+def _shard_main(argv: list[str]) -> int:
+    """``mri-tpu shard SRC --shards D --out DIR`` — partition a corpus
+    into D buildable doc-shards with global-BM25 sidecars
+    (cluster/partition.py)."""
+    p = argparse.ArgumentParser(
+        prog="mri-tpu shard",
+        description="partition a corpus manifest into D doc-shards, "
+                    "build each with the unchanged --artifact path, "
+                    "and stamp global BM25 stats into per-shard "
+                    "sidecars so a router over the shards answers "
+                    "byte-identically to a monolithic build")
+    p.add_argument("file_list", help="source corpus manifest (count "
+                                     "header then one path per line)")
+    p.add_argument("--shards", type=int, required=True, metavar="D",
+                   help="number of doc-shards (1 <= D <= corpus size)")
+    p.add_argument("--out", required=True, metavar="DIR",
+                   help="cluster directory; shard s builds into "
+                        "DIR/shard-s")
+    p.add_argument("--mode", choices=("round-robin", "size-balanced"),
+                   default="round-robin",
+                   help="doc assignment: round-robin by manifest "
+                        "position (default) or greedy size-balanced "
+                        "over file bytes")
+    p.add_argument("--mappers", type=int, default=1,
+                   help="per-shard build mapper count")
+    p.add_argument("--reducers", type=int, default=2,
+                   help="per-shard build reducer count")
+    p.add_argument("--verify", action="store_true",
+                   help="after building (or against an existing DIR), "
+                        "byte-verify every per-shard manifest and gid "
+                        "map against the recomputed assignment")
+    p.add_argument("--verify-only", action="store_true",
+                   help="skip the build; just verify DIR")
+    args = p.parse_args(argv)
+
+    from .cluster import partition as part_mod
+    try:
+        if not args.verify_only:
+            cluster = part_mod.partition(
+                args.file_list, args.shards, args.out,
+                mode=args.mode, mappers=args.mappers,
+                reducers=args.reducers,
+                progress=lambda msg: print(
+                    json.dumps({"event": "progress", "detail": msg}),
+                    flush=True))
+            print(json.dumps({"event": "partitioned", **cluster},
+                             sort_keys=True), flush=True)
+        if args.verify or args.verify_only:
+            summary = part_mod.verify(args.file_list, args.out)
+            print(json.dumps({"event": "verified", **summary},
+                             sort_keys=True), flush=True)
+    except part_mod.PartitionError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def _router_main(argv: list[str]) -> int:
+    """``mri-tpu router --shards SPEC --listen HOST:PORT`` — the
+    scatter-gather front-end over shard daemons (cluster/router.py).
+    Blocks until drained by SIGTERM/SIGINT, mirroring 'serve'."""
+    import signal
+    import threading
+
+    p = argparse.ArgumentParser(
+        prog="mri-tpu router",
+        description="scatter-gather router over doc-shard serve "
+                    "daemons: same JSON-lines protocol as 'serve', "
+                    "data ops fan out to every shard and gather "
+                    "through a D-way merge; hedged requests and "
+                    "replica failover ride shard health")
+    p.add_argument("--shards", required=True, metavar="SPEC",
+                   help="shard endpoints: shards joined by ',', "
+                        "replicas of one shard joined by '|' — "
+                        "'h:1|h:2,h:3' is two shards, the first with "
+                        "two replicas")
+    p.add_argument("--listen", default="127.0.0.1:0", metavar="HOST:PORT",
+                   help="bind address (port 0 = ephemeral; the chosen "
+                        "port is printed in the 'listening' JSON line)")
+    p.add_argument("--hedge-ms", type=float, default=None,
+                   help="hedge delay: -1 adaptive shard p95 (default, "
+                        "from MRI_CLUSTER_HEDGE_MS), 0 off, >0 fixed ms")
+    p.add_argument("--fault-spec", default=None,
+                   help="arm the deterministic fault injector "
+                        "(cluster kinds: shard-dead/shard-slow/"
+                        "router-conn-reset) — test/bench only")
+    args = p.parse_args(argv)
+
+    from .obs import logging as obs_logging
+    obs_logging.configure()
+
+    if args.fault_spec is not None:
+        try:
+            faults.install(args.fault_spec)
+        except faults.FaultSpecError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    from .cluster import router as router_mod
+    try:
+        shard_addrs = router_mod.parse_shard_arg(args.shards)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    host, _, port_s = args.listen.rpartition(":")
+    try:
+        port = int(port_s)
+        if not host or not (0 <= port <= 65535):
+            raise ValueError
+    except ValueError:
+        print(f"error: --listen must be HOST:PORT, got {args.listen!r}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        router = router_mod.RouterDaemon(shard_addrs, host, port,
+                                         hedge_ms=args.hedge_ms)
+    except ValueError as e:
+        # covers construction-time knob reads (KnobError, e.g. a bad
+        # $MRI_CLUSTER_HEDGE_MS) — the one-line exit-2 contract
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        router.start()
+    except OSError as e:
+        print(f"error: cannot listen on {args.listen}: {e}",
+              file=sys.stderr)
+        return 2
+
+    stop = threading.Event()
+
+    def _on_stop_signal(signum, frame):
+        if stop.is_set():
+            # mrilint: allow(exit-code) the one sanctioned exit-1 path
+            os._exit(1)
+        stop.set()
+
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, _on_stop_signal)
+        signal.signal(signal.SIGINT, _on_stop_signal)
+
+    bound_host, bound_port = router.address
+    print(json.dumps({"event": "listening", "host": bound_host,
+                      "port": bound_port, "pid": os.getpid(),
+                      "shards": len(shard_addrs),
+                      "replicas": [len(r) for r in shard_addrs]}),
+          flush=True)
+    while not stop.is_set():
+        stop.wait(0.2)
+    rc = router.drain()
+    print(json.dumps({"event": "drained",
+                      "counters": router.final_stats["counters"]},
+                     sort_keys=True), flush=True)
+    return rc
+
+
 def _metrics_main(argv: list[str]) -> int:
     """``mri-tpu metrics TARGET`` — Prometheus text exposition.
 
@@ -813,6 +1001,24 @@ def _top_render(target: str, sample: dict) -> str:
                          f"{_top_num(pt.get('ratio')):>12}"
                          f"{_top_num(pt.get('burn')):>10}"
                          f"{_top_num(pt.get('total')):>10}")
+    cluster = st.get("cluster") or {}
+    if cluster.get("shards"):
+        # router target: one fleet row per replica, all from the same
+        # single pipelined stats poll — no extra connections
+        lines.append("")
+        lines.append(f"{'shard':<8}{'replica':<22}{'state':<10}"
+                     f"{'p95 ms':>10}  reasons")
+        for sh in cluster["shards"]:
+            p95 = sh.get("p95_ms")
+            for rep in sh.get("replicas") or []:
+                state = "ready" if rep.get("ready") else "DOWN"
+                if rep.get("primary"):
+                    state += "*"
+                why = ",".join(rep.get("reasons") or []) or "-"
+                lines.append(
+                    f"{sh.get('shard', '?'):<8}"
+                    f"{rep.get('addr', '?'):<22}{state:<10}"
+                    f"{_top_num(p95):>10}  {why}")
     lines.append("")
     nonzero = "  ".join(f"{k}={v}" for k, v in counters.items() if v)
     lines.append("counters: " + (nonzero or "-"))
@@ -1052,6 +1258,10 @@ def main(argv: list[str] | None = None) -> int:
         return _query_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "shard":
+        return _shard_main(argv[1:])
+    if argv and argv[0] == "router":
+        return _router_main(argv[1:])
     if argv and argv[0] == "metrics":
         return _metrics_main(argv[1:])
     if argv and argv[0] == "flightdump":

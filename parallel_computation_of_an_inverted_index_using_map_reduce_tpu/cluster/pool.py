@@ -1,0 +1,405 @@
+"""Persistent pipelined replica connections + per-shard failover state.
+
+One :class:`ReplicaConn` per (shard, replica) endpoint: a single
+long-lived socket carrying many concurrent RPCs, correlated by ``id``
+(the daemon echoes it).  RPC ids come from ONE process-global counter,
+so the same encoded request line can be scattered verbatim to every
+shard — the router JSON-encodes each client query once, not D times.
+
+Threading: each live connection owns a reader thread (parse + resolve
+callbacks) and a writer thread draining a deque with one batched
+``sendall`` per wakeup — pipelined senders amortize syscalls exactly
+like the daemon's writer.  Any socket error condemns the connection:
+every pending callback is resolved with ``None`` (the
+connection-death sentinel) and the owning :class:`ShardClient` marks
+the replica down, which is what the router's failover keys off.
+
+:class:`ShardClient` holds one shard's replica set: health state fed
+by the router's healthz prober, the current primary, and a rolling
+latency reservoir whose p95 drives adaptive hedging (hedge.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import socket
+import threading
+import time
+from collections import deque
+
+from .. import faults
+
+log = logging.getLogger("mri_tpu.cluster")
+
+#: PR 14 readiness reasons that must push traffic off a replica even
+#: though its TCP endpoint still answers.
+NOT_READY_REASONS = ("draining", "stalled", "overloaded",
+                    "replica_lagging", "reloading")
+
+_rpc_ids = itertools.count(1)
+
+
+def next_rpc_id() -> int:
+    """Process-global RPC id (``next`` on a count is atomic under the
+    GIL) — unique across every replica connection, so one encoded
+    request line is valid on all of them simultaneously."""
+    return next(_rpc_ids)
+
+
+class ConnDead(Exception):
+    """The replica connection is gone (send refused or torn)."""
+
+
+class ReplicaConn:
+    """One pipelined JSON-lines connection to a shard replica."""
+
+    def __init__(self, shard: int, replica: int, addr: tuple,
+                 on_dead=None, connect_timeout: float = 5.0):
+        self.shard = shard
+        self.replica = replica
+        self.addr = addr
+        self._on_dead = on_dead
+        # mrilint: allow(fault-boundary) router->shard dial, not corpus I/O; cluster faults inject at send (shard-slow/router-conn-reset) and by killing real daemons (shard-dead)
+        self.sock = socket.create_connection(addr,
+                                             timeout=connect_timeout)
+        self.sock.settimeout(None)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # mrilint: allow(fault-boundary) read framing on the same router->shard RPC socket
+        self._rfile = self.sock.makefile("rb")
+        self._pending: dict[int, object] = {}  # guarded by: self._lock
+        self._lock = threading.Lock()
+        self._outq: deque[bytes] = deque()  # guarded by: self._out_cv
+        self._out_cv = threading.Condition()
+        self.dead = False
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"mri-router-read-s{shard}r{replica}")
+        self._writer = threading.Thread(
+            target=self._write_loop, daemon=True,
+            name=f"mri-router-write-s{shard}r{replica}")
+        self._reader.start()
+        self._writer.start()
+
+    def send(self, rpc_id: int, data: bytes, cb) -> None:
+        """Register ``cb(payload)`` for ``rpc_id`` and enqueue one
+        encoded request line.  Raises :class:`ConnDead` when the
+        connection is already condemned; a death AFTER enqueue resolves
+        the callback with ``None`` instead."""
+        inj = faults.active()
+        if inj is not None:
+            try:
+                inj.on_router_send(self.shard, self.replica)
+            except faults.InjectedConnReset:
+                self._fail()
+                raise ConnDead(
+                    f"shard {self.shard} replica {self.replica}: "
+                    "injected connection reset") from None
+        with self._lock:
+            if self.dead:
+                raise ConnDead(
+                    f"shard {self.shard} replica {self.replica} "
+                    f"({self.addr[0]}:{self.addr[1]}): connection down")
+            self._pending[rpc_id] = cb
+        with self._out_cv:
+            self._outq.append(data)
+            self._out_cv.notify()
+
+    def forget(self, rpc_id: int) -> None:
+        """Drop the callback for an RPC the caller no longer wants
+        (deadline passed, hedge already won).  A late response is then
+        discarded by the reader."""
+        with self._lock:
+            self._pending.pop(rpc_id, None)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._out_cv:
+                while not self._outq and not self.dead:
+                    self._out_cv.wait()
+                if self.dead and not self._outq:
+                    return
+                chunk = b"".join(self._outq)
+                self._outq.clear()
+            try:
+                self.sock.sendall(chunk)
+            except OSError:
+                self._fail()
+                return
+
+    def _read_loop(self) -> None:
+        try:
+            for raw in self._rfile:
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    log.warning("shard %d replica %d: undecodable "
+                                "response line dropped", self.shard,
+                                self.replica)
+                    continue
+                rid = payload.get("id") if isinstance(payload, dict) \
+                    else None
+                if rid is None:
+                    continue  # unsolicited (id-less bad_request echo)
+                with self._lock:
+                    cb = self._pending.pop(rid, None)
+                if cb is not None:
+                    cb(payload)
+        except OSError:
+            pass
+        self._fail()
+
+    def _fail(self) -> None:
+        """Condemn the connection once: close, fail every pending RPC
+        with the ``None`` death sentinel, notify the owner."""
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+            orphans = list(self._pending.values())
+            self._pending.clear()
+        with self._out_cv:
+            self._out_cv.notify_all()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        # the makefile handle holds the fd's last reference — close it
+        # too or the socket outlives the condemned connection
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self._on_dead is not None:
+            self._on_dead(self)
+        for cb in orphans:
+            try:
+                cb(None)
+            except Exception:
+                log.exception("rpc callback failed on connection death")
+
+    def close(self) -> None:
+        self._fail()
+
+
+class _P95Ring:
+    """Fixed-size latency reservoir; p95 recomputed every few inserts
+    (a 128-float sort is cheap, per-RPC would still be waste)."""
+
+    def __init__(self, size: int = 128, refresh: int = 16):
+        self._buf: list[float] = []
+        self._size = size
+        self._refresh = refresh
+        self._i = 0
+        self._n = 0
+        self._p95: float | None = None
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            if len(self._buf) < self._size:
+                self._buf.append(seconds)
+            else:
+                self._buf[self._i] = seconds
+                self._i = (self._i + 1) % self._size
+            self._n += 1
+            if self._n % self._refresh == 0 or self._p95 is None:
+                s = sorted(self._buf)
+                self._p95 = s[min(len(s) - 1,
+                                  int(0.95 * (len(s) - 1) + 0.5))]
+
+    def p95(self) -> float | None:
+        with self._lock:
+            return self._p95
+
+
+class Replica:
+    """Health + connection state for one endpoint of one shard."""
+
+    def __init__(self, shard: int, idx: int, addr: tuple):
+        self.shard = shard
+        self.idx = idx
+        self.addr = addr
+        self.conn: ReplicaConn | None = None  # guarded by: self.lock
+        self.lock = threading.Lock()
+        self.ready = False   # last healthz verdict
+        self.reasons: list = ["unprobed"]
+        self.last_probe = 0.0
+
+    def describe(self) -> dict:
+        return {"addr": f"{self.addr[0]}:{self.addr[1]}",
+                "ready": self.ready,
+                "reasons": list(self.reasons)}
+
+
+class ShardClient:
+    """One doc-shard's replica set, as the router sees it."""
+
+    def __init__(self, shard: int, addrs: list):
+        self.shard = shard
+        self.replicas = [Replica(shard, i, a)
+                         for i, a in enumerate(addrs)]
+        self.primary = 0  # guarded by: self._lock
+        self._lock = threading.Lock()
+        self.latency = _P95Ring()
+
+    def conn(self, ri: int) -> ReplicaConn:
+        """The live connection for replica ``ri``, dialing on demand.
+        Raises :class:`ConnDead` when the endpoint refuses."""
+        rep = self.replicas[ri]
+        with rep.lock:
+            c = rep.conn
+            if c is not None and not c.dead:
+                return c
+            try:
+                c = ReplicaConn(self.shard, ri, rep.addr,
+                                on_dead=self._conn_died)
+            except OSError as e:
+                raise ConnDead(
+                    f"shard {self.shard} replica {ri} "
+                    f"({rep.addr[0]}:{rep.addr[1]}): {e}") from e
+            rep.conn = c
+            return c
+
+    def _conn_died(self, conn: ReplicaConn) -> None:
+        rep = self.replicas[conn.replica]
+        rep.ready = False
+        rep.reasons = ["connection_lost"]
+
+    def pick(self, exclude: tuple = ()) -> int:
+        """Replica to try next: the primary when it is ready, else the
+        first ready replica (and that becomes the new primary — a
+        health-based failover the router counts), else any non-excluded
+        endpoint as a last resort.  -1 when nothing is left."""
+        with self._lock:
+            p = self.primary
+            if p not in exclude and self.replicas[p].ready:
+                return p
+            for r in self.replicas:
+                if r.idx not in exclude and r.ready:
+                    self.primary = r.idx
+                    return r.idx
+            for r in self.replicas:
+                if r.idx not in exclude:
+                    return r.idx
+        return -1
+
+    def hedge_pick(self, primary_ri: int) -> int:
+        """A DIFFERENT ready replica for the hedge RPC (-1 if none)."""
+        for r in self.replicas:
+            if r.idx != primary_ri and r.ready:
+                return r.idx
+        return -1
+
+    def ready_count(self) -> int:
+        return sum(1 for r in self.replicas if r.ready)
+
+    def describe(self) -> dict:
+        with self._lock:
+            primary = self.primary
+        reps = []
+        for r in self.replicas:
+            d = r.describe()
+            d["primary"] = r.idx == primary
+            reps.append(d)
+        p95 = self.latency.p95()
+        return {"shard": self.shard,
+                "p95_ms": round(p95 * 1e3, 3) if p95 is not None
+                          else None,
+                "replicas": reps}
+
+    def close(self) -> None:
+        for r in self.replicas:
+            with r.lock:
+                c, r.conn = r.conn, None
+            if c is not None:
+                c.close()
+
+
+class HealthProber:
+    """One thread probing every replica of every shard with pipelined
+    ``healthz`` RPCs at a fixed cadence, updating replica readiness
+    from PR 14's ``ready``/``reasons`` verdict.  An unanswered probe
+    (connection death, or no reply within two cadences) marks the
+    replica down; the next cycle re-dials through ``ShardClient.conn``.
+    """
+
+    def __init__(self, shards: list, interval_s: float,
+                 on_transition=None):
+        self.shards = shards
+        self.interval_s = interval_s
+        self._on_transition = on_transition
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mri-router-health")
+
+    def start(self) -> None:
+        self._probe_all(first=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._probe_all()
+
+    def _probe_all(self, first: bool = False) -> None:
+        now = time.monotonic()
+        for sc in self.shards:
+            for rep in sc.replicas:
+                self._probe(sc, rep, now, first)
+
+    def _probe(self, sc: ShardClient, rep: Replica, now: float,
+               first: bool) -> None:
+        def _verdict(payload, rep=rep, sc=sc):
+            was = rep.ready
+            if payload is None:
+                rep.ready = False
+                rep.reasons = ["connection_lost"]
+            else:
+                rep.ready = bool(payload.get("ready"))
+                rep.reasons = list(payload.get("reasons") or ())
+            rep.last_probe = time.monotonic()
+            if was != rep.ready and self._on_transition is not None:
+                self._on_transition(sc, rep, was)
+
+        # a probe two cadences old means the endpoint is wedged (alive
+        # TCP, no answers): treat as down until it speaks again
+        if rep.ready and rep.last_probe \
+                and now - rep.last_probe > 3 * self.interval_s:
+            was = rep.ready
+            rep.ready = False
+            rep.reasons = ["probe_timeout"]
+            if was and self._on_transition is not None:
+                self._on_transition(sc, rep, was)
+        rid = next_rpc_id()
+        line = (json.dumps({"id": rid, "op": "healthz"},
+                           separators=(",", ":")) + "\n").encode()
+        try:
+            conn = sc.conn(rep.idx)
+            conn.send(rid, line, _verdict)
+        except ConnDead:
+            _verdict(None)
+            return
+        if first:
+            # synchronous first round so the router starts with real
+            # readiness instead of an all-down fleet
+            deadline = time.monotonic() + max(1.0, self.interval_s)
+            while rep.last_probe == 0.0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)

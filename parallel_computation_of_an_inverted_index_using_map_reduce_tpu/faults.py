@@ -54,6 +54,18 @@ Three layers live here:
         lease-steal                  replica: the primary's lease is
                                      rewritten to a foreign owner once
                                      (next mutation rejects lease_lost)
+        shard-dead:shard=1           cluster router: shard 1's next
+                                     RPC send dies with a connection
+                                     reset (omit shard= for any shard;
+                                     the router must fail over)
+        shard-slow:shard=2:ms=50     cluster router: shard 2's next
+                                     send stalls ms before the write
+                                     (the hedging trigger; replica=K
+                                     pins either cluster kind to one
+                                     replica of the shard)
+        router-conn-reset:req=3      cluster router: the client
+                                     connection carrying data request
+                                     3 is dropped before its answer
         chaos:seed=5:n=3             sample 3 faults from a seeded RNG
         seed=7                       RNG seed for ``p=`` rules
 
@@ -175,6 +187,14 @@ class InjectedCompactCrash(RuntimeError):
     directory no manifest references — what a real crash leaves."""
 
 
+class InjectedConnReset(ConnectionError):
+    """Injected cluster connection loss (``shard-dead`` /
+    ``router-conn-reset`` rules).  A ConnectionError on purpose: the
+    router's replica pool handles it through the same OSError path a
+    real RST takes, so failover is proven against production code,
+    not a parallel test-only branch."""
+
+
 class InjectedWalTorn(RuntimeError):
     """Injected WAL append tear (``wal-torn-record`` rule): the record
     bytes were truncated mid-payload and the fsync never ran, so the
@@ -196,6 +216,7 @@ _SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
 _SEGMENT_KINDS = ("append-torn-manifest", "compact-crash",
                   "tombstone-corrupt")
 _WAL_KINDS = ("wal-torn-record", "fetch-partial", "lease-steal")
+_CLUSTER_KINDS = ("shard-dead", "shard-slow", "router-conn-reset")
 
 #: What ``chaos:`` may sample by default — every kind the parallel host
 #: path recovers from in-run (sigkill is excluded: its story is the
@@ -228,6 +249,12 @@ SPILL_CHAOS_KINDS = ("spill-corrupt", "merge-crash")
 #: Named-only like the other serve-side families.
 WAL_CHAOS_KINDS = _WAL_KINDS
 
+#: What ``chaos:kinds=...`` may name for cluster soaks — the router's
+#: fault points (a shard replica's connection dying or stalling, a
+#: router client connection reset).  Named-only: they only fire inside
+#: a router process.
+CLUSTER_CHAOS_KINDS = _CLUSTER_KINDS
+
 
 @dataclasses.dataclass
 class _Rule:
@@ -243,6 +270,7 @@ class _Rule:
     save: int = 0               # ckpt-corrupt
     spill: int = 0              # spill-corrupt: 1-based run-file ordinal
     shard: int | None = None    # merge-crash (None = any shard)
+    replica: int | None = None  # cluster kinds (None = any replica)
     worker: int | None = None   # worker-death (None = any worker)
     reducer: int | None = None  # reducer-death (None = any reducer)
     silent: int = 0             # scan-error: 1 = drop window, no raise
@@ -282,7 +310,7 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         return None
     rule = _Rule(kind=head)
     if head not in (_READ_KINDS + _DEATH_KINDS + _SERVE_KINDS
-                    + _SEGMENT_KINDS + _WAL_KINDS):
+                    + _SEGMENT_KINDS + _WAL_KINDS + _CLUSTER_KINDS):
         raise FaultSpecError(f"unknown fault kind {head!r}")
     for field in parts[1:]:
         if field == "all":
@@ -316,6 +344,8 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             rule.spill = _parse_int(head, k, v)
         elif k == "shard":
             rule.shard = _parse_int(head, k, v)
+        elif k == "replica":
+            rule.replica = _parse_int(head, k, v)
         elif k == "worker":
             rule.worker = _parse_int(head, k, v)
         elif k == "reducer":
@@ -343,12 +373,12 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             bad = [s for s in kinds
                    if s not in (CHAOS_KINDS + SERVE_CHAOS_KINDS
                                 + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS
-                                + WAL_CHAOS_KINDS)]
+                                + WAL_CHAOS_KINDS + CLUSTER_CHAOS_KINDS)]
             if bad:
                 raise FaultSpecError(
                     f"chaos: kinds not samplable: {bad} "
                     f"(choose from "
-                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS + WAL_CHAOS_KINDS)})")
+                    f"{list(CHAOS_KINDS + SERVE_CHAOS_KINDS + SEGMENT_CHAOS_KINDS + SPILL_CHAOS_KINDS + WAL_CHAOS_KINDS + CLUSTER_CHAOS_KINDS)})")
             rule.kinds = kinds
         else:
             raise FaultSpecError(f"{head}: unknown key {k!r}")
@@ -366,6 +396,10 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         raise FaultSpecError(f"{head} needs req=N (1-based)")
     if rule.kind == "slow-client" and rule.ms <= 0:
         rule.ms = 50.0
+    if rule.kind == "shard-slow" and rule.ms <= 0:
+        rule.ms = 20.0
+    if rule.kind == "router-conn-reset" and rule.req < 1:
+        raise FaultSpecError("router-conn-reset needs req=N (1-based)")
     if rule.kind == "dispatcher-hang" and rule.ms <= 0:
         rule.ms = 500.0
     if rule.kind == "chaos":
@@ -426,6 +460,15 @@ def _sample_chaos(rule: _Rule) -> list[_Rule]:
             # any-shard: fires on whichever merger reaches it first,
             # so the takeover is guaranteed to be exercised
             out.append(_Rule(kind=kind))
+        elif kind == "shard-dead":
+            # any-shard: fires on whichever scatter send reaches it
+            # first, so the failover is guaranteed to be exercised
+            out.append(_Rule(kind=kind))
+        elif kind == "shard-slow":
+            out.append(_Rule(kind=kind,
+                             ms=float(rng.choice((20, 50, 100)))))
+        elif kind == "router-conn-reset":
+            out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs)))
         elif kind in _SEGMENT_KINDS + _WAL_KINDS:
             # no ordinal to pick: each fires once, on the next matching
             # segment mutation / fetch / lease check (times=1 default)
@@ -699,6 +742,52 @@ class FaultInjector:
         if delay:
             time.sleep(delay)
         return drop
+
+    def on_router_send(self, shard: int, replica: int) -> None:
+        """Fires in the cluster router as an RPC is handed to the
+        connection for ``(shard, replica)``.  ``shard-dead`` (matching
+        ``shard=K`` or any-shard) raises :class:`InjectedConnReset`,
+        which the replica pool handles exactly like a real RST —
+        condemn the connection, fail its pending RPCs, let the router
+        fail over.  ``shard-slow`` sleeps ``ms`` here, outside the
+        injector lock, stalling only this shard's sends (the hedging
+        trigger)."""
+        delay = 0.0
+        dead = False
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.shard is not None and rule.shard != shard:
+                    continue
+                if rule.replica is not None and rule.replica != replica:
+                    continue
+                if rule.kind == "shard-dead":
+                    if self._fire_once(ri, rule):
+                        dead = True
+                elif rule.kind == "shard-slow":
+                    if self._fire_once(ri, rule):
+                        delay = max(delay, rule.ms / 1e3)
+        if delay:
+            time.sleep(delay)
+        if dead:
+            raise InjectedConnReset(
+                f"injected shard-dead: shard {shard} replica {replica} "
+                "(fault spec)")
+
+    def on_router_client(self, req: int) -> bool:
+        """Fires in the router as data request ``req`` (1-based global
+        ordinal) is admitted; True means the client connection must be
+        dropped as if the peer's NAT sent an RST (``router-conn-reset``
+        rule).  The chaos soak uses it to prove a torn client sees
+        either no answer or one answer — never two."""
+        if req < 1:
+            return False
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "router-conn-reset" or rule.req != req:
+                    continue
+                if self._fire_once(ri, rule):
+                    return True
+        return False
 
     def on_dispatch_batch(self) -> None:
         """Fires in the serve daemon's dispatcher thread as it picks up

@@ -349,6 +349,28 @@ KNOWN_METRICS = (
      "Fault-injection rules fired, all kinds."),
     ("mri_fault_<kind>_fired_total", "counter",
      "Fault-injection firings of one kind (read_error, ...)."),
+    # scale-out cluster (router registry: the admission plane reuses
+    # the mri_serve_* families above — the router is a serve-plane
+    # daemon, so SLO/windows/top math applies unchanged — while shard
+    # families arrive in the router scrape labelled
+    # {shard="K",replica="R"} via merge_expositions label injection)
+    ("mri_cluster_shards", "gauge",
+     "Doc-shards the router scatters every data op to."),
+    ("mri_cluster_replicas_ready", "gauge",
+     "Replica endpoints whose last health probe answered ready."),
+    ("mri_router_scatter_rpcs_total", "counter",
+     "Shard RPCs issued by scatter fan-out (hedges/retries included)."),
+    ("mri_cluster_hedges_total", "counter",
+     "Hedge RPCs fired after MRI_CLUSTER_HEDGE_MS (or the shard's "
+     "rolling p95) with no primary answer."),
+    ("mri_cluster_hedge_wins_total", "counter",
+     "Hedged shard RPCs the hedge replica answered first."),
+    ("mri_cluster_failovers_total", "counter",
+     "Shard RPCs re-routed to another replica after a connection "
+     "failure or a not-ready health probe."),
+    ("mri_cluster_shard_errors_total", "counter",
+     "Shard RPC failures (connection loss / error responses) the "
+     "router observed before any retry."),
 )
 
 _HELP = {name: help for name, _kind, help in KNOWN_METRICS}
@@ -452,18 +474,46 @@ class Registry:
         return out
 
 
-def merge_expositions(parts) -> str:
-    """Concatenate text expositions, dropping later duplicate metric
-    families by name (first occurrence wins).  Several registries can
-    legitimately carry the same family — e.g. the serve daemon's own
-    registry and a multi-segment engine's both track
-    ``mri_generation`` — but one exposition must name each family
-    exactly once."""
+def _label_sample(line: str, label_txt: str) -> str:
+    """Inject a rendered label set into one sample line, preserving
+    existing labels (histogram ``le``) and any exemplar suffix."""
+    head, sep, ex = line.partition(" # ")
+    try:
+        body, val = head.rsplit(" ", 1)
+    except ValueError:
+        return line
+    if body.endswith("}"):
+        body = body[:-1] + "," + label_txt + "}"
+    else:
+        body = body + "{" + label_txt + "}"
+    return body + " " + val + (sep + ex if sep else "")
+
+
+def merge_expositions(parts, labels=None) -> str:
+    """Concatenate text expositions into one legal exposition.
+
+    Unlabelled parts keep the historical semantics: later duplicate
+    metric families are dropped by name (first occurrence wins).
+    Several registries can legitimately carry the same family — e.g.
+    the serve daemon's own registry and a multi-segment engine's both
+    track ``mri_generation`` — but one exposition must name each
+    family's ``# HELP``/``# TYPE`` exactly once.
+
+    ``labels`` (optional, parallel to ``parts``) maps a part to a
+    label dict (or None) injected into every one of its sample lines —
+    the scatter-gather router merges its own registry with D shard
+    scrapes whose families all collide, so per-part ``{shard="K"}``
+    labels keep every series while HELP/TYPE stay deduplicated.
+    """
     seen: set[str] = set()
     out: list[str] = []
-    for text in parts:
+    for pi, text in enumerate(parts):
         if not text:
             continue
+        part_labels = labels[pi] if labels is not None else None
+        label_txt = ",".join(
+            f'{k}="{v}"' for k, v in part_labels.items()) \
+            if part_labels else ""
         keep = True
         for line in text.splitlines():
             if line.startswith(("# HELP ", "# TYPE ")):
@@ -474,7 +524,14 @@ def merge_expositions(parts) -> str:
                 else:
                     # HELP precedes TYPE: peek whether its family is new
                     keep = name not in seen
-            if keep:
+                if keep:
+                    out.append(line)
+                continue
+            if label_txt:
+                # labelled samples always survive — the labels are the
+                # disambiguation — only their HELP/TYPE dedups above
+                out.append(_label_sample(line, label_txt))
+            elif keep:
                 out.append(line)
     return "\n".join(out) + "\n" if out else ""
 

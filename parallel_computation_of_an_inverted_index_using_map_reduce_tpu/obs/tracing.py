@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 from collections import deque
 
@@ -40,9 +41,17 @@ def ring_capacity() -> int:
     return envknobs.get(RING_ENV)
 
 
+#: seeded once from the OS, stepped in C thereafter: trace ids are
+#: collision-avoidance for a bounded ring, not secrets, and a
+#: getrandom(2) syscall per request dominates the serve loop's serial
+#: read path on slow-entropy hosts.  getrandbits is a single C call,
+#: so concurrent callers are safe under the GIL.
+_trace_rng = random.Random(os.urandom(16))
+
+
 def gen_trace_id() -> str:
     """16 hex chars, collision-safe for a ring of recent traces."""
-    return os.urandom(8).hex()
+    return f"{_trace_rng.getrandbits(64):016x}"
 
 
 class TraceRing:

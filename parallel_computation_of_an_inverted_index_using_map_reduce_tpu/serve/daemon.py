@@ -1052,6 +1052,37 @@ class ServeDaemon:
                             self._count("internal_errors")
                             self._finish(it, {"error": "internal",
                                               "detail": str(e)})
+            # ranked groups: a router fanning one client's pipelined
+            # BM25 queries across shards lands same-k bursts here — one
+            # top_k_scored_batch call crosses into the native kernel
+            # once for the whole group.  Solo requests keep the
+            # per-query path (planner trace detail rides it), and
+            # explain requests always run solo for honest attribution.
+            ranked = [it for it in ready
+                      if not it.done and not it.explain
+                      and it.op == "top_k" and it.score == "bm25"]
+            batcher = getattr(eng, "top_k_scored_batch", None)
+            if len(ranked) > 1 and batcher is not None:
+                by_k: dict[int, list] = {}
+                for it in ranked:
+                    by_k.setdefault(it.k, []).append(it)
+                for k, group in by_k.items():
+                    if len(group) < 2:
+                        continue
+                    try:
+                        tops = batcher(
+                            [eng.encode_batch(it.terms)
+                             for it in group], k)
+                        for it, top in zip(group, tops):
+                            self._finish(it, {
+                                "ok": True,
+                                "docs": [[d, s] for d, s in top]})
+                    except Exception as e:
+                        for it in group:
+                            if not it.done:
+                                self._count("internal_errors")
+                                self._finish(it, {"error": "internal",
+                                                  "detail": str(e)})
             for it in ready:
                 if it.done:
                     continue

@@ -1390,6 +1390,15 @@ def create_engine(path, engine: str | None = None, *,
     applies to the device engine's batch-dimension mesh.
     """
     which = resolve_engine(engine)
+    from ..cluster import shard as cluster_shard
+    if cluster_shard.has_sidecar(path):
+        if which == "device":
+            raise artifact_mod.ArtifactError(
+                f"{path} is a cluster shard (cluster_shard.json "
+                "present): the device engine serves plain artifacts "
+                "only (use host or auto, which route to the shard "
+                "engine)")
+        return cluster_shard.ShardEngine(path, cache_terms=cache_terms)
     if artifact_mod.is_segment_managed(path):
         if which == "device":
             raise artifact_mod.ArtifactError(

@@ -48,6 +48,42 @@ from ..segments import manifest as seg_manifest
 from ..segments import tombstones as tomb_mod
 
 
+def merge_ranked(per_part, k: int) -> list[tuple[int, float]]:
+    """Gather a ranked answer from per-part candidate lists.
+
+    Each part is a list of ``(-score, global_doc_id)`` pairs already
+    sorted ascending — i.e. best-first by ``(-score, gid)``, the
+    single-engine tie order.  A D-way :func:`heapq.merge` pops exactly
+    ``k`` winners without materializing the rest; parts may be empty.
+    Shared by :class:`MultiSegmentEngine` (parts = segments) and the
+    cluster router (parts = doc-shards answering over TCP).
+    """
+    out: list[tuple[int, float]] = []
+    if k <= 0:
+        return out
+    for neg, gid in heapq.merge(*per_part):
+        out.append((gid, -neg))
+        if len(out) == k:
+            break
+    return out
+
+
+def merge_doc_ids(parts) -> np.ndarray:
+    """Gather one globally ascending int32 doc-id array from per-part
+    ascending arrays over disjoint id sets.  Parts covering ascending
+    disjoint *ranges* (segments in doc_base order) concatenate as-is;
+    interleaved id sets (round-robin doc shards) take the sort arm —
+    either way the output is what a monolithic engine would return.
+    """
+    parts = [np.asarray(p, dtype=np.int64) for p in parts if len(p)]
+    if not parts:
+        return np.zeros(0, dtype=np.int32)
+    out = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    if len(out) > 1 and not (np.diff(out) > 0).all():
+        out = np.sort(out, kind="mergesort")
+    return out.astype(np.int32)
+
+
 class _Segment:
     """One opened segment: entry metadata, its Engine, its tombstones."""
 
@@ -268,8 +304,7 @@ class MultiSegmentEngine:
                 finally:
                     if token is not None:
                         obs_attrib.uninstall(token)
-            return [np.concatenate(p).astype(np.int32) if p else None
-                    for p in parts]
+            return [merge_doc_ids(p) if p else None for p in parts]
 
     # -- compound queries -------------------------------------------------
 
@@ -293,9 +328,7 @@ class MultiSegmentEngine:
                 res = s.live_locals(res)
                 if len(res):
                     outs.append(res.astype(np.int64) + s.doc_base)
-            if not outs:
-                return np.zeros(0, dtype=np.int32)
-            return np.concatenate(outs).astype(np.int32)
+            return merge_doc_ids(outs)
 
     def query_or(self, batch) -> np.ndarray:
         """Docs containing ANY term (disjoint ranges: concat merge)."""
@@ -313,9 +346,7 @@ class MultiSegmentEngine:
                 res = s.live_locals(res)
                 if len(res):
                     outs.append(res.astype(np.int64) + s.doc_base)
-            if not outs:
-                return np.zeros(0, dtype=np.int32)
-            return np.concatenate(outs).astype(np.int32)
+            return merge_doc_ids(outs)
 
     def top_k(self, letter, k: int) -> list[tuple[bytes, int]]:
         """The letter's k highest-live-df terms across segments,
@@ -378,14 +409,9 @@ class MultiSegmentEngine:
                 per_seg.append(
                     [(-sc, d + s.doc_base) for d, sc in res])
             # D-way heap merge on (-score, global id): per-segment
-            # lists are already sorted that way, so islice-ing k off
-            # the merge never materializes the rest
-            out = []
-            for neg, gid in heapq.merge(*per_seg):
-                out.append((gid, -neg))
-                if len(out) == k:
-                    break
-            return out
+            # lists are already sorted that way (merge_ranked never
+            # materializes past the k winners)
+            return merge_ranked(per_seg, k)
         finally:
             self._h_topk.observe(_time.perf_counter() - t0)
 

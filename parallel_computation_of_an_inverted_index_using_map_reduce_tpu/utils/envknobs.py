@@ -285,6 +285,28 @@ declare("MRI_REPLICA_POLL_MS", int, 500,
         "--replica-of' (each poll ships missing segments + WAL tail "
         "from the primary).",
         scope="serve", minimum=1)
+declare("MRI_CLUSTER_HEDGE_MS", float, -1.0,
+        "Router hedging delay in ms: a shard RPC unanswered this long "
+        "is re-sent to another replica of the same shard. -1 adapts "
+        "per shard (rolling p95 of recent RPC latency, 1 ms floor), "
+        "0 disables hedging, positive values are a fixed delay.",
+        scope="serve", minimum=-1.0)
+declare("MRI_CLUSTER_HEALTH_MS", int, 500,
+        "Router health-probe period in ms: each replica's `healthz` "
+        "is polled on its pipelined connection and the readiness "
+        "reasons (draining/stalled/overloaded/replica_lagging) steer "
+        "replica selection away before requests fail.",
+        scope="serve", minimum=1)
+declare("MRI_CLUSTER_INFLIGHT", int, 1024,
+        "Router admission cap: client requests in flight (scattered "
+        "but not yet gathered) beyond this are shed with "
+        "`overloaded`, mirroring the daemon's bounded queue.",
+        scope="serve", minimum=1)
+declare("MRI_CLUSTER_RPC_TIMEOUT_MS", float, 30000.0,
+        "Router-side ceiling in ms on one shard RPC (including "
+        "failover retries) when the client request carries no "
+        "deadline_ms of its own.",
+        scope="serve", minimum=1.0)
 
 # -- observability ----------------------------------------------------
 declare("MRI_OBS_ENABLE", int, 1,
@@ -452,6 +474,15 @@ declare("MRI_DAEMON_WINDOW", int, 512,
 declare("MRI_DAEMON_OPEN_WINDOW", int, 2400,
         "Max in-flight requests in the daemon open-loop bench.",
         scope="bench")
+declare("MRI_CLUSTER_BENCH_N", int, 12000,
+        "Ranked requests per cluster-bench throughput leg "
+        "(--cluster-ab).", scope="bench")
+declare("MRI_CLUSTER_BENCH_SHARDS", str, "4,8",
+        "Comma list of shard counts the cluster bench sweeps.",
+        scope="bench")
+declare("MRI_CLUSTER_BENCH_SLOW_MS", float, 20.0,
+        "Injected shard-slow delay in ms for the cluster bench's "
+        "hedged-vs-unhedged p99 comparison.", scope="bench")
 
 # -- test hooks -------------------------------------------------------
 declare("MRI_EMIT_KILL_AFTER_LETTERS", int, None,

@@ -64,6 +64,13 @@ _BASIS = {
         "value IS the ratio: WAL-on mutation ack p99 vs the same "
         "run's WAL-off leg (budget {}x); replica catch-up {} MB/s"
         .format(d["gate"], d["replication"]["mb_per_s"])),
+    "BENCH_CLUSTER_r18.json": lambda d, ln: (
+        "{}x the same run's 1-core scaling envelope at D=4; hedged "
+        "p99 {}x unhedged under a slow shard".format(
+            round(d["sweep"]["4"]["cluster_pipelined"]["qps"]
+                  / d["sweep"]["4"]["envelope_qps"], 2),
+            round(d["hedge"]["hedged"]["p99_ms"]
+                  / d["hedge"]["unhedged"]["p99_ms"], 2))),
     "BENCH_BUILD_OOC_r15.json": lambda d, ln: (
         "value IS the ratio: spill-tier wall vs the same run's "
         "in-memory build on a {}x-budget corpus (zero-spill {}x)"

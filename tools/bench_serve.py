@@ -58,6 +58,18 @@ Four modes, all printing ONE JSON line mirroring bench.py's shape:
                       rate by segment shipping (s/GB + the idempotent
                       no-op round); written to --out-wal
                       (BENCH_WAL_r17.json)
+  --cluster-ab        doc-sharded scale-out A/B (make bench-cluster):
+                      partition the bench corpus at D=4,8 shards, then
+                      ranked BM25 QPS through the scatter-gather
+                      router (pipelined + Poisson open-loop) vs one
+                      shard served through the same stack — gated at
+                      0.7x the core-aware linear envelope
+                      Q_1shard_via_router * min(1, max(1, cores-2)/D),
+                      byte-parity swept
+                      against the monolith engine, plus a hedged-vs-
+                      unhedged p99 comparison under an injected
+                      20 ms slow replica — written to --out-cluster
+                      (BENCH_CLUSTER_r18.json)
   --daemon-bench      the resident-daemon sweep (make bench-daemon):
                       pipelined coalesced capacity + closed-loop rpc
                       floor vs the in-process batch-1 baseline, then an
@@ -2030,6 +2042,296 @@ def _closed_loop(engine_name: str, open_loop_rps: float | None) -> dict:
     return line
 
 
+# -- cluster A/B ------------------------------------------------------
+
+CLUSTER_BENCH_N = envknobs.get("MRI_CLUSTER_BENCH_N")
+CLUSTER_BENCH_SHARDS = tuple(
+    int(x) for x in envknobs.get("MRI_CLUSTER_BENCH_SHARDS").split(","))
+CLUSTER_BENCH_SLOW_MS = envknobs.get("MRI_CLUSTER_BENCH_SLOW_MS")
+#: fraction of the core-aware linear envelope the cluster must clear
+CLUSTER_GATE = 0.7
+CLUSTER_PARITY_QUERIES = 40
+
+
+def _spawn_router(spec: str, env_extra: dict | None = None):
+    """A real `mri router` subprocess; returns (proc, addr)."""
+    import subprocess
+
+    repo = str(Path(__file__).resolve().parent.parent)
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "router", "--shards", spec, "--listen", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=repo, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise RuntimeError(f"router died on startup: {proc.stderr.read()}")
+    ready = json.loads(line)
+    return proc, (ready["host"], ready["port"])
+
+
+def _encode_ranked(terms: list[str], n: int, k: int = 10) -> list[bytes]:
+    """Pre-encoded two-term ranked requests (ids 0..n-1)."""
+    m = len(terms)
+    return [json.dumps({"id": i, "op": "top_k", "k": k, "score": "bm25",
+                        "terms": [terms[i % m], terms[(i * 7 + 3) % m]]}
+                       ).encode() + b"\n"
+            for i in range(n)]
+
+
+def _kill_procs(procs) -> None:
+    for p in procs:
+        if p is None:
+            continue
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+        for f in (p.stdout, p.stderr):
+            if f is not None and not f.closed:
+                f.close()
+
+
+def _spawn_cluster(cl_dir: Path, d: int, *, replicate: int | None = None,
+                   router_env: dict | None = None):
+    """D shard daemons (optionally two replicas of shard ``replicate``)
+    behind a router subprocess; returns (daemons, router_proc, addr)."""
+    procs = []
+    try:
+        specs = []
+        for s in range(d):
+            reps = 2 if s == replicate else 1
+            addrs = []
+            for _ in range(reps):
+                proc, addr = _spawn_daemon(str(cl_dir / f"shard-{s}"))
+                procs.append(proc)
+                addrs.append(f"{addr[0]}:{addr[1]}")
+            specs.append("|".join(addrs))
+        router, raddr = _spawn_router(",".join(specs), router_env)
+        return procs, router, raddr
+    except BaseException:
+        _kill_procs(procs)
+        raise
+
+
+class _LineRpc:
+    """One blocking JSON-lines round trip at a time (parity sweep)."""
+
+    def __init__(self, addr):
+        import socket as _socket
+
+        self.sock = _socket.create_connection(addr, timeout=60)
+        self.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self.f = self.sock.makefile("rb")
+
+    def rpc(self, **req) -> dict:
+        self.sock.sendall(json.dumps(req).encode() + b"\n")
+        return json.loads(self.f.readline())
+
+    def close(self):
+        self.f.close()
+        self.sock.close()
+
+
+def _cluster_parity(raddr, engine, terms: list[str], rng) -> int:
+    """Every data op through the router must equal the monolith engine
+    byte-for-byte — BM25 floats included, not approx."""
+    checked = 0
+    c = _LineRpc(raddr)
+    try:
+        for i in range(CLUSTER_PARITY_QUERIES):
+            qt = [terms[int(rng.integers(len(terms)))]
+                  for _ in range(int(rng.integers(1, 4)))]
+            batch = engine.encode_batch(qt)
+            r = c.rpc(id=i, op="df", terms=qt)
+            assert r.get("ok") and r["df"] == engine.df(batch).tolist(), r
+            r = c.rpc(id=i, op="postings", terms=qt)
+            want = [p.tolist() if p is not None else None
+                    for p in engine.postings(batch)]
+            assert r["postings"] == want, f"postings parity: {qt}"
+            r = c.rpc(id=i, op="and", terms=qt)
+            assert r["docs"] == engine.query_and(batch).tolist()
+            r = c.rpc(id=i, op="or", terms=qt)
+            assert r["docs"] == engine.query_or(batch).tolist()
+            k = int(rng.integers(1, 20))
+            r = c.rpc(id=i, op="top_k", terms=qt, k=k, score="bm25")
+            want = [[doc, score] for doc, score
+                    in engine.top_k_scored(batch, k)]
+            assert r["docs"] == want, f"ranked parity: {qt} k={k}"
+            checked += 5
+        for letter in "abcde":
+            r = c.rpc(id=999, op="top_k", letter=letter, k=5)
+            want = [[t.decode("ascii"), int(df)] for t, df
+                    in engine.top_k(letter, 5)]
+            assert r["top"] == want, f"letter parity: {letter}"
+            checked += 1
+    finally:
+        c.close()
+    return checked
+
+
+def _cluster_ab(out_path: str | None) -> dict:
+    """Doc-sharded scale-out A/B -> BENCH_CLUSTER_r18.json."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cluster import (
+        partition as part_mod,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.manifest import (
+        write_manifest,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve import (
+        Engine,
+    )
+
+    manifest, corpus_metric = bench._manifest()
+    out_dir, build_report = _build_index()
+    rng = np.random.default_rng(SEED)
+    cores = os.cpu_count() or 1
+
+    engine = Engine(os.path.join(out_dir, "index.mri"))
+    terms = _zipf_terms(engine, 4096, rng)
+    scratch = Path(bench._scratch_mkdtemp("bench_cluster_"))
+    src_list = scratch / "corpus.list"
+    write_manifest(src_list, list(manifest.paths))
+    lines = _encode_ranked(terms, CLUSTER_BENCH_N)
+
+    sweep = {}
+    for d in CLUSTER_BENCH_SHARDS:
+        cl_dir = scratch / f"cluster-{d}"
+        t = time.perf_counter()
+        part_mod.partition(src_list, d, cl_dir)
+        partition_s = time.perf_counter() - t
+
+        # per-shard baselines over the same pipelined window: the
+        # shard daemon answered directly, and the same shard behind a
+        # D=1 router.  The envelope scales the ROUTER baseline — the
+        # router's constant per-request cost is a stack property, not
+        # a scaling loss, and on a box with spare cores it overlaps
+        # the shard work entirely
+        proc, addr = _spawn_daemon(str(cl_dir / "shard-0"))
+        try:
+            shard1 = _daemon_pipelined_qps(addr, lines)
+        finally:
+            _kill_procs([proc])
+        print(f"# D={d} shard-0 direct: {shard1}", file=sys.stderr,
+              flush=True)
+        procs, router, raddr = _spawn_cluster(cl_dir, 1)
+        try:
+            router1 = _daemon_pipelined_qps(raddr, lines)
+            _stop_daemon(router)
+            router = None
+        finally:
+            _kill_procs([router])
+            _kill_procs(procs)
+        print(f"# D={d} shard-0 via router: {router1}", file=sys.stderr,
+              flush=True)
+
+        procs, router, raddr = _spawn_cluster(cl_dir, d)
+        try:
+            cluster = _daemon_pipelined_qps(raddr, lines)
+            print(f"# D={d} cluster: {cluster}", file=sys.stderr,
+                  flush=True)
+            rate = 0.6 * cluster["qps"]
+            n_open = min(max(int(rate * DAEMON_OPEN_SECONDS), 100),
+                         CLUSTER_BENCH_N)
+            open_leg = _daemon_open_loop(
+                raddr, _encode_ranked(terms, n_open), rate, rng)
+            print(f"# D={d} open loop: {open_leg}", file=sys.stderr,
+                  flush=True)
+            parity = _cluster_parity(raddr, engine, terms, rng)
+            counters = _stop_daemon(router)
+            router = None
+        finally:
+            _kill_procs([router])
+            _kill_procs(procs)
+
+        # the scale-out contract, sized to the box: D daemons + a
+        # router time-share max(1, cores-2) usable cores, so ideal
+        # throughput is the one-shard-through-the-stack rate scaled by
+        # min(1, usable/D) — the cluster must land within CLUSTER_GATE
+        # of that envelope.  (With usable >= D this is plain 0.7x
+        # linear scaling of one shard.)
+        envelope = router1["qps"] * min(1.0, max(1, cores - 2) / d)
+        floor = CLUSTER_GATE * envelope
+        assert cluster["qps"] >= floor, (
+            f"D={d}: cluster {cluster['qps']} qps under "
+            f"{CLUSTER_GATE}x the {cores}-core envelope {envelope:.0f}")
+        sweep[str(d)] = {
+            "partition_s": round(partition_s, 2),
+            "shard1_direct": shard1,
+            "shard1_via_router": router1,
+            "cluster_pipelined": cluster,
+            "open_loop": open_leg,
+            "parity_checks": parity,
+            "envelope_qps": round(envelope, 1),
+            "gate_floor_qps": round(floor, 1),
+            "router_counters": counters,
+        }
+
+    # hedged-vs-unhedged p99 under one injected slow replica.  The
+    # LAST shard in scatter order gets a second (healthy) replica and
+    # the fault pins the stall to its replica 0: the stalled send then
+    # delays no other leg (the scatter issues legs in shard order on
+    # one thread), so the hedge's fast answer is what completes the
+    # request
+    d0 = CLUSTER_BENCH_SHARDS[0]
+    slow = (f"shard-slow:shard={d0 - 1}:replica=0:"
+            f"ms={CLUSTER_BENCH_SLOW_MS:g}:times=-1")
+    hedge_rate = min(25.0, 400.0 / CLUSTER_BENCH_SLOW_MS)
+    n_hedge = max(int(hedge_rate * 12), 240)
+    hedge = {"slow_ms": CLUSTER_BENCH_SLOW_MS,
+             "offered_rps": round(hedge_rate, 1)}
+    for label, hedge_ms in (("unhedged", "0"), ("hedged", "5")):
+        procs, router, raddr = _spawn_cluster(
+            scratch / f"cluster-{d0}", d0, replicate=d0 - 1,
+            router_env={"MRI_FAULTS": slow,
+                        "MRI_CLUSTER_HEDGE_MS": hedge_ms})
+        try:
+            leg = _daemon_open_loop(
+                raddr, _encode_ranked(terms, n_hedge), hedge_rate,
+                np.random.default_rng(SEED))
+            counters = _stop_daemon(router)
+            router = None
+            leg["hedges"] = counters.get("hedges", 0)
+            leg["hedge_wins"] = counters.get("hedge_wins", 0)
+            hedge[label] = leg
+            print(f"# {label}: {leg}", file=sys.stderr, flush=True)
+        finally:
+            _kill_procs([router])
+            _kill_procs(procs)
+    assert hedge["hedged"]["hedges"] > 0, "hedge leg never hedged"
+    assert hedge["hedged"]["p99_ms"] < hedge["unhedged"]["p99_ms"], (
+        f"hedging did not cut p99 under a {CLUSTER_BENCH_SLOW_MS}ms "
+        f"slow shard: {hedge['hedged']['p99_ms']} vs "
+        f"{hedge['unhedged']['p99_ms']}")
+
+    engine.close()
+    line = {
+        "metric": "cluster_ranked_qps",
+        "value": max(s["cluster_pipelined"]["qps"]
+                     for s in sweep.values()),
+        "unit": "queries/s",
+        "corpus_metric": corpus_metric,
+        "zipf_s": ZIPF_S,
+        "shards": list(CLUSTER_BENCH_SHARDS),
+        "requests_per_leg": CLUSTER_BENCH_N,
+        "envelope_rule": "Q_1shard_via_router * "
+                         "min(1.0, max(1, cores-2)/D)",
+        "envelope_gate": CLUSTER_GATE,
+        "host_cores": cores,
+        "sweep": sweep,
+        "hedge": hedge,
+        "artifact_bytes": int(build_report.get("artifact_bytes", 0)),
+        "scratch": bench._scratch_backing(),
+    }
+    if out_path:
+        Path(out_path).write_text(json.dumps(line, indent=2) + "\n")
+    return line
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="bench_serve",
@@ -2110,6 +2412,17 @@ def main(argv: list[str] | None = None) -> int:
                         "rate by segment shipping")
     p.add_argument("--out-wal", default="BENCH_WAL_r17.json",
                    help="where --wal-ab writes its JSON report")
+    p.add_argument("--cluster-ab", action="store_true",
+                   help="doc-sharded scale-out A/B: partition the "
+                        "bench corpus at D="
+                        f"{','.join(map(str, CLUSTER_BENCH_SHARDS))}, "
+                        "ranked QPS through the scatter-gather router "
+                        "vs one shard daemon direct (core-aware linear "
+                        "envelope gated), Poisson open-loop legs, "
+                        "byte-parity vs the monolith, and hedged-vs-"
+                        "unhedged p99 under an injected slow replica")
+    p.add_argument("--out-cluster", default="BENCH_CLUSTER_r18.json",
+                   help="where --cluster-ab writes its JSON report")
     p.add_argument("--slo-check", action="store_true",
                    help="operational-health overhead gate: price the "
                         "rolling-windows sampler tick + a 1 Hz `slo` "
@@ -2120,7 +2433,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="where --slo-check writes its JSON report")
     args = p.parse_args(argv)
 
-    if args.wal_ab:
+    if args.cluster_ab:
+        line = _cluster_ab(args.out_cluster)
+    elif args.wal_ab:
         line = _wal_ab(args.out_wal)
     elif args.segments_ab:
         line = _segments_ab(args.out_segments)

@@ -67,6 +67,7 @@ single-artifact build of the same documents:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import random
@@ -1329,6 +1330,273 @@ def run_wal_soak(work_dir: Path, trials: int, seed_base: int,
     }
 
 
+# -- cluster soak -------------------------------------------------------
+#
+# The scale-out serving contract under chaos: a router over doc-shard
+# daemons (one shard with two replicas) keeps answering BYTE-EXACT
+# ranked results while replicas die, wedge, or receive corrupt artifact
+# pushes.  Zero lost acknowledged queries, exactly-once answers, clean
+# router drain — or the trial fails.
+
+CLUSTER_SCENARIOS = ("kill-replica", "replica-partition",
+                     "corrupt-push")
+
+
+def _cluster_make_base(work: Path):
+    """Monolith + 2-shard partition (shard 0 gets two replicas at
+    serve time) over one Zipf corpus; returns (cluster_dir, expected)
+    where expected maps each probe query to its exact ranked answer."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cluster import (
+        partition as part_mod,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+        create_engine,
+    )
+    docs = zipf_corpus(num_docs=36, vocab_size=400, tokens_per_doc=60,
+                       seed=29)
+    paths = write_corpus(work / "docs", docs)
+    write_manifest(work / "list.txt", paths)
+    mono = work / "mono"
+    build_index(read_manifest(work / "list.txt"),
+                IndexConfig(backend="cpu", num_mappers=1,
+                            num_reducers=1, artifact=True),
+                output_dir=mono)
+    cluster = work / "cluster"
+    part_mod.partition(work / "list.txt", 2, cluster)
+    eng = create_engine(str(mono), engine="host")
+    try:
+        vocab = sorted(
+            {clean_token(w) for blob in docs for w in blob.split()}
+            - {""})
+        probes = []
+        for i in range(0, len(vocab) - 1, 7):
+            terms = vocab[i:i + 2]
+            top = eng.top_k_scored(eng.encode_batch(terms), 5)
+            probes.append((terms, [[d, s] for d, s in top]))
+    finally:
+        eng.close()
+    return cluster, probes
+
+
+def _spawn_router(spec: str, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT),
+               JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "router", "--shards", spec, "--listen", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=str(REPO_ROOT), text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise RuntimeError(
+            f"router died on startup: {proc.stderr.read()}")
+    ready = json.loads(line)
+    return proc, (ready["host"], ready["port"])
+
+
+def _cluster_burst(addr, sent, mid_action=None, mid_at=None,
+                   timeout=30.0):
+    """Pipeline the ``sent`` ranked queries, firing ``mid_action``
+    after the ``mid_at``-th send; returns (responses_by_id, error)."""
+    import threading as _threading
+
+    n = len(sent)
+    c = _ChaosClient(addr, timeout=timeout)
+    got = {}
+    box = {"err": None}
+
+    def reader():
+        try:
+            for _ in range(n):
+                r = c.recv()
+                if r is None:
+                    box["err"] = f"connection died after {len(got)}/{n}"
+                    return
+                if r["id"] in got:
+                    box["err"] = f"duplicate response id {r['id']}"
+                    return
+                got[r["id"]] = r
+        except OSError as e:
+            box["err"] = f"reader failed: {e}"
+
+    t = _threading.Thread(target=reader)
+    t.start()
+    try:
+        for i, terms in enumerate(sent):
+            c.send(id=i, op="top_k", terms=terms, k=5, score="bm25")
+            if mid_action is not None and i == mid_at:
+                mid_action()
+            if i % 40 == 39:
+                time.sleep(0.01)
+        t.join(timeout=timeout)
+        if t.is_alive():
+            return got, f"reader hung with {len(got)}/{n} responses"
+        return got, box["err"]
+    finally:
+        c.close()
+
+
+def _cluster_check_exact(got, probes, sent):
+    """Every response ok and byte-equal to the monolith's answer."""
+    if sorted(got) != list(range(len(sent))):
+        missing = sorted(set(range(len(sent))) - set(got))[:5]
+        return f"missing responses: {missing}"
+    by_terms = {tuple(t): want for t, want in probes}
+    for i, terms in enumerate(sent):
+        r = got[i]
+        if not r.get("ok"):
+            return f"request {i} failed: {r}"
+        if r["docs"] != by_terms[tuple(terms)]:
+            return (f"request {i} ({terms}): got {r['docs']} want "
+                    f"{by_terms[tuple(terms)]}")
+    return None
+
+
+def run_cluster_trial(cluster: Path, probes, seed: int, scenario: str,
+                      deadline_s: float = 120.0) -> dict:
+    """One seeded cluster trial: 3 shard daemons (shard 0 duplicated)
+    + a router subprocess, a pipelined ranked burst, one injected
+    infrastructure failure, and exact-answer / exactly-once /
+    clean-drain gates."""
+    rng = random.Random(seed)
+    verdict = {"seed": seed, "scenario": scenario, "ok": False,
+               "outcome": "?"}
+    t0 = time.monotonic()
+    daemons = []
+    router = None
+    try:
+        try:
+            d0a, a0a = _spawn_daemon(cluster / "shard-0")
+            daemons.append(d0a)
+            d0b, a0b = _spawn_daemon(cluster / "shard-0")
+            daemons.append(d0b)
+            d1, a1 = _spawn_daemon(cluster / "shard-1")
+            daemons.append(d1)
+            spec = (f"{a0a[0]}:{a0a[1]}|{a0b[0]}:{a0b[1]},"
+                    f"{a1[0]}:{a1[1]}")
+            router, raddr = _spawn_router(spec, env_extra={
+                "MRI_CLUSTER_HEALTH_MS": "100",
+                "MRI_CLUSTER_RPC_TIMEOUT_MS": "500"})
+        except (RuntimeError, OSError,
+                subprocess.TimeoutExpired) as e:
+            verdict["outcome"] = f"spawn-failed:{e}"
+            return verdict
+
+        n = rng.randrange(150, 300)
+        sent = [probes[rng.randrange(len(probes))][0]
+                for _ in range(n)]
+        mid_at = rng.randrange(20, 60)
+        if scenario == "kill-replica":
+            def mid():
+                daemons[0].kill()  # SIGKILL shard 0's primary
+        elif scenario == "replica-partition":
+            def mid():
+                # wedged, not dead: alive TCP that stops answering —
+                # RPC timeouts + probe staleness must route around it
+                daemons[0].send_signal(signal.SIGSTOP)
+        elif scenario == "corrupt-push":
+            def mid():
+                # pushes are atomic renames (new inode): truncating the
+                # served file in place would SIGBUS the daemon's live
+                # mmap, which is operator error, not a corrupt push
+                idx = cluster / "shard-1" / "index.mri"
+                good = idx.read_bytes()
+                tmp = idx.with_suffix(".push")
+                tmp.write_bytes(b"\x00garbage push\x00" * 64)
+                tmp.rename(idx)
+                daemons[2].send_signal(signal.SIGHUP)  # must reject
+                time.sleep(0.3)
+                tmp.write_bytes(good)
+                tmp.rename(idx)
+        else:
+            raise ValueError(f"unknown scenario {scenario!r}")
+
+        got, err = _cluster_burst(
+            raddr, sent, mid_action=mid, mid_at=mid_at,
+            timeout=max(30.0, deadline_s / 2))
+        if err is None:
+            err = _cluster_check_exact(got, probes, sent)
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        verdict["requests"] = n
+
+        if scenario == "replica-partition":
+            daemons[0].send_signal(signal.SIGCONT)
+        if not _drain_to_zero(router, verdict, timeout=max(
+                10.0, deadline_s - (time.monotonic() - t0))):
+            return verdict
+        if scenario == "kill-replica" \
+                and not verdict["counters"].get("failovers"):
+            verdict["outcome"] = "violation"
+            verdict["error"] = ("replica killed under load but "
+                                "mri_cluster_failovers_total stayed 0")
+            return verdict
+        if scenario == "corrupt-push":
+            # the shard daemon must have REJECTED the corrupt artifact
+            # (_drain_to_zero sends the SIGTERM — a second one would
+            # trip the daemon's documented forced-exit-1 path)
+            dv = {}
+            if not _drain_to_zero(daemons[2], dv, timeout=15.0):
+                verdict["outcome"] = "violation"
+                verdict["error"] = f"shard daemon drain failed: {dv}"
+                return verdict
+            if not dv["counters"].get("reload_rejected"):
+                verdict["outcome"] = "violation"
+                verdict["error"] = ("corrupt push was not rejected "
+                                    "(reload_rejected stayed 0)")
+                return verdict
+        verdict["outcome"] = "clean"
+        verdict["ok"] = True
+        return verdict
+    finally:
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+        for p in [router] + daemons:
+            if p is None:
+                continue
+            if p.poll() is None:
+                with contextlib.suppress(ProcessLookupError):
+                    p.send_signal(signal.SIGCONT)  # un-wedge first
+                p.kill()
+            p.wait()
+            p.stdout.close()
+            p.stderr.close()
+
+
+def run_cluster_soak(work_dir: Path, trials: int, seed_base: int,
+                     deadline_s: float = 120.0,
+                     verbose: bool = True) -> dict:
+    """``trials`` seeded cluster trials cycled over
+    CLUSTER_SCENARIOS.  Zero lost acknowledged queries or the soak
+    fails."""
+    work_dir.mkdir(parents=True, exist_ok=True)
+    cluster, probes = _cluster_make_base(work_dir / "cluster-base")
+    results = []
+    for t in range(trials):
+        scenario = CLUSTER_SCENARIOS[t % len(CLUSTER_SCENARIOS)]
+        v = run_cluster_trial(cluster, probes, seed_base + t, scenario,
+                              deadline_s=deadline_s)
+        results.append(v)
+        if verbose:
+            print(json.dumps(v, sort_keys=True), flush=True)
+        if v["outcome"] == "HANG":
+            break
+    failures = [v for v in results if not v["ok"]]
+    return {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "by_scenario": {s: sum(v["scenario"] == s and v["ok"]
+                               for v in results)
+                        for s in CLUSTER_SCENARIOS},
+        "failures": failures,
+    }
+
+
 # -- scenario registry ---------------------------------------------------
 #
 # One queryable source of truth for what this harness can throw, so
@@ -1354,6 +1622,12 @@ SCENARIO_REGISTRY = (
      "fault kinds armed mid-trial; per-op --verify, final from-scratch "
      "parity",
      SEGMENT_FAULT_KINDS),
+    ("cluster", "--cluster",
+     "scale-out serving: a router over doc-shard daemons keeps "
+     "answering byte-exact ranked results while replicas are killed, "
+     "wedged (SIGSTOP), or fed corrupt artifact pushes; zero lost "
+     "acknowledged queries, exactly-once answers, clean drain",
+     CLUSTER_SCENARIOS),
     ("wal", "--wal",
      "durability & replication: SIGKILL'd primaries recover every "
      "acknowledged mutation via WAL replay, replicas converge to "
@@ -1410,6 +1684,12 @@ def main(argv=None) -> int:
                          "acknowledged mutation through WAL replay, "
                          "replicas must converge to byte-equal answers "
                          "(scenarios: " + ", ".join(WAL_SCENARIOS) + ")")
+    ap.add_argument("--cluster", action="store_true",
+                    help="soak the scale-out serving layer: a real "
+                         "`mri router` over shard daemon subprocesses "
+                         "with replicas killed / wedged / corrupt-"
+                         "pushed mid-burst (scenarios: "
+                         + ", ".join(CLUSTER_SCENARIOS) + ")")
     ap.add_argument("--list", action="store_true",
                     help="print every soak mode and its scenario/fault-"
                          "kind names, then exit")
@@ -1424,6 +1704,20 @@ def main(argv=None) -> int:
     else:
         work = Path(args.work_dir)
     work = work.resolve()
+    if args.cluster:
+        if args.repro is not None:
+            t = args.repro - args.seed_base
+            scenario = CLUSTER_SCENARIOS[t % len(CLUSTER_SCENARIOS)]
+            work.mkdir(parents=True, exist_ok=True)
+            cluster, probes = _cluster_make_base(work / "cluster-base")
+            v = run_cluster_trial(cluster, probes, args.repro,
+                                  scenario, deadline_s=args.deadline)
+            print(json.dumps(v, sort_keys=True))
+            return 0 if v["ok"] else 1
+        summary = run_cluster_soak(work, args.trials, args.seed_base,
+                                   deadline_s=args.deadline)
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if not summary["failures"] else 1
     if args.wal:
         if args.repro is not None:
             t = args.repro - args.seed_base
