@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-wal test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-cluster test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-cluster bench-history capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-chaos-all test-wal test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-cluster test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-cluster bench-brownout bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -29,6 +29,7 @@ test:
 test-fast: lint
 	$(PY) -m pytest tests/ -q -m "not slow" \
 	  $$($(PY) -c "import importlib.util as u; print('-n auto' if u.find_spec('xdist') else '')")
+	$(PY) tools/chaos.py --all --fast
 
 # mrilint's own suite: checker semantics on planted fixtures under
 # tests/fixtures/lint/ plus the repo-clean gate
@@ -57,6 +58,13 @@ test-spill:
 # durability/replication sweep)
 test-chaos:
 	$(PY) -m pytest tests/ -q -m chaos
+
+# every chaos mode off the `tools/chaos.py --list` registry, full
+# trial counts, one process per mode; a new mode added to the registry
+# is picked up here with no Makefile edit.  `--fast` (the test-fast
+# cycle) runs the same sweep at reduced trials/deadlines
+test-chaos-all:
+	$(PY) tools/chaos.py --all
 
 # durability suite: WAL container integrity, torn-tail quarantine,
 # crash replay (incl. SIGKILL during a buffered tombstone batch),
@@ -227,6 +235,14 @@ bench-slo:
 # MRI_CLUSTER_BENCH_* knobs
 bench-cluster:
 	$(PY) tools/bench_serve.py --cluster-ab
+
+# brownout A/B: retry amplification on a D=2 cluster under a shard
+# blackout and an intermittent overload storm (default token-bucket
+# budget vs a loose contrast leg, gated at 1.1x), plus CoDel adaptive
+# admission vs a fixed queue at 2x measured capacity (compliant p99
+# gated at 2x unloaded) -> BENCH_BROWNOUT_r19.json
+bench-brownout:
+	$(PY) tools/bench_serve.py --brownout-ab
 
 # print the cross-round BENCH_*.json trajectory table (ratios against
 # each round's own baseline); `--write` regenerates the README block

@@ -86,6 +86,13 @@ fault-spec grammar (test/bench only; clauses joined by ';'):
   router-conn-reset:req=3        router: client connection 3 is reset
                                  mid-stream (exactly-once: admitted
                                  requests still answer or count)
+  shard-blackout:shard=0         router: EVERY send to shard 0 dies,
+                                 all replicas, permanently — drives
+                                 the partial-result/breaker paths
+  overload-storm:req=8:times=16  daemon: requests 8..23 shed with a
+                                 typed 'overloaded' answer (synthetic
+                                 sustained overload for admission-
+                                 control soaks)
   chaos:seed=5:n=3               sample 3 faults deterministically
                                  (bounds: windows= workers= reducers=
                                  docs= reqs= kinds=a,b,c)
@@ -199,7 +206,20 @@ cluster mode (doc-sharded scale-out; see README "Cluster serving"):
                                  monolithic daemon over the same
                                  corpus, BM25 floats included
   mri-tpu top ROUTER:PORT        fleet view: the router's stats carry
-                                 per-shard replica health rows
+                                 per-shard replica health rows with
+                                 circuit-breaker state and per-shard
+                                 partial-coverage readiness
+  degraded serving: requests may carry partial_policy 'fail' (default:
+                                 a dead shard is a typed
+                                 shard_unavailable error) or
+                                 'allow:min_coverage=F' (answer from
+                                 the live shards, flagged partial:true
+                                 with coverage metadata);
+                                 MRI_CLUSTER_PARTIAL sets the router
+                                 default, MRI_CLUSTER_RETRY_BUDGET
+                                 bounds retry/hedge amplification,
+                                 MRI_SERVE_CODEL_TARGET_MS arms CoDel
+                                 admission control in shard daemons
 
 metrics mode (Prometheus text exposition; obs/ registry):
   mri-tpu metrics DIR            open DIR's artifact, print the engine
@@ -725,7 +745,8 @@ def _router_main(argv: list[str]) -> int:
     p.add_argument("--fault-spec", default=None,
                    help="arm the deterministic fault injector "
                         "(cluster kinds: shard-dead/shard-slow/"
-                        "router-conn-reset) — test/bench only")
+                        "router-conn-reset/shard-blackout/"
+                        "overload-storm) — test/bench only")
     args = p.parse_args(argv)
 
     from .obs import logging as obs_logging
@@ -1007,10 +1028,16 @@ def _top_render(target: str, sample: dict) -> str:
         # single pipelined stats poll — no extra connections
         lines.append("")
         lines.append(f"{'shard':<8}{'replica':<22}{'state':<10}"
-                     f"{'p95 ms':>10}  reasons")
+                     f"{'breaker':<11}{'p95 ms':>10}  reasons")
+        answerable = 0
         for sh in cluster["shards"]:
             p95 = sh.get("p95_ms")
-            for rep in sh.get("replicas") or []:
+            reps = sh.get("replicas") or []
+            if any(r.get("ready")
+                   and r.get("breaker", "closed") != "open"
+                   for r in reps):
+                answerable += 1
+            for rep in reps:
                 state = "ready" if rep.get("ready") else "DOWN"
                 if rep.get("primary"):
                     state += "*"
@@ -1018,7 +1045,21 @@ def _top_render(target: str, sample: dict) -> str:
                 lines.append(
                     f"{sh.get('shard', '?'):<8}"
                     f"{rep.get('addr', '?'):<22}{state:<10}"
+                    f"{rep.get('breaker', 'closed'):<11}"
                     f"{_top_num(p95):>10}  {why}")
+        # degraded-serving readiness: a shard can answer (and so count
+        # toward partial coverage) while any replica is ready with a
+        # breaker still admitting traffic
+        nshards = len(cluster["shards"])
+        cov_line = (f"coverage: {answerable}/{nshards} shards "
+                    f"answerable")
+        if answerable < nshards:
+            cov_line += "  [DEGRADED]"
+        cov_line += (f"  partial_default="
+                     f"{cluster.get('partial_default') or 'fail'}"
+                     f"  breakers_open="
+                     f"{cluster.get('breakers_open', 0)}")
+        lines.append(cov_line)
     lines.append("")
     nonzero = "  ".join(f"{k}={v}" for k, v in counters.items() if v)
     lines.append("counters: " + (nonzero or "-"))

@@ -226,6 +226,155 @@ class _P95Ring:
             return self._p95
 
 
+class Breaker:
+    """Per-replica circuit breaker: closed → open → half-open.
+
+    Failure evidence (error answers, RPC timeouts, connection deaths)
+    lands in a ring of per-second ``(ok, err)`` buckets —
+    ``obs/windows.py``'s stamped-bucket discipline shrunk to one
+    counter pair — so verdicts follow a rolling ``WINDOW_S``-second
+    window, not all-time totals.  The breaker opens when the window
+    holds at least ``threshold`` failures and strictly more failures
+    than successes; an open breaker rejects picks for ``cooldown_s``,
+    then admits exactly ONE in-flight probe RPC (half-open) whose
+    outcome closes or re-opens it.  A ready ``healthz`` verdict also
+    closes it, so recovery is always probe-gated — by the router's own
+    traffic or by the health prober, whichever speaks first.
+    """
+
+    WINDOW_S = 10
+
+    CLOSED = "closed"
+    HALF_OPEN = "half-open"
+    OPEN = "open"
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 1.0,
+                 clock=time.monotonic):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ok = [0] * self.WINDOW_S
+        self._err = [0] * self.WINDOW_S
+        self._stamp = [-1] * self.WINDOW_S  # second each bucket holds
+        self.state = self.CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+
+    def _bucket(self, now: float) -> int:
+        sec = int(now)
+        i = sec % self.WINDOW_S
+        if self._stamp[i] != sec:
+            self._stamp[i] = sec
+            self._ok[i] = 0
+            self._err[i] = 0
+        return i
+
+    def _window(self, now: float) -> tuple:
+        lo = int(now) - self.WINDOW_S + 1
+        ok = err = 0
+        for i in range(self.WINDOW_S):
+            if self._stamp[i] >= lo:
+                ok += self._ok[i]
+                err += self._err[i]
+        return ok, err
+
+    def allow(self, now: float | None = None) -> bool:
+        """May the caller send this replica an RPC right now?  Open
+        says no until ``cooldown_s`` has passed, then one True answer
+        claims the half-open probe slot — callers that get True MUST
+        report the RPC's outcome or the slot stays claimed until the
+        health prober speaks."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if now - self._opened_at < self.cooldown_s:
+                    return False
+                self.state = self.HALF_OPEN
+                self._probing = True
+                return True
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        now = self._clock()
+        with self._lock:
+            if self.state != self.CLOSED:
+                self._close_locked()
+            self._ok[self._bucket(now)] += 1
+
+    def record_failure(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self._err[self._bucket(now)] += 1
+            if self.state == self.HALF_OPEN:
+                self._open_locked(now)
+            elif self.state == self.CLOSED:
+                ok, err = self._window(now)
+                if err >= self.threshold and err > ok:
+                    self._open_locked(now)
+
+    def note_ready(self) -> None:
+        """A ready healthz verdict — probe-gated recovery through the
+        prober's channel instead of a live data RPC."""
+        with self._lock:
+            if self.state != self.CLOSED:
+                self._close_locked()
+
+    def _open_locked(self, now: float) -> None:
+        self.state = self.OPEN
+        self._opened_at = now
+        self._probing = False
+
+    def _close_locked(self) -> None:
+        self.state = self.CLOSED
+        self._probing = False
+        # fresh start: the failures that opened the breaker must not
+        # re-open it on the first post-recovery error
+        self._stamp = [-1] * self.WINDOW_S
+
+
+class RetryBudget:
+    """Token-bucket retry/hedge budget, a ratio of live traffic.
+
+    Every FIRST attempt of a shard leg deposits ``ratio`` tokens;
+    every retry or hedge spends one whole token.  Over any window the
+    extra load a browning-out shard can attract is therefore capped
+    near ``ratio`` × its live traffic plus the small constant ``cap``
+    a cold router may bank — no retry storm compounds.  ``ratio`` 0
+    disables retries and hedges outright.
+    """
+
+    def __init__(self, ratio: float, cap: float = 8.0):
+        self.ratio = float(ratio)
+        self._cap = max(1.0, float(cap))
+        self._tokens = min(self._cap, 2.0) if self.ratio > 0 else 0.0
+        self._lock = threading.Lock()
+        self.denied = 0  # lifetime try_spend refusals (stats surface)
+
+    def deposit(self) -> None:
+        if self.ratio <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self._cap, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            self.denied += 1
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            return round(self._tokens, 3)
+
+
 class Replica:
     """Health + connection state for one endpoint of one shard."""
 
@@ -238,23 +387,27 @@ class Replica:
         self.ready = False   # last healthz verdict
         self.reasons: list = ["unprobed"]
         self.last_probe = 0.0
+        self.breaker = Breaker()
 
     def describe(self) -> dict:
         return {"addr": f"{self.addr[0]}:{self.addr[1]}",
                 "ready": self.ready,
-                "reasons": list(self.reasons)}
+                "reasons": list(self.reasons),
+                "breaker": self.breaker.state}
 
 
 class ShardClient:
     """One doc-shard's replica set, as the router sees it."""
 
-    def __init__(self, shard: int, addrs: list):
+    def __init__(self, shard: int, addrs: list,
+                 retry_budget_ratio: float = 0.1):
         self.shard = shard
         self.replicas = [Replica(shard, i, a)
                          for i, a in enumerate(addrs)]
         self.primary = 0  # guarded by: self._lock
         self._lock = threading.Lock()
         self.latency = _P95Ring()
+        self.budget = RetryBudget(retry_budget_ratio)
 
     def conn(self, ri: int) -> ReplicaConn:
         """The live connection for replica ``ri``, dialing on demand.
@@ -268,6 +421,7 @@ class ShardClient:
                 c = ReplicaConn(self.shard, ri, rep.addr,
                                 on_dead=self._conn_died)
             except OSError as e:
+                rep.breaker.record_failure()
                 raise ConnDead(
                     f"shard {self.shard} replica {ri} "
                     f"({rep.addr[0]}:{rep.addr[1]}): {e}") from e
@@ -278,31 +432,45 @@ class ShardClient:
         rep = self.replicas[conn.replica]
         rep.ready = False
         rep.reasons = ["connection_lost"]
+        rep.breaker.record_failure()
 
     def pick(self, exclude: tuple = ()) -> int:
-        """Replica to try next: the primary when it is ready, else the
-        first ready replica (and that becomes the new primary — a
-        health-based failover the router counts), else any non-excluded
-        endpoint as a last resort.  -1 when nothing is left."""
+        """Replica to try next: the primary when it is ready and its
+        breaker admits traffic, else the first such replica (and that
+        becomes the new primary — a health-based failover the router
+        counts), else any non-excluded endpoint whose breaker admits
+        as a last resort (an open breaker whose cooldown just expired
+        admits its single half-open probe here).  -1 when every
+        replica is excluded or breaker-rejected — the signal the
+        partial-result gather keys off."""
         with self._lock:
             p = self.primary
-            if p not in exclude and self.replicas[p].ready:
+            rep = self.replicas[p]
+            if p not in exclude and rep.ready and rep.breaker.allow():
                 return p
             for r in self.replicas:
-                if r.idx not in exclude and r.ready:
+                if r.idx != p and r.idx not in exclude and r.ready \
+                        and r.breaker.allow():
                     self.primary = r.idx
                     return r.idx
             for r in self.replicas:
-                if r.idx not in exclude:
+                if r.idx not in exclude and not r.ready \
+                        and r.breaker.allow():
                     return r.idx
         return -1
 
     def hedge_pick(self, primary_ri: int) -> int:
-        """A DIFFERENT ready replica for the hedge RPC (-1 if none)."""
+        """A DIFFERENT ready replica (breaker permitting) for the
+        hedge RPC (-1 if none)."""
         for r in self.replicas:
-            if r.idx != primary_ri and r.ready:
+            if r.idx != primary_ri and r.ready and r.breaker.allow():
                 return r.idx
         return -1
+
+    def breakers_open(self) -> int:
+        """Replicas currently refusing traffic (open or half-open)."""
+        return sum(1 for r in self.replicas
+                   if r.breaker.state != Breaker.CLOSED)
 
     def ready_count(self) -> int:
         return sum(1 for r in self.replicas if r.ready)
@@ -319,6 +487,9 @@ class ShardClient:
         return {"shard": self.shard,
                 "p95_ms": round(p95 * 1e3, 3) if p95 is not None
                           else None,
+                "breakers_open": self.breakers_open(),
+                "retry_tokens": self.budget.tokens(),
+                "retry_denied": self.budget.denied,
                 "replicas": reps}
 
     def close(self) -> None:
@@ -374,6 +545,8 @@ class HealthProber:
             else:
                 rep.ready = bool(payload.get("ready"))
                 rep.reasons = list(payload.get("reasons") or ())
+                if rep.ready:
+                    rep.breaker.note_ready()
             rep.last_probe = time.monotonic()
             if was != rep.ready and self._on_transition is not None:
                 self._on_transition(sc, rep, was)

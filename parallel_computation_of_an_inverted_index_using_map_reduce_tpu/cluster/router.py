@@ -67,6 +67,8 @@ HEDGE_ENV = "MRI_CLUSTER_HEDGE_MS"
 HEALTH_ENV = "MRI_CLUSTER_HEALTH_MS"
 INFLIGHT_ENV = "MRI_CLUSTER_INFLIGHT"
 RPC_TIMEOUT_ENV = "MRI_CLUSTER_RPC_TIMEOUT_MS"
+PARTIAL_ENV = "MRI_CLUSTER_PARTIAL"
+RETRY_BUDGET_ENV = "MRI_CLUSTER_RETRY_BUDGET"
 
 #: admission counters share the daemon's family names on purpose: the
 #: router IS a serve-plane daemon, so the SLO tracker, the rolling
@@ -87,6 +89,9 @@ _COUNTER_NAMES = (
     ("hedge_wins", "mri_cluster_hedge_wins_total"),
     ("failovers", "mri_cluster_failovers_total"),
     ("shard_errors", "mri_cluster_shard_errors_total"),
+    ("shard_unavailable", "mri_cluster_shard_unavailable_total"),
+    ("partial", "mri_cluster_partial_total"),
+    ("retry_denied", "mri_cluster_retry_denied_total"),
 )
 
 #: shard error answers the router retries on another replica — the
@@ -122,6 +127,37 @@ def parse_shard_arg(spec: str) -> list[list[tuple]]:
     if not shards:
         raise ValueError("--shards lists no endpoints")
     return shards
+
+
+def parse_partial_policy(spec) -> tuple:
+    """``partial_policy`` grammar: ``fail`` (any unanswerable shard
+    fails the whole request — the byte-compat default) or
+    ``allow[:min_coverage=F]`` (answer from the shards that did
+    answer, flagged with ``partial``+``coverage`` metadata, provided
+    at least fraction F of the corpus answered; F defaults to 0).
+    Returns ``(policy, min_coverage)``."""
+    if not isinstance(spec, str):
+        raise ValueError("partial_policy must be a string")
+    s = spec.strip()
+    if s == "fail":
+        return ("fail", 1.0)
+    if s == "allow":
+        return ("allow", 0.0)
+    if s.startswith("allow:"):
+        key, _, val = s[len("allow:"):].partition("=")
+        if key.strip() == "min_coverage":
+            try:
+                f = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"partial_policy: min_coverage {val!r} is not a "
+                    "number") from None
+            if 0.0 <= f <= 1.0:
+                return ("allow", f)
+            raise ValueError(
+                "partial_policy: min_coverage must be in [0, 1]")
+    raise ValueError(f"partial_policy {spec!r}: want 'fail' or "
+                     "'allow[:min_coverage=F]'")
 
 
 class _ClientConn:
@@ -191,10 +227,11 @@ class _Scatter:
     __slots__ = ("conn", "rid", "op", "tid", "line", "rpc_id",
                  "t_admit", "explain", "k", "done", "lock", "parts",
                  "remaining", "calls", "deadline_timer",
-                 "timeout_timer", "hedged", "failovers")
+                 "timeout_timer", "hedged", "failovers", "policy",
+                 "min_cov", "missing")
 
     def __init__(self, conn, rid, op, tid, line, rpc_id, t_admit,
-                 explain, k, nshards):
+                 explain, k, nshards, policy="fail", min_cov=1.0):
         self.conn = conn
         self.rid = rid
         self.op = op
@@ -213,6 +250,9 @@ class _Scatter:
         self.timeout_timer = None  # one RPC-timeout timer for all legs
         self.hedged: list = []  # shard idx, for explain
         self.failovers = 0
+        self.policy = policy  # partial_policy: "fail" | "allow"
+        self.min_cov = min_cov  # docs_fraction floor under "allow"
+        self.missing: list = []  # unanswerable shards  # guarded by: self.lock
 
 
 class _ShardCall:
@@ -220,7 +260,7 @@ class _ShardCall:
 
     __slots__ = ("tried", "conns", "hedge_timer",
                  "t0", "first_replica", "hedge_replica", "live",
-                 "resets", "done")
+                 "attempts", "done")
 
     def __init__(self):
         self.tried: set = set()  # guarded by: the scatter's lock
@@ -230,7 +270,7 @@ class _ShardCall:
         self.first_replica = -1
         self.hedge_replica = -1
         self.live = 0  # in-flight attempts  # guarded by: the scatter's lock
-        self.resets = 0  # exclusion-set clears  # guarded by: the scatter's lock
+        self.attempts = 0  # lifetime sends incl. hedges  # guarded by: the scatter's lock
         self.done = False  # guarded by: the scatter's lock
 
 
@@ -259,9 +299,19 @@ class RouterDaemon:
         health_ms = health_ms if health_ms is not None \
             else envknobs.get(HEALTH_ENV)
         self.drain_s = drain_s
+        self.partial_spec = envknobs.get(PARTIAL_ENV)
+        self.partial_default = parse_partial_policy(self.partial_spec)
+        self.retry_budget_ratio = envknobs.get(RETRY_BUDGET_ENV)
 
-        self.shards = [pool_mod.ShardClient(i, addrs)
+        self.shards = [pool_mod.ShardClient(
+                           i, addrs,
+                           retry_budget_ratio=self.retry_budget_ratio)
                        for i, addrs in enumerate(shard_addrs)]
+        # per-shard corpus sizes (learned from the shard engines'
+        # sidecar-fed describe()) back docs_fraction in coverage
+        # metadata; None until the background learner hears back
+        self._shard_docs: list = [None] * len(shard_addrs)
+        self._total_docs: int | None = None
         self.registry = obs_metrics.Registry()
         self._counts = {key: self.registry.counter(name)
                         for key, name in _COUNTER_NAMES}
@@ -271,6 +321,8 @@ class RouterDaemon:
             "mri_cluster_replicas_ready")
         self._g_inflight = self.registry.gauge("mri_serve_inflight")
         self._g_draining = self.registry.gauge("mri_serve_draining")
+        self._g_breakers = self.registry.gauge(
+            "mri_cluster_breakers_open")
         self._h_request = self.registry.histogram(
             "mri_serve_request_seconds")
         self._rolling = obs_windows.RollingWindows(
@@ -302,6 +354,9 @@ class RouterDaemon:
     def start(self) -> None:
         self.prober.start()
         self._rolling.start()
+        threading.Thread(target=self._learn_shard_docs, daemon=True,
+                         name="mri-router-docs").start()
+        # mrilint: allow(fault-boundary) client-facing listener bind, not corpus I/O; cluster faults inject on the shard side
         self._listener = socket.create_server(
             (self._host, self._port))
         self._listener.listen(128)
@@ -369,6 +424,49 @@ class RouterDaemon:
                 if sc.primary == rep.idx:
                     pass  # pick() moves the primary on the next RPC
         self._g_ready.set(sum(s.ready_count() for s in self.shards))
+
+    # -- coverage accounting --------------------------------------------
+
+    def _learn_shard_docs(self) -> None:
+        """Per-shard corpus sizes from the shard engines' describe()
+        (fed by the cluster_shard.json sidecars) so partial answers
+        report a docs_fraction, not just a shard count.  Best-effort:
+        retries in the background until every shard has answered once;
+        until then coverage falls back to the shard-count fraction."""
+        while not self._draining:
+            answers = self._rpc_all_blocking({"op": "stats"}, 2.0)
+            for s, a in enumerate(answers):
+                if not isinstance(a, dict):
+                    continue
+                eng = (a.get("stats") or {}).get("engine") or {}
+                cl = eng.get("cluster") or {}
+                ld, td = cl.get("local_docs"), cl.get("total_docs")
+                if isinstance(ld, int):
+                    self._shard_docs[s] = ld
+                if isinstance(td, int):
+                    self._total_docs = td
+            if all(d is not None for d in self._shard_docs):
+                return
+            time.sleep(0.5)
+
+    def _coverage(self, missing: list) -> dict:
+        """The coverage block a degraded answer carries: how many
+        shards answered, which are missing, and the fraction of the
+        corpus' documents the answer covers (shard-count fraction when
+        per-shard doc counts have not been learned yet)."""
+        nd = len(self.shards)
+        miss = sorted(set(missing))
+        answered = nd - len(miss)
+        cov = {"shards_answered": answered, "shards_total": nd,
+               "missing": miss}
+        docs, total = self._shard_docs, self._total_docs
+        if total and all(d is not None for d in docs):
+            have = sum(d for i, d in enumerate(docs) if i not in miss)
+            frac = have / total
+        else:
+            frac = answered / nd if nd else 0.0
+        cov["docs_fraction"] = round(frac, 6)
+        return cov
 
     # -- client plumbing ------------------------------------------------
 
@@ -450,6 +548,17 @@ class RouterDaemon:
             self._count("bad_request")
             self._reply_error(conn, rid, tid, "bad_request", err)
             return
+        pp = req.get("partial_policy")
+        if pp is None:
+            policy, min_cov = self.partial_default
+        else:
+            try:
+                policy, min_cov = parse_partial_policy(pp)
+            except ValueError as e:
+                self._count("bad_request")
+                self._reply_error(conn, rid, tid, "bad_request",
+                                  str(e))
+                return
         if self._draining:
             self._count("draining_rejected")
             self._reply_error(conn, rid, tid, "draining",
@@ -481,10 +590,11 @@ class RouterDaemon:
             # letter top_k needs multi-round refinement: run it on a
             # throwaway thread (rare op; the hot ops stay threadless)
             threading.Thread(
-                target=self._letter_topk, args=(conn, req, tid),
+                target=self._letter_topk,
+                args=(conn, req, tid, policy, min_cov),
                 daemon=True, name="mri-router-letter").start()
             return
-        self._start_scatter(conn, req, tid)
+        self._start_scatter(conn, req, tid, policy, min_cov)
 
     # the daemon's validation table, minus engine concerns
     @staticmethod
@@ -516,13 +626,16 @@ class RouterDaemon:
         out.update(overrides)
         return (json.dumps(out, separators=(",", ":")) + "\n").encode()
 
-    def _start_scatter(self, conn, req: dict, tid) -> None:
+    def _start_scatter(self, conn, req: dict, tid,
+                       policy: str = "fail",
+                       min_cov: float = 1.0) -> None:
         rpc_id = pool_mod.next_rpc_id()
         line = self._encode_shard_req(req, rpc_id, tid)
         sc = _Scatter(conn, req.get("id"), req["op"], tid, line,
                       rpc_id, time.monotonic(),
                       bool(req.get("explain", False)),
-                      int(req.get("k") or 0), len(self.shards))
+                      int(req.get("k") or 0), len(self.shards),
+                      policy=policy, min_cov=min_cov)
         dl = req.get("deadline_ms")
         if dl is not None:
             sc.deadline_timer = self.clock.schedule(
@@ -537,37 +650,69 @@ class RouterDaemon:
             sc.calls[shard] = call
             self._issue(sc, shard, call)
 
-    def _issue(self, sc: _Scatter, shard: int,
-               call: _ShardCall) -> None:
+    def _attempt_cap(self, client) -> int:
+        """Hard per-leg send bound: three passes over the replica set
+        (the old exclusion-reset semantics), floor 4.  A persistently
+        retryable replica — say stale_generation forever — must turn
+        into a prompt typed failure, not spin until the deadline."""
+        return max(4, 3 * len(client.replicas))
+
+    def _issue(self, sc: _Scatter, shard: int, call: _ShardCall,
+               charge_budget: bool = True) -> None:
         """Send (or resend) one shard leg on the best replica.  Never
         called (and never calls anything) while holding ``sc.lock``
         across a socket send — a send-side connection death resolves
-        other scatters' callbacks synchronously."""
+        other scatters' callbacks synchronously.
+
+        Every resend is bounded by the per-leg attempt cap; resends
+        that answer a typed shed (``charge_budget``) additionally
+        spend the shard's token-bucket retry budget, so a
+        browning-out shard cannot attract a compounding retry storm.
+        Failover after a connection death rides free
+        (``charge_budget=False``): the replica is *gone*, not
+        refusing, and re-homing its leg is the availability contract,
+        not load amplification — a killed replica must not turn a
+        burst of in-flight requests into typed failures because the
+        bucket could not cover them all at once."""
         client = self.shards[shard]
         with sc.lock:
             if sc.done or call.done:
                 return
-            ri = client.pick(tuple(call.tried))
-            if ri < 0 and call.resets < 2:
-                # every replica tried, but a timed-out RPC or a dead
-                # pooled connection is not proof the replica itself is
-                # gone — clear the exclusion set and re-dial.  Bounded,
-                # so a genuinely dead shard still fails promptly.
-                call.resets += 1
-                call.tried.clear()
-                ri = client.pick(())
-            if ri >= 0:
+            fail = None
+            if call.attempts > 0:
+                if call.attempts >= self._attempt_cap(client):
+                    fail = (f"shard {shard}: attempt cap "
+                            f"({self._attempt_cap(client)}) reached")
+                elif charge_budget and not client.budget.try_spend():
+                    self._count("retry_denied")
+                    fail = f"shard {shard}: retry budget exhausted"
+            ri = -1
+            if fail is None:
+                ri = client.pick(tuple(call.tried))
+                if ri < 0 and call.tried:
+                    # every replica tried this round, but a timed-out
+                    # RPC or a dead pooled connection is not proof the
+                    # replica itself is gone — clear the exclusion set
+                    # and re-dial (the attempt cap bounds this)
+                    call.tried.clear()
+                    ri = client.pick(())
+                if ri < 0:
+                    fail = (f"shard {shard}: no replica admits "
+                            "traffic (down or breaker-open)")
+            if fail is None:
                 if call.tried and ri not in call.tried:
                     self._count("failovers")
                     sc.failovers += 1
                 call.tried.add(ri)
                 call.live += 1
+                call.attempts += 1
+                if call.attempts == 1:
+                    client.budget.deposit()
+                if call.first_replica < 0:
+                    call.first_replica = ri
             call.t0 = call.t0 or time.monotonic()
-            if ri >= 0 and call.first_replica < 0:
-                call.first_replica = ri
-        if ri < 0:
-            self._shard_failed(sc, shard,
-                               f"shard {shard}: no replica left")
+        if fail is not None:
+            self._leg_unanswerable(sc, shard, call, fail)
             return
         # the hedge timer arms BEFORE the send: a stalled send (slow
         # shard, full kernel buffer) is exactly what hedges exist to
@@ -590,7 +735,7 @@ class RouterDaemon:
                 call.live = max(0, call.live - 1)
                 retry = call.live == 0 and not (sc.done or call.done)
             if retry:
-                self._issue(sc, shard, call)
+                self._issue(sc, shard, call, charge_budget=False)
             return
         with sc.lock:
             call.conns.append(conn)
@@ -605,8 +750,14 @@ class RouterDaemon:
             ri = client.hedge_pick(call.first_replica)
             if ri < 0 or ri in call.tried:
                 return
+            if not client.budget.try_spend():
+                # hedges ride the same retry budget: a tail-latency
+                # duplicate is exactly the load a brownout cannot absorb
+                self._count("retry_denied")
+                return
             call.tried.add(ri)
             call.live += 1
+            call.attempts += 1
         try:
             conn = client.conn(ri)
             conn.send(sc.rpc_id, sc.line,
@@ -635,20 +786,45 @@ class RouterDaemon:
                 if call is None or call.done:
                     continue
                 call.live = 0
-                stale.append((shard, call, list(call.conns)))
+                stale.append((shard, call, list(call.conns),
+                              tuple(call.tried)))
             sc.timeout_timer = self.clock.schedule(
                 self.rpc_timeout_s, lambda: self._rpc_timeout(sc))
-        for shard, call, conns in stale:
+        for shard, call, conns, tried in stale:
             self._count("shard_errors")
             for c in conns:
                 c.forget(sc.rpc_id)
-            self._issue(sc, shard, call)
+            # an unanswered window is failure evidence for every
+            # replica that was in flight — this is what walks a
+            # wedged-but-connected replica's breaker open.  The
+            # reissue is budget-free like a connection death: a
+            # wedged replica's burst of condemned in-flight legs is a
+            # failover event, not retry amplification (the attempt
+            # cap and the breaker bound it)
+            for ri in tried:
+                self.shards[shard].replicas[ri].breaker.record_failure()
+            self._issue(sc, shard, call, charge_budget=False)
 
     def _expire(self, sc: _Scatter) -> None:
+        salvage = False
         with sc.lock:
             if sc.done:
                 return
+            if sc.policy == "allow":
+                # deadline with partials in hand: give up the pending
+                # legs and answer from what arrived (the coverage
+                # floor is still enforced in _complete)
+                for shard, call in enumerate(sc.calls):
+                    if call is None or not call.done:
+                        if call is not None:
+                            call.done = True
+                        sc.missing.append(shard)
+                        sc.remaining -= 1
+                salvage = len(sc.missing) < len(sc.calls)
             sc.done = True
+        if salvage:
+            self._complete(sc)
+            return
         self._count("deadline_expired")
         self._teardown_calls(sc)
         self._finish(sc, {"error": "deadline_expired",
@@ -676,8 +852,79 @@ class RouterDaemon:
             self._count("internal_errors")
         elif kind == "deadline_expired":
             self._count("deadline_expired")
+        elif kind == "shard_unavailable":
+            self._count("shard_unavailable")
         self._teardown_calls(sc)
-        self._finish(sc, {"error": kind, "detail": detail})
+        payload = {"error": kind, "detail": detail}
+        if kind == "shard_unavailable":
+            payload["shard"] = shard
+        self._finish(sc, payload)
+
+    def _leg_unanswerable(self, sc: _Scatter, shard: int,
+                          call: _ShardCall, detail: str) -> None:
+        """This shard's leg cannot be answered: replicas exhausted or
+        breaker-rejected, attempt cap hit, or retry budget denied.
+        Under partial_policy ``allow`` the scatter completes without
+        it; under ``fail`` the whole request becomes a typed
+        ``shard_unavailable`` error naming the shard."""
+        if sc.policy != "allow":
+            self._shard_failed(sc, shard, detail,
+                               kind="shard_unavailable")
+            return
+        complete = False
+        with sc.lock:
+            if sc.done or call.done:
+                return
+            call.done = True
+            sc.missing.append(shard)
+            sc.remaining -= 1
+            if sc.remaining == 0:
+                sc.done = True
+                complete = True
+        if call.hedge_timer is not None:
+            self.clock.cancel(call.hedge_timer)
+        for c in call.conns:
+            c.forget(sc.rpc_id)
+        if complete:
+            self._complete(sc)
+
+    def _complete(self, sc: _Scatter) -> None:
+        """Every leg settled (answered, or given up under ``allow``):
+        cancel the timers, enforce the coverage floor, merge what
+        arrived, and flag the answer when shards are missing."""
+        for t in (sc.deadline_timer, sc.timeout_timer):
+            if t is not None:
+                self.clock.cancel(t)
+        self._teardown_calls(sc)
+        cov = self._coverage(sc.missing) if sc.missing else None
+        if cov is not None and (
+                cov["shards_answered"] == 0
+                or cov["docs_fraction"] < sc.min_cov):
+            self._count("shard_unavailable")
+            payload = {
+                "error": "shard_unavailable",
+                "detail": (f"shards {cov['missing']} unanswerable; "
+                           f"coverage {cov['docs_fraction']} below "
+                           f"min_coverage {sc.min_cov}"
+                           if cov["shards_answered"] else
+                           "no shard answered"),
+                "shard": cov["missing"][0],
+                "coverage": cov,
+            }
+            self._finish(sc, payload)
+            return
+        try:
+            out = self._merge(sc)
+            if cov is not None:
+                out["partial"] = True
+                out["coverage"] = cov
+                self._count("partial")
+            self._finish(sc, out)
+        except Exception as e:
+            log.exception("gather merge failed")
+            self._count("internal_errors")
+            self._finish(sc, {"error": "internal",
+                              "detail": f"gather failed: {e}"})
 
     def _on_part(self, sc: _Scatter, shard: int, replica: int,
                  payload) -> None:
@@ -688,6 +935,12 @@ class RouterDaemon:
         if payload is None or "error" in payload:
             kind = payload.get("error") if payload else None
             self._count("shard_errors")
+            if payload is not None and kind in _RETRYABLE:
+                # a refusing replica (overloaded / draining / stale)
+                # is breaker pressure, not an invitation to hammer it
+                client = self.shards[shard]
+                if 0 <= replica < len(client.replicas):
+                    client.replicas[replica].breaker.record_failure()
             if payload is not None and kind not in _RETRYABLE:
                 detail = (f"shard {shard}: {kind}: "
                           f"{payload.get('detail', '')}")
@@ -698,15 +951,19 @@ class RouterDaemon:
                 return
             # connection death / refusing replica: another attempt for
             # this leg may still be in flight (a hedge) — only reissue
-            # when this was the last one
+            # when this was the last one.  A typed shed spends retry
+            # budget; a dead connection (payload None) fails over free
             with sc.lock:
                 call.live = max(0, call.live - 1)
                 retry = call.live == 0 and not (sc.done or call.done)
             if retry:
-                self._issue(sc, shard, call)
+                self._issue(sc, shard, call,
+                            charge_budget=payload is not None)
             return
         client = self.shards[shard]
         client.latency.record(time.monotonic() - call.t0)
+        if 0 <= replica < len(client.replicas):
+            client.replicas[replica].breaker.record_success()
         merged = None
         with sc.lock:
             if sc.done or call.done:
@@ -724,19 +981,15 @@ class RouterDaemon:
         for c in call.conns:
             c.forget(sc.rpc_id)
         if merged:
-            for t in (sc.deadline_timer, sc.timeout_timer):
-                if t is not None:
-                    self.clock.cancel(t)
-            try:
-                self._finish(sc, self._merge(sc))
-            except Exception as e:
-                log.exception("gather merge failed")
-                self._count("internal_errors")
-                self._finish(sc, {"error": "internal",
-                                  "detail": f"gather failed: {e}"})
+            self._complete(sc)
 
     def _merge(self, sc: _Scatter) -> dict:
-        parts = sc.parts
+        # a missing shard (partial_policy=allow) left its part None —
+        # the merge over the remaining parts IS the monolith's answer
+        # restricted to the covered shards (disjoint doc spaces,
+        # global BM25 stats), which is the byte-parity contract the
+        # chaos soak holds degraded answers to
+        parts = [p for p in sc.parts if p is not None]
         if sc.op == "df":
             total = None
             for p in parts:
@@ -771,10 +1024,12 @@ class RouterDaemon:
                     "rpc_ms": {
                         str(i): round((time.monotonic()
                                        - sc.calls[i].t0) * 1e3, 3)
-                        for i in range(len(parts))},
+                        for i in range(len(sc.parts))
+                        if sc.calls[i] is not None},
                 },
                 "per_shard": {str(i): p.get("explain")
-                              for i, p in enumerate(parts)},
+                              for i, p in enumerate(sc.parts)
+                              if p is not None},
             }
         return out
 
@@ -844,65 +1099,110 @@ class RouterDaemon:
             ev.wait(max(0.0, deadline - time.monotonic()))
         return results
 
-    def _letter_topk(self, conn, req: dict, tid) -> None:
+    def _letter_topk(self, conn, req: dict, tid,
+                     policy: str = "fail",
+                     min_cov: float = 1.0) -> None:
         """Exact global letter top-k: rounds of (local k2-deep tops,
         exact global df sums) until the kth candidate provably beats
-        every unseen term.  ``terminated`` is guaranteed — k2 doubles
-        until every shard's letter range is exhausted."""
+        every unseen term.  Termination is guaranteed — k2 doubles
+        until every shard's letter range is exhausted.
+
+        Under partial_policy ``allow`` a shard that stops answering
+        mid-refinement is moved to the dead set and the refinement
+        restricts itself to the survivors — the answer is then the
+        restricted-corpus exact top-k, flagged with coverage."""
         k = int(req.get("k") or 0)
         letter = req["letter"]
         dl = req.get("deadline_ms")
         timeout_s = min(self.rpc_timeout_s,
                         dl / 1e3 if dl else self.rpc_timeout_s)
         t_admit = time.monotonic()
+        dead: set = set()
+        nd = len(self.shards)
         try:
             if k == 0:
-                self._answer_letter(conn, req, tid, t_admit, [])
+                self._answer_letter(conn, req, tid, t_admit, [],
+                                    dead, min_cov)
                 return
             k2 = max(k, 4)
             while True:
                 tops = self._rpc_all_blocking(
                     {"op": "top_k", "letter": letter, "k": k2},
                     timeout_s)
-                if any(t is None for t in tops):
-                    self._fail_letter(conn, req, tid, t_admit,
-                                      "shard unavailable")
+                miss = {i for i, t in enumerate(tops)
+                        if t is None} | dead
+                if miss and policy != "allow":
+                    self._fail_letter(
+                        conn, req, tid, t_admit,
+                        f"shards {sorted(miss)} unanswerable",
+                        kind="shard_unavailable", shard=min(miss))
                     return
-                exhausted = [len(t["top"]) < k2 for t in tops]
-                cands = sorted({term for t in tops
+                if len(miss) == nd:
+                    self._fail_letter(
+                        conn, req, tid, t_admit, "no shard answered",
+                        kind="shard_unavailable",
+                        shard=min(miss) if miss else 0)
+                    return
+                dead = miss
+                live = [i for i in range(nd) if i not in dead]
+                ltops = [tops[i] for i in live]
+                exhausted = [len(t["top"]) < k2 for t in ltops]
+                cands = sorted({term for t in ltops
                                 for term, _df in t["top"]})
                 if not cands:
-                    self._answer_letter(conn, req, tid, t_admit, [])
+                    self._answer_letter(conn, req, tid, t_admit, [],
+                                        dead, min_cov)
                     return
                 dfs = self._rpc_all_blocking(
                     {"op": "df", "terms": cands}, timeout_s)
-                if any(d is None for d in dfs):
-                    self._fail_letter(conn, req, tid, t_admit,
-                                      "shard unavailable")
-                    return
-                gdf = [sum(d["df"][i] for d in dfs)
-                       for i in range(len(cands))]
+                dmiss = {i for i in live if dfs[i] is None}
+                if dmiss:
+                    if policy != "allow":
+                        self._fail_letter(
+                            conn, req, tid, t_admit,
+                            f"shards {sorted(dmiss)} unanswerable",
+                            kind="shard_unavailable",
+                            shard=min(dmiss))
+                        return
+                    dead |= dmiss
+                    continue  # re-round over the shrunken live set
+                gdf = [sum(dfs[i]["df"][j] for i in live)
+                       for j in range(len(cands))]
                 ranked = sorted(zip(cands, gdf),
                                 key=lambda tg: (-tg[1], tg[0]))
                 # an unseen term's global df is at most the sum of the
                 # k2-th local dfs over shards that still have terms
                 threshold = sum(t["top"][-1][1]
-                                for t, ex in zip(tops, exhausted)
+                                for t, ex in zip(ltops, exhausted)
                                 if not ex and t["top"])
                 if all(exhausted) or (
                         len(ranked) >= k
                         and ranked[k - 1][1] > threshold):
                     self._answer_letter(conn, req, tid, t_admit,
-                                        ranked[:k])
+                                        ranked[:k], dead, min_cov)
                     return
                 k2 *= 2
         except Exception as e:
             log.exception("letter top_k failed")
             self._fail_letter(conn, req, tid, t_admit, str(e))
 
-    def _answer_letter(self, conn, req, tid, t_admit, ranked) -> None:
+    def _answer_letter(self, conn, req, tid, t_admit, ranked,
+                       missing=(), min_cov: float = 0.0) -> None:
+        cov = self._coverage(sorted(missing)) if missing else None
+        if cov is not None and cov["docs_fraction"] < min_cov:
+            self._fail_letter(
+                conn, req, tid, t_admit,
+                f"shards {cov['missing']} unanswerable; coverage "
+                f"{cov['docs_fraction']} below min_coverage {min_cov}",
+                kind="shard_unavailable", shard=cov["missing"][0],
+                coverage=cov)
+            return
         payload = {"ok": True,
                    "top": [[term, int(df)] for term, df in ranked]}
+        if cov is not None:
+            payload["partial"] = True
+            payload["coverage"] = cov
+            self._count("partial")
         rid = req.get("id")
         if rid is not None:
             payload["id"] = rid
@@ -913,13 +1213,27 @@ class RouterDaemon:
             self._inflight -= 1
         conn.enqueue(payload)
 
-    def _fail_letter(self, conn, req, tid, t_admit,
-                     detail: str) -> None:
-        self._count("internal_errors")
+    def _fail_letter(self, conn, req, tid, t_admit, detail: str,
+                     kind: str = "internal", shard: int | None = None,
+                     coverage: dict | None = None) -> None:
+        if kind == "shard_unavailable":
+            self._count("shard_unavailable")
+        else:
+            self._count("internal_errors")
         self._h_request.observe(time.monotonic() - t_admit)
         with self._count_lock:
             self._inflight -= 1
-        self._reply_error(conn, req.get("id"), tid, "internal", detail)
+        payload = {"error": kind, "detail": detail}
+        if shard is not None:
+            payload["shard"] = shard
+        if coverage is not None:
+            payload["coverage"] = coverage
+        rid = req.get("id")
+        if rid is not None:
+            payload["id"] = rid
+        if tid is not None:
+            payload["trace_id"] = tid
+        conn.enqueue(payload)
 
     # -- admin ----------------------------------------------------------
 
@@ -943,7 +1257,9 @@ class RouterDaemon:
             payload = {"ok": True, "live": True,
                        "ready": not reasons, "reasons": reasons,
                        "status": reasons[0] if reasons else "ok",
-                       "queue_depth": 0}
+                       "queue_depth": 0,
+                       "breakers_open": sum(s.breakers_open()
+                                            for s in self.shards)}
             if down:
                 payload["shards_down"] = down
         elif op == "slo":
@@ -978,6 +1294,12 @@ class RouterDaemon:
                 "shards": [sc.describe() for sc in self.shards],
                 "hedge_ms": self.hedge_ms,
                 "rpc_timeout_ms": round(self.rpc_timeout_s * 1e3, 3),
+                "partial_default": self.partial_spec,
+                "retry_budget_ratio": self.retry_budget_ratio,
+                "breakers_open": sum(s.breakers_open()
+                                     for s in self.shards),
+                "docs": {"per_shard": list(self._shard_docs),
+                         "total": self._total_docs},
             },
             "config": {
                 "max_inflight": self.max_inflight,
@@ -1018,6 +1340,19 @@ class RouterDaemon:
             self._g_inflight.set(self._inflight)
         self._g_draining.set(1 if self._draining else 0)
         self._g_ready.set(sum(s.ready_count() for s in self.shards))
+        state_code = {pool_mod.Breaker.CLOSED: 0,
+                      pool_mod.Breaker.HALF_OPEN: 1,
+                      pool_mod.Breaker.OPEN: 2}
+        open_n = 0
+        for s in self.shards:
+            for r in s.replicas:
+                st = r.breaker.state
+                if st != pool_mod.Breaker.CLOSED:
+                    open_n += 1
+                self.registry.gauge(
+                    f"mri_cluster_breaker_state_s{s.shard}_r{r.idx}"
+                ).set(state_code[st])
+        self._g_breakers.set(open_n)
         self._slo.set_gauges(self.registry)
         parts = [self.registry.render_text()]
         labels: list = [None]

@@ -216,7 +216,8 @@ _SERVE_KINDS = ("client-disconnect", "slow-client", "reload-corrupt",
 _SEGMENT_KINDS = ("append-torn-manifest", "compact-crash",
                   "tombstone-corrupt")
 _WAL_KINDS = ("wal-torn-record", "fetch-partial", "lease-steal")
-_CLUSTER_KINDS = ("shard-dead", "shard-slow", "router-conn-reset")
+_CLUSTER_KINDS = ("shard-dead", "shard-slow", "router-conn-reset",
+                  "shard-blackout", "overload-storm")
 
 #: What ``chaos:`` may sample by default — every kind the parallel host
 #: path recovers from in-run (sigkill is excluded: its story is the
@@ -251,8 +252,9 @@ WAL_CHAOS_KINDS = _WAL_KINDS
 
 #: What ``chaos:kinds=...`` may name for cluster soaks — the router's
 #: fault points (a shard replica's connection dying or stalling, a
-#: router client connection reset).  Named-only: they only fire inside
-#: a router process.
+#: router client connection reset, every replica of one shard going
+#: dark, a shard daemon shedding a synthetic overload storm).
+#: Named-only: they fire inside router/shard processes.
 CLUSTER_CHAOS_KINDS = _CLUSTER_KINDS
 
 
@@ -312,6 +314,7 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
     if head not in (_READ_KINDS + _DEATH_KINDS + _SERVE_KINDS
                     + _SEGMENT_KINDS + _WAL_KINDS + _CLUSTER_KINDS):
         raise FaultSpecError(f"unknown fault kind {head!r}")
+    saw_times = False
     for field in parts[1:]:
         if field == "all":
             rule.doc = None
@@ -326,6 +329,7 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
             rule.every = _parse_int(head, k, v)
         elif k == "times":
             rule.times = _parse_int(head, k, v)
+            saw_times = True
         elif k == "p":
             try:
                 rule.p = float(v)
@@ -400,6 +404,15 @@ def _parse_clause(clause: str, kv_global: dict) -> _Rule | None:
         rule.ms = 20.0
     if rule.kind == "router-conn-reset" and rule.req < 1:
         raise FaultSpecError("router-conn-reset needs req=N (1-based)")
+    if rule.kind == "shard-blackout" and not saw_times:
+        # a blackout is an outage, not a blip: every send to the shard
+        # dies until the rule is disarmed (override with times=N)
+        rule.times = -1
+    if rule.kind == "overload-storm":
+        if rule.req < 1:
+            rule.req = 1  # storm from the first data request
+        if not saw_times:
+            rule.times = 16  # a burst, not a single shed
     if rule.kind == "dispatcher-hang" and rule.ms <= 0:
         rule.ms = 500.0
     if rule.kind == "chaos":
@@ -469,6 +482,14 @@ def _sample_chaos(rule: _Rule) -> list[_Rule]:
                              ms=float(rng.choice((20, 50, 100)))))
         elif kind == "router-conn-reset":
             out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs)))
+        elif kind == "shard-blackout":
+            # pinned to one shard (soaks run small D): every replica
+            # of that shard refuses until the soak's recovery phase
+            out.append(_Rule(kind=kind, shard=rng.randrange(2),
+                             times=-1))
+        elif kind == "overload-storm":
+            out.append(_Rule(kind=kind, req=rng.randint(1, rule.reqs),
+                             times=rng.choice((8, 16, 32))))
         elif kind in _SEGMENT_KINDS + _WAL_KINDS:
             # no ordinal to pick: each fires once, on the next matching
             # segment mutation / fetch / lease check (times=1 default)
@@ -743,6 +764,31 @@ class FaultInjector:
             time.sleep(delay)
         return drop
 
+    def on_serve_admit(self, req: int) -> bool:
+        """Fires in the serve daemon as data request ``req`` (1-based
+        ordinal) is admitted, before it is queued.  True means the
+        daemon must shed it with a typed ``overloaded`` answer
+        (``overload-storm`` rule: fires for every request from ordinal
+        ``req=N`` on while its ``times`` budget lasts) — a synthetic
+        sustained overload the admission-control and router-breaker
+        soaks lean on without having to genuinely saturate the box.
+        An ``every=K`` clause sheds only every Kth request: an
+        INTERMITTENT overload, where the replica stays mostly healthy
+        so breakers correctly hold closed and the retry budget is the
+        only thing standing between a flaky shard and retry
+        amplification."""
+        if req < 1:
+            return False
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.kind != "overload-storm" or req < rule.req:
+                    continue
+                if rule.every is not None and req % rule.every != 0:
+                    continue
+                if self._fire_once(ri, rule):
+                    return True
+        return False
+
     def on_router_send(self, shard: int, replica: int) -> None:
         """Fires in the cluster router as an RPC is handed to the
         connection for ``(shard, replica)``.  ``shard-dead`` (matching
@@ -761,6 +807,13 @@ class FaultInjector:
                 if rule.replica is not None and rule.replica != replica:
                     continue
                 if rule.kind == "shard-dead":
+                    if self._fire_once(ri, rule):
+                        dead = True
+                elif rule.kind == "shard-blackout":
+                    # permanent by default (times=-1): EVERY send to
+                    # the matched shard dies, all replicas — the
+                    # replica-set-exhausted path partial results and
+                    # breakers exist for
                     if self._fire_once(ri, rule):
                         dead = True
                 elif rule.kind == "shard-slow":

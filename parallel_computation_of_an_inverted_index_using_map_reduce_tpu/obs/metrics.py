@@ -371,6 +371,30 @@ KNOWN_METRICS = (
     ("mri_cluster_shard_errors_total", "counter",
      "Shard RPC failures (connection loss / error responses) the "
      "router observed before any retry."),
+    # brownout degradation plane (router + daemon registries)
+    ("mri_cluster_shard_unavailable_total", "counter",
+     "Requests failed with the typed shard_unavailable error: a "
+     "shard's replica set was exhausted (or its leg timed out) under "
+     "partial_policy=fail, or coverage fell below min_coverage."),
+    ("mri_cluster_partial_total", "counter",
+     "Degraded answers served with partial=true coverage metadata "
+     "(partial_policy=allow riding out missing shards)."),
+    ("mri_cluster_retry_denied_total", "counter",
+     "Retries/hedges suppressed by the per-shard retry budget "
+     "(MRI_CLUSTER_RETRY_BUDGET token bucket empty)."),
+    ("mri_cluster_breakers_open", "gauge",
+     "Replica circuit breakers currently not closed (open or "
+     "half-open) across every shard."),
+    ("mri_cluster_breaker_state_s<shard>_r<replica>", "gauge",
+     "One replica's circuit-breaker state: 0 closed, 1 half-open, "
+     "2 open."),
+    ("mri_serve_codel_sheds_total", "counter",
+     "Requests shed by CoDel adaptive admission (typed overloaded "
+     "answer): queue delay stayed over MRI_SERVE_CODEL_TARGET_MS for "
+     "a full interval."),
+    ("mri_serve_codel_state", "gauge",
+     "CoDel admission controller state: 1 while in the dropping "
+     "regime, else 0."),
 )
 
 _HELP = {name: help for name, _kind, help in KNOWN_METRICS}

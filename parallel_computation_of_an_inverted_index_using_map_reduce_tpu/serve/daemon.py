@@ -153,6 +153,8 @@ COALESCE_ENV = "MRI_SERVE_COALESCE_US"
 QUEUE_ENV = "MRI_SERVE_QUEUE_DEPTH"
 BATCH_ENV = "MRI_SERVE_MAX_BATCH"
 DRAIN_ENV = "MRI_SERVE_DRAIN_S"
+CODEL_TARGET_ENV = "MRI_SERVE_CODEL_TARGET_MS"
+CODEL_INTERVAL_ENV = "MRI_SERVE_CODEL_INTERVAL_MS"
 
 #: Per-connection outbound response queue bound: past this, the peer
 #: is not reading and the connection is closed (counted) rather than
@@ -188,7 +190,103 @@ _COUNTER_NAMES = (
     ("mutations", "mri_serve_mutations_total"),
     ("mutation_rejected", "mri_serve_mutation_rejected_total"),
     ("stale_generation", "mri_serve_stale_generation_total"),
+    ("codel_sheds", "mri_serve_codel_sheds_total"),
 )
+
+
+class _CoDelGate:
+    """Controlled-delay admission: shed on sustained queue DELAY, not
+    queue depth.
+
+    The fixed bounded queue sheds only when it is completely full — by
+    then every queued request has already paid the worst-case wait,
+    and under sustained overload the daemon times out work it already
+    queued ("late and expensive").  This gate adapts CoDel (RFC 8289,
+    in its server-admission variant) to the dispatcher: the dispatcher
+    reports every popped request's queue delay via :meth:`on_delay`;
+    once the delay has stayed above ``target_s`` for a full
+    ``interval_s`` the gate enters the *dropping* state, where
+
+    * reader threads shed new arrivals at the control-law rate
+      (:meth:`should_shed`, next shed at ``interval/sqrt(count)`` —
+      pressure grows the longer the overload lasts), and
+    * the dispatcher sheds ALREADY-QUEUED requests whose delay
+      exceeds the target (:meth:`late_shed`) — cheap, pre-execution —
+      so the requests that DO execute carry bounded queueing.
+
+    The first on_delay below target exits dropping.  ``target_s`` 0
+    disables the gate entirely (fixed-queue behavior)."""
+
+    def __init__(self, target_s: float, interval_s: float,
+                 gauge=None, clock=time.monotonic):
+        self.target_s = target_s
+        self.interval_s = interval_s
+        self._gauge = gauge  # mri_serve_codel_state: 1 while dropping
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._first_above: float | None = None
+        self._dropping = False
+        self._drop_next = 0.0
+        self._count = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.target_s > 0
+
+    @property
+    def dropping(self) -> bool:
+        return self._dropping
+
+    def on_delay(self, delay_s: float) -> None:
+        """Dispatcher feed: the queue delay of a just-popped request."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        with self._lock:
+            if delay_s < self.target_s:
+                self._first_above = None
+                if self._dropping:
+                    self._dropping = False
+                    if self._gauge is not None:
+                        self._gauge.set(0)
+            elif self._first_above is None:
+                self._first_above = now
+            elif not self._dropping \
+                    and now - self._first_above >= self.interval_s:
+                self._dropping = True
+                # CoDel restart heuristic: a recent dropping episode
+                # resumes near its old rate instead of from scratch
+                self._count = self._count - 2 if self._count > 2 else 1
+                self._drop_next = now
+                if self._gauge is not None:
+                    self._gauge.set(1)
+
+    def should_shed(self) -> bool:
+        """Reader-thread admission check: shed this arrival?"""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if not self._dropping:
+                return False
+            now = self._clock()
+            if now < self._drop_next:
+                return False
+            self._count += 1
+            self._drop_next = now + \
+                self.interval_s / (self._count ** 0.5)
+            return True
+
+    def late_shed(self, delay_s: float) -> bool:
+        """Dispatcher dequeue check: while dropping, a request that
+        already waited past the target is shed before execution."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            return self._dropping and delay_s > self.target_s
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"dropping": self._dropping, "count": self._count}
 
 
 class _Request:
@@ -341,6 +439,8 @@ class ServeDaemon:
             else envknobs.get(BATCH_ENV)
         self.drain_s = drain_s if drain_s is not None \
             else envknobs.get(DRAIN_ENV)
+        self.codel_target_ms = envknobs.get(CODEL_TARGET_ENV)
+        self.codel_interval_ms = envknobs.get(CODEL_INTERVAL_ENV)
 
         self._engine_lock = threading.Lock()
         self._reload_lock = threading.Lock()
@@ -358,6 +458,9 @@ class ServeDaemon:
         self._g_queue_depth = self.registry.gauge("mri_serve_queue_depth")
         self._g_inflight = self.registry.gauge("mri_serve_inflight")
         self._g_draining = self.registry.gauge("mri_serve_draining")
+        self._codel = _CoDelGate(
+            self.codel_target_ms / 1e3, self.codel_interval_ms / 1e3,
+            gauge=self.registry.gauge("mri_serve_codel_state"))
         self._h_request = \
             self.registry.histogram("mri_serve_request_seconds")
         self._h_queue_wait = \
@@ -671,6 +774,29 @@ class ServeDaemon:
                         explain=bool(req.get("explain", False)))
         with conn.lock:
             conn.pending += 1
+        inj = faults.active()
+        if inj is not None and inj.on_serve_admit(seq):
+            # injected overload storm: this daemon pretends it cannot
+            # absorb the request — the typed refusal the router's
+            # breaker/budget machinery is soaked against
+            self._count("shed")
+            self._finish(item, {"error": "overloaded",
+                                "detail": "injected overload storm "
+                                          "(fault spec)"},
+                         admitted=False)
+            return
+        if self._codel.should_shed():
+            # adaptive admission: the queue's DELAY (not depth) says
+            # the daemon is past saturation — shed now, cheaply, while
+            # the request has cost nothing
+            self._count("shed")
+            self._count("codel_sheds")
+            self._finish(item, {"error": "overloaded",
+                                "detail": "queue delay over CoDel "
+                                          "target "
+                                          f"{self.codel_target_ms}ms"},
+                         admitted=False)
+            return
         try:
             self._queue.put_nowait(item)
             with self._count_lock:
@@ -856,6 +982,11 @@ class ServeDaemon:
             except queue.Empty:
                 if self._dispatch_stop.is_set():
                     return
+                # an empty queue IS a zero-delay observation: without
+                # it a drained-but-still-dropping gate would keep
+                # admission-shedding a modest retry stream forever —
+                # only dequeues exit dropping, and sheds never dequeue
+                self._codel.on_delay(0.0)
                 continue
             inj = faults.active()
             if inj is not None:
@@ -882,6 +1013,29 @@ class ServeDaemon:
                     break
                 rider.t_pop = time.monotonic()
                 batch.append(rider)
+            if self._codel.enabled:
+                # CoDel dequeue side: feed the gate every popped
+                # request's queue delay, and while dropping shed the
+                # ones that already waited past target BEFORE they
+                # reach the engine — executed requests then carry
+                # bounded queueing even under sustained overload
+                kept = []
+                for it in batch:
+                    delay = it.t_pop - it.t_admit
+                    self._codel.on_delay(delay)
+                    if self._codel.late_shed(delay):
+                        self._count("shed")
+                        self._count("codel_sheds")
+                        self._finish(
+                            it, {"error": "overloaded",
+                                 "detail": "queued past CoDel target "
+                                           f"{self.codel_target_ms}"
+                                           "ms"})
+                        continue
+                    kept.append(it)
+                if not kept:
+                    continue
+                batch = kept
             self._execute(batch)
 
     def _finish(self, item: _Request, payload: dict, *,
@@ -1405,7 +1559,10 @@ class ServeDaemon:
                 "queue_depth": self.queue_depth,
                 "max_batch": self.max_batch,
                 "drain_s": self.drain_s,
+                "codel_target_ms": self.codel_target_ms,
+                "codel_interval_ms": self.codel_interval_ms,
             },
+            "codel": self._codel.state(),
         }
 
     def _rolling_stats(self) -> dict:

@@ -84,6 +84,102 @@ def merge_doc_ids(parts) -> np.ndarray:
     return out.astype(np.int32)
 
 
+class ShardRestrictedOracle:
+    """The monolith's answers restricted to a covered subset of doc
+    shards — the byte-parity contract a *partial* cluster answer is
+    held to.
+
+    When the router degrades under ``partial_policy: allow`` (a shard's
+    replica set exhausted, its leg timed out), the gathered answer must
+    equal what a monolithic engine over the full corpus would return
+    with the missing shards' documents erased: doc shards are disjoint
+    and every shard scores with the injected GLOBAL BM25 stats, so
+    dropping a leg drops exactly that shard's docs from the merge and
+    nothing else.  This wrapper computes that reference answer from an
+    ordinary full-corpus :class:`~.engine.Engine` plus the covered gid
+    set, mirroring the router's merge rules:
+
+    * ``df`` — live df restricted to covered docs (the sum the router
+      takes over answered shards' local dfs);
+    * ``postings`` — covered docs only, ``None`` when no covered doc
+      holds the term (the router emits ``None`` when every answered
+      part is ``None``);
+    * ``query_and`` / ``query_or`` — covered docs only;
+    * ``top_k_scored`` — the full ranking filtered to covered docs,
+      then cut to k (scores are the monolith's floats untouched);
+    * ``top_k`` (letter) — terms re-ranked by restricted df,
+      zero-coverage terms dropped, ``(-df, term)`` order.
+
+    Test/chaos harness infrastructure: exactness over completeness —
+    everything is recomputed per call from the base engine.
+    """
+
+    def __init__(self, engine, covered_gids):
+        self._eng = engine
+        self._covered = frozenset(int(g) for g in covered_gids)
+
+    @classmethod
+    def round_robin(cls, engine, shards: int, covered,
+                    total_docs: int | None = None):
+        """Covered set for the partition tool's default assignment
+        (gid ``g`` lives on shard ``(g - 1) % shards``)."""
+        if total_docs is None:
+            total_docs = int(engine.artifact.max_doc_id)
+        cov = frozenset(int(s) for s in covered)
+        gids = [g for g in range(1, total_docs + 1)
+                if (g - 1) % shards in cov]
+        return cls(engine, gids)
+
+    def _mask(self, docs: np.ndarray) -> np.ndarray:
+        if not len(docs):
+            return np.asarray(docs, dtype=np.int32)
+        keep = np.array([int(d) in self._covered for d in docs])
+        return np.asarray(docs, dtype=np.int32)[keep]
+
+    def df(self, batch) -> np.ndarray:
+        out = np.zeros(len(batch), dtype=np.int64)
+        for j, col in enumerate(self._eng.postings(batch)):
+            if col is not None:
+                out[j] = len(self._mask(col))
+        return out
+
+    def postings(self, batch) -> list[np.ndarray | None]:
+        cols = []
+        for col in self._eng.postings(batch):
+            col = self._mask(col) if col is not None else col
+            cols.append(col if col is not None and len(col) else None)
+        return cols
+
+    def query_and(self, batch) -> np.ndarray:
+        return self._mask(self._eng.query_and(batch))
+
+    def query_or(self, batch) -> np.ndarray:
+        return self._mask(self._eng.query_or(batch))
+
+    def top_k_scored(self, batch, k: int) -> list[tuple[int, float]]:
+        if k <= 0:
+            return []
+        # the monolith's COMPLETE ranking (every OR candidate), then
+        # filter: a covered doc's rank among covered docs is its
+        # monolith rank with misses deleted — same (-score, id) order
+        full = self._eng.top_k_scored(
+            batch, int(len(self._eng.query_or(batch))))
+        return [(d, s) for d, s in full if d in self._covered][:k]
+
+    def top_k(self, letter, k: int) -> list[tuple[bytes, int]]:
+        every = self._eng.top_k(letter, self._eng.vocab_size)
+        if not every:
+            return []
+        terms = [t for t, _ in every]
+        dfs = self.df(self._eng.encode_batch(terms))
+        tally = [(t, int(d)) for t, d in zip(terms, dfs) if d > 0]
+        tally.sort(key=lambda kv: (-kv[1], kv[0]))
+        return tally[:max(k, 0)]
+
+    def encode_batch(self, terms) -> np.ndarray:
+        return self._eng.encode_batch(terms)
+
+
 class _Segment:
     """One opened segment: entry metadata, its Engine, its tombstones."""
 

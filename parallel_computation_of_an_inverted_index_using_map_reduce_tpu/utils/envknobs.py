@@ -307,6 +307,38 @@ declare("MRI_CLUSTER_RPC_TIMEOUT_MS", float, 30000.0,
         "failover retries) when the client request carries no "
         "deadline_ms of its own.",
         scope="serve", minimum=1.0)
+declare("MRI_CLUSTER_PARTIAL", str, "fail",
+        "Router default partial-result policy for requests carrying "
+        "no partial_policy field: 'fail' (any unanswerable shard "
+        "fails the whole request — byte-compat default) or "
+        "'allow[:min_coverage=F]' (answer from the shards that did "
+        "answer, flagged with partial+coverage metadata, provided at "
+        "least fraction F of the corpus answered; F defaults to 0).",
+        scope="serve")
+declare("MRI_CLUSTER_RETRY_BUDGET", float, 0.1,
+        "Router retry/hedge token budget per shard, as a ratio of "
+        "live (first-attempt) traffic: each original shard leg "
+        "deposits this many tokens and every retry or hedge spends "
+        "one, so brownout amplification is capped near (1 + ratio)x "
+        "instead of compounding; 0 disables retries and hedges "
+        "(first attempt only).",
+        scope="serve", minimum=0.0)
+declare("MRI_SERVE_CODEL_TARGET_MS", float, 0.0,
+        "CoDel-style adaptive admission target in ms: once the "
+        "dispatcher's observed queue delay stays above this for a "
+        "full MRI_SERVE_CODEL_INTERVAL_MS, the daemon sheds "
+        "('overloaded') early at admission and late at dequeue until "
+        "delay drops back under target, keeping executed requests' "
+        "queueing near the target under sustained overload; 0 "
+        "disables adaptive admission (fixed queue-depth shedding "
+        "only).",
+        scope="serve", minimum=0.0)
+declare("MRI_SERVE_CODEL_INTERVAL_MS", float, 100.0,
+        "CoDel sliding interval in ms: queue delay must exceed the "
+        "target this long before shedding starts, and it is the base "
+        "period of the control law that paces admission sheds while "
+        "the daemon stays over target.",
+        scope="serve", minimum=1.0)
 
 # -- observability ----------------------------------------------------
 declare("MRI_OBS_ENABLE", int, 1,

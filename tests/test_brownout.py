@@ -1,0 +1,739 @@
+"""Cluster brownout suite: partial-result degradation, circuit
+breakers, retry budgets, and CoDel adaptive admission.
+
+Five layers:
+
+* degradation primitives — the ``partial_policy`` grammar, the
+  per-replica :class:`Breaker` state machine (rolling window, cooldown,
+  single half-open probe, probe-gated recovery), the token-bucket
+  :class:`RetryBudget`, and the :class:`_CoDelGate` admission
+  controller, all under fake clocks;
+* fault grammar — ``shard-blackout`` / ``overload-storm`` parse with
+  their outage-shaped defaults and the ``chaos:`` sampler emits them;
+* restricted-parity oracle — :class:`ShardRestrictedOracle` with full
+  coverage IS the monolith, so the partial-merge contract has a
+  trustworthy reference;
+* router degradation — a blacked-out shard under ``fail`` policy is a
+  typed ``shard_unavailable`` naming the shard at EVERY op; under
+  ``allow`` the answer is flagged ``partial`` with coverage metadata
+  and is byte-identical to the oracle restricted to the live shards
+  (BM25 floats included), fuzzed across D in {2, 4, 8}; retries stay
+  bounded when every replica refuses forever (the retry-storm
+  regression); ``min_coverage`` floors degraded answers;
+* daemon admission — a dispatcher stall under CoDel turns into typed
+  ``overloaded`` sheds (counted, exactly-one-answer) instead of a
+  silently aging queue, and the gate re-closes once delay recovers.
+"""
+
+import contextlib
+import json
+import time
+
+import pytest
+
+from test_serve import build_corpus, naive_index
+from test_cluster import Client, cluster_up
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import (
+    faults,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    _top_render,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cluster import (
+    partition as part_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cluster import (
+    pool as pool_mod,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cluster.router import (
+    parse_partial_policy,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.corpus.synthetic import (
+    zipf_corpus,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.daemon import (
+    ServeDaemon,
+    _CoDelGate,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+    create_engine,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.multi_engine import (
+    ShardRestrictedOracle,
+)
+
+pytestmark = [pytest.mark.cluster, pytest.mark.serve]
+
+daemonized = pytest.mark.daemon
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+# -- partial_policy grammar ---------------------------------------------
+
+
+def test_parse_partial_policy_shapes():
+    assert parse_partial_policy("fail") == ("fail", 1.0)
+    assert parse_partial_policy("allow") == ("allow", 0.0)
+    assert parse_partial_policy("allow:min_coverage=0.5") == \
+        ("allow", 0.5)
+    assert parse_partial_policy(" allow:min_coverage=1 ") == \
+        ("allow", 1.0)
+    for bad in ("", "maybe", "allow:min_coverage=nope",
+                "allow:min_coverage=1.5", "allow:max_coverage=0.5",
+                3, None, ["allow"]):
+        with pytest.raises(ValueError):
+            parse_partial_policy(bad)
+
+
+# -- circuit breaker ----------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trips_on_windowed_failures():
+    clk = FakeClock()
+    b = pool_mod.Breaker(threshold=5, cooldown_s=1.0, clock=clk)
+    assert b.state == b.CLOSED and b.allow()
+    for _ in range(4):
+        b.record_failure()
+    assert b.state == b.CLOSED  # under threshold
+    b.record_failure()
+    assert b.state == b.OPEN
+    assert not b.allow()
+
+
+def test_breaker_needs_more_failures_than_successes():
+    clk = FakeClock()
+    b = pool_mod.Breaker(threshold=5, cooldown_s=1.0, clock=clk)
+    for _ in range(6):
+        b.record_success()
+    for _ in range(6):
+        b.record_failure()
+    assert b.state == b.CLOSED  # 6 err vs 6 ok: not strictly more
+    b.record_failure()
+    assert b.state == b.OPEN
+
+
+def test_breaker_window_expires_old_evidence():
+    clk = FakeClock()
+    b = pool_mod.Breaker(threshold=3, cooldown_s=1.0, clock=clk)
+    b.record_failure()
+    b.record_failure()
+    clk.t += pool_mod.Breaker.WINDOW_S + 1  # evidence ages out
+    b.record_failure()
+    assert b.state == b.CLOSED
+
+
+def test_breaker_half_open_single_probe_then_close_or_reopen():
+    clk = FakeClock()
+    b = pool_mod.Breaker(threshold=2, cooldown_s=1.0, clock=clk)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == b.OPEN and not b.allow()
+    clk.t += 1.5  # cooldown passed: exactly one probe admitted
+    assert b.allow()
+    assert b.state == b.HALF_OPEN
+    assert not b.allow()  # the probe slot is taken
+    b.record_failure()  # probe failed
+    assert b.state == b.OPEN and not b.allow()
+    clk.t += 1.5
+    assert b.allow()
+    b.record_success()  # probe succeeded
+    assert b.state == b.CLOSED and b.allow()
+    # recovery resets the window: one stray error must not re-open
+    b.record_failure()
+    assert b.state == b.CLOSED
+
+
+def test_breaker_health_verdict_closes():
+    clk = FakeClock()
+    b = pool_mod.Breaker(threshold=2, cooldown_s=1.0, clock=clk)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == b.OPEN
+    b.note_ready()  # prober heard a ready healthz
+    assert b.state == b.CLOSED and b.allow()
+
+
+# -- retry budget -------------------------------------------------------
+
+
+def test_retry_budget_token_bucket():
+    bud = pool_mod.RetryBudget(0.25, cap=8.0)  # binary-exact ratio
+    assert bud.tokens() == 2.0  # cold-start allowance
+    assert bud.try_spend() and bud.try_spend()
+    assert not bud.try_spend()  # bucket empty
+    assert bud.denied == 1
+    for _ in range(4):  # 4 live requests refill one token
+        bud.deposit()
+    assert bud.try_spend()
+    assert not bud.try_spend()
+    for _ in range(100):
+        bud.deposit()
+    assert bud.tokens() == 8.0  # capped
+
+
+def test_retry_budget_ratio_zero_disables_retries():
+    bud = pool_mod.RetryBudget(0.0)
+    assert bud.tokens() == 0.0
+    bud.deposit()
+    assert not bud.try_spend()
+
+
+# -- CoDel admission gate -----------------------------------------------
+
+
+def test_codel_gate_disabled_at_target_zero():
+    g = _CoDelGate(0.0, 0.1, clock=FakeClock())
+    g.on_delay(99.0)
+    assert not g.should_shed() and not g.late_shed(99.0)
+    assert not g.dropping
+
+
+def test_codel_gate_enters_dropping_after_full_interval():
+    clk = FakeClock()
+    g = _CoDelGate(0.005, 0.1, clock=clk)
+    g.on_delay(0.050)  # first above-target sighting arms the clock
+    assert not g.dropping
+    clk.t += 0.05
+    g.on_delay(0.050)  # only half an interval above target
+    assert not g.dropping
+    clk.t += 0.06
+    g.on_delay(0.050)  # sustained a full interval: dropping
+    assert g.dropping
+    assert g.late_shed(0.050)
+    assert not g.late_shed(0.001)
+    # control law: first shed immediate, next at interval/sqrt(2)
+    assert g.should_shed()
+    assert not g.should_shed()
+    clk.t += 0.1 / (2 ** 0.5) + 1e-6
+    assert g.should_shed()
+    # one below-target delay exits dropping at once
+    g.on_delay(0.001)
+    assert not g.dropping
+    assert not g.should_shed()
+
+
+def test_codel_gate_restart_resumes_near_old_rate():
+    clk = FakeClock()
+    g = _CoDelGate(0.005, 0.1, clock=clk)
+
+    def drive_into_dropping():
+        g.on_delay(0.05)
+        clk.t += 0.11
+        g.on_delay(0.05)
+
+    drive_into_dropping()
+    for _ in range(6):
+        g.should_shed()
+        clk.t += 1.0
+    count_before = g.state()["count"]
+    g.on_delay(0.001)  # recover
+    drive_into_dropping()
+    assert g.state()["count"] == count_before - 2
+
+
+# -- fault grammar ------------------------------------------------------
+
+
+def test_brownout_fault_kinds_parse_with_defaults():
+    inj = faults.FaultInjector("shard-blackout:shard=1")
+    assert inj.rules[0].times == -1  # an outage, not a blip
+    assert inj.rules[0].shard == 1
+    inj = faults.FaultInjector("shard-blackout:shard=0:times=2")
+    assert inj.rules[0].times == 2  # explicit budget respected
+    inj = faults.FaultInjector("overload-storm")
+    assert inj.rules[0].req == 1 and inj.rules[0].times == 16
+    inj = faults.FaultInjector("overload-storm:req=5:times=3")
+    assert [inj.on_serve_admit(i) for i in range(1, 10)] == \
+        [False] * 4 + [True] * 3 + [False] * 2
+
+
+def test_chaos_sampler_emits_cluster_brownout_kinds():
+    inj = faults.FaultInjector(
+        "chaos:seed=11:n=12:kinds=shard-blackout,overload-storm")
+    kinds = {r.kind for r in inj.rules}
+    assert kinds == {"shard-blackout", "overload-storm"}
+    for r in inj.rules:
+        if r.kind == "shard-blackout":
+            assert r.times == -1 and r.shard in (0, 1)
+        else:
+            assert r.req >= 1 and r.times in (8, 16, 32)
+    # determinism: same seed, same schedule
+    again = faults.FaultInjector(
+        "chaos:seed=11:n=12:kinds=shard-blackout,overload-storm")
+    assert [(r.kind, r.shard, r.req, r.times) for r in inj.rules] == \
+        [(r.kind, r.shard, r.req, r.times) for r in again.rules]
+
+
+# -- cluster fixtures ---------------------------------------------------
+
+DOCS = zipf_corpus(num_docs=48, vocab_size=600, tokens_per_doc=60,
+                   seed=23)
+
+
+@pytest.fixture(scope="module")
+def mono(tmp_path_factory):
+    out = build_corpus(tmp_path_factory.mktemp("brown_mono"), DOCS)
+    return out, naive_index(DOCS)
+
+
+@pytest.fixture(scope="module")
+def clusters(tmp_path_factory, mono):
+    out, _ = mono
+    src = out.parent / "list.txt"
+    dirs = {}
+    for d in (2, 4, 8):
+        cl = tmp_path_factory.mktemp(f"brown_d{d}")
+        part_mod.partition(src, d, cl)
+        dirs[d] = cl
+    return src, dirs
+
+
+def _wait_docs_learned(router, deadline_s: float = 5.0) -> None:
+    """Block until the router's background learner has the per-shard
+    doc counts (so coverage reports docs_fraction, not a shard count)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        docs = router.stats()["cluster"]["docs"]
+        if docs["total"] and all(d is not None
+                                 for d in docs["per_shard"]):
+            return
+        time.sleep(0.02)
+    raise AssertionError("router never learned per-shard doc counts")
+
+
+# -- restricted-parity oracle -------------------------------------------
+
+
+def test_oracle_full_coverage_is_the_monolith(mono):
+    out, naive = mono
+    eng = create_engine(str(out), engine="host")
+    try:
+        oracle = ShardRestrictedOracle.round_robin(
+            eng, 2, covered={0, 1})
+        terms = sorted(naive)[:4]
+        batch = eng.encode_batch(terms)
+        assert oracle.df(batch).tolist() == eng.df(batch).tolist()
+        want = [None if p is None else p.tolist()
+                for p in eng.postings(batch)]
+        got = [None if p is None else p.tolist()
+               for p in oracle.postings(batch)]
+        assert got == want
+        assert oracle.query_and(batch).tolist() == \
+            eng.query_and(batch).tolist()
+        assert oracle.query_or(batch).tolist() == \
+            eng.query_or(batch).tolist()
+        assert oracle.top_k_scored(batch, 10) == \
+            eng.top_k_scored(batch, 10)
+        assert oracle.top_k("t", 5) == eng.top_k("t", 5)
+    finally:
+        eng.close()
+
+
+def test_oracle_restriction_drops_missing_shard_docs(mono):
+    out, naive = mono
+    eng = create_engine(str(out), engine="host")
+    try:
+        oracle = ShardRestrictedOracle.round_robin(eng, 2, covered={1})
+        terms = sorted(naive)[:4]
+        batch = eng.encode_batch(terms)
+        # shard 1 of D=2 round-robin owns the EVEN gids
+        for p in oracle.postings(batch):
+            if p is not None:
+                assert all(d % 2 == 0 for d in p.tolist())
+        for d, _s in oracle.top_k_scored(batch, 20):
+            assert d % 2 == 0
+        # a term whose postings are all odd gids vanishes (None, not [])
+        only_odd = [t for t, posts in naive.items()
+                    if posts and all(g % 2 == 1 for g in posts)]
+        if only_odd:
+            got = oracle.postings(eng.encode_batch(only_odd[:1]))
+            assert got[0] is None
+    finally:
+        eng.close()
+
+
+# -- router degradation: blackout × policy × op -------------------------
+
+
+@daemonized
+def test_blackout_fail_policy_types_every_op(clusters):
+    """Default policy: a blacked-out shard is a typed
+    ``shard_unavailable`` error NAMING the shard, at every data op."""
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, _):
+        _wait_docs_learned(router)
+        faults.install("shard-blackout:shard=0")
+        with Client(router) as c:
+            ops = [
+                dict(op="df", terms=["the"]),
+                dict(op="postings", terms=["the"]),
+                dict(op="and", terms=["the"]),
+                dict(op="or", terms=["the"]),
+                dict(op="top_k", terms=["the"], k=3, score="bm25"),
+                dict(op="top_k", letter="t", k=3),
+            ]
+            for i, req in enumerate(ops):
+                r = c.rpc(id=i, **req)
+                assert r["error"] == "shard_unavailable", r
+                assert r["shard"] == 0
+        st = router.stats()
+        assert st["counters"]["shard_unavailable"] >= len(ops)
+        assert st["counters"]["partial"] == 0
+
+
+@daemonized
+def test_blackout_allow_policy_answers_partial(clusters, mono):
+    """``allow``: the gathered answer is flagged partial with coverage
+    metadata and equals the monolith restricted to the live shard —
+    BM25 floats byte-identical through the JSON round-trip."""
+    out, naive = mono
+    _, dirs = clusters
+    eng = create_engine(str(out), engine="host")
+    try:
+        oracle = ShardRestrictedOracle.round_robin(eng, 2, covered={1})
+        terms = sorted(naive)[:3]
+        batch = eng.encode_batch(terms)
+        with cluster_up(dirs[2], 2) as (router, _):
+            _wait_docs_learned(router)
+            faults.install("shard-blackout:shard=0")
+            with Client(router) as c:
+                r = c.rpc(id=1, op="df", terms=terms,
+                          partial_policy="allow")
+                assert r["ok"] and r["partial"] is True
+                cov = r["coverage"]
+                assert cov["shards_answered"] == 1
+                assert cov["shards_total"] == 2
+                assert cov["missing"] == [0]
+                assert cov["docs_fraction"] == 0.5  # 24 of 48 docs
+                assert r["df"] == oracle.df(batch).tolist()
+
+                r = c.rpc(id=2, op="postings", terms=terms,
+                          partial_policy="allow")
+                want = [None if p is None else p.tolist()
+                        for p in oracle.postings(batch)]
+                assert r["partial"] and r["postings"] == want
+
+                r = c.rpc(id=3, op="and", terms=terms,
+                          partial_policy="allow")
+                assert r["docs"] == oracle.query_and(batch).tolist()
+
+                r = c.rpc(id=4, op="or", terms=terms,
+                          partial_policy="allow")
+                assert r["docs"] == oracle.query_or(batch).tolist()
+
+                r = c.rpc(id=5, op="top_k", terms=terms, k=7,
+                          score="bm25", partial_policy="allow")
+                want = [[doc, score] for doc, score
+                        in oracle.top_k_scored(batch, 7)]
+                assert r["partial"] and r["docs"] == want  # floats exact
+
+                r = c.rpc(id=6, op="top_k", letter="t", k=4,
+                          partial_policy="allow")
+                want = [[t.decode("ascii"), int(df)] for t, df
+                        in oracle.top_k("t", 4)]
+                assert r["partial"] and r["top"] == want
+                assert r["coverage"]["missing"] == [0]
+            st = router.stats()
+            assert st["counters"]["partial"] >= 6
+            assert st["counters"]["shard_unavailable"] == 0
+    finally:
+        eng.close()
+
+
+@daemonized
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_blackout_partial_parity_fuzz(clusters, mono, d):
+    """Fuzz across D: with one shard blacked out, every degraded answer
+    matches the shard-restricted oracle exactly."""
+    import random
+
+    out, naive = mono
+    _, dirs = clusters
+    vocab = sorted(naive)
+    rng = random.Random(500 + d)
+    dead = rng.randrange(d)
+    eng = create_engine(str(out), engine="host")
+    try:
+        oracle = ShardRestrictedOracle.round_robin(
+            eng, d, covered=set(range(d)) - {dead})
+        with cluster_up(dirs[d], d) as (router, _):
+            _wait_docs_learned(router)
+            faults.install(f"shard-blackout:shard={dead}")
+            with Client(router) as c:
+                for i in range(12):
+                    terms = rng.sample(vocab, rng.randint(1, 4))
+                    batch = eng.encode_batch(terms)
+                    r = c.rpc(id=i, op="df", terms=terms,
+                              partial_policy="allow")
+                    assert r["ok"] and r["coverage"]["missing"] == \
+                        [dead]
+                    assert r["df"] == oracle.df(batch).tolist()
+                    r = c.rpc(id=i, op="or", terms=terms,
+                              partial_policy="allow")
+                    assert r["docs"] == \
+                        oracle.query_or(batch).tolist()
+                    k = rng.randint(1, 10)
+                    r = c.rpc(id=i, op="top_k", terms=terms, k=k,
+                              score="bm25", partial_policy="allow")
+                    want = [[doc, score] for doc, score
+                            in oracle.top_k_scored(batch, k)]
+                    assert r["docs"] == want
+    finally:
+        eng.close()
+
+
+@daemonized
+def test_min_coverage_floor_rejects_thin_answers(clusters):
+    """allow:min_coverage above the surviving fraction: typed failure
+    WITH the coverage block, so the client sees how short it fell."""
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, _):
+        _wait_docs_learned(router)
+        faults.install("shard-blackout:shard=0")
+        with Client(router) as c:
+            r = c.rpc(id=1, op="df", terms=["the"],
+                      partial_policy="allow:min_coverage=0.9")
+            assert r["error"] == "shard_unavailable"
+            assert r["coverage"]["docs_fraction"] == 0.5
+            assert r["shard"] == 0
+            # floor at/below the surviving fraction still answers
+            r = c.rpc(id=2, op="df", terms=["the"],
+                      partial_policy="allow:min_coverage=0.5")
+            assert r["ok"] and r["partial"] is True
+
+
+@daemonized
+def test_bad_partial_policy_is_bad_request(clusters):
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, _), Client(router) as c:
+        r = c.rpc(id=1, op="df", terms=["the"],
+                  partial_policy="sometimes")
+        assert r["error"] == "bad_request"
+        assert "partial_policy" in r["detail"]
+
+
+@daemonized
+def test_env_default_policy_applies(clusters, monkeypatch):
+    monkeypatch.setenv("MRI_CLUSTER_PARTIAL", "allow")
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, _):
+        assert router.partial_default == ("allow", 0.0)
+        _wait_docs_learned(router)
+        faults.install("shard-blackout:shard=1")
+        with Client(router) as c:
+            r = c.rpc(id=1, op="df", terms=["the"])  # no per-request
+            assert r["ok"] and r["partial"] is True
+            assert r["coverage"]["missing"] == [1]
+
+
+# -- bounded retries under persistent refusal (the storm regression) ----
+
+
+@daemonized
+def test_retries_bounded_when_every_replica_refuses(clusters):
+    """Every replica of every shard sheds forever (overload storm):
+    the router must answer a typed error promptly with a BOUNDED
+    number of shard RPCs — no retry storm, no hang — even with the
+    budget knob giving it cold-start tokens."""
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2, replicas=2) as (router, _):
+        base = router.stats()["counters"]["scatter_rpcs"]
+        faults.install("overload-storm:req=1:times=-1")
+        with Client(router) as c:
+            t0 = time.monotonic()
+            r = c.rpc(id=1, op="df", terms=["the"])
+            elapsed = time.monotonic() - t0
+        assert r["error"] == "shard_unavailable"
+        assert elapsed < 5.0  # typed failure, not a deadline crawl
+        st = router.stats()["counters"]
+        # per leg: at most the attempt cap (3 passes over 2 replicas)
+        assert st["scatter_rpcs"] - base <= 2 * 6 + 4
+        assert st["retry_denied"] >= 1
+
+
+@daemonized
+def test_breaker_opens_under_blackout_and_recovers(clusters):
+    """Sustained blackout walks the shard's breakers open (visible in
+    stats/healthz); disarming the fault lets the health prober close
+    them again — probe-gated recovery, no manual reset."""
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, _):
+        _wait_docs_learned(router)
+        faults.install("shard-blackout:shard=0")
+        with Client(router) as c:
+            for i in range(12):
+                r = c.rpc(id=i, op="df", terms=["the"],
+                          partial_policy="allow")
+                assert r["ok"]
+            deadline = time.monotonic() + 5.0
+            opened = 0
+            while time.monotonic() < deadline:
+                opened = router.stats()["cluster"]["breakers_open"]
+                if opened:
+                    break
+                c.rpc(id=99, op="df", terms=["the"],
+                      partial_policy="allow")
+            assert opened >= 1
+            h = c.rpc(id=100, op="healthz")
+            assert h["breakers_open"] >= 1
+            # recovery: disarm, wait for the prober to re-close
+            faults.install(None)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if router.stats()["cluster"]["breakers_open"] == 0:
+                    break
+                time.sleep(0.05)
+            assert router.stats()["cluster"]["breakers_open"] == 0
+            r = c.rpc(id=101, op="df", terms=["the"])
+            assert r["ok"] and "partial" not in r
+
+
+@daemonized
+def test_breaker_state_in_metrics_and_top(clusters):
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, _), Client(router) as c:
+        text = c.rpc(id=1, op="metrics")["text"]
+        assert "mri_cluster_breakers_open 0" in text
+        assert "mri_cluster_breaker_state_s0_r0 0" in text
+        assert "mri_cluster_breaker_state_s1_r0 0" in text
+        st = c.rpc(id=2, op="stats")["stats"]
+        sample = {"healthz": c.rpc(id=3, op="healthz"),
+                  "stats": st, "slo": {}}
+        frame = _top_render("r:1", sample)
+        assert "breaker" in frame
+        assert "closed" in frame
+        assert "coverage: 2/2 shards answerable" in frame
+        assert "DEGRADED" not in frame
+
+
+def test_top_render_flags_degraded_fleet():
+    sample = {
+        "healthz": {"ready": True, "status": "ok", "reasons": []},
+        "stats": {
+            "queue_depth": 0, "inflight": 0, "connections": 1,
+            "counters": {}, "rolling": {},
+            "cluster": {
+                "partial_default": "allow", "breakers_open": 1,
+                "shards": [
+                    {"shard": 0, "p95_ms": 1.0, "replicas": [
+                        {"addr": "h:1", "ready": False,
+                         "reasons": ["connection_lost"],
+                         "primary": True, "breaker": "open"}]},
+                    {"shard": 1, "p95_ms": 1.0, "replicas": [
+                        {"addr": "h:2", "ready": True, "reasons": [],
+                         "primary": True, "breaker": "closed"}]},
+                ]},
+        },
+        "slo": {},
+    }
+    frame = _top_render("r:1", sample)
+    assert "coverage: 1/2 shards answerable" in frame
+    assert "[DEGRADED]" in frame
+    assert "open" in frame and "breakers_open=1" in frame
+
+
+# -- daemon CoDel admission ---------------------------------------------
+
+
+@daemonized
+def test_codel_sheds_typed_overloaded_under_stall(mono, monkeypatch):
+    """A wedged dispatcher with CoDel armed: queued requests that aged
+    past target are shed as typed ``overloaded`` answers (counted),
+    every request gets exactly one answer, and the gate re-closes once
+    the queue drains."""
+    out, _ = mono
+    monkeypatch.setenv("MRI_SERVE_CODEL_TARGET_MS", "1")
+    monkeypatch.setenv("MRI_SERVE_CODEL_INTERVAL_MS", "5")
+    # hang EVERY one of the first few batch pickups: a single stall
+    # lets the dispatcher drain the whole backlog within one CoDel
+    # interval, never sustaining the over-target delay the gate needs
+    faults.install("dispatcher-hang:ms=120:times=4")
+    # queue deep enough that the fixed bound never fires (every shed
+    # must come from the CoDel gate) and batches small enough that the
+    # backlog spans several hung pickups instead of draining in one
+    daemon = ServeDaemon(str(out), coalesce_us=0, queue_depth=2048,
+                         max_batch=32)
+    daemon.start()
+    try:
+        with Client(daemon) as c:
+            n = 300
+            for i in range(n):
+                c.send(id=i, op="df", terms=["the"])
+            got = [c.recv() for _ in range(n)]
+        assert sorted(r["id"] for r in got) == list(range(n))
+        ok = [r for r in got if r.get("ok")]
+        shed = [r for r in got if r.get("error") == "overloaded"]
+        assert len(ok) + len(shed) == n
+        assert shed, "CoDel shed nothing under a 400ms stall"
+        assert any("CoDel" in r["detail"] for r in shed)
+        st = daemon.stats()
+        assert st["counters"]["codel_sheds"] >= len(shed)
+        assert st["config"]["codel_target_ms"] == 1.0
+        # drained queue: the gate stays dropping (admission sheds at
+        # the control-law cadence) until one request slips through,
+        # reports a below-target delay, and re-closes it
+        with Client(daemon) as c:
+            deadline = time.monotonic() + 5.0
+            recovered = False
+            i = 999
+            while time.monotonic() < deadline and not recovered:
+                recovered = c.rpc(id=i, op="df",
+                                  terms=["the"]).get("ok", False)
+                i += 1
+                time.sleep(0.01)
+            assert recovered
+            assert daemon.stats()["codel"]["dropping"] is False
+    finally:
+        daemon.drain()
+
+
+@daemonized
+def test_codel_off_by_default_keeps_fixed_queue_semantics(mono):
+    out, _ = mono
+    daemon = ServeDaemon(str(out), coalesce_us=0)
+    daemon.start()
+    try:
+        assert daemon.stats()["config"]["codel_target_ms"] == 0.0
+        with Client(daemon) as c:
+            r = c.rpc(id=1, op="df", terms=["the"])
+            assert r["ok"]
+        assert daemon.stats()["counters"]["codel_sheds"] == 0
+    finally:
+        daemon.drain()
+
+
+@daemonized
+def test_overload_storm_feeds_router_breakers(clusters):
+    """A shard daemon in a (injected) sustained overload storm: the
+    router converts the typed ``overloaded`` refusals into breaker
+    pressure instead of hammering the replica."""
+    _, dirs = clusters
+    with cluster_up(dirs[2], 2) as (router, daemons):
+        _wait_docs_learned(router)
+        # the injector is process-global, so every daemon storms —
+        # what matters is that each refusal lands as breaker evidence
+        # and the router's answer stays typed and bounded
+        faults.install("overload-storm:req=1:times=-1")
+        with Client(router) as c:
+            r = c.rpc(id=1, op="df", terms=["the"],
+                      partial_policy="allow")
+            # every shard refuses: nothing to answer from
+            assert r["error"] == "shard_unavailable"
+        st = router.stats()["counters"]
+        assert st["shard_errors"] >= 2
+        assert st["retry_denied"] >= 1

@@ -76,6 +76,15 @@ _BASIS = {
         "in-memory build on a {}x-budget corpus (zero-spill {}x)"
         .format(d["gates"]["corpus_over_budget"],
                 d["gates"]["zero_spill_overhead_x"])),
+    "BENCH_BROWNOUT_r19.json": lambda d, ln: (
+        "value IS the ratio: scatter RPCs per request*D under an "
+        "intermittent overload (loose budget {}x; gate {}x); CoDel "
+        "storm p99 {}x unloaded vs fixed-queue {}x".format(
+            d["storm_amplification"]["loose"]["amplification"],
+            d["amplification_gate"],
+            d["storm"]["compliant_p99_x_unloaded"],
+            round(d["storm"]["fixed_queue"]["compliant_p99_ms"]
+                  / d["storm"]["unloaded"]["compliant_p99_ms"], 1))),
 }
 
 _JSON_LINE_RE = re.compile(r"^\{.*\}$", re.M)

@@ -1378,7 +1378,7 @@ def _cluster_make_base(work: Path):
     return cluster, probes
 
 
-def _spawn_router(spec: str, env_extra=None):
+def _spawn_router(spec: str, env_extra=None, extra=()):
     env = dict(os.environ, PYTHONPATH=str(REPO_ROOT),
                JAX_PLATFORMS="cpu")
     if env_extra:
@@ -1386,7 +1386,8 @@ def _spawn_router(spec: str, env_extra=None):
     proc = subprocess.Popen(
         [sys.executable, "-m",
          "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
-         "router", "--shards", spec, "--listen", "127.0.0.1:0"],
+         "router", "--shards", spec, "--listen", "127.0.0.1:0",
+         *extra],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
         cwd=str(REPO_ROOT), text=True)
     line = proc.stdout.readline()
@@ -1597,6 +1598,267 @@ def run_cluster_soak(work_dir: Path, trials: int, seed_base: int,
     }
 
 
+# -- brownout soak ------------------------------------------------------
+#
+# The graceful-degradation contract under partial outages: when a whole
+# shard's replica set is unreachable (shard-blackout in the router) or
+# the daemons refuse under an injected overload storm, every answer the
+# router gives must be one of exactly three shapes — byte-equal to the
+# monolith (full coverage), FLAGGED partial and byte-equal to the
+# monolith restricted to the covered shards (allow policy, BM25 floats
+# included), or a typed shard_unavailable error.  An unflagged wrong
+# answer, a duplicate, or a hang fails the trial.
+
+BROWNOUT_SCENARIOS = ("shard-blackout", "overload-storm")
+
+
+def _brownout_make_base(work: Path):
+    """Cluster base plus per-probe degraded answers: for each probe,
+    the exact ranked result of the monolith restricted to the shard
+    set that survives each single-shard outage (D=2: missing 0, and
+    missing 1)."""
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.engine import (
+        create_engine,
+    )
+    from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.multi_engine import (
+        ShardRestrictedOracle,
+    )
+    cluster, probes = _cluster_make_base(work)
+    mono = work / "mono"
+    eng = create_engine(str(mono), engine="host")
+    try:
+        degraded = []
+        for dead in (0, 1):
+            oracle = ShardRestrictedOracle.round_robin(
+                eng, 2, covered={1 - dead})
+            by_terms = {}
+            for terms, _full in probes:
+                top = oracle.top_k_scored(eng.encode_batch(terms), 5)
+                by_terms[tuple(terms)] = [[d, s] for d, s in top]
+            degraded.append(by_terms)
+    finally:
+        eng.close()
+    full = {tuple(t): want for t, want in probes}
+    return cluster, [t for t, _ in probes], full, degraded
+
+
+def _brownout_burst(addr, sent, timeout=30.0):
+    """Pipeline ranked queries where each item is ``(terms, policy)``
+    — the per-request ``partial_policy`` rides along; returns
+    (responses_by_id, error)."""
+    import threading as _threading
+
+    n = len(sent)
+    c = _ChaosClient(addr, timeout=timeout)
+    got = {}
+    box = {"err": None}
+
+    def reader():
+        try:
+            for _ in range(n):
+                r = c.recv()
+                if r is None:
+                    box["err"] = f"connection died after {len(got)}/{n}"
+                    return
+                if r["id"] in got:
+                    box["err"] = f"duplicate response id {r['id']}"
+                    return
+                got[r["id"]] = r
+        except OSError as e:
+            box["err"] = f"reader failed: {e}"
+
+    t = _threading.Thread(target=reader)
+    t.start()
+    try:
+        for i, (terms, policy) in enumerate(sent):
+            c.send(id=i, op="top_k", terms=terms, k=5, score="bm25",
+                   partial_policy=policy)
+            if i % 40 == 39:
+                time.sleep(0.01)
+        t.join(timeout=timeout)
+        if t.is_alive():
+            return got, f"reader hung with {len(got)}/{n} responses"
+        return got, box["err"]
+    finally:
+        c.close()
+
+
+def _brownout_check(got, sent, full, degraded, dead=None):
+    """The three-shape contract.  ``dead`` pins the only shard allowed
+    to go missing (blackout trials); None admits either (storms)."""
+    if sorted(got) != list(range(len(sent))):
+        missing = sorted(set(range(len(sent))) - set(got))[:5]
+        return f"missing responses: {missing}"
+    for i, (terms, policy) in enumerate(sent):
+        r = got[i]
+        if r.get("ok"):
+            if r.get("partial"):
+                if policy != "allow":
+                    return (f"request {i}: partial answer under "
+                            f"policy {policy!r}")
+                cov = r.get("coverage") or {}
+                miss = cov.get("missing")
+                if not isinstance(miss, list) or len(miss) != 1 \
+                        or miss[0] not in (0, 1):
+                    return f"request {i}: bad coverage {cov}"
+                if dead is not None and miss != [dead]:
+                    return (f"request {i}: missing {miss}, only "
+                            f"shard {dead} is out")
+                if cov.get("shards_total") != 2 \
+                        or cov.get("shards_answered") != 1:
+                    return f"request {i}: bad coverage {cov}"
+                if r["docs"] != degraded[miss[0]][tuple(terms)]:
+                    return (f"request {i} ({terms}): flagged partial "
+                            f"missing {miss} but bytes diverge from "
+                            f"the covered-shard oracle: {r['docs']}")
+            else:
+                if r["docs"] != full[tuple(terms)]:
+                    return (f"request {i} ({terms}): UNFLAGGED wrong "
+                            f"answer {r['docs']} want "
+                            f"{full[tuple(terms)]}")
+        elif r.get("error") != "shard_unavailable":
+            return f"request {i}: unexpected error {r}"
+        elif dead is not None and policy == "fail" \
+                and r.get("shard") != dead:
+            return (f"request {i}: shard_unavailable names "
+                    f"{r.get('shard')}, outage is shard {dead}")
+    return None
+
+
+def run_brownout_trial(cluster: Path, vocab_probes, full, degraded,
+                       seed: int, scenario: str,
+                       deadline_s: float = 120.0) -> dict:
+    """One seeded brownout trial: 2 single-replica shard daemons + a
+    router, a mixed-policy pipelined ranked burst, and either a
+    permanent router-side shard blackout or daemon-side overload
+    storms with CoDel armed."""
+    rng = random.Random(seed)
+    verdict = {"seed": seed, "scenario": scenario, "ok": False,
+               "outcome": "?"}
+    t0 = time.monotonic()
+    daemons = []
+    router = None
+    try:
+        daemon_extra = []
+        daemon_env = None
+        router_extra = []
+        dead = None
+        if scenario == "shard-blackout":
+            dead = rng.randrange(2)
+            router_extra = ["--fault-spec",
+                            f"shard-blackout:shard={dead}"]
+        elif scenario == "overload-storm":
+            req = rng.randrange(1, 30)
+            times = rng.choice((16, 32, 64))
+            daemon_extra = ["--fault-spec",
+                            f"overload-storm:req={req}:times={times}"]
+            daemon_env = {"MRI_SERVE_CODEL_TARGET_MS": "5",
+                          "MRI_SERVE_CODEL_INTERVAL_MS": "20"}
+        else:
+            raise ValueError(f"unknown scenario {scenario!r}")
+        try:
+            d0, a0 = _spawn_daemon(cluster / "shard-0", *daemon_extra,
+                                   env_extra=daemon_env)
+            daemons.append(d0)
+            d1, a1 = _spawn_daemon(cluster / "shard-1", *daemon_extra,
+                                   env_extra=daemon_env)
+            daemons.append(d1)
+            spec = f"{a0[0]}:{a0[1]},{a1[0]}:{a1[1]}"
+            router, raddr = _spawn_router(spec, env_extra={
+                "MRI_CLUSTER_HEALTH_MS": "100",
+                "MRI_CLUSTER_RPC_TIMEOUT_MS": "500"},
+                extra=router_extra)
+        except (RuntimeError, OSError,
+                subprocess.TimeoutExpired) as e:
+            verdict["outcome"] = f"spawn-failed:{e}"
+            return verdict
+
+        n = rng.randrange(150, 300)
+        sent = []
+        for i in range(n):
+            terms = vocab_probes[rng.randrange(len(vocab_probes))]
+            # mostly allow (the degradation path under test), with a
+            # fail-policy minority so the typed-error contract is
+            # exercised in the same burst
+            policy = "fail" if rng.random() < 0.3 else "allow"
+            sent.append((terms, policy))
+        sent[0] = (sent[0][0], "allow")
+        sent[1] = (sent[1][0], "fail")
+
+        got, err = _brownout_burst(
+            raddr, sent, timeout=max(30.0, deadline_s / 2))
+        if err is None:
+            err = _brownout_check(got, sent, full, degraded, dead=dead)
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        verdict["requests"] = n
+        verdict["partial_answers"] = sum(
+            1 for r in got.values() if r.get("partial"))
+        verdict["typed_failures"] = sum(
+            1 for r in got.values() if not r.get("ok"))
+
+        if not _drain_to_zero(router, verdict, timeout=max(
+                10.0, deadline_s - (time.monotonic() - t0))):
+            return verdict
+        if scenario == "shard-blackout":
+            # a permanent blackout MUST have produced degraded traffic
+            if not verdict["counters"].get("partial"):
+                verdict["outcome"] = "violation"
+                verdict["error"] = ("blackout trial finished with "
+                                    "mri_cluster_partial_total == 0")
+                return verdict
+            if not verdict["counters"].get("shard_unavailable"):
+                verdict["outcome"] = "violation"
+                verdict["error"] = ("blackout trial finished with no "
+                                    "typed shard_unavailable answer")
+                return verdict
+        verdict["outcome"] = "clean"
+        verdict["ok"] = True
+        return verdict
+    finally:
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+        for p in [router] + daemons:
+            if p is None:
+                continue
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+            p.stdout.close()
+            p.stderr.close()
+
+
+def run_brownout_soak(work_dir: Path, trials: int, seed_base: int,
+                      deadline_s: float = 120.0,
+                      verbose: bool = True) -> dict:
+    """``trials`` seeded brownout trials cycled over
+    BROWNOUT_SCENARIOS.  One unflagged wrong answer fails the soak."""
+    work_dir.mkdir(parents=True, exist_ok=True)
+    cluster, vocab_probes, full, degraded = _brownout_make_base(
+        work_dir / "brownout-base")
+    results = []
+    for t in range(trials):
+        scenario = BROWNOUT_SCENARIOS[t % len(BROWNOUT_SCENARIOS)]
+        v = run_brownout_trial(cluster, vocab_probes, full, degraded,
+                               seed_base + t, scenario,
+                               deadline_s=deadline_s)
+        results.append(v)
+        if verbose:
+            print(json.dumps(v, sort_keys=True), flush=True)
+        if v["outcome"] == "HANG":
+            break
+    failures = [v for v in results if not v["ok"]]
+    return {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "by_scenario": {s: sum(v["scenario"] == s and v["ok"]
+                               for v in results)
+                        for s in BROWNOUT_SCENARIOS},
+        "failures": failures,
+    }
+
+
 # -- scenario registry ---------------------------------------------------
 #
 # One queryable source of truth for what this harness can throw, so
@@ -1633,7 +1895,32 @@ SCENARIO_REGISTRY = (
      "acknowledged mutation via WAL replay, replicas converge to "
      "byte-equal answers, stolen leases reject without corruption",
      WAL_SCENARIOS),
+    ("brownout", "--brownout",
+     "graceful degradation: blacked-out shards yield FLAGGED partial "
+     "answers byte-equal to the covered-shard oracle under the allow "
+     "policy (typed shard_unavailable under fail, naming the shard), "
+     "and daemon-side overload storms with CoDel admission stay typed "
+     "and bounded; exactly-once answers, clean drain",
+     BROWNOUT_SCENARIOS),
 )
+
+#: mode name -> soak runner with the uniform (work, trials, seed_base,
+#: deadline_s) shape, so `--all` can drive every mode off the registry
+#: instead of a hand-maintained if-chain
+MODE_RUNNERS = {
+    "build": lambda w, t, s, d: run_soak(w, t, s, deadline_s=d),
+    "spill": lambda w, t, s, d: run_soak(w, t, s, deadline_s=d,
+                                         spill=True),
+    "daemon": lambda w, t, s, d: run_daemon_soak(w, t, s,
+                                                 deadline_s=d),
+    "segments": lambda w, t, s, d: run_segments_soak(w, t, s,
+                                                     deadline_s=d),
+    "cluster": lambda w, t, s, d: run_cluster_soak(w, t, s,
+                                                   deadline_s=d),
+    "wal": lambda w, t, s, d: run_wal_soak(w, t, s, deadline_s=d),
+    "brownout": lambda w, t, s, d: run_brownout_soak(w, t, s,
+                                                     deadline_s=d),
+}
 
 
 def list_scenarios() -> str:
@@ -1690,6 +1977,22 @@ def main(argv=None) -> int:
                          "with replicas killed / wedged / corrupt-"
                          "pushed mid-burst (scenarios: "
                          + ", ".join(CLUSTER_SCENARIOS) + ")")
+    ap.add_argument("--brownout", action="store_true",
+                    help="soak the graceful-degradation layer: shard "
+                         "blackouts must yield flagged partial answers "
+                         "byte-equal to the covered-shard oracle (or "
+                         "typed shard_unavailable under the fail "
+                         "policy), overload storms must stay typed and "
+                         "bounded under retry budgets + CoDel "
+                         "(scenarios: "
+                         + ", ".join(BROWNOUT_SCENARIOS) + ")")
+    ap.add_argument("--all", action="store_true",
+                    help="run EVERY soak mode in the scenario registry "
+                         "back to back; exit 0 only if all are clean")
+    ap.add_argument("--fast", action="store_true",
+                    help="with --all: a fast cycle — enough trials per "
+                         "mode to visit each of its scenarios once, "
+                         "capped at 3")
     ap.add_argument("--list", action="store_true",
                     help="print every soak mode and its scenario/fault-"
                          "kind names, then exit")
@@ -1704,6 +2007,39 @@ def main(argv=None) -> int:
     else:
         work = Path(args.work_dir)
     work = work.resolve()
+    if args.all:
+        agg = {}
+        any_failed = False
+        for mode, _flag, _desc, names in SCENARIO_REGISTRY:
+            trials = min(len(names), 3) if args.fast else args.trials
+            print(f"=== chaos --all: {mode} ({trials} trials) ===",
+                  flush=True)
+            summary = MODE_RUNNERS[mode](work / mode, trials,
+                                         args.seed_base,
+                                         args.deadline)
+            agg[mode] = {"trials": summary["trials"],
+                         "clean": summary["clean"],
+                         "failures": summary["failures"]}
+            any_failed |= bool(summary["failures"])
+        print(json.dumps({"modes": agg,
+                          "ok": not any_failed}, sort_keys=True))
+        return 1 if any_failed else 0
+    if args.brownout:
+        if args.repro is not None:
+            t = args.repro - args.seed_base
+            scenario = BROWNOUT_SCENARIOS[t % len(BROWNOUT_SCENARIOS)]
+            work.mkdir(parents=True, exist_ok=True)
+            cluster, vocab_probes, full, degraded = \
+                _brownout_make_base(work / "brownout-base")
+            v = run_brownout_trial(cluster, vocab_probes, full,
+                                   degraded, args.repro, scenario,
+                                   deadline_s=args.deadline)
+            print(json.dumps(v, sort_keys=True))
+            return 0 if v["ok"] else 1
+        summary = run_brownout_soak(work, args.trials, args.seed_base,
+                                    deadline_s=args.deadline)
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if not summary["failures"] else 1
     if args.cluster:
         if args.repro is not None:
             t = args.repro - args.seed_base

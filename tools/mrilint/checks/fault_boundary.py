@@ -21,7 +21,8 @@ from ..core import Finding, Source, PACKAGE
 
 RULE = "fault-boundary"
 
-_IO_TAILS = {"open", "socket", "create_connection", "makefile", "mmap"}
+_IO_TAILS = {"open", "socket", "create_connection", "create_server",
+             "makefile", "mmap"}
 _HOOK_MARKERS = ("faults", "policy", "retry")
 
 #: Modules exempt wholesale: policy-free leaf helpers whose callers own
