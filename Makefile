@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-chaos-all test-wal test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-cluster test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-cluster bench-brownout bench-history capture rehearse clean clean-native
+.PHONY: build lint test test-fast test-lint test-faults test-parallel test-spill test-chaos test-chaos-all test-wal test-qos test-serve test-serve-device test-daemon test-obs test-segments test-attrib test-cluster test-native-asan test-native-ubsan bench bench-scale bench-sweep bench-build-ooc bench-serve bench-serve-device bench-serve-v2 bench-serve-ranked bench-serve-native bench-daemon bench-scrape bench-segments bench-wal bench-slo bench-cluster bench-brownout bench-qos bench-history capture rehearse clean clean-native
 
 build:
 	$(PY) -c "from parallel_computation_of_an_inverted_index_using_map_reduce_tpu import native; \
@@ -71,6 +71,12 @@ test-chaos-all:
 # lease semantics, segment-shipping replica catch-up + rollback refusal
 test-wal:
 	$(PY) -m pytest tests/ -q -m wal
+
+# multi-tenant QoS + result-cache suite: generation-keyed cache
+# byte-identity/invalidation, LRU byte accounting, token-bucket
+# admission, weighted-fair dequeue, per-tenant stats/flightdump/top
+test-qos:
+	$(PY) -m pytest tests/ -q -m qos
 
 # query-serving suite: index.mri format + Engine parity vs a naive text
 # scan, artifact corruption rejection, LRU cache semantics
@@ -243,6 +249,14 @@ bench-cluster:
 # gated at 2x unloaded) -> BENCH_BROWNOUT_r19.json
 bench-brownout:
 	$(PY) tools/bench_serve.py --brownout-ab
+
+# result-cache + QoS A/B: cached-hot vs uncached Zipf replay on one
+# daemon (speedup gated at 5x, byte-parity gated), then a diurnal-burst
+# tank tenant vs a paying tenant at 2x measured capacity — paying p99
+# gated at 1.2x its alone run, with an unfenced contrast leg
+# -> BENCH_QOS_r20.json
+bench-qos:
+	$(PY) tools/bench_serve.py --qos-ab
 
 # print the cross-round BENCH_*.json trajectory table (ratios against
 # each round's own baseline); `--write` regenerates the README block

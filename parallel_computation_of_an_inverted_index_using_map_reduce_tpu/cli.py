@@ -21,6 +21,7 @@ from .audit import AuditError, verify_output_dir
 from .config import IndexConfig
 from .corpus.manifest import read_manifest
 from .models.inverted_index import build_index
+from .utils import envknobs
 from .utils.checkpoint import CheckpointCorrupt
 
 _EPILOG = """\
@@ -590,6 +591,9 @@ def _serve_main(argv: list[str]) -> int:
             return 2
 
     try:
+        # resolved before the daemon exists so a bad value is the
+        # one-line exit-2 knob contract, not a traceback mid-serve
+        gc_freeze = envknobs.get("MRI_SERVE_GC_FREEZE")
         # construction runs startup WAL recovery (primaries) before the
         # first engine open — a torn directory rejects here, exit 2
         daemon = ServeDaemon(args.index_dir, host, port,
@@ -608,6 +612,18 @@ def _serve_main(argv: list[str]) -> int:
         print(f"error: cannot listen on {args.listen}: {e}",
               file=sys.stderr)
         return 2
+
+    if gc_freeze:
+        # The startup heap (interpreter, imports, engine) is permanent;
+        # without this, request churn — an admission-shed storm runs
+        # tens of thousands of allocations a second — schedules full
+        # cyclic-GC passes whose stop-the-world scan of that heap
+        # lands as multi-ms spikes in OTHER tenants' tail latency.
+        # Freeze it so every future pass scans only the churn.  CLI
+        # path only: an embedding application owns its own collector.
+        import gc
+        gc.collect()
+        gc.freeze()
 
     stop = threading.Event()
 
@@ -1007,6 +1023,30 @@ def _top_render(target: str, sample: dict) -> str:
                      f"{_top_num(w.get('error_per_s')):>10}"
                      f"{_top_num(w.get('p50_ms')):>10}"
                      f"{_top_num(w.get('p99_ms')):>10}")
+    tenants = st.get("tenants") or {}
+    if tenants:
+        # per-tenant QoS slice, all from the same single stats poll:
+        # admission vs shed, cache absorption, live lane depth, 1m
+        # tail latency and the worst 1m SLO burn
+        lines.append("")
+        lines.append(f"{'tenant':<12}{'wt':>4}{'rate':>8}"
+                     f"{'admitted':>10}{'shed':>8}{'hits':>8}"
+                     f"{'depth':>7}{'p95 ms':>10}{'burn 1m':>9}")
+        for name in sorted(tenants):
+            t = tenants[name] or {}
+            admitted = (t.get("requests", 0) or 0) \
+                - (t.get("shed", 0) or 0)
+            burns = [b for b in (t.get("burn_1m") or {}).values()
+                     if isinstance(b, (int, float))]
+            rate = t.get("rate_rps")
+            lines.append(
+                f"{name:<12}{_top_num(t.get('weight')):>4}"
+                f"{('-' if rate is None else f'{rate:g}'):>8}"
+                f"{admitted:>10}{_top_num(t.get('shed')):>8}"
+                f"{_top_num(t.get('cache_hits')):>8}"
+                f"{_top_num(t.get('queue_depth')):>7}"
+                f"{_top_num(t.get('p95_ms')):>10}"
+                f"{_top_num(max(burns) if burns else None):>9}")
     for name in sorted(slo):
         entry = slo[name] or {}
         head = f"slo {name} (target {entry.get('target')}"

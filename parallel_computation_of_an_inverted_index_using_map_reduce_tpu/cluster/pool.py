@@ -386,6 +386,7 @@ class Replica:
         self.lock = threading.Lock()
         self.ready = False   # last healthz verdict
         self.reasons: list = ["unprobed"]
+        self.generation = None  # serving generation from last healthz
         self.last_probe = 0.0
         self.breaker = Breaker()
 
@@ -393,6 +394,7 @@ class Replica:
         return {"addr": f"{self.addr[0]}:{self.addr[1]}",
                 "ready": self.ready,
                 "reasons": list(self.reasons),
+                "generation": self.generation,
                 "breaker": self.breaker.state}
 
 
@@ -542,9 +544,13 @@ class HealthProber:
             if payload is None:
                 rep.ready = False
                 rep.reasons = ["connection_lost"]
+                rep.generation = None
             else:
                 rep.ready = bool(payload.get("ready"))
                 rep.reasons = list(payload.get("reasons") or ())
+                gen = payload.get("generation")
+                rep.generation = gen if isinstance(gen, int) \
+                    and not isinstance(gen, bool) else None
                 if rep.ready:
                     rep.breaker.note_ready()
             rep.last_probe = time.monotonic()

@@ -54,6 +54,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import tracing as obs_tracing
 from ..obs import windows as obs_windows
+from ..serve import result_cache as result_cache_mod
 from ..serve.daemon import ADMIN_OPS, OUTBOUND_DEPTH
 from ..serve.multi_engine import merge_doc_ids, merge_ranked
 from ..utils import envknobs
@@ -228,10 +229,11 @@ class _Scatter:
                  "t_admit", "explain", "k", "done", "lock", "parts",
                  "remaining", "calls", "deadline_timer",
                  "timeout_timer", "hedged", "failovers", "policy",
-                 "min_cov", "missing")
+                 "min_cov", "missing", "ckey", "epoch")
 
     def __init__(self, conn, rid, op, tid, line, rpc_id, t_admit,
-                 explain, k, nshards, policy="fail", min_cov=1.0):
+                 explain, k, nshards, policy="fail", min_cov=1.0,
+                 ckey=None, epoch=None):
         self.conn = conn
         self.rid = rid
         self.op = op
@@ -253,6 +255,8 @@ class _Scatter:
         self.policy = policy  # partial_policy: "fail" | "allow"
         self.min_cov = min_cov  # docs_fraction floor under "allow"
         self.missing: list = []  # unanswerable shards  # guarded by: self.lock
+        self.ckey = ckey   # result-cache key (None: not cacheable)
+        self.epoch = epoch  # shard-generation vector at admission
 
 
 class _ShardCall:
@@ -331,6 +335,14 @@ class RouterDaemon:
             histograms=("mri_serve_request_seconds",))
         self._slo = obs_slo.SLOTracker(self._rolling)
         self._obs_enabled = obs_tracing.enabled()
+        # whole-answer cache above the scatter, keyed on the vector of
+        # per-shard generations learned from health probes — a hot
+        # query at a fully-known, agreed epoch never fans out.  The
+        # epoch lags a shard mutation by at most one health-probe
+        # period (MRI_CLUSTER_HEALTH_MS); until the prober re-agrees,
+        # the epoch is unknown and every query bypasses the cache.
+        self._result_cache = result_cache_mod.ResultCache(
+            registry=self.registry)
 
         self.clock = hedge_mod.Clock()
         self.prober = pool_mod.HealthProber(
@@ -424,6 +436,27 @@ class RouterDaemon:
                 if sc.primary == rep.idx:
                     pass  # pick() moves the primary on the next RPC
         self._g_ready.set(sum(s.ready_count() for s in self.shards))
+
+    # -- result-cache epoch ---------------------------------------------
+
+    def _current_epoch(self) -> tuple | None:
+        """The per-shard serving-generation vector, or ``None`` while
+        it is not fully known.  A shard's generation is known only when
+        every READY replica reported the same one on its last healthz —
+        a down shard, an unprobed replica, or a mid-catch-up replica
+        set makes the epoch unknown and disables caching until the
+        prober re-agrees (self-healing within one probe period)."""
+        gens = []
+        for sc in self.shards:
+            seen = {rep.generation for rep in sc.replicas if rep.ready}
+            if len(seen) != 1 or None in seen:
+                return None
+            gens.append(seen.pop())
+        epoch = tuple(gens)
+        # adopting a changed epoch drops every entry keyed under the
+        # old one (they can never be probed again)
+        self._result_cache.on_epoch(epoch)
+        return epoch
 
     # -- coverage accounting --------------------------------------------
 
@@ -567,6 +600,41 @@ class RouterDaemon:
         with self._count_lock:
             self._seq += 1
             seq = self._seq
+        inj = faults.active()
+        if inj is not None and inj.on_router_client(seq):
+            # injected client reset: the peer vanishes before its
+            # answer — the scatter never starts, nothing was acked.
+            # Faults fire before the cache probe so chaos specs keyed
+            # on request ordinal keep biting on hot queries.
+            self._count("client_disconnects")
+            conn.kill()
+            return
+        if tid is None and self._obs_enabled:
+            tid = obs_tracing.gen_trace_id()
+        ckey = epoch = None
+        if req.get("partial_policy") is None \
+                and req.get("min_generation") is None \
+                and not req.get("explain"):
+            ckey = result_cache_mod.key_for(
+                op, req.get("terms"), req.get("letter"),
+                int(req.get("k") or 0), req.get("score") or "df")
+        if ckey is not None:
+            t_admit = time.monotonic()
+            epoch = self._current_epoch()
+            hit = self._result_cache.lookup(ckey, epoch)
+            if hit is not None:
+                # answered above the scatter: a hot query at a known
+                # epoch never fans out and never occupies an inflight
+                # slot — it stays answerable even at the inflight cap
+                self._count("requests")
+                if rid is not None:
+                    hit["id"] = rid
+                if tid is not None:
+                    hit.setdefault("trace_id", tid)
+                self._h_request.observe(time.monotonic() - t_admit)
+                conn.enqueue(hit)
+                return
+        with self._count_lock:
             if self._inflight >= self.max_inflight:
                 self._count("shed")
                 self._reply_error(conn, rid, tid, "overloaded",
@@ -574,27 +642,17 @@ class RouterDaemon:
                                   "inflight")
                 return
             self._inflight += 1
-        inj = faults.active()
-        if inj is not None and inj.on_router_client(seq):
-            # injected client reset: the peer vanishes before its
-            # answer — the scatter never starts, nothing was acked
-            with self._count_lock:
-                self._inflight -= 1
-            self._count("client_disconnects")
-            conn.kill()
-            return
-        if tid is None and self._obs_enabled:
-            tid = obs_tracing.gen_trace_id()
         self._count("requests")
         if op == "top_k" and (req.get("score") or "df") == "df":
             # letter top_k needs multi-round refinement: run it on a
             # throwaway thread (rare op; the hot ops stay threadless)
             threading.Thread(
                 target=self._letter_topk,
-                args=(conn, req, tid, policy, min_cov),
+                args=(conn, req, tid, policy, min_cov, ckey, epoch),
                 daemon=True, name="mri-router-letter").start()
             return
-        self._start_scatter(conn, req, tid, policy, min_cov)
+        self._start_scatter(conn, req, tid, policy, min_cov,
+                            ckey=ckey, epoch=epoch)
 
     # the daemon's validation table, minus engine concerns
     @staticmethod
@@ -617,7 +675,7 @@ class RouterDaemon:
                           **overrides) -> bytes:
         out = {"id": rpc_id, "op": req["op"]}
         for key in ("terms", "letter", "k", "score", "deadline_ms",
-                    "explain"):
+                    "explain", "tenant"):
             v = req.get(key)
             if v is not None:
                 out[key] = v
@@ -628,14 +686,16 @@ class RouterDaemon:
 
     def _start_scatter(self, conn, req: dict, tid,
                        policy: str = "fail",
-                       min_cov: float = 1.0) -> None:
+                       min_cov: float = 1.0,
+                       ckey=None, epoch=None) -> None:
         rpc_id = pool_mod.next_rpc_id()
         line = self._encode_shard_req(req, rpc_id, tid)
         sc = _Scatter(conn, req.get("id"), req["op"], tid, line,
                       rpc_id, time.monotonic(),
                       bool(req.get("explain", False)),
                       int(req.get("k") or 0), len(self.shards),
-                      policy=policy, min_cov=min_cov)
+                      policy=policy, min_cov=min_cov,
+                      ckey=ckey, epoch=epoch)
         dl = req.get("deadline_ms")
         if dl is not None:
             sc.deadline_timer = self.clock.schedule(
@@ -919,6 +979,11 @@ class RouterDaemon:
                 out["partial"] = True
                 out["coverage"] = cov
                 self._count("partial")
+            elif sc.ckey is not None and sc.epoch is not None:
+                # only full-coverage answers at the admission-time
+                # epoch are cacheable: a partial answer depends on
+                # which shards happened to be down, not on the epoch
+                self._result_cache.fill(sc.ckey, sc.epoch, out)
             self._finish(sc, out)
         except Exception as e:
             log.exception("gather merge failed")
@@ -1101,7 +1166,8 @@ class RouterDaemon:
 
     def _letter_topk(self, conn, req: dict, tid,
                      policy: str = "fail",
-                     min_cov: float = 1.0) -> None:
+                     min_cov: float = 1.0,
+                     ckey=None, epoch=None) -> None:
         """Exact global letter top-k: rounds of (local k2-deep tops,
         exact global df sums) until the kth candidate provably beats
         every unseen term.  Termination is guaranteed — k2 doubles
@@ -1122,7 +1188,7 @@ class RouterDaemon:
         try:
             if k == 0:
                 self._answer_letter(conn, req, tid, t_admit, [],
-                                    dead, min_cov)
+                                    dead, min_cov, ckey, epoch)
                 return
             k2 = max(k, 4)
             while True:
@@ -1179,7 +1245,8 @@ class RouterDaemon:
                         len(ranked) >= k
                         and ranked[k - 1][1] > threshold):
                     self._answer_letter(conn, req, tid, t_admit,
-                                        ranked[:k], dead, min_cov)
+                                        ranked[:k], dead, min_cov,
+                                        ckey, epoch)
                     return
                 k2 *= 2
         except Exception as e:
@@ -1187,7 +1254,8 @@ class RouterDaemon:
             self._fail_letter(conn, req, tid, t_admit, str(e))
 
     def _answer_letter(self, conn, req, tid, t_admit, ranked,
-                       missing=(), min_cov: float = 0.0) -> None:
+                       missing=(), min_cov: float = 0.0,
+                       ckey=None, epoch=None) -> None:
         cov = self._coverage(sorted(missing)) if missing else None
         if cov is not None and cov["docs_fraction"] < min_cov:
             self._fail_letter(
@@ -1203,6 +1271,8 @@ class RouterDaemon:
             payload["partial"] = True
             payload["coverage"] = cov
             self._count("partial")
+        elif ckey is not None and epoch is not None:
+            self._result_cache.fill(ckey, epoch, payload)
         rid = req.get("id")
         if rid is not None:
             payload["id"] = rid
@@ -1290,8 +1360,10 @@ class RouterDaemon:
             "counters": counters,
             "rolling": self._rolling_stats(),
             "slo": self._slo.report(),
+            "result_cache": self._result_cache.stats(),
             "cluster": {
                 "shards": [sc.describe() for sc in self.shards],
+                "epoch": self._current_epoch(),
                 "hedge_ms": self.hedge_ms,
                 "rpc_timeout_ms": round(self.rpc_timeout_s * 1e3, 3),
                 "partial_default": self.partial_spec,

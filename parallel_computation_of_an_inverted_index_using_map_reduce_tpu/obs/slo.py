@@ -69,28 +69,40 @@ def default_slos() -> tuple:
 
 
 class SLOTracker:
-    """Window math over a :class:`RollingWindows` for a set of SLOs."""
+    """Window math over a :class:`RollingWindows` for a set of SLOs.
 
-    def __init__(self, windows: obs_windows.RollingWindows, slos=None):
+    The metric names default to the daemon-wide families; per-tenant
+    trackers pass their own lane's names (``total``/``bad``/
+    ``extra_total``/``latency_hist``) and reuse the same math.
+    """
+
+    def __init__(self, windows: obs_windows.RollingWindows, slos=None, *,
+                 total: str = _TOTAL, bad=_BAD,
+                 extra_total=("mri_serve_shed_total",
+                              "mri_serve_draining_rejected_total"),
+                 latency_hist: str = _LATENCY_HIST):
         self.windows = windows
         self.slos = tuple(slos) if slos is not None else default_slos()
+        self._total = total
+        self._bad = tuple(bad)
+        # sheds/rejections never reach the requests counter: the
+        # denominator is every admission attempt the window saw
+        self._extra_total = tuple(extra_total)
+        self._latency_hist = latency_hist
 
     def _window_point(self, slo: SLO, span: float) -> dict:
         if slo.threshold_ms is None:
             counts = self.windows.counts(span)
-            bad = sum(counts.get(n, 0) for n in _BAD)
-            # sheds/rejections never reach the requests counter: the
-            # denominator is every admission attempt the window saw
-            total = (counts.get(_TOTAL, 0)
-                     + counts.get("mri_serve_shed_total", 0)
-                     + counts.get("mri_serve_draining_rejected_total", 0))
+            bad = sum(counts.get(n, 0) for n in self._bad)
+            total = (counts.get(self._total, 0)
+                     + sum(counts.get(n, 0) for n in self._extra_total))
             ratio = 1.0 if total <= 0 else max(
                 0.0, 1.0 - bad / total)
             point = {"total": total, "bad": bad}
         else:
-            total = self.windows.hist_count(_LATENCY_HIST, span)
+            total = self.windows.hist_count(self._latency_hist, span)
             frac = self.windows.good_fraction(
-                _LATENCY_HIST, span, slo.threshold_ms / 1e3)
+                self._latency_hist, span, slo.threshold_ms / 1e3)
             ratio = 1.0 if frac is None else frac
             point = {"total": total}
         point["ratio"] = round(ratio, 6)

@@ -106,6 +106,30 @@ class RollingWindows:
             d_c, d_h = self._snapshot_locked()
             self._ring.append(_Bucket(now, d_c, d_h))
 
+    def track(self, counters=(), histograms=()) -> None:
+        """Register additional registry series after construction —
+        per-tenant lanes appear lazily on a tenant's first request.
+        New series are seeded at their *current* cumulative value so
+        the next tick diffs cleanly (no phantom first-bucket spike);
+        already-tracked names are no-ops."""
+        with self._lock:
+            for n in counters:
+                if n in self._counters:
+                    continue
+                c = self.registry.counter(n)
+                self._counters[n] = c
+                self._prev_c[n] = c.value
+            for n in histograms:
+                if n in self._hists:
+                    continue
+                h = self.registry.histogram(n)
+                self._hists[n] = h
+                self._prev_h[n] = (tuple(h.cumulative_counts()), h.sum)
+
+    def tracks(self, name: str) -> bool:
+        with self._lock:
+            return name in self._counters or name in self._hists
+
     # -- sampler thread -------------------------------------------------
 
     def start(self) -> None:
@@ -146,8 +170,8 @@ class RollingWindows:
     def counts(self, window_s: float) -> dict:
         """Summed counter deltas over the window."""
         now = self._clock()
-        out = dict.fromkeys(self._counters, 0)
         with self._lock:
+            out = dict.fromkeys(self._counters, 0)
             for b in self._buckets(window_s, now):
                 for name, d in b.counters.items():
                     out[name] += d

@@ -67,6 +67,8 @@ Protocol — one JSON object per line, one response line per request::
     {"id": 18, "op": "wal_tail", "after_seq": 12}  # admin: WAL tail
     {"id": 19, "op": "df", "terms": ["cat"],
                "min_generation": 7}  # read-your-writes fence
+    {"id": 20, "op": "df", "terms": ["cat"],
+               "tenant": "search-ui"}  # multi-tenant QoS lane
 
 Live mutations (the ``append``/``delete``/``compact`` ops) run on the
 reader thread under the reload lock — never the dispatcher — publish a
@@ -93,6 +95,25 @@ generation answers ``stale_generation`` instead of serving stale
 state.  With ``MRI_SEGMENT_LEASE_TTL_S`` > 0 mutations renew a TTL'd
 primary lease inside ``segments.lock`` first; a live foreign holder
 rejects the mutation with a ``lease_lost`` detail.
+
+Result cache: repeat data queries are answered from a
+generation-keyed whole-payload cache (:mod:`.result_cache`,
+``MRI_SERVE_RESULT_CACHE``) on the reader thread — a hit never touches
+the dispatch queue or the engine, and the answer is byte-identical to
+the engine's because the cache key carries the published manifest
+generation: a mutation's generation bump invalidates exactly (a hot
+reload, which may change content at an unchanged generation, purges
+outright).  ``explain`` requests always run the engine.
+
+Multi-tenant QoS: requests may carry a ``tenant`` name.  Each tenant
+gets its own bounded dispatch lane (weighted-fair dequeue per
+``MRI_SERVE_TENANT_WEIGHTS``), an optional token-bucket admission rate
+(``MRI_SERVE_TENANT_RATE``), its own CoDel gate (the PR 19 delay
+machinery composes per tenant), per-tenant counters/latency histogram
+on the registry (rolled into the PR 14 windows + SLO burn, surfaced in
+``stats()["tenants"]``), and a ``tenant``-filtered ``flightdump``
+slice.  Untagged requests ride the ``default`` tenant and behave
+exactly like the pre-tenant daemon.
 
 Success: ``{"id":1,"ok":true,"df":[5241,3]}``.  Failure:
 ``{"id":2,"error":"<kind>","detail":"..."}`` with kind one of
@@ -131,9 +152,11 @@ import json
 import logging
 import os
 import queue
+import re
 import socket
 import threading
 import time
+from collections import deque
 
 from .. import faults
 from ..obs import attribution as obs_attrib
@@ -144,6 +167,7 @@ from ..obs import tracing as obs_tracing
 from ..obs import watchdog as obs_watchdog
 from ..obs import windows as obs_windows
 from ..utils import envknobs
+from . import result_cache as result_cache_mod
 from .artifact import ArtifactError
 from .engine import create_engine
 
@@ -289,6 +313,219 @@ class _CoDelGate:
             return {"dropping": self._dropping, "count": self._count}
 
 
+#: tenant names on the wire: short, metric-safe-ish, no whitespace
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+#: overflow lane once MRI_SERVE_TENANT_MAX distinct names are tracked
+OTHER_TENANT = "other"
+
+
+def _sanitize_tenant(name: str) -> str:
+    """Metric-name-safe label for a tenant (dots/dashes to underscores;
+    two names that sanitize identically share metric series — the
+    admission lanes stay distinct)."""
+    return re.sub(r"[^A-Za-z0-9_]", "_", name)
+
+
+def _parse_tenant_weights(spec: str) -> dict:
+    """``MRI_SERVE_TENANT_WEIGHTS`` grammar: ``name=w,name=w,*=w``
+    (integer weights >= 1; ``*`` is the default for unlisted names)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, w = part.partition("=")
+        if not sep or not name.strip():
+            raise ValueError(
+                f"tenant weight {part!r} is not name=weight")
+        try:
+            wi = int(w)
+        except ValueError:
+            raise ValueError(f"tenant weight {part!r}: weight must be "
+                             "an integer") from None
+        if wi < 1:
+            raise ValueError(
+                f"tenant weight {part!r}: weight must be >= 1")
+        out[name.strip()] = wi
+    return out
+
+
+def _parse_tenant_rates(spec: str) -> dict:
+    """``MRI_SERVE_TENANT_RATE`` grammar: ``name=rps[:burst],...``
+    (floats; burst defaults to one second of rps, floor 1)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, rate = part.partition("=")
+        if not sep or not name.strip():
+            raise ValueError(
+                f"tenant rate {part!r} is not name=rps[:burst]")
+        rps_s, _, burst_s = rate.partition(":")
+        try:
+            rps = float(rps_s)
+            burst = float(burst_s) if burst_s else max(1.0, rps)
+        except ValueError:
+            raise ValueError(f"tenant rate {part!r}: rps/burst must "
+                             "be numbers") from None
+        if rps <= 0 or burst < 1:
+            raise ValueError(f"tenant rate {part!r}: rps must be > 0 "
+                             "and burst >= 1")
+        out[name.strip()] = (rps, burst)
+    return out
+
+
+class _TokenBucket:
+    """Classic token bucket: ``rps`` refill, ``burst`` cap, one token
+    per admitted request.  Thread-safe (reader threads race)."""
+
+    def __init__(self, rps: float, burst: float, clock=time.monotonic):
+        self.rps = float(rps)
+        self.burst = float(burst)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.burst  # guarded by: self._lock
+        self._t = clock()          # guarded by: self._lock
+
+    def allow(self) -> bool:
+        now = self._clock()
+        with self._lock:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rps)
+            self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class _TenantState:
+    """One tenant's QoS lane: weight, optional admission bucket, its
+    own CoDel gate, per-tenant counters/histogram (tracked by the
+    rolling windows) and an SLO tracker over them."""
+
+    __slots__ = ("name", "label", "weight", "bucket", "codel",
+                 "c_requests", "c_shed", "c_deadline", "c_errors",
+                 "c_cache_hits", "h_request", "hist_name", "slo")
+
+    def __init__(self, name: str, *, registry, rolling, weight: int,
+                 rate, codel):
+        self.name = name
+        self.label = _sanitize_tenant(name)
+        base = f"mri_serve_tenant_{self.label}"
+        self.c_requests = registry.counter(f"{base}_requests_total")
+        self.c_shed = registry.counter(f"{base}_shed_total")
+        self.c_deadline = registry.counter(
+            f"{base}_deadline_expired_total")
+        self.c_errors = registry.counter(f"{base}_errors_total")
+        self.c_cache_hits = registry.counter(
+            f"{base}_result_cache_hits_total")
+        self.hist_name = f"{base}_request_seconds"
+        self.h_request = registry.histogram(self.hist_name)
+        rolling.track(
+            counters=(f"{base}_requests_total", f"{base}_shed_total",
+                      f"{base}_deadline_expired_total",
+                      f"{base}_errors_total"),
+            histograms=(self.hist_name,))
+        self.weight = max(1, int(weight))
+        self.bucket = None if rate is None else _TokenBucket(*rate)
+        self.codel = codel
+        # per-tenant burn: same math as the daemon-wide tracker over
+        # this lane's series; the lane's requests counter already
+        # counts its sheds (incremented at arrival), so no extra_total
+        self.slo = obs_slo.SLOTracker(
+            rolling,
+            total=f"{base}_requests_total",
+            bad=(f"{base}_errors_total", f"{base}_shed_total",
+                 f"{base}_deadline_expired_total"),
+            extra_total=(),
+            latency_hist=self.hist_name)
+
+
+class _FairQueue:
+    """Weighted-fair dispatch queue, drop-in for the old bounded
+    ``queue.Queue``: ``put_nowait`` / ``get`` / ``get_nowait`` /
+    ``qsize`` keep their signatures (``queue.Full`` / ``queue.Empty``
+    included) so the dispatcher and drain paths are unchanged.  One
+    bounded FIFO lane per tenant; ``get`` serves lanes round-robin
+    with each lane taking up to ``weight`` consecutive items at the
+    head before rotating to the back.  A full lane sheds only its own
+    tenant.  With a single tenant this degenerates to exactly the old
+    single FIFO."""
+
+    def __init__(self, depth: int):
+        self.depth = depth
+        self._cv = threading.Condition()
+        self._lanes: dict = {}    # tstate -> deque  # guarded by: self._cv
+        self._active: deque = deque()  # lanes with items, RR order  # guarded by: self._cv
+        self._queued: set = set()  # tstates present in _active  # guarded by: self._cv
+        self._credit = 0  # head lane's remaining turn  # guarded by: self._cv
+        self._size = 0    # guarded by: self._cv
+
+    def put_nowait(self, item) -> None:
+        ts = item.tstate
+        with self._cv:
+            lane = self._lanes.get(ts)
+            if lane is None:
+                lane = self._lanes[ts] = deque()
+            if len(lane) >= self.depth:
+                raise queue.Full
+            lane.append(item)
+            self._size += 1
+            if ts not in self._queued:
+                self._active.append(ts)
+                self._queued.add(ts)
+                if len(self._active) == 1:
+                    self._credit = ts.weight
+            self._cv.notify()
+
+    # mrilint: holds(self._cv)
+    def _pop_locked(self):
+        ts = self._active[0]
+        lane = self._lanes[ts]
+        item = lane.popleft()
+        self._size -= 1
+        self._credit -= 1
+        if not lane:
+            self._active.popleft()
+            self._queued.discard(ts)
+            if self._active:
+                self._credit = self._active[0].weight
+        elif self._credit <= 0:
+            self._active.rotate(-1)
+            self._credit = self._active[0].weight
+        return item
+
+    def get(self, timeout: float | None = None):
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            while self._size == 0:
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise queue.Empty
+                self._cv.wait(rem)
+            return self._pop_locked()
+
+    def get_nowait(self):
+        with self._cv:
+            if self._size == 0:
+                raise queue.Empty
+            return self._pop_locked()
+
+    def qsize(self) -> int:
+        with self._cv:
+            return self._size
+
+    def lane_depth(self, ts) -> int:
+        with self._cv:
+            lane = self._lanes.get(ts)
+            return len(lane) if lane else 0
+
+
 class _Request:
     """One admitted data request, from queue admission to its single
     ``finish`` (exactly one response per request — ok or counted
@@ -296,10 +533,12 @@ class _Request:
 
     __slots__ = ("conn", "rid", "op", "terms", "letter", "k", "score",
                  "seq", "expires_at", "done", "trace_id", "t_admit",
-                 "t_pop", "t_exec", "planner", "explain", "attrib")
+                 "t_pop", "t_exec", "planner", "explain", "attrib",
+                 "tenant", "tstate", "cached", "ckey", "cgen")
 
     def __init__(self, conn, rid, op, terms, letter, k, score, seq,
-                 expires_at, trace_id=None, t_admit=0.0, explain=False):
+                 expires_at, trace_id=None, t_admit=0.0, explain=False,
+                 tenant=None, tstate=None):
         self.conn = conn
         self.rid = rid
         self.op = op
@@ -317,6 +556,11 @@ class _Request:
         self.planner = None  # ranked queries: the planner's decision
         self.explain = explain  # run solo under a cost collector
         self.attrib = None  # the collector, once the request executed
+        self.tenant = tenant  # wire tenant name ("default" if untagged)
+        self.tstate = tstate  # its _TenantState (QoS lane)
+        self.cached = False  # answered from the result cache
+        self.ckey = None  # epoch-free result-cache key (None: uncacheable)
+        self.cgen = None  # generation snapshot taken with the engine
 
 
 class _Conn:
@@ -446,7 +690,9 @@ class ServeDaemon:
         self._reload_lock = threading.Lock()
         self._engine = create_engine(path, engine, cache_terms=cache_terms,
                                      shards=shards)  # guarded by: self._engine_lock
-        self._queue: queue.Queue = queue.Queue(maxsize=self.queue_depth)
+        td = envknobs.get("MRI_SERVE_TENANT_QUEUE_DEPTH")
+        self._tenant_depth = td if td > 0 else self.queue_depth
+        self._queue = _FairQueue(self._tenant_depth)
         self._inflight = 0  # admitted minus finished  # guarded by: self._count_lock
         self._seq = 0  # data-request ordinal (faults)  # guarded by: self._count_lock
         # every tally is an obs counter on this per-daemon registry;
@@ -480,6 +726,21 @@ class ServeDaemon:
             counters=[name for _key, name in _COUNTER_NAMES],
             histograms=("mri_serve_request_seconds",))
         self._slo = obs_slo.SLOTracker(self._rolling)
+        # generation-keyed whole-payload cache, probed on reader
+        # threads and filled by the dispatcher under the engine lock
+        self._result_cache = result_cache_mod.ResultCache(
+            registry=self.registry)
+        # multi-tenant QoS: lanes materialize on a tenant's first
+        # request; untagged traffic rides "default", whose CoDel gate
+        # IS the daemon-wide gate (pre-tenant behavior preserved)
+        self._tenant_lock = threading.Lock()
+        self._tenants: dict[str, _TenantState] = {}  # guarded by: self._tenant_lock
+        self._tenant_weights = _parse_tenant_weights(
+            envknobs.get("MRI_SERVE_TENANT_WEIGHTS"))
+        self._tenant_rates = _parse_tenant_rates(
+            envknobs.get("MRI_SERVE_TENANT_RATE"))
+        self._tenant_max = envknobs.get("MRI_SERVE_TENANT_MAX")
+        self._tenant("default")
         self._watchdog = obs_watchdog.Watchdog(
             on_stall=self._on_stall, on_recover=self._on_recover,
             registry=self.registry)
@@ -591,6 +852,38 @@ class ServeDaemon:
 
     def _count(self, key: str, n: int = 1) -> None:
         self._counts[key].inc(n)
+
+    # -- multi-tenant QoS ----------------------------------------------
+
+    def _tenant(self, name: str) -> _TenantState:
+        """The tenant's lane, created on first sight.  Past
+        ``MRI_SERVE_TENANT_MAX`` distinct names, new ones fold into the
+        shared ``other`` lane (bounded metric cardinality)."""
+        with self._tenant_lock:
+            ts = self._tenants.get(name)
+            if ts is not None:
+                return ts
+            if len(self._tenants) >= self._tenant_max:
+                name = OTHER_TENANT
+                ts = self._tenants.get(name)
+                if ts is not None:
+                    return ts
+            gate = self._codel if name == "default" else _CoDelGate(
+                self.codel_target_ms / 1e3,
+                self.codel_interval_ms / 1e3)
+            ts = _TenantState(
+                name, registry=self.registry, rolling=self._rolling,
+                weight=self._tenant_weights.get(
+                    name, self._tenant_weights.get("*", 1)),
+                rate=self._tenant_rates.get(
+                    name, self._tenant_rates.get("*")),
+                codel=gate)
+            self._tenants[name] = ts
+            return ts
+
+    def _tenant_list(self) -> list:
+        with self._tenant_lock:
+            return list(self._tenants.values())
 
     # -- operational health --------------------------------------------
 
@@ -761,6 +1054,9 @@ class ServeDaemon:
             tid = obs_tracing.gen_trace_id()
         t_admit = time.monotonic()
         self._counts["requests"].inc()
+        tname = req.get("tenant") or "default"
+        tstate = self._tenant(tname)
+        tstate.c_requests.inc()
         with self._count_lock:
             self._seq += 1
             seq = self._seq
@@ -771,21 +1067,55 @@ class ServeDaemon:
                         req.get("letter"), int(req.get("k") or 0),
                         req.get("score") or "df", seq, expires_at,
                         trace_id=tid, t_admit=t_admit,
-                        explain=bool(req.get("explain", False)))
+                        explain=bool(req.get("explain", False)),
+                        tenant=tname, tstate=tstate)
         with conn.lock:
             conn.pending += 1
         inj = faults.active()
         if inj is not None and inj.on_serve_admit(seq):
             # injected overload storm: this daemon pretends it cannot
             # absorb the request — the typed refusal the router's
-            # breaker/budget machinery is soaked against
+            # breaker/budget machinery is soaked against.  Faults fire
+            # before the result cache so chaos scenarios keep biting
+            # even when the probed query is hot.
             self._count("shed")
             self._finish(item, {"error": "overloaded",
                                 "detail": "injected overload storm "
                                           "(fault spec)"},
                          admitted=False)
             return
-        if self._codel.should_shed():
+        if not item.explain:
+            item.ckey = result_cache_mod.key_for(
+                op, item.terms, item.letter, item.k, item.score)
+        hit = self._result_cache.lookup(item.ckey, self._generation)
+        if hit is not None:
+            if inj is not None:
+                # request-targeted faults fire whether the answer
+                # comes from the engine or the cache: a hit is still
+                # request handling, and chaos specs key on seq
+                try:
+                    inj.on_serve_request(seq)
+                except faults.HandlerCrash as e:
+                    self._count("internal_errors")
+                    self._finish(item, {"error": "internal",
+                                        "detail": str(e)},
+                                 admitted=False)
+                    return
+            # answered from the reader thread: a hot query never
+            # touches the dispatch queue, token bucket or CoDel gate —
+            # it costs no engine time, so it spends no admission budget
+            item.cached = True
+            tstate.c_cache_hits.inc()
+            self._finish(item, hit, admitted=False)
+            return
+        if tstate.bucket is not None and not tstate.bucket.allow():
+            self._count("shed")
+            self._finish(item, {"error": "overloaded",
+                                "detail": f"tenant {tname!r} over its "
+                                          "admission rate"},
+                         admitted=False)
+            return
+        if tstate.codel.should_shed():
             # adaptive admission: the queue's DELAY (not depth) says
             # the daemon is past saturation — shed now, cheaply, while
             # the request has cost nothing
@@ -805,7 +1135,7 @@ class ServeDaemon:
             self._count("shed")
             self._finish(item, {"error": "overloaded",
                                 "detail": f"pending queue at depth "
-                                          f"{self.queue_depth}"},
+                                          f"{self._tenant_depth}"},
                          admitted=False)
 
     @staticmethod
@@ -818,6 +1148,11 @@ class ServeDaemon:
         if dl is not None and (not isinstance(dl, (int, float))
                                or isinstance(dl, bool) or dl <= 0):
             return f"deadline_ms must be a positive number, got {dl!r}"
+        tn = req.get("tenant")
+        if tn is not None and (not isinstance(tn, str)
+                               or not _TENANT_RE.match(tn)):
+            return ("tenant must be 1-64 chars of [A-Za-z0-9._-], "
+                    f"got {tn!r}")
         ex = req.get("explain")
         if ex is not None and not isinstance(ex, bool):
             return f"explain must be a boolean, got {ex!r}"
@@ -868,7 +1203,11 @@ class ServeDaemon:
                        "ready": not reasons,
                        "reasons": reasons,
                        "status": reasons[0] if reasons else "ok",
-                       "queue_depth": self._queue.qsize()}
+                       "queue_depth": self._queue.qsize(),
+                       # additive: the router's health prober learns
+                       # each shard's serving generation from here and
+                       # keys its result cache on the full vector
+                       "generation": self._generation}
         elif op == "slo":
             payload = {"ok": True, "slo": self._slo.report()}
         elif op == "stats":
@@ -882,7 +1221,17 @@ class ServeDaemon:
             payload = {"ok": True,
                        "traces": self._trace_ring.snapshot(n)}
         elif op == "flightdump":
-            payload = {"ok": True, "flight": self._flight.dump("admin")}
+            flight = self._flight.dump("admin")
+            tn = req.get("tenant")
+            if isinstance(tn, str) and tn and isinstance(flight, dict):
+                # per-tenant slice: keep only this lane's requests in
+                # both lists (headline fields stay daemon-wide)
+                for lst in ("requests", "slow"):
+                    flight[lst] = [
+                        e for e in flight.get(lst, ())
+                        if e.get("trace", {}).get("tenant") == tn]
+                flight["tenant"] = tn
+            payload = {"ok": True, "flight": flight}
             where = req.get("write_to")
             if isinstance(where, str) and where:
                 payload["path"] = self._flight.dump_to_file(where, "admin")
@@ -985,8 +1334,11 @@ class ServeDaemon:
                 # an empty queue IS a zero-delay observation: without
                 # it a drained-but-still-dropping gate would keep
                 # admission-shedding a modest retry stream forever —
-                # only dequeues exit dropping, and sheds never dequeue
-                self._codel.on_delay(0.0)
+                # only dequeues exit dropping, and sheds never dequeue.
+                # Every tenant's gate gets the observation: an idle
+                # queue is idle for all lanes at once.
+                for ts in self._tenant_list():
+                    ts.codel.on_delay(0.0)
                 continue
             inj = faults.active()
             if inj is not None:
@@ -1014,16 +1366,21 @@ class ServeDaemon:
                 rider.t_pop = time.monotonic()
                 batch.append(rider)
             if self._codel.enabled:
-                # CoDel dequeue side: feed the gate every popped
-                # request's queue delay, and while dropping shed the
-                # ones that already waited past target BEFORE they
-                # reach the engine — executed requests then carry
-                # bounded queueing even under sustained overload
+                # CoDel dequeue side: feed each request's queue delay
+                # to ITS TENANT's gate (the default lane's gate is the
+                # daemon-wide one), and while dropping shed the ones
+                # that already waited past target BEFORE they reach
+                # the engine — executed requests then carry bounded
+                # queueing even under sustained overload, and one
+                # tenant's self-inflicted queue delay closes only its
+                # own admission gate
                 kept = []
                 for it in batch:
                     delay = it.t_pop - it.t_admit
-                    self._codel.on_delay(delay)
-                    if self._codel.late_shed(delay):
+                    gate = it.tstate.codel if it.tstate is not None \
+                        else self._codel
+                    gate.on_delay(delay)
+                    if gate.late_shed(delay):
                         self._count("shed")
                         self._count("codel_sheds")
                         self._finish(
@@ -1044,6 +1401,20 @@ class ServeDaemon:
         if item.done:
             return
         item.done = True
+        if item.tstate is not None:
+            err = payload.get("error")
+            if err == "overloaded":
+                item.tstate.c_shed.inc()
+            elif err == "deadline_expired":
+                item.tstate.c_deadline.inc()
+            elif err == "internal":
+                item.tstate.c_errors.inc()
+        if not item.cached and item.ckey is not None \
+                and item.cgen is not None and payload.get("ok"):
+            # fill before id/trace_id stamping: the cached payload must
+            # stay request-agnostic so a later hit for a different
+            # request id returns byte-identical *data* fields
+            self._result_cache.fill(item.ckey, item.cgen, payload)
         if item.rid is not None:
             payload.setdefault("id", item.rid)
         if item.trace_id is not None:
@@ -1089,6 +1460,8 @@ class ServeDaemon:
         self._h_request.observe(
             t_done - t0,
             exemplar=item.trace_id if self._exemplars else None)
+        if item.tstate is not None:
+            item.tstate.h_request.observe(t_done - t0)
         if item.t_pop is not None:
             self._h_queue_wait.observe(item.t_pop - t0)
         want_trace = self._obs_enabled and item.trace_id is not None
@@ -1101,8 +1474,9 @@ class ServeDaemon:
                           "start_ms": round((a - t0) * 1e3, 3),
                           "dur_ms": round((b - a) * 1e3, 3)})
 
-        if item.t_pop is None:  # shed at admission or drain flush
-            add("admission", t0, t_done)
+        if item.t_pop is None:  # cache hit, admission shed, drain flush
+            add("result_cache" if item.cached else "admission",
+                t0, t_done)
         elif item.t_exec is None:  # popped, never reached the engine
             add("queue_wait", t0, item.t_pop)
             add("dispatch", item.t_pop, t_done)
@@ -1125,6 +1499,8 @@ class ServeDaemon:
             "dur_ms": round(dur_ms, 3),
             "spans": spans,
         }
+        if item.tenant is not None:
+            trace["tenant"] = item.tenant
         if want_trace:
             self._trace_ring.push(trace)
             if 0 < self._slow_ms <= dur_ms:
@@ -1141,8 +1517,15 @@ class ServeDaemon:
             # the last instant before dispatch — so stale work never
             # reaches the batch path no matter where the queue stalled
             now = time.monotonic()
+            # snapshot the cache epoch under the same lock that pins
+            # the engine: mutations swap the engine BEFORE bumping
+            # self._generation, so the only possible mismatch pairs
+            # NEW bytes with the OLD generation key — an entry the next
+            # probe (at the new generation) can never return
+            gen = self._generation
             for it in items:
                 it.t_exec = now
+                it.cgen = gen
             live = []
             for it in items:
                 if it.expires_at is not None and now > it.expires_at:
@@ -1429,6 +1812,9 @@ class ServeDaemon:
                 if isinstance(res, dict) \
                         and res.get("generation") is not None:
                     self._generation = int(res["generation"])
+                # generation bumped (or content republished): entries
+                # keyed under the old generation are dead — drop them
+                self._result_cache.on_epoch(self._generation)
             self._count("mutations")
             dur_ms = round((time.monotonic() - t0) * 1e3, 3)
             # mrilint: allow(trace) append delete compact — every
@@ -1474,6 +1860,10 @@ class ServeDaemon:
                 with self._engine_lock:
                     old, self._engine = self._engine, new_engine
                 old.close()
+                # a reload can change artifact content at an UNCHANGED
+                # generation (an out-of-band artifact push) — the
+                # epoch key cannot see that, so drop everything
+                self._result_cache.purge()
                 self._count("reload_ok")
                 log.info("hot reload: swapped in %s", self._path)
                 return True, ""
@@ -1525,6 +1915,7 @@ class ServeDaemon:
                 old, self._engine = self._engine, new_engine
             old.close()
             self._generation = generation
+            self._result_cache.on_epoch(generation)
 
     # -- stats ---------------------------------------------------------
 
@@ -1563,7 +1954,35 @@ class ServeDaemon:
                 "codel_interval_ms": self.codel_interval_ms,
             },
             "codel": self._codel.state(),
+            "result_cache": self._result_cache.stats(),
+            "tenants": self._tenant_stats(),
         }
+
+    def _tenant_stats(self) -> dict:
+        """Per-tenant QoS slice for ``stats()``: cumulative counters,
+        live lane depth, 1m p95 and 1m SLO burn — one poll answers
+        ``mri top``'s whole tenants table."""
+        out = {}
+        for ts in self._tenant_list():
+            p95 = self._rolling.quantile(ts.hist_name, 60.0, 95.0)
+            burn = {
+                name: entry["windows"]["1m"]["burn"]
+                for name, entry in ts.slo.report().items()}
+            out[ts.name] = {
+                "weight": ts.weight,
+                "rate_rps": None if ts.bucket is None
+                            else ts.bucket.rps,
+                "requests": ts.c_requests.value,
+                "shed": ts.c_shed.value,
+                "deadline_expired": ts.c_deadline.value,
+                "errors": ts.c_errors.value,
+                "cache_hits": ts.c_cache_hits.value,
+                "queue_depth": self._queue.lane_depth(ts),
+                "p95_ms": None if p95 is None
+                          else round(p95 * 1e3, 3),
+                "burn_1m": burn,
+            }
+        return out
 
     def _rolling_stats(self) -> dict:
         """Per-window rates + latency quantiles for ``stats()``."""

@@ -339,6 +339,55 @@ declare("MRI_SERVE_CODEL_INTERVAL_MS", float, 100.0,
         "period of the control law that paces admission sheds while "
         "the daemon stays over target.",
         scope="serve", minimum=1.0)
+declare("MRI_SERVE_RESULT_CACHE", int, 1,
+        "Generation-keyed query-result cache: 1 answers repeat "
+        "queries from the reader thread (daemon) / above the "
+        "scatter-gather (router) without touching the engine, keyed "
+        "on (op, normalized terms, k, score, manifest generation) so "
+        "a mutation's generation bump invalidates exactly; 0 "
+        "disables the cache (every request reaches the engine).",
+        scope="serve", choices=(0, 1))
+declare("MRI_SERVE_RESULT_CACHE_ENTRIES", int, 4096,
+        "Entry-count bound on the result cache (LRU beyond it).",
+        scope="serve", minimum=1)
+declare("MRI_SERVE_RESULT_CACHE_BYTES", int, 8 << 20,
+        "Byte bound on the result cache: cached payloads are sized "
+        "by their JSON encoding and evicted LRU-first once the sum "
+        "exceeds this; 0 removes the byte bound (entry count only).",
+        scope="serve", minimum=0)
+declare("MRI_SERVE_TENANT_WEIGHTS", str, "",
+        "Weighted-fair dequeue shares per tenant as "
+        "'name=w,name=w,*=w' (integer weights; '*' sets the default "
+        "for unlisted tenants, 1 if absent). Empty string gives every "
+        "tenant weight 1 (pure round-robin between active tenants).",
+        scope="serve")
+declare("MRI_SERVE_TENANT_RATE", str, "",
+        "Per-tenant token-bucket admission as "
+        "'name=rps[:burst],*=rps[:burst]' (floats; burst defaults to "
+        "one second of rps). Requests over a tenant's bucket are shed "
+        "with `overloaded` before queueing; empty string disables "
+        "rate limiting (weighted-fair dequeue still applies).",
+        scope="serve")
+declare("MRI_SERVE_TENANT_MAX", int, 32,
+        "Cap on distinct tracked tenants: past it, new tenant names "
+        "fold into the shared 'other' lane (bounds per-tenant metric "
+        "and queue memory against tenant-id cardinality attacks).",
+        scope="serve", minimum=1)
+declare("MRI_SERVE_GC_FREEZE", int, 1,
+        "Daemon-process GC taming (the `mri serve` CLI only, never "
+        "in-process embedding): after the engine is loaded, collect "
+        "once and gc.freeze() the warm startup heap so cyclic-GC "
+        "passes scan only request churn — an admission-shed storm "
+        "allocates fast enough to schedule full collections, and a "
+        "full pass over the interpreter+engine heap is a multi-ms "
+        "stop-the-world spike in someone else's tail latency. 0 "
+        "leaves the collector untouched.",
+        scope="serve", choices=(0, 1))
+declare("MRI_SERVE_TENANT_QUEUE_DEPTH", int, 0,
+        "Per-tenant dispatch-queue depth; a tenant whose lane is full "
+        "sheds with `overloaded` without displacing other tenants. 0 "
+        "inherits MRI_SERVE_QUEUE_DEPTH.",
+        scope="serve", minimum=0)
 
 # -- observability ----------------------------------------------------
 declare("MRI_OBS_ENABLE", int, 1,

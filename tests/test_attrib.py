@@ -711,13 +711,23 @@ def test_trace_ring_contiguity_under_concurrent_mutations(
             traces = qc.rpc(op="trace", n=256)["traces"]
         qts = [t for t in traces if t["op"] == "and"]
         assert len(qts) >= 50
+        engine_traces = 0
         for t in qts:
             names = [s["name"] for s in t["spans"]]
+            if names == ["result_cache"]:
+                # repeats of the hot query answered by the result
+                # cache between generation bumps
+                assert t["spans"][0]["start_ms"] == 0.0
+                continue
+            engine_traces += 1
             assert names == ["queue_wait", "coalesce", "engine"]
             assert t["spans"][0]["start_ms"] == 0.0
             for a, b in zip(t["spans"], t["spans"][1:]):
                 assert b["start_ms"] == pytest.approx(
                     a["start_ms"] + a["dur_ms"], abs=2e-3)
+        # every generation bump purges the cache, so the engine must
+        # have answered at least the cold query per generation
+        assert engine_traces >= 1
         mts = [t for t in traces if t["op"] in ("append", "compact")]
         assert len(mts) == 4
         for t in mts:

@@ -686,14 +686,16 @@ def test_codel_sheds_typed_overloaded_under_stall(mono, monkeypatch):
         assert st["config"]["codel_target_ms"] == 1.0
         # drained queue: the gate stays dropping (admission sheds at
         # the control-law cadence) until one request slips through,
-        # reports a below-target delay, and re-closes it
+        # reports a below-target delay, and re-closes it.  Probe with
+        # a fresh term each time so every probe is a result-cache miss
+        # that must actually transit the queue.
         with Client(daemon) as c:
             deadline = time.monotonic() + 5.0
             recovered = False
             i = 999
             while time.monotonic() < deadline and not recovered:
                 recovered = c.rpc(id=i, op="df",
-                                  terms=["the"]).get("ok", False)
+                                  terms=[f"novel{i}"]).get("ok", False)
                 i += 1
                 time.sleep(0.01)
             assert recovered

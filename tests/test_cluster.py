@@ -530,7 +530,15 @@ def test_hedges_fire_on_slowed_shard(clusters):
             for i in range(3):
                 r = c.rpc(id=i, op="df", terms=["the"])
                 assert r["ok"]
-        st = router.stats()["counters"]
+        # the hedge send itself rides the injected 40ms slow-down, so
+        # its counter increment can land just after the primary's
+        # answer — poll briefly instead of racing it
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            st = router.stats()["counters"]
+            if st["hedges"] >= 1:
+                break
+            time.sleep(0.01)
         assert st["hedges"] >= 1
 
 

@@ -1,0 +1,504 @@
+"""Multi-tenant QoS + generation-keyed result-cache suite (PR 20).
+
+Four layers:
+
+* cache primitives — :class:`LRUCache` byte-size accounting (payloads
+  counted, evicted LRU-first past ``max_bytes``, oversized entries
+  refused) and :func:`key_for` normalization (two requests share an
+  entry only when the engine provably answers them byte-identically);
+* :class:`ResultCache` — epoch-keyed lookup/fill, exact invalidation
+  on epoch change (no TTLs), copies in/copies out, disabled is inert;
+* QoS primitives — the ``MRI_SERVE_TENANT_WEIGHTS`` /
+  ``MRI_SERVE_TENANT_RATE`` grammars, the :class:`_TokenBucket` under
+  a fake clock, and :class:`_FairQueue` weighted dequeue order with
+  per-lane depth bounds;
+* daemon integration — cache hits answered from the reader thread are
+  byte-identical to engine answers and a live mutation's generation
+  bump invalidates them; the ``tenant`` wire field is validated; a
+  tenant over its bucket sheds typed ``overloaded`` without touching
+  other lanes; ``stats()["tenants"]`` carries the whole per-tenant
+  slice (counters, lane depth, 1m p95, 1m SLO burn) in one poll;
+  ``flightdump`` slices by tenant; ``mri top`` renders tenant rows.
+"""
+
+import os
+import queue
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from test_daemon import Client, serving
+
+from test_serve import build_corpus, naive_index
+
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.cli import (
+    _top_render,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.obs import (
+    metrics as obs_metrics,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.cache import (
+    LRUCache,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.daemon import (
+    _FairQueue,
+    _TokenBucket,
+    _parse_tenant_rates,
+    _parse_tenant_weights,
+)
+from parallel_computation_of_an_inverted_index_using_map_reduce_tpu.serve.result_cache import (
+    CACHEABLE_OPS,
+    ResultCache,
+    key_for,
+)
+
+pytestmark = [pytest.mark.qos, pytest.mark.serve]
+
+daemonized = pytest.mark.daemon
+
+DOCS = [b"the cat sat on the mat", b"the dog ran far",
+        b"cat and dog nap", b"a quiet zebra naps",
+        b"dog dog dog barks the most"]
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    out = build_corpus(tmp_path_factory.mktemp("qos_corpus"), DOCS)
+    return out, naive_index(DOCS)
+
+
+# -- LRUCache byte accounting -------------------------------------------
+
+
+def test_lru_byte_bound_evicts_lru_first():
+    c = LRUCache(10, max_bytes=100)
+    for i in range(3):
+        c.put(f"k{i}", i, nbytes=40)  # 120 > 100: k0 must go
+    assert "k0" not in c and "k1" in c and "k2" in c
+    st = c.stats()
+    assert st["bytes"] == 80
+    assert st["max_bytes"] == 100
+    assert st["evictions"] == 1
+
+
+def test_lru_byte_bound_oversized_entry_refused():
+    c = LRUCache(10, max_bytes=100)
+    c.put("small", 1, nbytes=60)
+    c.put("huge", 2, nbytes=101)  # bigger than the whole budget
+    assert "huge" not in c
+    assert "small" in c, "oversized insert flushed the working set"
+    assert c.stats()["bytes"] == 60
+
+
+def test_lru_byte_accounting_tracks_replacement():
+    c = LRUCache(10, max_bytes=100)
+    c.put("k", 1, nbytes=90)
+    c.put("k", 2, nbytes=10)  # replace: old size must be released
+    assert c.stats()["bytes"] == 10
+    c.put("j", 3, nbytes=80)  # fits only if the 90 was released
+    assert "k" in c and "j" in c
+    assert c.stats()["bytes"] == 90
+
+
+def test_lru_purge_resets_bytes_keeps_tallies():
+    c = LRUCache(4, max_bytes=100)
+    c.put("k", 1, nbytes=50)
+    assert c.get("k") == 1
+    assert c.get("nope") is None
+    assert c.purge() == 1
+    st = c.stats()
+    assert st["entries"] == 0 and st["bytes"] == 0
+    assert st["hits"] == 1 and st["misses"] == 1
+
+
+def test_lru_entry_count_bound_still_applies():
+    c = LRUCache(2, max_bytes=0)  # 0 = no byte bound
+    for i in range(3):
+        c.put(i, i, nbytes=10 ** 9)
+    assert len(c) == 2
+    assert c.stats()["bytes"] == 2 * 10 ** 9  # accounted even unbounded
+
+
+# -- cache key normalization --------------------------------------------
+
+
+def test_key_for_and_or_order_and_dupes_collapse():
+    a = key_for("and", ["b", "a", "b"], None, 0, None)
+    b = key_for("and", ["a", "b"], None, 0, None)
+    assert a == b
+    assert key_for("or", ["x", "y"], None, 0, None) \
+        == key_for("or", ["y", "x", "x"], None, 0, None)
+
+
+def test_key_for_top_k_keeps_duplicates_not_order():
+    dup = key_for("top_k", ["a", "a"], None, 10, "bm25")
+    one = key_for("top_k", ["a"], None, 10, "bm25")
+    assert dup != one, "a repeated term scores twice in BM25"
+    assert key_for("top_k", ["b", "a"], None, 10, "bm25") \
+        == key_for("top_k", ["a", "b"], None, 10, "bm25")
+    assert key_for("top_k", ["a"], None, 10, "bm25") \
+        != key_for("top_k", ["a"], None, 20, "bm25")
+
+
+def test_key_for_df_postings_positional():
+    assert key_for("df", ["b", "a"], None, 0, None) \
+        != key_for("df", ["a", "b"], None, 0, None)
+    assert key_for("postings", ["a", "a"], None, 0, None) \
+        != key_for("postings", ["a"], None, 0, None)
+
+
+def test_key_for_uncacheable_shapes():
+    for op in ("stats", "append", "delete", "compact", "healthz",
+               "flightdump", "reload"):
+        assert op not in CACHEABLE_OPS
+        assert key_for(op, ["a"], None, 0, None) is None
+    assert key_for("and", [], None, 0, None) is None
+    assert key_for("and", None, "c", 0, None) is None  # letter non-top_k
+    assert key_for("top_k", None, "c", 10, "bm25") is not None
+
+
+# -- ResultCache --------------------------------------------------------
+
+
+def _rc(**kw):
+    kw.setdefault("registry", obs_metrics.Registry())
+    kw.setdefault("enabled", True)
+    kw.setdefault("entries", 64)
+    kw.setdefault("max_bytes", 1 << 20)
+    return ResultCache(**kw)
+
+
+def test_result_cache_roundtrip_epoch_keyed():
+    rc = _rc()
+    k = key_for("df", ["cat"], None, 0, None)
+    rc.fill(k, 3, {"ok": True, "df": [2]})
+    assert rc.lookup(k, 3) == {"ok": True, "df": [2]}
+    assert rc.lookup(k, 4) is None, "a generation bump must miss"
+    assert rc.lookup(k, None) is None, "no epoch, no cache"
+    st = rc.stats()
+    assert st["enabled"] is True
+    assert st["hits"] == 1 and st["entries"] == 1
+    assert st["bytes"] > 0
+
+
+def test_result_cache_on_epoch_purges_and_counts():
+    rc = _rc()
+    k = key_for("and", ["a", "b"], None, 0, None)
+    rc.on_epoch(1)
+    base = rc.stats()["invalidations"]
+    rc.fill(k, 1, {"ok": True, "docs": [0]})
+    rc.on_epoch(2)  # change: purge + count
+    assert rc.stats()["invalidations"] == base + 1
+    assert rc.stats()["entries"] == 0
+    rc.on_epoch(2)  # unchanged: neither
+    assert rc.stats()["invalidations"] == base + 1
+
+
+def test_result_cache_returns_copies():
+    rc = _rc()
+    k = key_for("df", ["x"], None, 0, None)
+    payload = {"ok": True, "df": [1]}
+    rc.fill(k, 1, payload)
+    payload["ok"] = False  # caller mutates after fill
+    hit = rc.lookup(k, 1)
+    assert hit["ok"] is True
+    hit["id"] = 99  # response stamping mutates the hit
+    assert "id" not in rc.lookup(k, 1)
+
+
+def test_result_cache_disabled_is_inert():
+    rc = _rc(enabled=False)
+    k = key_for("df", ["x"], None, 0, None)
+    rc.fill(k, 1, {"ok": True})
+    assert rc.lookup(k, 1) is None
+    rc.on_epoch(2)
+    st = rc.stats()
+    assert st["enabled"] is False
+    assert st["invalidations"] == 0 and st["capacity"] == 0
+
+
+# -- tenant knob grammars -----------------------------------------------
+
+
+def test_parse_tenant_weights_grammar():
+    assert _parse_tenant_weights("") == {}
+    assert _parse_tenant_weights("a=2, b=8 ,*=1") \
+        == {"a": 2, "b": 8, "*": 1}
+    for bad in ("a", "a=0", "a=x", "=2"):
+        with pytest.raises(ValueError):
+            _parse_tenant_weights(bad)
+
+
+def test_parse_tenant_rates_grammar():
+    assert _parse_tenant_rates("") == {}
+    out = _parse_tenant_rates("tank=5.5:2,pay=100")
+    assert out["tank"] == (5.5, 2.0)
+    assert out["pay"] == (100.0, 100.0), "burst defaults to 1s of rps"
+    assert _parse_tenant_rates("slow=0.25")["slow"] == (0.25, 1.0), \
+        "burst floor is 1 (a sub-1 bucket could never admit)"
+    for bad in ("tank", "tank=0", "tank=1:0.5", "tank=x", "=1"):
+        with pytest.raises(ValueError):
+            _parse_tenant_rates(bad)
+
+
+def test_token_bucket_fake_clock():
+    now = [0.0]
+    b = _TokenBucket(2.0, 3.0, clock=lambda: now[0])
+    assert [b.allow() for _ in range(4)] == [True, True, True, False]
+    now[0] = 1.0  # 2 tokens refilled
+    assert [b.allow() for _ in range(3)] == [True, True, False]
+    now[0] = 100.0  # refill caps at burst
+    assert [b.allow() for _ in range(4)] == [True, True, True, False]
+
+
+# -- weighted-fair queue ------------------------------------------------
+
+
+class _Lane:
+    def __init__(self, weight):
+        self.weight = weight
+
+
+class _Item:
+    def __init__(self, tstate, tag):
+        self.tstate = tstate
+        self.tag = tag
+
+
+def test_fair_queue_weighted_dequeue_order():
+    heavy, light = _Lane(2), _Lane(1)
+    q = _FairQueue(16)
+    for i in range(4):
+        q.put_nowait(_Item(heavy, f"h{i}"))
+        q.put_nowait(_Item(light, f"l{i}"))
+    got = [q.get_nowait().tag for _ in range(8)]
+    # heavy takes 2 per turn, light 1: h h l h h l l l (drain tail)
+    assert got == ["h0", "h1", "l0", "h2", "h3", "l1", "l2", "l3"]
+    with pytest.raises(queue.Empty):
+        q.get_nowait()
+
+
+def test_fair_queue_single_lane_is_fifo():
+    lane = _Lane(3)
+    q = _FairQueue(16)
+    for i in range(5):
+        q.put_nowait(_Item(lane, i))
+    assert [q.get_nowait().tag for _ in range(5)] == list(range(5))
+
+
+def test_fair_queue_full_lane_sheds_only_its_tenant():
+    a, b = _Lane(1), _Lane(1)
+    q = _FairQueue(2)
+    q.put_nowait(_Item(a, 1))
+    q.put_nowait(_Item(a, 2))
+    with pytest.raises(queue.Full):
+        q.put_nowait(_Item(a, 3))
+    q.put_nowait(_Item(b, 4))  # other lane unaffected
+    assert q.qsize() == 3
+    assert q.lane_depth(a) == 2 and q.lane_depth(b) == 1
+
+
+def test_fair_queue_get_timeout():
+    q = _FairQueue(4)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.02)
+
+
+# -- daemon integration -------------------------------------------------
+
+
+def _strip(resp):
+    r = dict(resp)
+    r.pop("id", None)
+    r.pop("trace_id", None)
+    return r
+
+
+@daemonized
+def test_daemon_cache_hit_is_byte_identical(built):
+    out, naive = built
+    with serving(out) as daemon, Client(daemon) as c:
+        first = c.rpc(id=1, op="df", terms=["cat", "dog"])
+        assert first["ok"]
+        assert first["df"] == [len(naive["cat"]), len(naive["dog"])]
+        second = c.rpc(id=2, op="df", terms=["cat", "dog"])
+        assert _strip(second) == _strip(first)
+        # the hit must carry a FRESH trace stamp, not the cached one
+        assert second["trace_id"] != first["trace_id"]
+        # cross-tenant hit: the key excludes the tenant — same bytes
+        tagged = c.rpc(id=3, op="df", terms=["cat", "dog"],
+                       tenant="alpha")
+        assert _strip(tagged) == _strip(first)
+        st = daemon.stats()
+        assert st["result_cache"]["enabled"] is True
+        assert st["result_cache"]["hits"] >= 2
+        assert st["tenants"]["alpha"]["cache_hits"] == 1
+
+
+@daemonized
+def test_daemon_mutation_invalidates_exactly(built, tmp_path):
+    out, naive = built
+    idx = tmp_path / "mut"
+    shutil.copytree(out, idx)
+    extra = tmp_path / "extra.txt"
+    extra.write_text("cat cat zebra")
+    with serving(str(idx)) as daemon, Client(daemon) as c:
+        before = c.rpc(id=1, op="df", terms=["cat"])
+        assert before["df"] == [len(naive["cat"])]
+        new_df = len(naive["cat"]) + 1  # extra.txt mentions cat
+        assert _strip(c.rpc(id=2, op="df", terms=["cat"])) \
+            == _strip(before)  # warm hit
+        r = c.rpc(id=3, op="append", files=[str(extra)])
+        assert r.get("ok"), r
+        after = c.rpc(id=4, op="df", terms=["cat"])
+        assert after["df"] == [new_df], \
+            "post-append answer served stale cached bytes"
+        st = daemon.stats()["result_cache"]
+        assert st["invalidations"] >= 1
+
+
+@daemonized
+def test_daemon_tenant_wire_validation(built):
+    out, _ = built
+    with serving(out) as daemon, Client(daemon) as c:
+        for bad in ("has space", "x" * 65, 7, ""):
+            r = c.rpc(id=1, op="df", terms=["cat"], tenant=bad)
+            assert r["error"] == "bad_request", (bad, r)
+            assert "tenant" in r["detail"]
+        # absent field rides the default lane untouched
+        assert c.rpc(id=2, op="df", terms=["cat"])["ok"]
+
+
+@daemonized
+def test_daemon_tenant_bucket_sheds_typed(built, monkeypatch):
+    out, _ = built
+    monkeypatch.setenv("MRI_SERVE_TENANT_RATE", "tank=1:1")
+    with serving(out) as daemon, Client(daemon) as c:
+        n = 8
+        for i in range(n):
+            # novel terms: every request is a cache miss, so each one
+            # must pass the admission bucket
+            c.send(id=i, op="df", terms=[f"novel{i}"], tenant="tank")
+        got = [c.recv() for _ in range(n)]
+        ok = [r for r in got if r.get("ok")]
+        shed = [r for r in got if r.get("error") == "overloaded"]
+        assert len(ok) + len(shed) == n
+        assert ok, "burst=1 must admit the first request"
+        assert len(shed) >= n - 3
+        assert all("admission rate" in r["detail"] for r in shed)
+        # an untagged request is untouched by the tank's bucket
+        assert c.rpc(id=99, op="df", terms=["cat"])["ok"]
+        ts = daemon.stats()["tenants"]
+        assert ts["tank"]["shed"] == len(shed)
+        assert ts["tank"]["rate_rps"] == 1.0
+        assert ts["default"]["shed"] == 0
+
+
+@daemonized
+def test_daemon_tenant_stats_one_poll(built, monkeypatch):
+    out, _ = built
+    monkeypatch.setenv("MRI_SERVE_TENANT_WEIGHTS", "alpha=4,*=1")
+    with serving(out) as daemon, Client(daemon) as c:
+        for i, tn in enumerate(("alpha", "alpha", "beta")):
+            assert c.rpc(id=i, op="df", terms=["cat"],
+                         tenant=tn)["ok"]
+        ts = daemon.stats()["tenants"]
+        assert set(ts) >= {"default", "alpha", "beta"}
+        a = ts["alpha"]
+        assert a["weight"] == 4 and ts["beta"]["weight"] == 1
+        assert a["requests"] == 2 and ts["beta"]["requests"] == 1
+        assert a["rate_rps"] is None
+        assert a["queue_depth"] == 0
+        assert isinstance(a["burn_1m"], dict) and a["burn_1m"], \
+            "per-tenant SLO burn must ride the same poll"
+        for entry in ("shed", "deadline_expired", "errors",
+                      "cache_hits", "p95_ms"):
+            assert entry in a
+
+
+@daemonized
+def test_daemon_flightdump_tenant_slice(built):
+    out, _ = built
+    with serving(out) as daemon, Client(daemon) as c:
+        for i, tn in enumerate(("alpha", "beta", "alpha")):
+            assert c.rpc(id=i, op="top_k", terms=["dog"], k=2,
+                         score="bm25", tenant=tn)["ok"]
+        r = c.rpc(id=10, op="flightdump", tenant="alpha")
+        assert r["ok"]
+        flight = r["flight"]
+        assert flight["tenant"] == "alpha"
+        reqs = flight["requests"]
+        assert reqs, "alpha's requests must survive its own slice"
+        assert all(e["trace"]["tenant"] == "alpha" for e in reqs)
+        full = c.rpc(id=11, op="flightdump")["flight"]
+        assert "tenant" not in full
+        assert {e["trace"]["tenant"] for e in full["requests"]} \
+            >= {"alpha", "beta"}
+
+
+# -- mri top tenant rows ------------------------------------------------
+
+
+def test_top_render_tenant_table():
+    sample = {
+        "healthz": {"ready": True, "status": "ok", "reasons": []},
+        "stats": {
+            "queue_depth": 0, "inflight": 0, "connections": 1,
+            "counters": {}, "rolling": {},
+            "tenants": {
+                "paying": {"weight": 8, "rate_rps": None,
+                           "requests": 120, "shed": 0,
+                           "deadline_expired": 0, "errors": 0,
+                           "cache_hits": 40, "queue_depth": 1,
+                           "p95_ms": 4.2,
+                           "burn_1m": {"availability": 0.5,
+                                       "latency": 1.25}},
+                "tank": {"weight": 1, "rate_rps": 6.4,
+                         "requests": 900, "shed": 850,
+                         "deadline_expired": 0, "errors": 0,
+                         "cache_hits": 0, "queue_depth": 3,
+                         "p95_ms": 9.9, "burn_1m": {}},
+            },
+        },
+        "slo": {},
+    }
+    frame = _top_render("d:1", sample)
+    assert "tenant" in frame and "burn 1m" in frame
+    paying = next(ln for ln in frame.splitlines()
+                  if ln.startswith("paying"))
+    assert "120" in paying and "4.2" in paying
+    assert "1.25" in paying, "burn column shows the worst 1m burn"
+    tank = next(ln for ln in frame.splitlines()
+                if ln.startswith("tank"))
+    assert "850" in tank and "6.4" in tank
+    assert "50" in tank, "admitted = requests - shed"
+
+
+def test_cli_serve_bad_gc_freeze_knob_exits_2(built):
+    # regression: the knob was read after daemon.start(), so a bad
+    # value escaped `mri serve` as a traceback instead of the one-line
+    # exit-2 env-knob contract
+    out, _ = built
+    env = dict(os.environ, PYTHONPATH=str(Path(__file__).parent.parent),
+               JAX_PLATFORMS="cpu", MRI_SERVE_GC_FREEZE="nope")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parallel_computation_of_an_inverted_index_using_map_reduce_tpu",
+         "serve", str(out), "--listen", "127.0.0.1:0"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 2
+    assert "MRI_SERVE_GC_FREEZE" in proc.stderr
+    assert proc.stderr.count("\n") == 1
+
+
+def test_top_render_without_tenants_unchanged():
+    sample = {
+        "healthz": {"ready": True, "status": "ok", "reasons": []},
+        "stats": {"queue_depth": 0, "inflight": 0, "connections": 1,
+                  "counters": {}, "rolling": {}},
+        "slo": {},
+    }
+    assert "tenant" not in _top_render("d:1", sample)

@@ -85,6 +85,15 @@ _BASIS = {
             d["storm"]["compliant_p99_x_unloaded"],
             round(d["storm"]["fixed_queue"]["compliant_p99_ms"]
                   / d["storm"]["unloaded"]["compliant_p99_ms"], 1))),
+    "BENCH_QOS_r20.json": lambda d, ln: (
+        "value IS the ratio: cached-hot qps vs the same run's "
+        "uncached engine on one Zipf replay (gate {}x, byte-identical "
+        "answers); paying-tenant p99 {}x alone beside a 2x-capacity "
+        "tank (gate {}x; unfenced contrast {}x)".format(
+            d["cache"]["gate"],
+            d["isolation"]["paying_p99_x_alone"],
+            d["isolation"]["gate"],
+            d["isolation"]["unfenced_p99_x_alone"])),
 }
 
 _JSON_LINE_RE = re.compile(r"^\{.*\}$", re.M)

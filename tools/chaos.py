@@ -62,6 +62,19 @@ single-artifact build of the same documents:
 
     python tools/chaos.py --segments --trials 24 --seed-base 9000
     python tools/chaos.py --segments --repro 9007
+
+``--qos`` soaks the generation-keyed result cache (PR 20): live
+append/delete/compact schedules fuzzed under cached hot queries, at
+D=1 (a daemon with the cache on vs a truth-dict oracle; every hot
+query asked twice so the warm hit must be byte-equal to the engine's
+answer) and D=4 (four shard daemons under a cache-on router AND a
+cache-off router — each other's oracle — with mutations pushed
+straight to a random shard; once the cache-on router's epoch adopts
+the bumped generation vector, both must answer byte-identically).
+One stale cached byte at a settled generation fails the soak:
+
+    python tools/chaos.py --qos --trials 8 --seed-base 11000
+    python tools/chaos.py --qos --repro 11001
 """
 
 from __future__ import annotations
@@ -1859,6 +1872,421 @@ def run_brownout_soak(work_dir: Path, trials: int, seed_base: int,
     }
 
 
+# -- qos / result-cache soak ---------------------------------------------
+#
+# PR 20: generation-keyed result cache + multi-tenant QoS.  The cache
+# has exactly one correctness contract: a HIT must be byte-identical
+# to what the engine would answer at the live generation.  These
+# scenarios fuzz the only window where that can silently break — live
+# append/delete/compact flipping the generation under cached hot
+# queries — at both depths the cache is deployed at:
+#
+# - ``mutate-invalidate`` (D=1): one daemon with the cache on, a truth
+#   dict as the df oracle.  Every hot query is asked twice (the second
+#   ask is the hit once warm) and the pair must be byte-equal; after
+#   every settled mutation the same hot queries must match the truth
+#   dict — a stale cache entry surviving a generation bump shows up as
+#   a pre-mutation df.
+# - ``cluster-epoch-parity`` (D=4): four shard daemons under TWO
+#   routers over the same spec, one with the result cache on and one
+#   with it off — each other's oracle.  Mutations go straight to a
+#   random shard daemon; once the cache-on router's epoch adopts the
+#   new generation vector (the documented MRI_CLUSTER_HEALTH_MS
+#   staleness bound), both routers must answer the hot set
+#   byte-identically.
+
+QOS_SCENARIOS = ("mutate-invalidate", "cluster-epoch-parity")
+
+#: tenant labels sprinkled over qos queries: the cache key excludes
+#: the tenant (answers are tenant-independent), so cross-tenant hits
+#: must be byte-equal too — asking under rotating labels proves it
+_QOS_TENANTS = ("default", "alpha", "beta")
+
+
+def _qos_strip(resp: dict) -> dict:
+    """Drop the per-request stamps two answers can never share."""
+    r = dict(resp)
+    r.pop("trace_id", None)
+    return r
+
+
+def _qos_truth_df(truth: dict, terms) -> list[int]:
+    return [sum(1 for words in truth.values() if t in words)
+            for t in terms]
+
+
+def _qos_hit_parity(c: _ChaosClient, req: dict) -> tuple[dict, str | None]:
+    """Ask the same request twice: the second answer (a cache hit once
+    the entry is warm) must be byte-equal to the first (engine-fed)."""
+    a = c.rpc(**req)
+    b = c.rpc(**req)
+    if _qos_strip(a) != _qos_strip(b):
+        return a, (f"repeat answer diverged for {req}: "
+                   f"{_qos_strip(b)} != {_qos_strip(a)}")
+    return a, None
+
+
+def run_qos_d1_trial(base: Path, base_truth: dict, work_dir: Path,
+                     seed: int, deadline_s: float = 120.0) -> dict:
+    """One seeded D=1 invalidation trial (see QOS_SCENARIOS)."""
+    import shutil
+
+    rng = random.Random(seed)
+    verdict = {"seed": seed, "scenario": "mutate-invalidate",
+               "ok": False, "outcome": "?"}
+    work = work_dir / f"qos-{seed}"
+    idx = work / "idx"
+    work.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(base, idx)
+    truth = {gid: set(words) for gid, words in base_truth.items()}
+    next_gid = max(truth) + 1
+    t0 = time.monotonic()
+    try:
+        # flush-every-delete keeps the truth dict exact: a buffered
+        # delete is (correctly) invisible until its tombstone flush,
+        # which would desync the oracle, not the cache
+        proc, addr = _spawn_daemon(
+            idx, env_extra={"MRI_SEGMENT_TOMBSTONE_FLUSH": "1"})
+    except (RuntimeError, OSError, subprocess.TimeoutExpired) as e:
+        verdict["outcome"] = f"spawn-failed:{e}"
+        return verdict
+    try:
+        c = _ChaosClient(addr, timeout=max(15.0, deadline_s / 2))
+        try:
+            vocab = sorted(set().union(*truth.values()))
+            hot_df = [rng.sample(vocab, min(2, len(vocab)))
+                      for _ in range(6)]
+            hot_ranked = [rng.sample(vocab, min(2, len(vocab)))
+                          for _ in range(4)]
+            err = None
+            mutations = 0
+            for rnd in range(rng.randrange(3, 5)):
+                for qi, terms in enumerate(hot_df):
+                    tn = _QOS_TENANTS[(rnd + qi) % len(_QOS_TENANTS)]
+                    a, err = _qos_hit_parity(c, dict(
+                        id=f"df{rnd}.{qi}", op="df", terms=terms,
+                        tenant=tn))
+                    if err:
+                        break
+                    want = _qos_truth_df(truth, terms)
+                    if not a.get("ok") or a["df"] != want:
+                        err = (f"df {terms} diverged from truth at "
+                               f"round {rnd}: got {a.get('df')} "
+                               f"want {want}")
+                        break
+                if err:
+                    break
+                for qi, terms in enumerate(hot_ranked):
+                    a, err = _qos_hit_parity(c, dict(
+                        id=f"tk{rnd}.{qi}", op="top_k", terms=terms,
+                        k=5, score="bm25",
+                        tenant=rng.choice(_QOS_TENANTS)))
+                    if err:
+                        break
+                    if not a.get("ok"):
+                        err = f"ranked {terms} rejected: {a}"
+                        break
+                if err:
+                    break
+                # one settled mutation between query rounds: the NEXT
+                # round's hot queries were cached under the old
+                # generation and must all re-derive
+                kind = rng.choice(("append", "delete", "compact"))
+                if mutations == 0 or (kind == "delete"
+                                      and len(truth) <= 4):
+                    # a fresh artifact dir only becomes segment-managed
+                    # on its first append; delete/compact before that
+                    # are typed rejections, not invalidation coverage
+                    kind = "append"
+                if kind == "append":
+                    ids = list(range(next_gid,
+                                     next_gid + rng.randrange(2, 4)))
+                    paths, toks = _seg_write_docs(work / "docs", rng,
+                                                  ids)
+                    r = c.rpc(id=f"a{next_gid}", op="append",
+                              files=paths)
+                    if not r.get("ok"):
+                        err = f"append rejected: {r}"
+                        break
+                    for gid, words in zip(ids, toks):
+                        truth[gid] = set(words)
+                    next_gid = ids[-1] + 1
+                elif kind == "delete":
+                    victims = rng.sample(
+                        sorted(truth),
+                        min(rng.randrange(1, 3), len(truth) - 2))
+                    r = c.rpc(id=f"d{victims[0]}", op="delete",
+                              docs=victims)
+                    if not r.get("ok"):
+                        err = f"delete rejected: {r}"
+                        break
+                    for gid in victims:
+                        truth.pop(gid)
+                else:
+                    r = c.rpc(id=f"c{rnd}", op="compact", force=True)
+                    if not r.get("ok"):
+                        err = f"compact rejected: {r}"
+                        break
+                mutations += 1
+            if err is None:
+                st = c.rpc(id="st", op="stats")["stats"]
+                rc = st.get("result_cache", {})
+                if not rc.get("enabled"):
+                    err = "result cache was not enabled"
+                elif rc.get("hits", 0) <= 0:
+                    err = f"no result-cache hits recorded: {rc}"
+                elif mutations and rc.get("invalidations", 0) <= 0:
+                    err = (f"{mutations} mutations but zero cache "
+                           f"invalidations: {rc}")
+                else:
+                    verdict["mutations"] = mutations
+                    verdict["cache"] = {
+                        k: rc.get(k)
+                        for k in ("hits", "misses", "invalidations")}
+        finally:
+            c.close()
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        if not _drain_to_zero(proc, verdict, timeout=max(
+                10.0, deadline_s - (time.monotonic() - t0))):
+            return verdict
+        proc = None
+        verdict["outcome"] = "clean"
+        verdict["ok"] = True
+        return verdict
+    finally:
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        if proc is not None:
+            proc.wait()
+            proc.stdout.close()
+            proc.stderr.close()
+
+
+def _qos_make_cluster(work: Path, shards: int = 4):
+    """Zipf corpus doc-sharded into ``shards`` independent MUTABLE
+    index dirs; returns (cluster_dir, vocab).
+
+    Deliberately NOT `cluster.partition`: its ``cluster_shard.json``
+    sidecar routes the daemon to the read-only ShardEngine, which
+    cannot become segment-managed — and this soak's whole point is
+    live mutation under a router.  Plain per-slice builds accept
+    append/delete/compact like any single daemon; both routers see
+    the same shard answers either way, so the parity oracle is
+    unaffected."""
+    docs = zipf_corpus(num_docs=48, vocab_size=400, tokens_per_doc=60,
+                       seed=31)
+    paths = write_corpus(work / "docs", docs)
+    cluster = work / "cluster"
+    for s in range(shards):
+        write_manifest(work / f"list-{s}.txt", paths[s::shards])
+        build_index(read_manifest(work / f"list-{s}.txt"),
+                    IndexConfig(backend="cpu", num_mappers=1,
+                                num_reducers=1, artifact=True),
+                    output_dir=cluster / f"shard-{s}")
+    vocab = sorted(
+        {clean_token(w) for blob in docs for w in blob.split()}
+        - {""})
+    return cluster, vocab
+
+
+def _qos_router_epoch(addr):
+    """The cache-epoch vector a router currently serves under."""
+    c = _ChaosClient(addr, timeout=10.0)
+    try:
+        st = c.rpc(id="e", op="stats")
+        return ((st.get("stats") or {}).get("cluster")
+                or {}).get("epoch")
+    finally:
+        c.close()
+
+
+def run_qos_d4_trial(cluster_base: Path, vocab, work_dir: Path,
+                     seed: int, deadline_s: float = 120.0) -> dict:
+    """One seeded D=4 epoch-parity trial (see QOS_SCENARIOS)."""
+    import shutil
+
+    rng = random.Random(seed)
+    verdict = {"seed": seed, "scenario": "cluster-epoch-parity",
+               "ok": False, "outcome": "?"}
+    work = work_dir / f"qos-{seed}"
+    cluster = work / "cluster"
+    work.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(cluster_base, cluster)
+    t0 = time.monotonic()
+    daemons, routers = [], []
+    shard_addrs = []
+    try:
+        try:
+            for s in range(4):
+                d, a = _spawn_daemon(cluster / f"shard-{s}")
+                daemons.append(d)
+                shard_addrs.append(a)
+            spec = ",".join(f"{h}:{p}" for h, p in shard_addrs)
+            renv = {"MRI_CLUSTER_HEALTH_MS": "100",
+                    "MRI_CLUSTER_RPC_TIMEOUT_MS": "10000"}
+            for env in (renv,
+                        {**renv, "MRI_SERVE_RESULT_CACHE": "0"}):
+                r, ra = _spawn_router(spec, env_extra=env)
+                routers.append((r, ra))
+        except (RuntimeError, OSError,
+                subprocess.TimeoutExpired) as e:
+            verdict["outcome"] = f"spawn-failed:{e}"
+            return verdict
+        (cached_proc, cached_addr), (plain_proc, plain_addr) = routers
+
+        hot = [rng.sample(vocab, 2) for _ in range(8)]
+        next_gid = 1000
+        err = None
+        ca = _ChaosClient(cached_addr, timeout=max(15.0,
+                                                   deadline_s / 2))
+        cb = _ChaosClient(plain_addr, timeout=max(15.0,
+                                                  deadline_s / 2))
+        try:
+            for rnd in range(rng.randrange(2, 4)):
+                for qi, terms in enumerate(hot):
+                    req = dict(id=f"q{rnd}.{qi}", op="top_k",
+                               terms=terms, k=5, score="bm25",
+                               tenant=rng.choice(_QOS_TENANTS))
+                    a, err = _qos_hit_parity(ca, req)
+                    if err:
+                        break
+                    b = cb.rpc(**req)
+                    if _qos_strip(a) != _qos_strip(b):
+                        err = (f"cache-on router diverged from "
+                               f"cache-off for {terms} at round "
+                               f"{rnd}: {_qos_strip(a)} != "
+                               f"{_qos_strip(b)}")
+                        break
+                if err:
+                    break
+                # mutate a random shard directly; the cache-on
+                # router's epoch must adopt the bumped generation
+                # within the health-probe bound, after which both
+                # routers must agree again
+                before = _qos_router_epoch(cached_addr)
+                ids = list(range(next_gid, next_gid + 2))
+                paths, _toks = _seg_write_docs(work / "docs-new",
+                                               rng, ids)
+                next_gid = ids[-1] + 1
+                victim = rng.randrange(4)
+                dc = _ChaosClient(shard_addrs[victim], timeout=15.0)
+                try:
+                    r = dc.rpc(id=f"m{rnd}", op="append", files=paths)
+                finally:
+                    dc.close()
+                if not r.get("ok"):
+                    err = f"shard {victim} append rejected: {r}"
+                    break
+                adopt_by = time.monotonic() + 5.0
+                while time.monotonic() < adopt_by:
+                    ep = _qos_router_epoch(cached_addr)
+                    if ep is not None and ep != before:
+                        break
+                    time.sleep(0.05)
+                else:
+                    err = (f"router epoch never adopted shard "
+                           f"{victim}'s new generation (stuck at "
+                           f"{before})")
+                    break
+            if err is None:
+                c = _ChaosClient(cached_addr, timeout=10.0)
+                try:
+                    rc = (c.rpc(id="st", op="stats")["stats"]
+                          .get("result_cache", {}))
+                finally:
+                    c.close()
+                if rc.get("hits", 0) <= 0:
+                    err = f"no router result-cache hits: {rc}"
+                elif rc.get("invalidations", 0) <= 0:
+                    err = f"no router cache invalidations: {rc}"
+                else:
+                    verdict["cache"] = {
+                        k: rc.get(k)
+                        for k in ("hits", "misses", "invalidations")}
+        finally:
+            ca.close()
+            cb.close()
+        if err:
+            verdict["outcome"] = "violation"
+            verdict["error"] = err
+            return verdict
+        for proc in (cached_proc, plain_proc):
+            dv = {}
+            if not _drain_to_zero(proc, dv, timeout=max(
+                    10.0, deadline_s - (time.monotonic() - t0))):
+                verdict["outcome"] = "violation"
+                verdict["error"] = f"router drain failed: {dv}"
+                return verdict
+        routers = []
+        verdict["outcome"] = "clean"
+        verdict["ok"] = True
+        return verdict
+    finally:
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 3)
+        for p in [r for r, _ in routers] + daemons:
+            if p is None:
+                continue
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+            p.stdout.close()
+            p.stderr.close()
+
+
+def run_qos_trial(work_dir: Path, seed: int, scenario: str,
+                  deadline_s: float = 120.0, *, d1_base=None,
+                  d4_base=None) -> dict:
+    """Dispatch one seeded qos trial, building bases on demand (the
+    soak passes prebuilt ones)."""
+    if scenario == "mutate-invalidate":
+        if d1_base is None:
+            d1_base = _wal_make_base(work_dir / "qos-d1-base")
+        base, truth = d1_base
+        return run_qos_d1_trial(base, truth, work_dir, seed,
+                                deadline_s=deadline_s)
+    if scenario == "cluster-epoch-parity":
+        if d4_base is None:
+            d4_base = _qos_make_cluster(work_dir / "qos-d4-base")
+        cluster, vocab = d4_base
+        return run_qos_d4_trial(cluster, vocab, work_dir, seed,
+                                deadline_s=deadline_s)
+    raise ValueError(f"unknown scenario {scenario!r}")
+
+
+def run_qos_soak(work_dir: Path, trials: int, seed_base: int,
+                 deadline_s: float = 120.0,
+                 verbose: bool = True) -> dict:
+    """``trials`` seeded qos trials cycled over QOS_SCENARIOS.  One
+    stale or divergent cached byte fails the soak."""
+    work_dir.mkdir(parents=True, exist_ok=True)
+    d1_base = _wal_make_base(work_dir / "qos-d1-base")
+    d4_base = _qos_make_cluster(work_dir / "qos-d4-base")
+    results = []
+    for t in range(trials):
+        scenario = QOS_SCENARIOS[t % len(QOS_SCENARIOS)]
+        v = run_qos_trial(work_dir, seed_base + t, scenario,
+                          deadline_s=deadline_s, d1_base=d1_base,
+                          d4_base=d4_base)
+        results.append(v)
+        if verbose:
+            print(json.dumps(v, sort_keys=True), flush=True)
+        if v["outcome"] == "HANG":
+            break
+    failures = [v for v in results if not v["ok"]]
+    return {
+        "trials": len(results),
+        "clean": sum(v["outcome"] == "clean" for v in results),
+        "by_scenario": {s: sum(v["scenario"] == s and v["ok"]
+                               for v in results)
+                        for s in QOS_SCENARIOS},
+        "failures": failures,
+    }
+
+
 # -- scenario registry ---------------------------------------------------
 #
 # One queryable source of truth for what this harness can throw, so
@@ -1902,6 +2330,12 @@ SCENARIO_REGISTRY = (
      "and daemon-side overload storms with CoDel admission stay typed "
      "and bounded; exactly-once answers, clean drain",
      BROWNOUT_SCENARIOS),
+    ("qos", "--qos",
+     "result-cache invalidation: append/delete/compact fuzzed under "
+     "cached hot queries at D=1 (daemon vs truth oracle, repeat asks "
+     "byte-equal) and D=4 (cache-on router vs cache-off router "
+     "byte-parity once the epoch adopts); stale cached bytes fail",
+     QOS_SCENARIOS),
 )
 
 #: mode name -> soak runner with the uniform (work, trials, seed_base,
@@ -1920,6 +2354,7 @@ MODE_RUNNERS = {
     "wal": lambda w, t, s, d: run_wal_soak(w, t, s, deadline_s=d),
     "brownout": lambda w, t, s, d: run_brownout_soak(w, t, s,
                                                      deadline_s=d),
+    "qos": lambda w, t, s, d: run_qos_soak(w, t, s, deadline_s=d),
 }
 
 
@@ -1986,6 +2421,13 @@ def main(argv=None) -> int:
                          "bounded under retry budgets + CoDel "
                          "(scenarios: "
                          + ", ".join(BROWNOUT_SCENARIOS) + ")")
+    ap.add_argument("--qos", action="store_true",
+                    help="soak the result cache's generation keying: "
+                         "live append/delete/compact fuzzed under "
+                         "cached hot queries at D=1 and D=4, byte-"
+                         "identity vs an uncached oracle at every "
+                         "settled generation (scenarios: "
+                         + ", ".join(QOS_SCENARIOS) + ")")
     ap.add_argument("--all", action="store_true",
                     help="run EVERY soak mode in the scenario registry "
                          "back to back; exit 0 only if all are clean")
@@ -2024,6 +2466,19 @@ def main(argv=None) -> int:
         print(json.dumps({"modes": agg,
                           "ok": not any_failed}, sort_keys=True))
         return 1 if any_failed else 0
+    if args.qos:
+        if args.repro is not None:
+            t = args.repro - args.seed_base
+            scenario = QOS_SCENARIOS[t % len(QOS_SCENARIOS)]
+            work.mkdir(parents=True, exist_ok=True)
+            v = run_qos_trial(work, args.repro, scenario,
+                              deadline_s=args.deadline)
+            print(json.dumps(v, sort_keys=True))
+            return 0 if v["ok"] else 1
+        summary = run_qos_soak(work, args.trials, args.seed_base,
+                               deadline_s=args.deadline)
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if not summary["failures"] else 1
     if args.brownout:
         if args.repro is not None:
             t = args.repro - args.seed_base

@@ -1,0 +1,405 @@
+#!/usr/bin/env python
+"""Zipf-tenant diurnal-burst trace generator + open-loop replayer.
+
+The QoS and result-cache work (r20) is priced against *traffic that
+looks like production*, not uniform random queries: real serving load
+is (a) Zipf over a small hot query set — which is what makes a
+generation-keyed result cache worth building — and (b) multi-tenant
+with diurnal swell and tenant-local bursts — which is what makes
+weighted-fair dequeue + per-tenant token buckets worth building.  This
+module is the one place that workload shape is defined, so the bench
+(`bench_serve --qos-ab`), the chaos soak (`chaos --qos`) and ad-hoc
+replays all speak the same trace.
+
+Model
+-----
+A trace is a seeded list of timestamped requests.  Each tenant draws a
+non-homogeneous Poisson process whose rate is::
+
+    rate(t) = share * rps * (1 + amp * sin(2*pi*t/duration - pi/2))
+              * (burst_x   if burst_from <= t/duration < burst_to)
+
+i.e. a diurnal cycle compressed into the trace (trough at the start,
+peak mid-trace) with an optional burst window — the "tank tenant
+floors it at 14:00" shape.  Every tenant's query mix is Zipf over its
+own ``unique`` templates (a rotation of the shared term list keeps
+tenants' hot sets distinct), so repeats are frequent and the result
+cache has something honest to hit.
+
+The replayer opens ONE connection per tenant (tenants are distinct
+clients in production) and offers each tenant's requests open-loop at
+their scheduled arrivals; latency is measured from the *scheduled*
+arrival, so client-side queueing under overload is latency too.  A
+``pipelined`` mode ignores arrivals and drives each connection windowed
+flat-out — the capacity view the cache A/B gates on.
+
+CLI::
+
+    python tools/trace_replay.py --addr 127.0.0.1:7070 \
+        --terms-file vocab.txt --duration 5 --rps 400 \
+        --tenant paying:0.8 --tenant tank:0.2:0.4-0.7@8 --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Tenant:
+    """One tenant's slice of the trace (see module docstring)."""
+
+    name: str
+    share: float = 1.0        # fraction of the base offered rate
+    zipf_s: float = 1.3       # skew of this tenant's query mix
+    unique: int = 256         # distinct query templates in the mix
+    width: int = 2            # terms per query
+    burst_from: float | None = None   # burst window, as trace fraction
+    burst_to: float | None = None
+    burst_x: float = 1.0      # rate multiplier inside the window
+
+
+def _rate_x(frac: float, amp: float, ten: Tenant) -> float:
+    """Diurnal+burst multiplier at trace fraction ``frac`` in [0,1)."""
+    x = 1.0 + amp * np.sin(2.0 * np.pi * frac - np.pi / 2.0)
+    if (ten.burst_from is not None
+            and ten.burst_from <= frac < (ten.burst_to or 1.0)):
+        x *= ten.burst_x
+    return x
+
+
+def generate_trace(terms: list[str], tenants: list[Tenant], *,
+                   duration_s: float, rps: float, seed: int,
+                   op: str = "top_k", k: int = 10,
+                   score: str = "bm25", diurnal_amp: float = 0.5,
+                   deadline_ms: float | None = None) -> list[dict]:
+    """Seeded trace: arrival-sorted events, each
+    ``{"t", "tenant", "lid", "line"}`` where ``lid`` is the request id
+    on that tenant's connection and ``line`` the encoded wire bytes."""
+    m = len(terms)
+    events: list[tuple[float, int]] = []
+    for ti, ten in enumerate(tenants):
+        rng = np.random.default_rng((seed, 7919 * ti))
+        peak = (ten.share * rps * (1.0 + diurnal_amp)
+                * max(1.0, ten.burst_x))
+        if peak <= 0:
+            continue
+        # thinning: homogeneous arrivals at the peak rate, each kept
+        # with probability rate(t)/peak — exact for any rate shape
+        t = float(rng.exponential(1.0 / peak))
+        while t < duration_s:
+            if (rng.random() * peak
+                    <= ten.share * rps
+                    * _rate_x(t / duration_s, diurnal_amp, ten)):
+                events.append((t, ti))
+            t += float(rng.exponential(1.0 / peak))
+    events.sort()
+
+    trace: list[dict] = []
+    # lids are per NAME, not per spec entry: two Tenant entries may
+    # share a name (the "no labels" contrast folds every workload onto
+    # one connection) and ids must stay unique per connection
+    lids: dict[str, int] = {}
+    qrng = [np.random.default_rng((seed, 104729 * i))
+            for i in range(len(tenants))]
+    extra = {} if deadline_ms is None else {"deadline_ms": deadline_ms}
+    for t, ti in events:
+        ten = tenants[ti]
+        # template index: Zipf rank folded into the tenant's mix; the
+        # per-tenant rotation (101*ti) keeps hot sets disjoint
+        tpl = int(min(qrng[ti].zipf(ten.zipf_s), ten.unique)) - 1
+        q = [terms[(tpl * 7 + 3 * j + 101 * ti + 1) % m]
+             for j in range(ten.width)]
+        lid = lids.get(ten.name, 0)
+        lids[ten.name] = lid + 1
+        req = {"id": lid, "op": op, "terms": q,
+               "tenant": ten.name, **extra}
+        if op == "top_k":
+            req["k"] = k
+            req["score"] = score
+        trace.append({"t": t, "tenant": ten.name, "lid": lid,
+                      "line": json.dumps(req).encode() + b"\n"})
+    return trace
+
+
+class _Reader:
+    """Drains one connection's responses on a thread; per-lid arrival
+    times, ok verdicts, error-kind tallies, optional payload capture."""
+
+    def __init__(self, sock, n: int, window, collect: bool):
+        self.f = sock.makefile("rb")
+        self.done_at = np.full(n, np.nan)
+        self.ok_mask = np.zeros(n, dtype=bool)
+        self.kinds: dict[str, int] = {}
+        self.payloads: list[dict | None] = [None] * n if collect else []
+        self.error: str | None = None
+        self._n = n
+        self._window = window
+        self._collect = collect
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for _ in range(self._n):
+                line = self.f.readline()
+                if not line:
+                    self.error = "connection closed early"
+                    return
+                r = json.loads(line)
+                lid = r["id"]
+                self.done_at[lid] = time.perf_counter()
+                if r.get("ok"):
+                    self.ok_mask[lid] = True
+                else:
+                    kind = r.get("error", "?")
+                    self.kinds[kind] = self.kinds.get(kind, 0) + 1
+                if self._collect:
+                    self.payloads[lid] = r
+                self._window.release()
+        except (OSError, ValueError) as e:
+            self.error = str(e)
+        finally:
+            for _ in range(self._n):   # unblock a waiting sender
+                self._window.release()
+
+    def join(self, timeout=300):
+        self.thread.join(timeout)
+        if self.thread.is_alive():
+            self.error = self.error or "reader wedged"
+
+    def close(self):
+        try:
+            self.f.close()
+        except OSError:
+            pass
+
+
+def _tenant_leg(addr, lines: list[bytes], arrivals, t0_box, start_evt,
+                window_n: int, collect: bool, out: dict):
+    """One tenant's open-loop (or pipelined, arrivals=None) sender +
+    reader over its own connection.  Runs on its own thread so one
+    saturated tenant can never delay another tenant's *offered* load —
+    isolation must be measured server-side, not granted client-side."""
+    n = len(lines)
+    try:
+        sock = socket.create_connection(addr, timeout=60)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError as e:
+        out["error"] = f"connect failed: {e}"
+        return
+    window = threading.Semaphore(window_n)
+    reader = _Reader(sock, n, window, collect)
+    try:
+        start_evt.wait()
+        t0 = t0_box["t0"]
+        if arrivals is None:
+            chunk = min(64, window_n)
+            for i in range(0, n, chunk):
+                batch = lines[i:i + chunk]
+                for _ in batch:
+                    window.acquire()
+                sock.sendall(b"".join(batch))
+        else:
+            i = 0
+            while i < n:
+                now = time.perf_counter() - t0
+                j = i
+                while j < n and arrivals[j] <= now:
+                    j += 1
+                # cap a burst below the window so the sender can never
+                # hold every permit with nothing in flight to free one
+                j = min(j, i + max(1, window_n // 2))
+                if j > i:
+                    for _ in range(j - i):
+                        window.acquire()
+                    sock.sendall(b"".join(lines[i:j]))
+                    i = j
+                else:
+                    time.sleep(min(arrivals[i] - now, 0.001))
+        reader.join()
+        wall = time.perf_counter() - t0
+        out["wall_s"] = round(wall, 3)
+        out["requests"] = n
+        out["ok"] = int(reader.ok_mask.sum())
+        out["kinds"] = dict(reader.kinds)
+        out["error"] = reader.error
+        if collect:
+            out["payloads"] = reader.payloads
+        base = t0 + (arrivals if arrivals is not None else 0.0)
+        lat = reader.done_at - base
+        ok_lat = lat[reader.ok_mask & ~np.isnan(lat)]
+        if len(ok_lat):
+            out["compliant_p50_ms"] = round(
+                float(np.percentile(ok_lat, 50)) * 1e3, 3)
+            out["compliant_p99_ms"] = round(
+                float(np.percentile(ok_lat, 99)) * 1e3, 3)
+            out["compliant_max_ms"] = round(
+                float(ok_lat.max()) * 1e3, 3)
+    except OSError as e:
+        out["error"] = f"sender failed: {e}"
+    finally:
+        sock.close()
+        reader.close()
+
+
+def replay(addr, trace: list[dict], *, pipelined: bool = False,
+           window: int = 64, collect: bool = False) -> dict:
+    """Replay a generated trace; returns per-tenant stats plus totals.
+
+    ``pipelined=True`` ignores arrival times and drives every tenant's
+    connection windowed flat-out (the capacity view); otherwise each
+    tenant offers its requests open-loop at their scheduled arrivals
+    and latency runs from the scheduled arrival.  ``collect=True``
+    additionally returns every parsed response per tenant, in lid
+    order — the byte-parity hook."""
+    by_tenant: dict[str, list[dict]] = {}
+    for ev in trace:
+        by_tenant.setdefault(ev["tenant"], []).append(ev)
+    start_evt = threading.Event()
+    t0_box: dict = {}
+    threads, outs = [], {}
+    for name, evs in by_tenant.items():
+        lines = [ev["line"] for ev in evs]
+        arrivals = None if pipelined \
+            else np.array([ev["t"] for ev in evs])
+        outs[name] = {}
+        th = threading.Thread(
+            target=_tenant_leg,
+            args=(addr, lines, arrivals, t0_box, start_evt, window,
+                  collect, outs[name]),
+            daemon=True)
+        th.start()
+        threads.append(th)
+    t0_box["t0"] = time.perf_counter()
+    start_evt.set()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0_box["t0"]
+    total = sum(o.get("requests", 0) for o in outs.values())
+    ok = sum(o.get("ok", 0) for o in outs.values())
+    errors = [f"{n}: {o['error']}" for n, o in outs.items()
+              if o.get("error")]
+    return {
+        "pipelined": pipelined,
+        "requests": total,
+        "ok": ok,
+        "wall_s": round(wall, 3),
+        "qps": round(total / wall, 1) if wall > 0 else 0.0,
+        "tenants": outs,
+        "errors": errors,
+    }
+
+
+def strip_volatile(resp: dict | None) -> dict | None:
+    """Drop the per-request stamps two daemons can never agree on;
+    everything left must be byte-comparable across cache on/off."""
+    if resp is None:
+        return None
+    r = dict(resp)
+    r.pop("trace_id", None)
+    return r
+
+
+def parse_tenant(spec: str) -> Tenant:
+    """``name[:share[:from-to@x]]`` -> Tenant."""
+    parts = spec.split(":")
+    name = parts[0]
+    share = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
+    burst_from = burst_to = None
+    burst_x = 1.0
+    if len(parts) > 2 and parts[2]:
+        wdw, _, mult = parts[2].partition("@")
+        lo, _, hi = wdw.partition("-")
+        burst_from, burst_to = float(lo), float(hi)
+        burst_x = float(mult) if mult else 1.0
+    return Tenant(name=name, share=share, burst_from=burst_from,
+                  burst_to=burst_to, burst_x=burst_x)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_replay",
+        description="generate a seeded Zipf-tenant diurnal-burst "
+                    "trace and replay it open-loop against a live "
+                    "daemon or router")
+    p.add_argument("--addr", required=True, metavar="HOST:PORT")
+    p.add_argument("--terms-file", required=True,
+                   help="newline-separated query vocabulary")
+    p.add_argument("--tenant", action="append", default=[],
+                   metavar="NAME[:SHARE[:FROM-TO@X]]",
+                   help="tenant spec (repeatable; default one "
+                        "'default' tenant at share 1.0); FROM-TO@X is "
+                        "a burst window as trace fractions with an X "
+                        "rate multiplier")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--rps", type=float, default=200.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--op", default="top_k",
+                   choices=("top_k", "df", "and", "or", "postings"))
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--score", default="bm25")
+    p.add_argument("--diurnal-amp", type=float, default=0.5)
+    p.add_argument("--zipf-s", type=float, default=None,
+                   help="query-template Zipf skew for every tenant in "
+                        "this invocation")
+    p.add_argument("--unique", type=int, default=None,
+                   help="distinct query templates per tenant")
+    p.add_argument("--width", type=int, default=None,
+                   help="terms per query")
+    p.add_argument("--deadline-ms", type=float, default=None)
+    p.add_argument("--pipelined", action="store_true",
+                   help="ignore arrivals; windowed flat-out capacity "
+                        "replay")
+    p.add_argument("--window", type=int, default=64,
+                   help="per-tenant in-flight cap (open-loop sends "
+                        "stall past it: TCP-like backpressure)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full per-tenant result dict as one "
+                        "JSON line")
+    args = p.parse_args(argv)
+
+    host, _, port = args.addr.rpartition(":")
+    terms = [t for t in
+             Path(args.terms_file).read_text().split() if t]
+    if not terms:
+        p.error(f"no terms in {args.terms_file}")
+    tenants = [parse_tenant(s) for s in args.tenant] \
+        or [Tenant(name="default")]
+    shape = {k: v for k, v in (("zipf_s", args.zipf_s),
+                               ("unique", args.unique),
+                               ("width", args.width)) if v is not None}
+    if shape:
+        tenants = [Tenant(**{**t.__dict__, **shape}) for t in tenants]
+    trace = generate_trace(terms, tenants, duration_s=args.duration,
+                           rps=args.rps, seed=args.seed, op=args.op,
+                           k=args.k, score=args.score,
+                           diurnal_amp=args.diurnal_amp,
+                           deadline_ms=args.deadline_ms)
+    res = replay((host, int(port)), trace, pipelined=args.pipelined,
+                 window=args.window)
+    if args.json:
+        print(json.dumps(res, sort_keys=True))
+    else:
+        print(f"replayed {res['requests']} requests "
+              f"({res['ok']} ok) in {res['wall_s']}s "
+              f"= {res['qps']} qps")
+        for name, o in sorted(res["tenants"].items()):
+            print(f"  {name}: {o.get('requests', 0)} req, "
+                  f"{o.get('ok', 0)} ok, kinds={o.get('kinds', {})}, "
+                  f"p99={o.get('compliant_p99_ms', '—')}ms")
+    return 1 if res["errors"] or res["ok"] == 0 else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
